@@ -1,0 +1,86 @@
+"""raft_tpu.core — resource/handle system and data-layer vocabulary.
+
+(ref: cpp/include/raft/core — see SURVEY.md §2.1.)
+"""
+
+from raft_tpu.core.error import (
+    RaftException,
+    LogicError,
+    DeviceError,
+    OutOfMemoryError,
+    expects,
+    fail,
+)
+from raft_tpu.core.resources import (
+    Resources,
+    DeviceResources,
+    Handle,
+    KeyStream,
+    CompileCache,
+    WorkspaceResource,
+    device_resources,
+    ensure_resources,
+)
+from raft_tpu.core.resource_types import ResourceType
+from raft_tpu.core.mdarray import (
+    MemoryType,
+    Layout,
+    MdSpan,
+    MdArray,
+    MdBuffer,
+    wrap,
+    copy,
+    make_device_mdarray,
+    make_device_matrix,
+    make_device_vector,
+    make_device_scalar,
+    make_host_mdarray,
+    make_host_matrix,
+    make_host_vector,
+    is_row_major,
+    is_col_major,
+)
+from raft_tpu.core.sparse_types import (
+    COOStructure,
+    COOMatrix,
+    CSRStructure,
+    CSRMatrix,
+)
+from raft_tpu.core.bitset import Bitset, BitsetView, BitmapView
+from raft_tpu.core.kvp import KeyValuePair
+from raft_tpu.core import operators
+from raft_tpu.core import nvtx
+from raft_tpu.core import interruptible
+from raft_tpu.core.serialize import (
+    serialize_mdspan,
+    deserialize_mdspan,
+    serialize_scalar,
+    deserialize_scalar,
+    mdspan_to_bytes,
+    mdspan_from_bytes,
+)
+from raft_tpu.core.memory import (
+    MemoryTracker,
+    StatisticsAdaptor,
+    NotifyingAdaptor,
+    ResourceMonitor,
+    device_memory_stats,
+)
+
+__all__ = [
+    "RaftException", "LogicError", "DeviceError", "OutOfMemoryError",
+    "expects", "fail",
+    "Resources", "DeviceResources", "Handle", "KeyStream", "CompileCache",
+    "WorkspaceResource", "device_resources", "ensure_resources", "ResourceType",
+    "MemoryType", "Layout", "MdSpan", "MdArray", "MdBuffer", "wrap", "copy",
+    "make_device_mdarray", "make_device_matrix", "make_device_vector",
+    "make_device_scalar", "make_host_mdarray", "make_host_matrix",
+    "make_host_vector", "is_row_major", "is_col_major",
+    "COOStructure", "COOMatrix", "CSRStructure", "CSRMatrix",
+    "Bitset", "BitsetView", "BitmapView", "KeyValuePair",
+    "operators", "nvtx", "interruptible",
+    "serialize_mdspan", "deserialize_mdspan", "serialize_scalar",
+    "deserialize_scalar", "mdspan_to_bytes", "mdspan_from_bytes",
+    "MemoryTracker", "StatisticsAdaptor", "NotifyingAdaptor",
+    "ResourceMonitor", "device_memory_stats",
+]

@@ -1,0 +1,148 @@
+"""Device bitset / bitmap.
+
+(ref: cpp/include/raft/core/bitset.hpp:33 ``bitset_view``, :279 ``bitset``;
+core/bitmap.hpp:34 ``bitmap_view``; util/popc.cuh.)
+
+TPU-first design: the bitset is a ``uint32`` word array manipulated with
+vectorized bit ops — test/set become gather + mask ops, ``popc`` is
+``lax.population_count`` + sum, flip is bitwise-not. All methods are
+functional (return new arrays) so they compose under ``jit``; the owning
+:class:`Bitset` class carries the current words array for handle-style use.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+
+_WORD_BITS = 32
+
+
+def _n_words(n_bits: int) -> int:
+    return (n_bits + _WORD_BITS - 1) // _WORD_BITS
+
+
+class BitsetView:
+    """Non-owning view over a words array. (ref: core/bitset.hpp:33)"""
+
+    def __init__(self, words: jax.Array, n_bits: int):
+        self.words = words
+        self.n_bits = int(n_bits)
+
+    def test(self, indices) -> jax.Array:
+        """Gather bit values at ``indices`` → bool array.
+        (ref: bitset.hpp ``bitset_view::test``)"""
+        indices = jnp.asarray(indices)
+        word = self.words[indices // _WORD_BITS]
+        bit = (word >> (indices % _WORD_BITS).astype(jnp.uint32)) & jnp.uint32(1)
+        return bit.astype(jnp.bool_)
+
+    def to_dense(self) -> jax.Array:
+        """All bits as a bool vector of length n_bits."""
+        idx = jnp.arange(self.n_bits)
+        return self.test(idx)
+
+    def count(self) -> jax.Array:
+        """Number of set bits. (ref: util/popc.cuh + bitset::count)"""
+        mask = _tail_mask(self.n_bits, self.words.shape[0])
+        return jnp.sum(jax.lax.population_count(self.words & mask)).astype(jnp.int32)
+
+    def sparsity(self) -> jax.Array:
+        return 1.0 - self.count() / jnp.float32(max(1, self.n_bits))
+
+
+def _tail_mask(n_bits: int, n_words: int) -> jax.Array:
+    """Mask clearing padding bits in the last word."""
+    bits_in_last = n_bits - (n_words - 1) * _WORD_BITS
+    full = jnp.full((n_words,), 0xFFFFFFFF, dtype=jnp.uint32)
+    if bits_in_last == _WORD_BITS:
+        return full
+    last = jnp.uint32((1 << bits_in_last) - 1)
+    return full.at[-1].set(last)
+
+
+class Bitset(BitsetView):
+    """Owning bitset. (ref: core/bitset.hpp:279)"""
+
+    def __init__(self, n_bits: int, default_value: bool = True,
+                 words: Optional[jax.Array] = None):
+        if words is None:
+            fill = jnp.uint32(0xFFFFFFFF) if default_value else jnp.uint32(0)
+            words = jnp.full((_n_words(n_bits),), fill, dtype=jnp.uint32)
+        super().__init__(words, n_bits)
+
+    @classmethod
+    def from_dense(cls, bits) -> "Bitset":
+        bits = jnp.asarray(bits, dtype=jnp.bool_)
+        n = bits.shape[0]
+        pad = _n_words(n) * _WORD_BITS - n
+        padded = jnp.concatenate([bits, jnp.zeros((pad,), jnp.bool_)]) if pad else bits
+        chunks = padded.reshape(-1, _WORD_BITS).astype(jnp.uint32)
+        weights = (jnp.uint32(1) << jnp.arange(_WORD_BITS, dtype=jnp.uint32))[None, :]
+        words = jnp.sum(chunks * weights, axis=1, dtype=jnp.uint32)
+        return cls(n, words=words)
+
+    def set(self, indices, value: bool = True) -> "Bitset":
+        """Set/clear bits at indices (functional: returns new Bitset).
+        (ref: bitset.hpp ``bitset::set`` kernel)"""
+        indices = jnp.asarray(indices)
+        word_idx = indices // _WORD_BITS
+        bit = jnp.uint32(1) << (indices % _WORD_BITS).astype(jnp.uint32)
+        upd = _scatter_or(self.words.shape[0], word_idx, bit)
+        words = self.words | upd if value else self.words & ~upd
+        return Bitset(self.n_bits, words=words)
+
+    def flip(self) -> "Bitset":
+        mask = _tail_mask(self.n_bits, self.words.shape[0])
+        return Bitset(self.n_bits, words=(~self.words) & mask)
+
+    def reset(self, default_value: bool = True) -> "Bitset":
+        return Bitset(self.n_bits, default_value)
+
+
+def _scatter_or(n_words: int, word_idx: jax.Array, bits: jax.Array) -> jax.Array:
+    """OR-scatter single-bit masks into a zeroed words array. Duplicate
+    indices must OR together; integer scatter-add would carry across bit
+    positions, so reduce each of the 32 bit-planes with a segment max
+    (OR == max for 0/1 planes)."""
+    out = jnp.zeros((n_words,), jnp.uint32)
+
+    def body(b, acc):
+        plane = ((bits >> jnp.uint32(b)) & jnp.uint32(1)).astype(jnp.uint32)
+        has = jax.ops.segment_max(plane, word_idx, num_segments=n_words)
+        return acc | (has.astype(jnp.uint32) << jnp.uint32(b))
+
+    return jax.lax.fori_loop(0, _WORD_BITS, body, out)
+
+
+class BitmapView:
+    """2-D bitmap view over a bitset words array, rows×cols bit matrix.
+    (ref: core/bitmap.hpp:34)"""
+
+    def __init__(self, words: jax.Array, n_rows: int, n_cols: int):
+        self.words = words
+        self.n_rows = int(n_rows)
+        self.n_cols = int(n_cols)
+        self._bitset = BitsetView(words, self.n_rows * self.n_cols)
+
+    def test(self, rows, cols) -> jax.Array:
+        rows = jnp.asarray(rows)
+        cols = jnp.asarray(cols)
+        return self._bitset.test(rows * self.n_cols + cols)
+
+    def to_dense(self) -> jax.Array:
+        return self._bitset.to_dense().reshape(self.n_rows, self.n_cols)
+
+    def count(self) -> jax.Array:
+        return self._bitset.count()
+
+    @classmethod
+    def from_dense(cls, mat) -> "BitmapView":
+        mat = jnp.asarray(mat, dtype=jnp.bool_)
+        bs = Bitset.from_dense(mat.reshape(-1))
+        return cls(bs.words, mat.shape[0], mat.shape[1])

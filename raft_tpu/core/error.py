@@ -1,0 +1,43 @@
+"""Error system.
+
+TPU-native equivalent of the reference's exception hierarchy and check macros
+(ref: cpp/include/raft/core/error.hpp — ``raft::exception`` with backtrace,
+``RAFT_EXPECTS`` / ``RAFT_FAIL``, and the per-vendor-library error macros).
+On TPU there are no cublas/cusolver/cusparse/nccl handles; what remains is a
+single device-error type for XLA-side failures plus the logic/runtime pair.
+Python already attaches tracebacks to exceptions, so no manual backtrace
+capture is needed.
+"""
+
+from __future__ import annotations
+
+
+class RaftException(Exception):
+    """Base exception. (ref: core/error.hpp ``raft::exception``)"""
+
+
+class LogicError(RaftException):
+    """Invalid API usage / failed precondition.
+    (ref: core/error.hpp ``raft::logic_error``)"""
+
+
+class DeviceError(RaftException):
+    """Accelerator-side failure (XLA compile/runtime error surfaced to the
+    host). (ref: core/error.hpp ``raft::cuda_error``)"""
+
+
+class OutOfMemoryError(DeviceError):
+    """HBM exhaustion. (ref: rmm::bad_alloc path)"""
+
+
+def expects(condition: bool, fmt: str, *args) -> None:
+    """Check a precondition; raise :class:`LogicError` on failure.
+    (ref: core/error.hpp ``RAFT_EXPECTS``)"""
+    if not condition:
+        raise LogicError(fmt % args if args else fmt)
+
+
+def fail(fmt: str, *args) -> None:
+    """Unconditionally raise :class:`LogicError`.
+    (ref: core/error.hpp ``RAFT_FAIL``)"""
+    raise LogicError(fmt % args if args else fmt)

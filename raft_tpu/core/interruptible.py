@@ -1,0 +1,92 @@
+"""Cooperative cancellation of device synchronization points.
+
+TPU-native equivalent of the reference's ``raft::interruptible`` (ref:
+cpp/include/raft/core/interruptible.hpp:64-105 — a per-thread token registry;
+``interruptible::synchronize(stream)`` spins on the stream while polling the
+token; ``cancel(thread)`` flips the token and the spinning thread raises).
+
+JAX has no stream handle to spin on; dispatch is async and completion is
+observed with ``block_until_ready``. The same vocabulary is preserved:
+
+- :func:`synchronize` — block on arrays becoming ready while polling this
+  thread's cancellation token (uses ``jax.Array.is_ready`` so the wait can be
+  interrupted between polls).
+- :func:`yield_no_throw` / :func:`yield_` — explicit cancellation points for
+  host-orchestrated solver loops (Lanczos etc.), which is where cancellation
+  is actually actionable on TPU.
+- :func:`cancel` — flip another thread's token.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+import jax
+
+from raft_tpu.core.error import RaftException
+
+
+class InterruptedException(RaftException):
+    """Raised at a cancellation point after ``cancel()``.
+    (ref: core/interruptible.hpp ``raft::interrupted_exception``)"""
+
+
+class _Token:
+    __slots__ = ("cancelled",)
+
+    def __init__(self):
+        self.cancelled = False
+
+
+_registry: Dict[int, _Token] = {}
+_registry_lock = threading.Lock()
+
+
+def get_token(thread_id: int | None = None) -> _Token:
+    """Token for a thread (default: calling thread), creating it on first use.
+    (ref: interruptible.hpp ``get_token``)"""
+    tid = thread_id if thread_id is not None else threading.get_ident()
+    with _registry_lock:
+        tok = _registry.get(tid)
+        if tok is None:
+            tok = _Token()
+            _registry[tid] = tok
+        return tok
+
+
+def cancel(thread_id: int | None = None) -> None:
+    """Request cancellation of a thread's next interruptible wait.
+    (ref: interruptible.hpp ``cancel``)"""
+    get_token(thread_id).cancelled = True
+
+
+def yield_no_throw() -> bool:
+    """Check-and-clear this thread's token; returns True if cancelled."""
+    tok = get_token()
+    if tok.cancelled:
+        tok.cancelled = False
+        return True
+    return False
+
+
+def yield_() -> None:
+    """Cancellation point: raises :class:`InterruptedException` if cancelled.
+    (ref: interruptible.hpp ``yield``)"""
+    if yield_no_throw():
+        raise InterruptedException("interruptible: cancelled")
+
+
+def synchronize(*arrays, poll_interval_s: float = 0.001):
+    """Block until all ``arrays`` are ready, polling the cancellation token.
+    (ref: interruptible.hpp ``synchronize(stream)``; the stream becomes the
+    set of in-flight arrays)."""
+    pending = [a for a in jax.tree_util.tree_leaves(arrays) if hasattr(a, "is_ready")]
+    while pending:
+        yield_()
+        pending = [a for a in pending if not a.is_ready()]
+        if pending:
+            time.sleep(poll_interval_s)
+    yield_()
+    return arrays[0] if len(arrays) == 1 else arrays

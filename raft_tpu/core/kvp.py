@@ -1,0 +1,15 @@
+"""Key-value pair for argmin/argmax-style reductions.
+
+(ref: cpp/include/raft/core/kvp.hpp ``raft::KeyValuePair``). As a NamedTuple
+it is a JAX pytree, so it flows through ``jit`` / ``lax.reduce`` / ``vmap``
+unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+
+class KeyValuePair(NamedTuple):
+    key: Any
+    value: Any

@@ -1,0 +1,70 @@
+"""Logging.
+
+TPU-native counterpart of the reference logger (ref:
+cpp/include/raft/core/logger.hpp:25-67 — wraps rapids_logger, default sink
+stderr or a file named by env var ``RAFT_DEBUG_LOG_FILE``, ``RAFT_LOG_*``
+macros gated by ``RAFT_LOG_ACTIVE_LEVEL``). Here it is a thin configuration
+of :mod:`logging` with the same env-var contract:
+
+- ``RAFT_DEBUG_LOG_FILE`` — if set, log to that file instead of stderr.
+- ``RAFT_TPU_LOG_LEVEL``  — initial level name (default ``INFO``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LOGGER_NAME = "raft_tpu"
+
+
+def default_logger() -> logging.Logger:
+    """The process-wide raft_tpu logger, lazily configured.
+    (ref: core/logger.hpp ``default_logger()``)"""
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        log_file = os.environ.get("RAFT_DEBUG_LOG_FILE")
+        handler: logging.Handler
+        if log_file:
+            handler = logging.FileHandler(log_file)
+        else:
+            handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("[%(levelname)s] [%(asctime)s] %(message)s")
+        )
+        logger.addHandler(handler)
+        level = os.environ.get("RAFT_TPU_LOG_LEVEL", "INFO").upper()
+        logger.setLevel(getattr(logging, level, logging.INFO))
+    return logger
+
+
+def set_level(level: int | str) -> None:
+    if isinstance(level, str):
+        level = getattr(logging, level.upper())
+    default_logger().setLevel(level)
+
+
+# RAFT_LOG_* macro equivalents (ref: core/logger.hpp:58+).
+def log_trace(fmt: str, *args) -> None:
+    default_logger().log(5, fmt, *args)
+
+
+def log_debug(fmt: str, *args) -> None:
+    default_logger().debug(fmt, *args)
+
+
+def log_info(fmt: str, *args) -> None:
+    default_logger().info(fmt, *args)
+
+
+def log_warn(fmt: str, *args) -> None:
+    default_logger().warning(fmt, *args)
+
+
+def log_error(fmt: str, *args) -> None:
+    default_logger().error(fmt, *args)
+
+
+def log_critical(fmt: str, *args) -> None:
+    default_logger().critical(fmt, *args)

@@ -1,0 +1,30 @@
+"""Host/device-safe scalar math.
+
+(ref: cpp/include/raft/core/math.hpp — ``raft::min/max/log/sqrt/...`` that
+work on both host and device). In JAX the same ``jnp`` functions trace on
+device and evaluate eagerly on host, so these are thin aliases kept for API
+parity; they also accept python scalars.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+abs = jnp.abs  # noqa: A001
+exp = jnp.exp
+log = jnp.log
+log2 = jnp.log2
+sqrt = jnp.sqrt
+sin = jnp.sin
+cos = jnp.cos
+tanh = jnp.tanh
+pow = jnp.power  # noqa: A001
+min = jnp.minimum  # noqa: A001
+max = jnp.maximum  # noqa: A001
+atanh = jnp.arctanh
+asin = jnp.arcsin
+acos = jnp.arccos
+
+
+def sgn(x):
+    return jnp.sign(x)

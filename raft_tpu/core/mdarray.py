@@ -1,0 +1,263 @@
+"""mdspan/mdarray/mdbuffer — the data-layer vocabulary over ``jax.Array``.
+
+(ref: cpp/include/raft/core/mdspan.hpp:26, core/mdarray.hpp:124,
+core/mdbuffer.cuh:391, core/memory_type.hpp:21, core/host_device_accessor.hpp,
+core/{host,device,managed,pinned}_md{span,array}.hpp.)
+
+Design stance (SURVEY §7): do not transliterate accessor/container-policy
+template machinery. ``jax.Array`` already is an owning, device-placed,
+layout-carrying n-d array; what the reference's layer adds on top is a
+*vocabulary*: where the memory lives (:class:`MemoryType`), how it is laid
+out (:class:`Layout`), non-owning views (:class:`MdSpan`), owning arrays
+(:class:`MdArray`), a maybe-owning cross-memory bridge (:class:`MdBuffer`),
+and factory functions (``make_device_matrix`` …). That vocabulary is kept;
+the representation is a ``jax.Array`` (or ``numpy.ndarray`` for host memory)
+plus metadata.
+
+Column-major note: XLA’s logical layout is row-major; COL_MAJOR here is a
+*logical* tag meaning "indexing follows Fortran order", realized by storing
+the transposed buffer. ``as_jax()`` always returns the logically-indexed
+array so math code never branches on layout.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.resources import Resources, ensure_resources
+
+
+class MemoryType(enum.Enum):
+    """(ref: core/memory_type.hpp:21 — host/pinned/device/managed)"""
+
+    HOST = "host"
+    PINNED = "pinned_host"
+    DEVICE = "device"
+    # TPU has no managed memory; map to device (XLA may spill to host).
+    MANAGED = "device"
+
+
+class Layout(enum.Enum):
+    """(ref: layout_c_contiguous / layout_f_contiguous / padded layouts)"""
+
+    ROW_MAJOR = "C"
+    COL_MAJOR = "F"
+
+
+def is_row_major(x: "MdSpan | Any") -> bool:
+    """(ref: core/mdspan.hpp ``is_row_major``)"""
+    return getattr(x, "layout", Layout.ROW_MAJOR) == Layout.ROW_MAJOR
+
+
+def is_col_major(x: "MdSpan | Any") -> bool:
+    return getattr(x, "layout", Layout.ROW_MAJOR) == Layout.COL_MAJOR
+
+
+class MdSpan:
+    """Non-owning nd view: array + (memory_type, layout) metadata.
+    (ref: core/mdspan.hpp:26)"""
+
+    __slots__ = ("_data", "memory_type", "layout")
+
+    def __init__(self, data, memory_type: MemoryType, layout: Layout):
+        self._data = data
+        self.memory_type = memory_type
+        self.layout = layout
+
+    # -- shape/dtype in LOGICAL index order ------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        s = self._data.shape
+        return tuple(reversed(s)) if self.layout == Layout.COL_MAJOR else tuple(s)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def extent(self, i: int) -> int:
+        return self.shape[i]
+
+    # -- access ----------------------------------------------------------
+    def as_jax(self) -> jax.Array:
+        """The logically-indexed jnp array (transposes COL_MAJOR storage)."""
+        arr = jnp.asarray(self._data)
+        return arr.T if self.layout == Layout.COL_MAJOR else arr
+
+    def as_numpy(self) -> np.ndarray:
+        arr = np.asarray(self._data)
+        return arr.T if self.layout == Layout.COL_MAJOR else arr
+
+    def raw(self):
+        """The underlying storage in physical order."""
+        return self._data
+
+    def __getitem__(self, idx):
+        return self.as_jax()[idx]
+
+    def __repr__(self):
+        return (
+            f"MdSpan(shape={self.shape}, dtype={self.dtype}, "
+            f"memory={self.memory_type.name}, layout={self.layout.name})"
+        )
+
+
+class MdArray(MdSpan):
+    """Owning nd array (same metadata; owns its buffer).
+    (ref: core/mdarray.hpp:124 — mdarray via container policies; the
+    container policy here is simply "jax.Array on a device" or
+    "numpy.ndarray on host".)"""
+
+    def view(self) -> MdSpan:
+        return MdSpan(self._data, self.memory_type, self.layout)
+
+
+def _alloc(shape, dtype, memory_type: MemoryType, layout: Layout, device=None):
+    phys_shape = tuple(reversed(shape)) if layout == Layout.COL_MAJOR else tuple(shape)
+    if memory_type == MemoryType.HOST:
+        return np.zeros(phys_shape, dtype=dtype)
+    arr = jnp.zeros(phys_shape, dtype=dtype)
+    if device is not None:
+        arr = jax.device_put(arr, device)
+    return arr
+
+
+# ---- factories (ref: core/device_mdarray.hpp make_device_matrix etc.) ----
+def make_device_mdarray(
+    res: Optional[Resources],
+    shape: Sequence[int],
+    dtype=jnp.float32,
+    layout: Layout = Layout.ROW_MAJOR,
+) -> MdArray:
+    res = ensure_resources(res)
+    return MdArray(
+        _alloc(tuple(shape), dtype, MemoryType.DEVICE, layout, res.device),
+        MemoryType.DEVICE,
+        layout,
+    )
+
+
+def make_device_matrix(res, n_rows: int, n_cols: int, dtype=jnp.float32,
+                       layout: Layout = Layout.ROW_MAJOR) -> MdArray:
+    return make_device_mdarray(res, (n_rows, n_cols), dtype, layout)
+
+
+def make_device_vector(res, n: int, dtype=jnp.float32) -> MdArray:
+    return make_device_mdarray(res, (n,), dtype)
+
+
+def make_device_scalar(res, value, dtype=None) -> MdArray:
+    res = ensure_resources(res)
+    arr = jnp.asarray(value, dtype=dtype)
+    return MdArray(jax.device_put(arr, res.device), MemoryType.DEVICE, Layout.ROW_MAJOR)
+
+
+def make_host_mdarray(shape, dtype=np.float32, layout: Layout = Layout.ROW_MAJOR) -> MdArray:
+    return MdArray(_alloc(tuple(shape), dtype, MemoryType.HOST, layout), MemoryType.HOST, layout)
+
+
+def make_host_matrix(n_rows: int, n_cols: int, dtype=np.float32,
+                     layout: Layout = Layout.ROW_MAJOR) -> MdArray:
+    return make_host_mdarray((n_rows, n_cols), dtype, layout)
+
+
+def make_host_vector(n: int, dtype=np.float32) -> MdArray:
+    return make_host_mdarray((n,), dtype)
+
+
+def wrap(data, memory_type: Optional[MemoryType] = None,
+         layout: Layout = Layout.ROW_MAJOR) -> MdSpan:
+    """Wrap an existing array (no copy) as an MdSpan."""
+    if memory_type is None:
+        memory_type = MemoryType.HOST if isinstance(data, np.ndarray) else MemoryType.DEVICE
+    return MdSpan(data, memory_type, layout)
+
+
+class MdBuffer:
+    """Maybe-owning buffer that converts to a requested memory type / dtype
+    on demand, caching conversions. (ref: core/mdbuffer.cuh:391 — the
+    cross-memory bridge; conversion here is ``jax.device_put`` across memory
+    kinds + ``astype``.)"""
+
+    def __init__(self, data: "MdSpan | Any", memory_type: Optional[MemoryType] = None):
+        if not isinstance(data, MdSpan):
+            data = wrap(data, memory_type)
+        self._source = data
+        self._cache: dict = {}
+
+    @property
+    def memory_type(self) -> MemoryType:
+        return self._source.memory_type
+
+    @property
+    def dtype(self):
+        return self._source.dtype
+
+    @property
+    def shape(self):
+        return self._source.shape
+
+    def view(self, memory_type: Optional[MemoryType] = None, dtype=None) -> MdSpan:
+        memory_type = memory_type or self._source.memory_type
+        dtype = np.dtype(dtype) if dtype is not None else np.dtype(self._source.dtype)
+        if memory_type == self._source.memory_type and dtype == np.dtype(self._source.dtype):
+            return self._source
+        key = (memory_type, dtype)
+        if key not in self._cache:
+            logical = self._source.as_jax().astype(dtype)
+            if memory_type == MemoryType.HOST:
+                data: Any = np.asarray(logical)
+            elif memory_type == MemoryType.PINNED:
+                data = _to_memory_kind(logical, "pinned_host")
+            else:
+                data = _to_memory_kind(logical, "device")
+            self._cache[key] = MdSpan(data, memory_type, Layout.ROW_MAJOR)
+        return self._cache[key]
+
+
+def _to_memory_kind(arr: jax.Array, kind: str) -> jax.Array:
+    """Place an array into a named memory kind ("device" / "pinned_host"),
+    degrading gracefully on platforms without that memory space."""
+    try:
+        dev = arr.devices().pop() if hasattr(arr, "devices") else jax.devices()[0]
+        sharding = jax.sharding.SingleDeviceSharding(dev, memory_kind=kind)
+        return jax.device_put(arr, sharding)
+    except (ValueError, NotImplementedError):
+        return jax.device_put(arr)
+
+
+def copy(res: Optional[Resources], dst: "MdSpan | None", src: "MdSpan | Any") -> MdSpan:
+    """Generic mdspan→mdspan copy across layouts and memory types.
+    (ref: core/copy.cuh ``raft::copy`` — kernel / memcpy / host-loop
+    dispatch; here: layout-normalizing ``device_put``.) Returns the
+    destination view (functional style: if ``dst`` is None a new buffer in
+    src's logical shape on the handle's device is returned)."""
+    res = ensure_resources(res)
+    if not isinstance(src, MdSpan):
+        src = wrap(src)
+    logical = src.as_jax()
+    if dst is None:
+        return MdSpan(jax.device_put(logical, res.device), MemoryType.DEVICE, Layout.ROW_MAJOR)
+    expects(tuple(dst.shape) == tuple(src.shape),
+            "copy: shape mismatch %s vs %s", dst.shape, src.shape)
+    converted = logical.astype(dst.dtype)
+    if dst.layout == Layout.COL_MAJOR:
+        converted = converted.T
+    if dst.memory_type == MemoryType.HOST:
+        out: Any = np.asarray(converted)
+    else:
+        out = jax.device_put(converted, res.device)
+    dst._data = out
+    return dst

@@ -1,0 +1,138 @@
+"""Memory observability: tracking/statistics/notifying adaptors.
+
+(ref: cpp/include/raft/core/memory_stats_resources.hpp,
+core/memory_tracking_resources.hpp, mr/statistics_adaptor.hpp:25,66,
+mr/notifying_adaptor.hpp:25,77, mr/resource_monitor.hpp:42.)
+
+On TPU, XLA owns the allocator, so the adaptor stack cannot interpose on
+real HBM allocations; what it *can* do — and what the reference adaptors are
+used for — is account logical allocations made through the framework and
+surface live/peak statistics. :class:`MemoryTracker` is the accounting core;
+:class:`StatisticsAdaptor` / :class:`NotifyingAdaptor` reproduce the adaptor
+vocabulary; :class:`ResourceMonitor` samples device ``memory_stats()``
+attributed to the active tracing range (see :mod:`raft_tpu.core.nvtx`),
+reproducing the NVTX-attributed memory timeline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, TextIO
+
+import jax
+
+from raft_tpu.core import nvtx
+
+
+class MemoryTracker:
+    """Live/peak/total byte and allocation counters.
+    (ref: mr/statistics_adaptor.hpp counters)"""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.current_bytes = 0
+        self.peak_bytes = 0
+        self.total_bytes = 0
+        self.current_count = 0
+        self.peak_count = 0
+        self.total_count = 0
+
+    def allocate(self, nbytes: int) -> None:
+        with self._lock:
+            self.current_bytes += nbytes
+            self.total_bytes += nbytes
+            self.peak_bytes = max(self.peak_bytes, self.current_bytes)
+            self.current_count += 1
+            self.total_count += 1
+            self.peak_count = max(self.peak_count, self.current_count)
+
+    def deallocate(self, nbytes: int) -> None:
+        with self._lock:
+            self.current_bytes -= nbytes
+            self.current_count -= 1
+
+
+class StatisticsAdaptor:
+    """Wraps an upstream 'allocate' callable with statistics accounting.
+    (ref: mr/statistics_adaptor.hpp:66)"""
+
+    def __init__(self, upstream: Optional[Callable[[int], object]] = None):
+        self.upstream = upstream
+        self.stats = MemoryTracker()
+
+    def allocate(self, nbytes: int):
+        self.stats.allocate(nbytes)
+        return self.upstream(nbytes) if self.upstream else None
+
+    def deallocate(self, obj, nbytes: int) -> None:
+        self.stats.deallocate(nbytes)
+
+
+class NotifyingAdaptor:
+    """Invokes callbacks on every allocate/deallocate.
+    (ref: mr/notifying_adaptor.hpp:77)"""
+
+    def __init__(
+        self,
+        upstream: Optional[Callable[[int], object]] = None,
+        on_allocate: Optional[Callable[[int], None]] = None,
+        on_deallocate: Optional[Callable[[int], None]] = None,
+    ):
+        self.upstream = upstream
+        self.on_allocate = on_allocate
+        self.on_deallocate = on_deallocate
+
+    def allocate(self, nbytes: int):
+        if self.on_allocate:
+            self.on_allocate(nbytes)
+        return self.upstream(nbytes) if self.upstream else None
+
+    def deallocate(self, obj, nbytes: int) -> None:
+        if self.on_deallocate:
+            self.on_deallocate(nbytes)
+
+
+class ResourceMonitor:
+    """Samples per-device memory stats on a background thread, attributing
+    each sample to the innermost active tracing range, and writes a timeline.
+    (ref: mr/resource_monitor.hpp:42 — NVTX-range-attributed memory
+    timeline.)"""
+
+    def __init__(self, out: TextIO, period_s: float = 0.01, device=None):
+        self._out = out
+        self._period = period_s
+        self._device = device
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.samples: List[tuple] = []
+
+    def _sample_loop(self):
+        dev = self._device or jax.devices()[0]
+        t0 = time.monotonic()
+        while not self._stop.is_set():
+            stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
+            in_use = stats.get("bytes_in_use", 0) if stats else 0
+            tag = nvtx.current_range() or ""
+            rec = (time.monotonic() - t0, in_use, tag)
+            self.samples.append(rec)
+            self._out.write(f"{rec[0]:.6f}\t{rec[1]}\t{rec[2]}\n")
+            self._stop.wait(self._period)
+
+    def __enter__(self):
+        self._thread = threading.Thread(target=self._sample_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        return False
+
+
+def device_memory_stats(device=None) -> dict:
+    """Current XLA allocator stats for a device (bytes_in_use, peak, limit)."""
+    dev = device or jax.devices()[0]
+    stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
+    return dict(stats) if stats else {}

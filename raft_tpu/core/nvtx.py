@@ -1,0 +1,101 @@
+"""Tracing / profiling ranges.
+
+TPU-native equivalent of the reference's NVTX subsystem (ref:
+cpp/include/raft/core/nvtx.hpp:88-121 — ``push_range``/``pop_range`` + RAII
+``range``, domain tags, thread-local range stack in
+core/detail/nvtx_range_stack.hpp). On TPU the profiler is xprof; JAX exposes
+it via ``jax.profiler.TraceAnnotation`` (host timeline) and
+``jax.named_scope`` (HLO op names). ``push_range``/``pop_range`` maintain the
+same thread-local stack semantics so the memory ``resource_monitor`` can
+attribute samples to the innermost active range (see
+:mod:`raft_tpu.core.memory`).
+
+Disabled globally when env ``RAFT_TPU_DISABLE_TRACING`` is set (the
+equivalent of building with ``--no-nvtx``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Iterator, List, Optional
+
+import jax
+
+_ENABLED = not os.environ.get("RAFT_TPU_DISABLE_TRACING")
+
+_tls = threading.local()
+
+
+def _stack() -> List[str]:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+def current_range() -> Optional[str]:
+    """Innermost active range name on this thread, or None.
+    (ref: core/detail/nvtx_range_stack.hpp)"""
+    st = _stack()
+    return st[-1] if st else None
+
+
+def range_stack() -> List[str]:
+    return list(_stack())
+
+
+class _RangeEntry:
+    __slots__ = ("name", "_ann", "_scope")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+        self._scope = jax.named_scope(name)
+
+    def enter(self):
+        self._ann.__enter__()
+        self._scope.__enter__()
+        _stack().append(self.name)
+
+    def exit(self):
+        st = _stack()
+        if st and st[-1] == self.name:
+            st.pop()
+        self._scope.__exit__(None, None, None)
+        self._ann.__exit__(None, None, None)
+
+
+def push_range(fmt: str, *args) -> None:
+    """(ref: core/nvtx.hpp:88 ``push_range``)"""
+    if not _ENABLED:
+        return
+    name = fmt % args if args else fmt
+    entry = _RangeEntry(name)
+    entry.enter()
+    if not hasattr(_tls, "entries"):
+        _tls.entries = []
+    _tls.entries.append(entry)
+
+
+def pop_range() -> None:
+    """(ref: core/nvtx.hpp:104 ``pop_range``)"""
+    if not _ENABLED:
+        return
+    entries = getattr(_tls, "entries", None)
+    if entries:
+        entries.pop().exit()
+
+
+@contextlib.contextmanager
+def annotate(fmt: str, *args) -> Iterator[None]:
+    """RAII-style scoped range. (ref: core/nvtx.hpp:121 ``range``)"""
+    push_range(fmt, *args)
+    try:
+        yield
+    finally:
+        pop_range()
+
+
+# Alias matching the reference class name.
+range = annotate  # noqa: A001

@@ -1,0 +1,163 @@
+"""Composable elementwise operator functors.
+
+TPU-native equivalent of the reference's device functor vocabulary (ref:
+cpp/include/raft/core/operators.hpp — ``identity_op``, ``sq_op``, ``add_op``,
+``key_op``…) which are passed as template arguments into map/reduce kernels.
+Here they are plain callables (usable both in traced JAX code and inside
+Pallas kernel bodies) plus combinators for composition and argument binding.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.core.kvp import KeyValuePair
+
+
+# ---- nullary / unary ----
+def identity_op(x, *_):
+    return x
+
+
+def const_op(value):
+    def op(*_):
+        return value
+
+    return op
+
+
+def cast_op(dtype):
+    def op(x, *_):
+        return x.astype(dtype) if hasattr(x, "astype") else dtype(x)
+
+    return op
+
+
+def key_op(kvp: KeyValuePair, *_):
+    return kvp.key
+
+
+def value_op(kvp: KeyValuePair, *_):
+    return kvp.value
+
+
+def sq_op(x, *_):
+    return x * x
+
+
+def abs_op(x, *_):
+    return jnp.abs(x)
+
+
+def sqrt_op(x, *_):
+    return jnp.sqrt(x)
+
+
+def nz_op(x, *_):
+    return jnp.where(x != 0, jnp.ones_like(x), jnp.zeros_like(x))
+
+
+# ---- binary ----
+def add_op(a, b):
+    return a + b
+
+
+def sub_op(a, b):
+    return a - b
+
+
+def mul_op(a, b):
+    return a * b
+
+
+def div_op(a, b):
+    return a / b
+
+
+def div_checkzero_op(a, b):
+    return jnp.where(b == 0, jnp.zeros_like(a * b), a / b)
+
+
+def pow_op(a, b):
+    return a**b
+
+
+def mod_op(a, b):
+    return a % b
+
+
+def min_op(a, b):
+    return jnp.minimum(a, b)
+
+
+def max_op(a, b):
+    return jnp.maximum(a, b)
+
+
+def argmin_op(a: KeyValuePair, b: KeyValuePair) -> KeyValuePair:
+    """KVP reduction keeping the smaller value (ties → smaller key).
+    (ref: core/kvp.hpp use in argmin reductions)"""
+    take_b = (b.value < a.value) | ((b.value == a.value) & (b.key < a.key))
+    return KeyValuePair(
+        key=jnp.where(take_b, b.key, a.key),
+        value=jnp.where(take_b, b.value, a.value),
+    )
+
+
+def argmax_op(a: KeyValuePair, b: KeyValuePair) -> KeyValuePair:
+    take_b = (b.value > a.value) | ((b.value == a.value) & (b.key < a.key))
+    return KeyValuePair(
+        key=jnp.where(take_b, b.key, a.key),
+        value=jnp.where(take_b, b.value, a.value),
+    )
+
+
+def sqdiff_op(a, b):
+    d = a - b
+    return d * d
+
+
+def absdiff_op(a, b):
+    return jnp.abs(a - b)
+
+
+# ---- combinators (ref: core/operators.hpp compose_op / plug_const_op) ----
+def compose_op(*ops):
+    """compose_op(f, g, h)(x) == f(g(h(x))) — innermost applied first,
+    matching the reference's template ordering."""
+
+    def composed(x, *args):
+        for op in reversed(ops):
+            x = op(x, *args)
+        return x
+
+    return composed
+
+
+def plug_const_op(const, binary):
+    """Bind the second argument of a binary op to a constant."""
+
+    def op(x, *_):
+        return binary(x, const)
+
+    return op
+
+
+def add_const_op(const):
+    return plug_const_op(const, add_op)
+
+
+def sub_const_op(const):
+    return plug_const_op(const, sub_op)
+
+
+def mul_const_op(const):
+    return plug_const_op(const, mul_op)
+
+
+def div_const_op(const):
+    return plug_const_op(const, div_op)
+
+
+def pow_const_op(const):
+    return plug_const_op(const, pow_op)

@@ -1,0 +1,49 @@
+"""Resource slot vocabulary for the resources registry.
+
+(ref: cpp/include/raft/core/resource/resource_types.hpp:20-100 — the enum of
+22 slots: vendor-library handles, streams, comms, workspace MRs, device
+id/properties…). The TPU-native slot set drops CUDA-specific entries
+(cuBLAS/cuSOLVER/cuSPARSE handles, streams, thrust policy — XLA owns those
+concerns) and adds the mesh/PRNG/compile-cache slots that a JAX runtime
+actually hangs on to.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ResourceType(enum.Enum):
+    # device identity (ref: resource_types.hpp DEVICE_ID / DEVICE_PROPERTIES)
+    DEVICE = enum.auto()
+    DEVICE_ID = enum.auto()
+    PLATFORM = enum.auto()
+    DEVICE_PROPERTIES = enum.auto()
+
+    # SPMD topology (replaces CUDA stream/stream-pool slots: parallelism on
+    # TPU is expressed as a device mesh, not streams)
+    MESH = enum.auto()
+
+    # communications (ref: COMMUNICATOR / SUB_COMMUNICATOR / NCCL_COMM /
+    # ROOT_RANK / MULTI_GPU)
+    COMMUNICATOR = enum.auto()
+    SUB_COMMUNICATOR = enum.auto()
+    ROOT_RANK = enum.auto()
+    MULTI_DEVICE = enum.auto()
+
+    # memory (ref: WORKSPACE_RESOURCE / LARGE_WORKSPACE_RESOURCE / PINNED /
+    # MANAGED memory resources)
+    WORKSPACE_RESOURCE = enum.auto()
+    LARGE_WORKSPACE_RESOURCE = enum.auto()
+    MEMORY_KIND = enum.auto()
+    HOST_MEMORY_KIND = enum.auto()
+
+    # RNG key stream (no reference slot — RAFT passes RngState per call; on
+    # TPU a handle-scoped threefry key stream is the idiomatic equivalent)
+    RNG = enum.auto()
+
+    # compiled-executable cache (replaces the "legacy handle caches")
+    COMPILE_CACHE = enum.auto()
+
+    # user-defined (ref: CUSTOM)
+    CUSTOM = enum.auto()

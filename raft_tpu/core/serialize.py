@@ -1,0 +1,55 @@
+"""Serialization: mdspan ⇄ NumPy ``.npy``.
+
+(ref: cpp/include/raft/core/serialize.hpp, core/numpy_serializer.hpp,
+core/detail/mdspan_numpy_serializer.hpp — hand-rolled npy header writer.
+Python has numpy; the contract kept is the wire format (standard .npy) and
+the mdspan-level API names, incl. scalar serialization.)
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, BinaryIO
+
+import numpy as np
+
+from raft_tpu.core.mdarray import MdSpan, wrap
+
+
+def _logical_numpy(obj: Any) -> np.ndarray:
+    if isinstance(obj, MdSpan):
+        return obj.as_numpy()
+    return np.asarray(obj)
+
+
+def serialize_mdspan(res, stream: BinaryIO, obj: Any) -> None:
+    """Write an array to a binary stream in .npy format.
+    (ref: core/serialize.hpp ``serialize_mdspan``)"""
+    np.save(stream, _logical_numpy(obj), allow_pickle=False)
+
+
+def deserialize_mdspan(res, stream: BinaryIO) -> MdSpan:
+    """Read a .npy array back as a host mdspan.
+    (ref: core/serialize.hpp ``deserialize_mdspan``)"""
+    arr = np.load(stream, allow_pickle=False)
+    return wrap(arr)
+
+
+def serialize_scalar(res, stream: BinaryIO, value) -> None:
+    """(ref: core/serialize.hpp ``serialize_scalar``)"""
+    np.save(stream, np.asarray(value), allow_pickle=False)
+
+
+def deserialize_scalar(res, stream: BinaryIO):
+    arr = np.load(stream, allow_pickle=False)
+    return arr[()] if arr.ndim == 0 else arr.item()
+
+
+def mdspan_to_bytes(obj: Any) -> bytes:
+    buf = io.BytesIO()
+    serialize_mdspan(None, buf, obj)
+    return buf.getvalue()
+
+
+def mdspan_from_bytes(data: bytes) -> MdSpan:
+    return deserialize_mdspan(None, io.BytesIO(data))

@@ -1,0 +1,213 @@
+"""Sparse matrix vocabulary types: COO and CSR with structure/values
+separation.
+
+(ref: cpp/include/raft/core/sparse_types.hpp, core/coo_matrix.hpp,
+core/csr_matrix.hpp, core/device_coo_matrix.hpp, core/device_csr_matrix.hpp —
+owning + view types where a ``*_structure`` (indices/indptr + shape) is held
+separately from the values so several value arrays can share one structure.)
+
+TPU-first: arrays are ``jax.Array``; both types are registered as JAX pytrees
+so they can be passed through ``jit``/``vmap``/``shard_map`` directly. ``nnz``
+and ``shape`` are static (Python ints) — XLA needs static shapes; sparsity
+patterns with varying nnz are handled by padding (see
+:mod:`raft_tpu.sparse.convert`). Padding convention: padded entries carry
+``row = n_rows`` sentinel? No — padded entries use row/col = last valid
+index with value 0, so every op is correct without masking.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class COOStructure:
+    """(ref: core/coo_matrix.hpp ``coordinate_structure_t``)"""
+
+    def __init__(self, rows, cols, shape: Tuple[int, int]):
+        self.rows = rows
+        self.cols = cols
+        self.shape = (int(shape[0]), int(shape[1]))
+
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.shape[0])
+
+    @property
+    def n_rows(self) -> int:
+        return self.shape[0]
+
+    @property
+    def n_cols(self) -> int:
+        return self.shape[1]
+
+    def tree_flatten(self):
+        return (self.rows, self.cols), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+
+@jax.tree_util.register_pytree_node_class
+class COOMatrix:
+    """Owning COO matrix = structure + values.
+    (ref: core/coo_matrix.hpp, sparse/coo.hpp ``raft::sparse::COO``)"""
+
+    def __init__(self, rows, cols, values, shape: Tuple[int, int]):
+        self.structure = COOStructure(rows, cols, shape)
+        self.values = values
+
+    # convenience accessors
+    @property
+    def rows(self):
+        return self.structure.rows
+
+    @property
+    def cols(self):
+        return self.structure.cols
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self.structure.shape
+
+    @property
+    def nnz(self) -> int:
+        return self.structure.nnz
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def view(self) -> "COOMatrix":
+        return self
+
+    def with_values(self, values) -> "COOMatrix":
+        """New COO sharing this structure (the structure/values split)."""
+        return COOMatrix(self.rows, self.cols, values, self.shape)
+
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros(self.shape, dtype=self.values.dtype)
+        return out.at[self.rows, self.cols].add(self.values)
+
+    @classmethod
+    def from_dense(cls, mat) -> "COOMatrix":
+        mat = jnp.asarray(mat)
+        import numpy as np
+
+        host = np.asarray(mat)
+        r, c = np.nonzero(host)
+        return cls(jnp.asarray(r, jnp.int32), jnp.asarray(c, jnp.int32),
+                   mat[r, c], mat.shape)
+
+    def tree_flatten(self):
+        return (self.rows, self.cols, self.values), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], children[2], aux)
+
+    def __repr__(self):
+        return f"COOMatrix(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})"
+
+
+@jax.tree_util.register_pytree_node_class
+class CSRStructure:
+    """(ref: core/csr_matrix.hpp ``compressed_structure_t``)"""
+
+    def __init__(self, indptr, indices, shape: Tuple[int, int]):
+        self.indptr = indptr
+        self.indices = indices
+        self.shape = (int(shape[0]), int(shape[1]))
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.shape[0])
+
+    @property
+    def n_rows(self) -> int:
+        return self.shape[0]
+
+    @property
+    def n_cols(self) -> int:
+        return self.shape[1]
+
+    def tree_flatten(self):
+        return (self.indptr, self.indices), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+
+@jax.tree_util.register_pytree_node_class
+class CSRMatrix:
+    """Owning CSR matrix = compressed structure + values.
+    (ref: core/csr_matrix.hpp, core/device_csr_matrix.hpp)"""
+
+    def __init__(self, indptr, indices, values, shape: Tuple[int, int]):
+        self.structure = CSRStructure(indptr, indices, shape)
+        self.values = values
+
+    @property
+    def indptr(self):
+        return self.structure.indptr
+
+    @property
+    def indices(self):
+        return self.structure.indices
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self.structure.shape
+
+    @property
+    def nnz(self) -> int:
+        return self.structure.nnz
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def with_values(self, values) -> "CSRMatrix":
+        return CSRMatrix(self.indptr, self.indices, values, self.shape)
+
+    def row_ids(self) -> jax.Array:
+        """Expand indptr to one row id per nnz (the csr→coo row expansion,
+        ref: sparse/convert/csr.cuh)."""
+        n_rows = self.shape[0]
+        counts = jnp.diff(self.indptr)
+        return jnp.repeat(
+            jnp.arange(n_rows, dtype=self.indices.dtype),
+            counts,
+            total_repeat_length=self.nnz,
+        )
+
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros(self.shape, dtype=self.values.dtype)
+        return out.at[self.row_ids(), self.indices].add(self.values)
+
+    @classmethod
+    def from_dense(cls, mat) -> "CSRMatrix":
+        import numpy as np
+
+        host = np.asarray(mat)
+        r, c = np.nonzero(host)
+        indptr = np.zeros(host.shape[0] + 1, np.int32)
+        np.add.at(indptr, r + 1, 1)
+        indptr = np.cumsum(indptr).astype(np.int32)
+        return cls(jnp.asarray(indptr), jnp.asarray(c, jnp.int32),
+                   jnp.asarray(host[r, c]), host.shape)
+
+    def tree_flatten(self):
+        return (self.indptr, self.indices, self.values), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], children[2], aux)
+
+    def __repr__(self):
+        return f"CSRMatrix(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})"

@@ -1,0 +1,28 @@
+"""raft_tpu.utils — host-side toolkit.
+
+(ref: cpp/include/raft/util — SURVEY §2.2. Most of the reference's util
+layer is warp/SM machinery that dissolves into Pallas/XLA idioms; what
+survives host-side is kept here: power-of-two arithmetic, integer utilities,
+test param generation, TPU-generation dispatch, the key→vector cache, the
+prime sieve, and input validation.)
+"""
+
+from raft_tpu.utils.pow2 import Pow2, round_up_safe, round_down_safe, is_pow2
+from raft_tpu.utils.integer_utils import ceildiv, alignTo, alignDown, gcd, lcm
+from raft_tpu.utils.arch import tpu_generation, device_kind, ArchRange
+from raft_tpu.utils.itertools import product as param_product
+from raft_tpu.utils.cache import VectorCache
+from raft_tpu.utils.seive import Seive
+from raft_tpu.utils.input_validation import (
+    is_contiguous,
+    validate_matrix,
+    validate_vector,
+)
+
+__all__ = [
+    "Pow2", "round_up_safe", "round_down_safe", "is_pow2",
+    "ceildiv", "alignTo", "alignDown", "gcd", "lcm",
+    "tpu_generation", "device_kind", "ArchRange",
+    "param_product", "VectorCache", "Seive",
+    "is_contiguous", "validate_matrix", "validate_vector",
+]

@@ -1,0 +1,39 @@
+"""Accelerator-generation dispatch.
+
+(ref: cpp/include/raft/util/arch.cuh — runtime SM-architecture ranges used
+to pick kernel variants per GPU generation. The TPU equivalent keys off
+``device_kind`` — v4/v5e/v5p/v6 … — so Pallas kernels can pick tile sizes
+per generation.)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import jax
+
+
+def device_kind(device: Optional[jax.Device] = None) -> str:
+    dev = device or jax.devices()[0]
+    return getattr(dev, "device_kind", "cpu")
+
+
+def tpu_generation(device: Optional[jax.Device] = None) -> int:
+    """TPU generation number (4, 5, 6, ...); 0 for non-TPU platforms."""
+    kind = device_kind(device).lower()
+    m = re.search(r"v(\d+)", kind)
+    return int(m.group(1)) if m else 0
+
+
+class ArchRange:
+    """Half-open generation range for kernel dispatch.
+    (ref: util/arch.cuh ``SM_range``)"""
+
+    def __init__(self, min_gen: int, max_gen: int = 1 << 30):
+        self.min_gen = min_gen
+        self.max_gen = max_gen
+
+    def contains(self, gen: Optional[int] = None) -> bool:
+        g = tpu_generation() if gen is None else gen
+        return self.min_gen <= g < self.max_gen

@@ -1,0 +1,56 @@
+"""Key → vector cache.
+
+(ref: cpp/include/raft/util/cache.cuh + cache_util.cuh — a GPU-resident
+set-associative cache mapping integer keys to fixed-width vectors, used to
+memoize expensive per-key vectors. TPU-first rendering: the cache store is a
+dense ``jax.Array`` of shape (capacity, dim) living in HBM, with a host-side
+hash index; assign/lookup are vectorized gather/scatter.)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class VectorCache:
+    def __init__(self, capacity: int, dim: int, dtype=jnp.float32):
+        self.capacity = int(capacity)
+        self.dim = int(dim)
+        self.store = jnp.zeros((self.capacity, self.dim), dtype=dtype)
+        self._slot_of: Dict[int, int] = {}
+        self._order: list = []  # FIFO eviction order
+
+    def assign(self, keys, vectors) -> None:
+        """Insert vectors for keys (evicting FIFO on overflow)."""
+        keys = np.asarray(keys).tolist()
+        vectors = jnp.asarray(vectors)
+        slots = []
+        for k in keys:
+            if k in self._slot_of:
+                slots.append(self._slot_of[k])
+                continue
+            if len(self._order) < self.capacity:
+                slot = len(self._order)
+            else:
+                evicted = self._order.pop(0)
+                slot = self._slot_of.pop(evicted)
+            self._slot_of[k] = slot
+            self._order.append(k)
+            slots.append(slot)
+        self.store = self.store.at[jnp.asarray(slots, jnp.int32)].set(vectors)
+
+    def lookup(self, keys) -> Tuple[jnp.ndarray, np.ndarray]:
+        """Return (vectors, hit_mask); missing keys give zero vectors."""
+        keys = np.asarray(keys).tolist()
+        slots = np.array([self._slot_of.get(k, 0) for k in keys], np.int32)
+        hits = np.array([k in self._slot_of for k in keys], bool)
+        vecs = self.store[jnp.asarray(slots)]
+        vecs = jnp.where(jnp.asarray(hits)[:, None], vecs, 0)
+        return vecs, hits
+
+    @property
+    def size(self) -> int:
+        return len(self._order)

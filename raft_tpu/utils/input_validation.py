@@ -1,0 +1,38 @@
+"""Input validation helpers.
+
+(ref: cpp/include/raft/util/input_validation.hpp — mdspan contiguity/extent
+checks. ``jax.Array``s are always dense; what remains meaningful is rank,
+extent, and dtype validation with RAFT-style error messages.)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import MdSpan
+
+
+def _shape(x):
+    return x.shape
+
+
+def is_contiguous(x) -> bool:
+    """jax arrays / MdSpans are always logically contiguous."""
+    return True
+
+
+def validate_matrix(x, name: str = "input", dtype=None):
+    arr = x.as_jax() if isinstance(x, MdSpan) else jnp.asarray(x)
+    expects(arr.ndim == 2, "%s must be a matrix (2-d), got %d-d", name, arr.ndim)
+    if dtype is not None:
+        expects(arr.dtype == dtype, "%s must have dtype %s, got %s", name, dtype, arr.dtype)
+    return arr
+
+
+def validate_vector(x, name: str = "input", dtype=None):
+    arr = x.as_jax() if isinstance(x, MdSpan) else jnp.asarray(x)
+    expects(arr.ndim == 1, "%s must be a vector (1-d), got %d-d", name, arr.ndim)
+    if dtype is not None:
+        expects(arr.dtype == dtype, "%s must have dtype %s, got %s", name, dtype, arr.dtype)
+    return arr

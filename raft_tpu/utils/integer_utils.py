@@ -1,0 +1,26 @@
+"""Integer utilities. (ref: cpp/include/raft/util/integer_utils.hpp)"""
+
+from __future__ import annotations
+
+import math
+
+
+def ceildiv(a: int, b: int) -> int:
+    """(ref: util/integer_utils.hpp ``ceildiv`` / ``div_rounding_up_safe``)"""
+    return -(-a // b)
+
+
+def alignTo(v: int, align: int) -> int:
+    return ceildiv(v, align) * align
+
+
+def alignDown(v: int, align: int) -> int:
+    return (v // align) * align
+
+
+def gcd(a: int, b: int) -> int:
+    return math.gcd(a, b)
+
+
+def lcm(a: int, b: int) -> int:
+    return a * b // math.gcd(a, b) if a and b else 0

@@ -1,0 +1,13 @@
+"""Host cartesian-product helper for test parameter generation.
+(ref: cpp/include/raft/util/itertools.hpp — builds vectors of param structs
+from value lists.)"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List
+
+
+def product(make: Callable[..., Any], *value_lists: Iterable) -> List[Any]:
+    """``product(Params, [1,2], ["a"])`` → ``[Params(1,"a"), Params(2,"a")]``"""
+    return [make(*combo) for combo in itertools.product(*value_lists)]

@@ -1,0 +1,47 @@
+"""Power-of-two arithmetic helpers.
+
+(ref: cpp/include/raft/util/pow2_utils.cuh ``Pow2<Value>`` — compile-time
+power-of-two div/mod/round helpers used for tiling. On TPU these survive as
+host-side tiling math for Pallas block specs.)
+"""
+
+from __future__ import annotations
+
+
+def is_pow2(v: int) -> bool:
+    return v > 0 and (v & (v - 1)) == 0
+
+
+def round_up_safe(value: int, multiple: int) -> int:
+    """(ref: util/integer_utils.hpp round_up_safe)"""
+    return ((value + multiple - 1) // multiple) * multiple
+
+
+def round_down_safe(value: int, multiple: int) -> int:
+    return (value // multiple) * multiple
+
+
+class Pow2:
+    """(ref: util/pow2_utils.cuh) — div/mod/round for a fixed power of two."""
+
+    def __init__(self, value: int):
+        if not is_pow2(value):
+            raise ValueError(f"Pow2 requires a power of two, got {value}")
+        self.value = value
+        self.log2 = value.bit_length() - 1
+        self.mask = value - 1
+
+    def div(self, x: int) -> int:
+        return x >> self.log2
+
+    def mod(self, x: int) -> int:
+        return x & self.mask
+
+    def round_up(self, x: int) -> int:
+        return (x + self.mask) & ~self.mask
+
+    def round_down(self, x: int) -> int:
+        return x & ~self.mask
+
+    def is_aligned(self, x: int) -> bool:
+        return (x & self.mask) == 0

@@ -1,0 +1,23 @@
+"""Sieve of Eratosthenes. (ref: cpp/include/raft/util/seive.hpp — host-side
+prime sieve, spelling kept from the reference.)"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Seive:
+    def __init__(self, n: int):
+        self.n = int(n)
+        sieve = np.ones(self.n + 1, dtype=bool)
+        sieve[:2] = False
+        for p in range(2, int(self.n**0.5) + 1):
+            if sieve[p]:
+                sieve[p * p :: p] = False
+        self._sieve = sieve
+
+    def is_prime(self, k: int) -> bool:
+        return bool(self._sieve[k])
+
+    def primes(self) -> np.ndarray:
+        return np.nonzero(self._sieve)[0]

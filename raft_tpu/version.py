@@ -1,0 +1,5 @@
+"""Version of raft_tpu. Mirrors the reference snapshot it tracks
+(/root/reference VERSION = 26.08.00) with an independent scheme."""
+
+__version__ = "0.1.0"
+RAFT_REFERENCE_VERSION = "26.08.00"

@@ -1,0 +1,41 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU platform so that multi-chip sharding /
+comms paths are exercised without TPU hardware — the same trick the
+reference uses with LocalCUDACluster on a single CI node (ref:
+python/raft-dask/raft_dask/tests/conftest.py:14-35): the code path is
+identical between the virtual mesh and a real pod.
+
+Must run before jax initializes its backends, hence env mutation at import
+time of this conftest (pytest imports it first).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The env var alone is not honored under the axon TPU tunnel — force it via
+# config as well (must happen before any backend is initialized).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture()
+def res():
+    """A fresh DeviceResources handle."""
+    from raft_tpu.core import DeviceResources
+
+    return DeviceResources(seed=42)

@@ -1,0 +1,229 @@
+"""Tests: bitset/bitmap, sparse types, operators, kvp, nvtx, interruptible,
+memory tracking, utils.
+(mirrors cpp/tests/core/bitset.cu, bitmap.cu, sparse_matrix tests,
+operators tests, nvtx.cpp, interruptible.cu, allocation_tracking.cpp,
+util/pow2_utils.cu, seive.cu)"""
+
+import io
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import (
+    Bitset,
+    BitmapView,
+    COOMatrix,
+    CSRMatrix,
+    KeyValuePair,
+    MemoryTracker,
+    NotifyingAdaptor,
+    StatisticsAdaptor,
+    interruptible,
+    nvtx,
+    operators as ops,
+)
+from raft_tpu.utils import Pow2, Seive, ceildiv, param_product, tpu_generation
+
+
+# ---- bitset ----
+def test_bitset_roundtrip():
+    rng = np.random.default_rng(3)
+    bits = rng.random(100) < 0.3
+    bs = Bitset.from_dense(bits)
+    np.testing.assert_array_equal(np.asarray(bs.to_dense()), bits)
+    assert int(bs.count()) == bits.sum()
+
+
+def test_bitset_set_and_flip():
+    bs = Bitset(70, default_value=False)
+    bs2 = bs.set(jnp.array([0, 33, 69]))
+    assert int(bs2.count()) == 3
+    assert bool(bs2.test(jnp.array([33]))[0])
+    bs3 = bs2.set(jnp.array([33]), value=False)
+    assert int(bs3.count()) == 2
+    flipped = bs3.flip()
+    assert int(flipped.count()) == 70 - 2
+
+
+def test_bitset_duplicate_set_indices():
+    bs = Bitset(40, default_value=False).set(jnp.array([5, 5, 5, 7]))
+    assert int(bs.count()) == 2
+
+
+def test_bitmap():
+    mat = np.zeros((5, 9), dtype=bool)
+    mat[1, 3] = mat[4, 8] = True
+    bm = BitmapView.from_dense(mat)
+    np.testing.assert_array_equal(np.asarray(bm.to_dense()), mat)
+    assert bool(bm.test(jnp.array([1]), jnp.array([3]))[0])
+    assert not bool(bm.test(jnp.array([0]), jnp.array([0]))[0])
+    assert int(bm.count()) == 2
+
+
+# ---- sparse types ----
+def test_coo_roundtrip():
+    dense = np.array([[1.0, 0, 2], [0, 0, 3], [4, 0, 0]], np.float32)
+    coo = COOMatrix.from_dense(dense)
+    assert coo.nnz == 4
+    np.testing.assert_array_equal(np.asarray(coo.to_dense()), dense)
+    doubled = coo.with_values(coo.values * 2)
+    np.testing.assert_array_equal(np.asarray(doubled.to_dense()), dense * 2)
+    assert doubled.structure.rows is coo.structure.rows  # shared structure
+
+
+def test_csr_roundtrip():
+    dense = np.array([[1.0, 0, 2], [0, 0, 0], [4, 5, 0]], np.float32)
+    csr = CSRMatrix.from_dense(dense)
+    np.testing.assert_array_equal(np.asarray(csr.indptr), [0, 2, 2, 4])
+    np.testing.assert_array_equal(np.asarray(csr.to_dense()), dense)
+    np.testing.assert_array_equal(np.asarray(csr.row_ids()), [0, 0, 2, 2])
+
+
+def test_sparse_types_are_pytrees():
+    import jax
+
+    coo = COOMatrix.from_dense(np.eye(3, dtype=np.float32))
+
+    @jax.jit
+    def scale(c):
+        return c.with_values(c.values * 3.0)
+
+    out = scale(coo)
+    np.testing.assert_array_equal(np.asarray(out.to_dense()), np.eye(3) * 3)
+
+
+# ---- operators / kvp ----
+def test_operators():
+    assert ops.sq_op(3.0) == 9.0
+    assert ops.add_op(2, 5) == 7
+    assert float(ops.div_checkzero_op(jnp.float32(1), jnp.float32(0))) == 0.0
+    composed = ops.compose_op(ops.sqrt_op, ops.sq_op)
+    assert float(composed(jnp.float32(-4.0))) == 4.0
+    add3 = ops.add_const_op(3)
+    assert add3(4) == 7
+
+
+def test_argmin_op():
+    a = KeyValuePair(jnp.int32(1), jnp.float32(5.0))
+    b = KeyValuePair(jnp.int32(2), jnp.float32(3.0))
+    r = ops.argmin_op(a, b)
+    assert int(r.key) == 2 and float(r.value) == 3.0
+    r2 = ops.argmax_op(a, b)
+    assert int(r2.key) == 1 and float(r2.value) == 5.0
+    # tie → smaller key
+    c = KeyValuePair(jnp.int32(0), jnp.float32(5.0))
+    assert int(ops.argmax_op(a, c).key) == 0
+
+
+# ---- nvtx ----
+def test_nvtx_range_stack():
+    assert nvtx.current_range() is None
+    with nvtx.annotate("outer"):
+        assert nvtx.current_range() == "outer"
+        with nvtx.annotate("inner %d", 2):
+            assert nvtx.current_range() == "inner 2"
+            assert nvtx.range_stack() == ["outer", "inner 2"]
+        assert nvtx.current_range() == "outer"
+    assert nvtx.current_range() is None
+
+
+def test_nvtx_push_pop():
+    nvtx.push_range("r1")
+    assert nvtx.current_range() == "r1"
+    nvtx.pop_range()
+    assert nvtx.current_range() is None
+
+
+# ---- interruptible ----
+def test_interruptible_sync_completes():
+    x = jnp.arange(16.0)
+    y = interruptible.synchronize(x * 2)
+    np.testing.assert_array_equal(np.asarray(y), np.arange(16.0) * 2)
+
+
+def test_interruptible_cancel():
+    main_tid = threading.get_ident()
+    interruptible.cancel(main_tid)
+    with pytest.raises(interruptible.InterruptedException):
+        interruptible.yield_()
+    # token cleared after raise
+    interruptible.yield_()
+
+
+def test_interruptible_cancel_from_other_thread():
+    done = {}
+    tid_holder = {}
+
+    def worker():
+        tid_holder["tid"] = threading.get_ident()
+        try:
+            for _ in range(10_000):
+                interruptible.yield_()
+                time.sleep(0.001)
+            done["r"] = "finished"
+        except interruptible.InterruptedException:
+            done["r"] = "cancelled"
+
+    t = threading.Thread(target=worker)
+    t.start()
+    while "tid" not in tid_holder:
+        time.sleep(0.001)
+    interruptible.cancel(tid_holder["tid"])
+    t.join(timeout=10)
+    assert done["r"] == "cancelled"
+
+
+# ---- memory tracking ----
+def test_memory_tracker_stats():
+    adaptor = StatisticsAdaptor()
+    adaptor.allocate(100)
+    adaptor.allocate(50)
+    adaptor.deallocate(None, 100)
+    s = adaptor.stats
+    assert s.current_bytes == 50
+    assert s.peak_bytes == 150
+    assert s.total_bytes == 150
+    assert s.total_count == 2
+
+
+def test_notifying_adaptor():
+    events = []
+    ad = NotifyingAdaptor(
+        on_allocate=lambda n: events.append(("a", n)),
+        on_deallocate=lambda n: events.append(("d", n)),
+    )
+    ad.allocate(10)
+    ad.deallocate(None, 10)
+    assert events == [("a", 10), ("d", 10)]
+
+
+# ---- utils ----
+def test_pow2():
+    p = Pow2(128)
+    assert p.div(1000) == 7
+    assert p.mod(1000) == 1000 - 7 * 128
+    assert p.round_up(100) == 128
+    assert p.round_down(200) == 128
+    assert p.is_aligned(256)
+    with pytest.raises(ValueError):
+        Pow2(100)
+
+
+def test_ceildiv_and_product():
+    assert ceildiv(10, 3) == 4
+    combos = param_product(lambda a, b: (a, b), [1, 2], ["x"])
+    assert combos == [(1, "x"), (2, "x")]
+
+
+def test_seive():
+    s = Seive(30)
+    assert s.is_prime(29)
+    assert not s.is_prime(27)
+    np.testing.assert_array_equal(s.primes(), [2, 3, 5, 7, 11, 13, 17, 19, 23, 29])
+
+
+def test_tpu_generation_on_cpu():
+    assert tpu_generation() == 0  # cpu test platform

@@ -1,0 +1,124 @@
+"""Core resources registry tests. (mirrors cpp/tests/core/handle.cpp,
+device_resources_manager.cpp)"""
+
+import threading
+
+import jax
+import pytest
+
+from raft_tpu.core import (
+    DeviceResources,
+    LogicError,
+    Resources,
+    ResourceType,
+    device_resources,
+    ensure_resources,
+)
+
+
+def test_lazy_factory_instantiation():
+    res = Resources()
+    calls = []
+
+    def factory(r):
+        calls.append(1)
+        return "value"
+
+    res.add_resource_factory(ResourceType.CUSTOM, factory)
+    assert calls == []  # lazy
+    assert res.get_resource(ResourceType.CUSTOM) == "value"
+    assert res.get_resource(ResourceType.CUSTOM) == "value"
+    assert calls == [1]  # instantiated once
+
+
+def test_missing_factory_raises():
+    res = Resources()
+    with pytest.raises(LogicError):
+        res.get_resource(ResourceType.CUSTOM)
+
+
+def test_shallow_copy_shares_resources():
+    res = Resources()
+    res.add_resource_factory(ResourceType.CUSTOM, lambda r: object())
+    alias = Resources(_shared_from=res)
+    assert alias.get_resource(ResourceType.CUSTOM) is res.get_resource(
+        ResourceType.CUSTOM
+    )
+
+
+def test_replacing_factory_resets_instance():
+    res = Resources()
+    res.add_resource_factory(ResourceType.CUSTOM, lambda r: "a")
+    assert res.get_resource(ResourceType.CUSTOM) == "a"
+    res.add_resource_factory(ResourceType.CUSTOM, lambda r: "b")
+    assert res.get_resource(ResourceType.CUSTOM) == "b"
+
+
+def test_device_resources_defaults():
+    res = DeviceResources(seed=7)
+    assert res.device in jax.devices()
+    assert res.platform == "cpu"  # conftest forces cpu
+    assert res.mesh.devices.size == 1
+    assert res.rng.seed == 7
+    k1 = res.rng.next_key()
+    k2 = res.rng.next_key()
+    assert not jax.numpy.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
+
+
+def test_default_handle_singleton():
+    assert device_resources() is device_resources()
+    assert ensure_resources(None) is device_resources()
+    custom = DeviceResources()
+    assert ensure_resources(custom) is custom
+
+
+def test_workspace_budget():
+    res = DeviceResources(workspace_limit=1 << 20)
+    assert res.workspace.allocation_limit == 1 << 20
+    assert res.workspace.batch_rows(row_bytes=1024) == 1024
+
+
+def test_compile_cache():
+    res = DeviceResources()
+    cache = res.compile_cache
+    a = cache.get_or_compile("k", lambda: [1])
+    b = cache.get_or_compile("k", lambda: [2])
+    assert a is b
+    assert cache.hits == 1 and cache.misses == 1
+
+
+def test_comms_accessors():
+    res = DeviceResources()
+    assert not res.comms_initialized()
+    with pytest.raises(LogicError):
+        res.get_comms()
+    res.set_comms("fake-comms")
+    assert res.comms_initialized()
+    assert res.get_comms() == "fake-comms"
+    res.set_subcomm("row", "row-comms")
+    assert res.get_subcomm("row") == "row-comms"
+    with pytest.raises(LogicError):
+        res.get_subcomm("col")
+
+
+def test_registry_thread_safety():
+    res = Resources()
+    built = []
+
+    def factory(r):
+        built.append(1)
+        return object()
+
+    res.add_resource_factory(ResourceType.CUSTOM, factory)
+    results = []
+
+    def worker():
+        results.append(res.get_resource(ResourceType.CUSTOM))
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(built) == 1
+    assert all(r is results[0] for r in results)
