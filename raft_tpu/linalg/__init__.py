@@ -1,0 +1,42 @@
+"""raft_tpu.linalg — dense linear algebra. (ref: cpp/include/raft/linalg,
+SURVEY §2.3.)"""
+
+from raft_tpu.linalg.types import Apply, NormType
+from raft_tpu.linalg.map import (
+    map,
+    map_offset,
+    unary_op,
+    write_only_unary_op,
+    binary_op,
+    ternary_op,
+)
+from raft_tpu.linalg.eltwise import (
+    add, subtract, multiply, divide, power, sqrt,
+    add_scalar, subtract_scalar, multiply_scalar, divide_scalar, power_scalar,
+    scalar_add, scalar_multiply,
+    eltwise_add, eltwise_sub, eltwise_multiply, eltwise_divide,
+    eltwise_divide_check_zero,
+)
+from raft_tpu.linalg.reduce import (
+    reduce,
+    coalesced_reduction,
+    strided_reduction,
+    map_then_reduce,
+    map_reduce,
+    mean_squared_error,
+)
+from raft_tpu.linalg.norm import norm, row_norm, col_norm, normalize, row_normalize
+from raft_tpu.linalg.matrix_vector import (
+    matrix_vector_op,
+    matrix_vector_op2,
+    binary_mult,
+    binary_mult_skip_zero,
+    binary_div,
+    binary_div_skip_zero,
+    binary_add,
+    binary_sub,
+)
+from raft_tpu.linalg.reduce_by_key import reduce_rows_by_key, reduce_cols_by_key
+from raft_tpu.linalg.blas import gemm, gemv, axpy, dot
+from raft_tpu.linalg.transpose import transpose, transpose_inplace
+from raft_tpu.linalg.init import range_fill

@@ -1,0 +1,64 @@
+"""BLAS-level ops: gemm / gemv / axpy / dot.
+
+(ref: cpp/include/raft/linalg/gemm.cuh:51 mdspan gemm,
+linalg/detail/gemm.cuh ``legacy_matmul`` → cuBLASLt; gemv.cuh, axpy.cuh,
+dot.cuh.) On TPU the MXU path is XLA's dot_general — the wrappers keep the
+reference's alpha/beta/transpose surface and always set
+``preferred_element_type`` so bf16 inputs accumulate in f32 on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def _preferred(dtype):
+    return jnp.float32 if dtype in (jnp.bfloat16, jnp.float16) else dtype
+
+
+def gemm(res, A, B, C: Optional[jnp.ndarray] = None, alpha=1.0, beta=0.0,
+         trans_a: bool = False, trans_b: bool = False,
+         preferred_element_type=None):
+    """C = alpha * op(A) @ op(B) + beta * C. (ref: gemm.cuh:51)"""
+    A = jnp.asarray(A)
+    B = jnp.asarray(B)
+    if trans_a:
+        A = A.T
+    if trans_b:
+        B = B.T
+    pet = preferred_element_type or _preferred(A.dtype)
+    out = alpha * jnp.matmul(A, B, preferred_element_type=pet)
+    if C is not None and beta != 0.0:
+        out = out + beta * jnp.asarray(C)
+    return out.astype(A.dtype) if preferred_element_type is None else out
+
+
+def gemv(res, A, x, y: Optional[jnp.ndarray] = None, alpha=1.0, beta=0.0,
+         trans_a: bool = False):
+    """y = alpha * op(A) @ x + beta * y. (ref: linalg/gemv.cuh)"""
+    A = jnp.asarray(A)
+    x = jnp.asarray(x)
+    if trans_a:
+        A = A.T
+    expects(A.shape[1] == x.shape[0], "gemv: inner dim mismatch %d vs %d",
+            A.shape[1], x.shape[0])
+    out = alpha * jnp.matmul(A, x, preferred_element_type=_preferred(A.dtype))
+    if y is not None and beta != 0.0:
+        out = out + beta * jnp.asarray(y)
+    return out.astype(A.dtype)
+
+
+def axpy(res, alpha, x, y):
+    """y = alpha*x + y. (ref: linalg/axpy.cuh)"""
+    return alpha * jnp.asarray(x) + jnp.asarray(y)
+
+
+def dot(res, x, y):
+    """(ref: linalg/dot.cuh)"""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    return jnp.dot(x, y, preferred_element_type=_preferred(x.dtype)).astype(x.dtype)

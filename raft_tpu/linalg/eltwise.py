@@ -1,0 +1,77 @@
+"""Elementwise arithmetic with the reference's naming.
+
+(ref: cpp/include/raft/linalg/add.cuh, subtract.cuh, multiply.cuh,
+divide.cuh, power.cuh, sqrt.cuh, eltwise.cuh — scalar and elementwise
+variants. All are XLA-fused one-liners here; kept as named functions for API
+parity and for composition inside bigger primitives.)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _a(x):
+    return jnp.asarray(x)
+
+
+# vector ⊕ vector
+def add(res, a, b):
+    return _a(a) + _a(b)
+
+
+def subtract(res, a, b):
+    return _a(a) - _a(b)
+
+
+def multiply(res, a, b):
+    return _a(a) * _a(b)
+
+
+def divide(res, a, b):
+    return _a(a) / _a(b)
+
+
+def power(res, a, b):
+    return _a(a) ** _a(b)
+
+
+def sqrt(res, a):
+    return jnp.sqrt(_a(a))
+
+
+# vector ⊕ scalar (ref: *_scalar variants)
+def add_scalar(res, a, scalar):
+    return _a(a) + scalar
+
+
+def subtract_scalar(res, a, scalar):
+    return _a(a) - scalar
+
+
+def multiply_scalar(res, a, scalar):
+    return _a(a) * scalar
+
+
+def divide_scalar(res, a, scalar):
+    return _a(a) / scalar
+
+
+def power_scalar(res, a, scalar):
+    return _a(a) ** scalar
+
+
+# eltwise aliases (ref: eltwise.cuh scalarAdd/scalarMultiply/eltwiseAdd/...)
+scalar_add = add_scalar
+scalar_multiply = multiply_scalar
+eltwise_add = add
+eltwise_sub = subtract
+eltwise_multiply = multiply
+eltwise_divide = divide
+
+
+def eltwise_divide_check_zero(res, a, b):
+    """(ref: eltwise.cuh ``eltwiseDivideCheckZero`` — 0 where divisor is 0)"""
+    a, b = _a(a), _a(b)
+    safe = jnp.where(b == 0, jnp.ones_like(b), b)
+    return jnp.where(b == 0, jnp.zeros_like(a / safe), a / safe)

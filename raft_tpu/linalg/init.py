@@ -1,0 +1,11 @@
+"""Sequence init. (ref: cpp/include/raft/linalg/init.cuh ``range`` — fill a
+vector with start..end.)"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def range_fill(res, start: int, end: int, dtype=jnp.int32):
+    """(ref: init.cuh ``range(out, start, end, stream)``)"""
+    return jnp.arange(start, end, dtype=dtype)

@@ -1,0 +1,61 @@
+"""Elementwise map kernels.
+
+(ref: cpp/include/raft/linalg/map.cuh:95,118,144 ``map``/``map_offset`` and
+linalg/unary_op.cuh / binary_op.cuh / ternary_op.cuh — all elementwise ops in
+the reference funnel into one vectorized map kernel,
+linalg/detail/map.cuh. On TPU the fusion/vectorization is XLA's job: these
+are thin functional wrappers that keep the reference's API vocabulary and
+broadcast semantics, and they fuse into surrounding jitted code.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources
+
+
+def map(res: Resources | None, f: Callable, *arrays):  # noqa: A001
+    """out[i] = f(a0[i], a1[i], ...). (ref: map.cuh:95)"""
+    args = [jnp.asarray(a) for a in arrays]
+    return f(*args)
+
+
+def map_offset(res: Resources | None, shape, f: Callable, *arrays):
+    """out[i] = f(i, a0[i], ...) — the index-aware variant.
+    (ref: map.cuh ``map_offset``) For multi-d inputs the offset is the
+    row-major linear index."""
+    args = [jnp.asarray(a) for a in arrays]
+    target_shape = tuple(shape) if shape is not None else args[0].shape
+    n = 1
+    for s in target_shape:
+        n *= s
+    idx = jnp.arange(n).reshape(target_shape)
+    return f(idx, *args)
+
+
+def unary_op(res, x, f: Callable):
+    """(ref: linalg/unary_op.cuh ``unaryOp``)"""
+    return f(jnp.asarray(x))
+
+
+def write_only_unary_op(res, shape, dtype, f: Callable):
+    """Generate an array from indices alone.
+    (ref: unary_op.cuh ``writeOnlyUnaryOp``)"""
+    n = 1
+    for s in shape:
+        n *= s
+    idx = jnp.arange(n).reshape(tuple(shape))
+    return f(idx).astype(dtype)
+
+
+def binary_op(res, a, b, f: Callable):
+    """(ref: linalg/binary_op.cuh)"""
+    return f(jnp.asarray(a), jnp.asarray(b))
+
+
+def ternary_op(res, a, b, c, f: Callable):
+    """(ref: linalg/ternary_op.cuh)"""
+    return f(jnp.asarray(a), jnp.asarray(b), jnp.asarray(c))

@@ -1,0 +1,84 @@
+"""Matrix ⊕ vector broadcast ops.
+
+(ref: cpp/include/raft/linalg/matrix_vector_op.cuh — ``matrix_vector_op``
+broadcasting a vector along rows or columns with a custom op (the
+``detail/matrix_vector_op.cuh`` linewise kernel), and
+linalg/matrix_vector.cuh — named binary mult/div/add/sub variants incl.
+skip-zero division.)
+
+Convention: ``apply=Apply.ALONG_ROWS`` broadcasts the vector along rows
+(vector length == n_cols, added to every row); ``ALONG_COLUMNS`` broadcasts
+along columns (vector length == n_rows).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.linalg.types import Apply
+
+
+def _bcast(vec, apply: Apply):
+    vec = jnp.asarray(vec)
+    return vec[None, :] if apply == Apply.ALONG_ROWS else vec[:, None]
+
+
+def matrix_vector_op(res, matrix, vec, op: Callable,
+                     apply: Apply = Apply.ALONG_ROWS):
+    """(ref: matrix_vector_op.cuh:1-arg-vector overload)"""
+    matrix = jnp.asarray(matrix)
+    v = _bcast(vec, apply)
+    n = matrix.shape[1] if apply == Apply.ALONG_ROWS else matrix.shape[0]
+    expects(v.size == n, "matrix_vector_op: vector length %d != extent %d", v.size, n)
+    return op(matrix, v)
+
+
+def matrix_vector_op2(res, matrix, vec1, vec2, op: Callable,
+                      apply: Apply = Apply.ALONG_ROWS):
+    """Two-vector overload. (ref: matrix_vector_op.cuh 2-vector)"""
+    matrix = jnp.asarray(matrix)
+    return op(matrix, _bcast(vec1, apply), _bcast(vec2, apply))
+
+
+# named variants (ref: linalg/matrix_vector.cuh)
+def binary_mult(res, matrix, vec, apply: Apply = Apply.ALONG_ROWS):
+    return matrix_vector_op(res, matrix, vec, lambda m, v: m * v, apply)
+
+
+def binary_mult_skip_zero(res, matrix, vec, apply: Apply = Apply.ALONG_ROWS):
+    """Multiply, treating zero vector entries as 1 (skip).
+    (ref: matrix_vector.cuh ``binary_mult_skip_zero``)"""
+
+    def op(m, v):
+        return jnp.where(v == 0, m, m * v)
+
+    return matrix_vector_op(res, matrix, vec, op, apply)
+
+
+def binary_div(res, matrix, vec, apply: Apply = Apply.ALONG_ROWS):
+    return matrix_vector_op(res, matrix, vec, lambda m, v: m / v, apply)
+
+
+def binary_div_skip_zero(res, matrix, vec, apply: Apply = Apply.ALONG_ROWS,
+                         return_zero: bool = False):
+    """Divide, skipping zero vector entries (or zeroing the output there).
+    (ref: matrix_vector.cuh ``binary_div_skip_zero``)"""
+
+    def op(m, v):
+        safe = jnp.where(v == 0, jnp.ones_like(v), v)
+        if return_zero:
+            return jnp.where(v == 0, jnp.zeros_like(m), m / safe)
+        return jnp.where(v == 0, m, m / safe)
+
+    return matrix_vector_op(res, matrix, vec, op, apply)
+
+
+def binary_add(res, matrix, vec, apply: Apply = Apply.ALONG_ROWS):
+    return matrix_vector_op(res, matrix, vec, lambda m, v: m + v, apply)
+
+
+def binary_sub(res, matrix, vec, apply: Apply = Apply.ALONG_ROWS):
+    return matrix_vector_op(res, matrix, vec, lambda m, v: m - v, apply)

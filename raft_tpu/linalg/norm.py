@@ -1,0 +1,68 @@
+"""Row/column norms and normalization.
+
+(ref: cpp/include/raft/linalg/norm.cuh — rowNorm/colNorm with
+L1/L2/Linf × optional final sqrt; linalg/normalize.cuh — row normalization
+with norm-type dispatch.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.core import operators as ops
+from raft_tpu.linalg.types import Apply, NormType
+
+
+def _norm(data, norm_type: NormType, axis: int, final_sqrt: bool):
+    if norm_type == NormType.L1:
+        out = jnp.sum(jnp.abs(data), axis=axis)
+    elif norm_type == NormType.L2:
+        out = jnp.sum(data * data, axis=axis)
+        if final_sqrt:
+            out = jnp.sqrt(out)
+    else:
+        out = jnp.max(jnp.abs(data), axis=axis)
+    return out
+
+
+def row_norm(res, data, norm_type: NormType = NormType.L2,
+             final_sqrt: bool = False, final_op: Callable = ops.identity_op):
+    """One norm per row. (ref: norm.cuh ``rowNorm``; L2 returns the
+    *squared* norm unless final_sqrt, matching the reference.)"""
+    return final_op(_norm(jnp.asarray(data), norm_type, 1, final_sqrt))
+
+
+def col_norm(res, data, norm_type: NormType = NormType.L2,
+             final_sqrt: bool = False, final_op: Callable = ops.identity_op):
+    """One norm per column. (ref: norm.cuh ``colNorm``)"""
+    return final_op(_norm(jnp.asarray(data), norm_type, 0, final_sqrt))
+
+
+def norm(res, data, norm_type: NormType = NormType.L2,
+         apply: Apply = Apply.ALONG_ROWS, final_sqrt: bool = False,
+         final_op: Callable = ops.identity_op):
+    """mdspan-style entry, reference convention (norm.cuh): ALONG_ROWS →
+    one norm per row (rowNorm), ALONG_COLUMNS → one per column (colNorm)."""
+    if apply == Apply.ALONG_ROWS:
+        return row_norm(res, data, norm_type, final_sqrt, final_op)
+    return col_norm(res, data, norm_type, final_sqrt, final_op)
+
+
+def normalize(res, data, norm_type: NormType = NormType.L2, eps: float = 1e-8):
+    """Normalize each row by its norm. (ref: linalg/normalize.cuh
+    ``row_normalize``; rows with norm <= eps are left as zeros, matching the
+    reference's divide-by-zero guard.)"""
+    data = jnp.asarray(data)
+    if norm_type == NormType.L2:
+        norms = jnp.sqrt(jnp.sum(data * data, axis=1, keepdims=True))
+    elif norm_type == NormType.L1:
+        norms = jnp.sum(jnp.abs(data), axis=1, keepdims=True)
+    else:
+        norms = jnp.max(jnp.abs(data), axis=1, keepdims=True)
+    safe = jnp.where(norms <= eps, jnp.ones_like(norms), norms)
+    return jnp.where(norms <= eps, jnp.zeros_like(data), data / safe)
+
+
+row_normalize = normalize

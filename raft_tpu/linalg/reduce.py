@@ -1,0 +1,134 @@
+"""Reductions: reduce / coalesced / strided / map-reduce / MSE.
+
+(ref: cpp/include/raft/linalg/reduce.cuh, coalesced_reduction.cuh,
+strided_reduction.cuh, map_then_reduce.cuh, mean_squared_error.cuh.
+The reference picks between coalesced (thin/medium/thick policies,
+linalg/detail/coalesced_reduction-inl.cuh:22-141 incl. a Kahan-sum variant)
+and strided kernels based on layout × direction; XLA owns that scheduling on
+TPU, so both spellings lower to an axis reduction. The semantic surface kept:
+``main_op`` applied per element (with column index), reduction via ``op`` from
+``init``, ``final_op`` on the result, optional ``inplace`` accumulate, and
+the reference's row-major × along-rows/columns convention.)
+
+Accumulation note (replacing the Kahan variant): reductions accumulate in
+f32 at minimum — pass ``accumulate_dtype`` to widen (e.g. bf16 data summed
+in f32), which is the TPU-idiomatic fix for the same numerical concern.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.core import operators as ops
+from raft_tpu.linalg.types import Apply
+
+
+def _axis_for(apply: Apply, ndim: int) -> int:
+    # Reference convention (linalg/reduce.cuh): ALONG_ROWS outputs one value
+    # per row → reduce across the column axis (1); ALONG_COLUMNS outputs one
+    # value per column → reduce down the row axis (0). 1-D inputs reduce
+    # their only axis.
+    if ndim == 1:
+        return 0
+    return 1 if apply == Apply.ALONG_ROWS else 0
+
+
+_REDUCERS = {
+    ops.add_op: jnp.sum,
+    ops.min_op: jnp.min,
+    ops.max_op: jnp.max,
+    ops.mul_op: jnp.prod,
+}
+
+
+def reduce(
+    res,
+    data,
+    apply: Apply = Apply.ALONG_ROWS,
+    init=0,
+    main_op: Callable = ops.identity_op,
+    reduce_op: Callable = ops.add_op,
+    final_op: Callable = ops.identity_op,
+    inplace_target=None,
+    accumulate_dtype=None,
+):
+    """General matrix reduction. (ref: linalg/reduce.cuh ``reduce``)
+
+    ``main_op(value, column_index)`` per element; associative ``reduce_op``
+    folds with ``init``; if ``inplace_target`` is given it is folded in
+    BEFORE ``final_op`` — matching the reference's
+    ``final_op(reduce_op(dots, acc))`` ordering
+    (detail/coalesced_reduction-inl.cuh).
+    """
+    data = jnp.asarray(data)
+    axis = _axis_for(apply, data.ndim)
+    col_idx = jnp.arange(data.shape[1])[None, :] if data.ndim == 2 else jnp.arange(data.shape[0])
+    mapped = main_op(data, jnp.broadcast_to(col_idx, data.shape))
+    acc_dtype = accumulate_dtype
+    if acc_dtype is None and mapped.dtype in (jnp.bfloat16, jnp.float16):
+        acc_dtype = jnp.float32
+    if acc_dtype is not None:
+        mapped = mapped.astype(acc_dtype)
+
+    reducer = _REDUCERS.get(reduce_op)
+    if reducer is not None:
+        folded = reducer(mapped, axis=axis)
+        folded = reduce_op(folded, jnp.asarray(init, folded.dtype))
+    else:
+        # generic associative fold over the reduction axis
+        moved = jnp.moveaxis(mapped, axis, 0)
+        import jax
+
+        folded = jax.lax.reduce(
+            moved, jnp.asarray(init, moved.dtype), reduce_op, (0,)
+        )
+    if inplace_target is not None:
+        folded = reduce_op(folded, jnp.asarray(inplace_target))
+    return final_op(folded)
+
+
+def coalesced_reduction(res, data, init=0, main_op=ops.identity_op,
+                        reduce_op=ops.add_op, final_op=ops.identity_op,
+                        inplace_target=None):
+    """Reduce along the contiguous (last) dimension — one output per row.
+    (ref: linalg/coalesced_reduction.cuh)"""
+    return reduce(res, data, Apply.ALONG_ROWS, init, main_op, reduce_op,
+                  final_op, inplace_target)
+
+
+def strided_reduction(res, data, init=0, main_op=ops.identity_op,
+                      reduce_op=ops.add_op, final_op=ops.identity_op,
+                      inplace_target=None):
+    """Reduce along the strided (first) dimension — one output per column.
+    (ref: linalg/strided_reduction.cuh)"""
+    return reduce(res, data, Apply.ALONG_COLUMNS, init, main_op, reduce_op,
+                  final_op, inplace_target)
+
+
+def map_then_reduce(res, *arrays, map_op: Callable = ops.identity_op,
+                    reduce_op: Callable = ops.add_op, init=0,
+                    final_op: Callable = ops.identity_op):
+    """Full map-then-reduce to a scalar. (ref: linalg/map_then_reduce.cuh,
+    map_reduce.cuh)"""
+    mapped = map_op(*[jnp.asarray(a) for a in arrays])
+    reducer = _REDUCERS.get(reduce_op)
+    if reducer is not None:
+        folded = reduce_op(reducer(mapped), jnp.asarray(init, mapped.dtype))
+    else:
+        import jax
+
+        folded = jax.lax.reduce(
+            mapped.reshape(-1), jnp.asarray(init, mapped.dtype), reduce_op, (0,)
+        )
+    return final_op(folded)
+
+
+map_reduce = map_then_reduce
+
+
+def mean_squared_error(res, a, b, weight: float = 1.0):
+    """weight * mean((a-b)^2). (ref: linalg/mean_squared_error.cuh)"""
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    return jnp.mean(ops.sqdiff_op(a, b)) * weight

@@ -1,0 +1,35 @@
+"""Keyed reductions over rows/columns.
+
+(ref: cpp/include/raft/linalg/reduce_rows_by_key.cuh,
+reduce_cols_by_key.cuh — sum rows (or columns) of a matrix into output
+slots selected by a per-row (per-column) key vector. TPU-first: this is a
+one-hot matmul (MXU-friendly) for medium key counts and a segment-sum for
+large ones; we use ``jax.ops.segment_sum`` which XLA lowers to an efficient
+scatter-add.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def reduce_rows_by_key(res, matrix, keys, n_unique_keys: int,
+                       weights=None):
+    """out[k, :] = sum over rows r with keys[r]==k of w[r]*matrix[r, :].
+    (ref: reduce_rows_by_key.cuh)"""
+    matrix = jnp.asarray(matrix)
+    keys = jnp.asarray(keys)
+    if weights is not None:
+        matrix = matrix * jnp.asarray(weights)[:, None]
+    return jax.ops.segment_sum(matrix, keys, num_segments=n_unique_keys)
+
+
+def reduce_cols_by_key(res, matrix, keys, n_unique_keys: int):
+    """out[:, k] = sum over columns c with keys[c]==k of matrix[:, c].
+    (ref: reduce_cols_by_key.cuh)"""
+    matrix = jnp.asarray(matrix)
+    keys = jnp.asarray(keys)
+    return jax.ops.segment_sum(matrix.T, keys, num_segments=n_unique_keys).T

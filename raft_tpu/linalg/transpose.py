@@ -1,0 +1,14 @@
+"""Transpose. (ref: cpp/include/raft/linalg/transpose.cuh — cublasgeam
+out-of-place + an in-place swap kernel; on TPU both are XLA transposes,
+usually free (layout change) when fused.)"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def transpose(res, matrix):
+    return jnp.asarray(matrix).T
+
+
+transpose_inplace = transpose  # functional: "in-place" has no meaning in JAX

@@ -1,0 +1,35 @@
+"""raft_tpu.matrix — matrix manipulation + batched top-k. (ref:
+cpp/include/raft/matrix, SURVEY §2.4.)"""
+
+from raft_tpu.matrix.select_k import select_k, choose_select_k_algorithm
+from raft_tpu.matrix.select_k_types import SelectAlgo
+from raft_tpu.matrix.gather import gather, gather_if, gather_inplace, scatter
+from raft_tpu.matrix.manip import (
+    slice,
+    reverse,
+    col_reverse,
+    row_reverse,
+    shift,
+    get_diagonal,
+    set_diagonal,
+    invert_diagonal,
+    upper_triangular,
+    lower_triangular,
+    eye,
+    fill,
+    linewise_op,
+    print_matrix,
+)
+from raft_tpu.matrix.math_ops import (
+    power,
+    weighted_power,
+    sqrt,
+    ratio,
+    reciprocal,
+    zero_small_values,
+    argmax,
+    argmin,
+    sign_flip,
+    sample_rows,
+    sort_cols_per_row,
+)

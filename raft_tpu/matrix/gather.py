@@ -1,0 +1,45 @@
+"""Row gather/scatter (+ conditional and map-transform variants).
+
+(ref: cpp/include/raft/matrix/gather.cuh, matrix/detail/gather.cuh,
+matrix/gather_inplace.cuh, matrix/scatter.cuh. The reference's in-place
+variants exist for memory reasons; in functional JAX all variants return new
+arrays — XLA elides the copy when it can.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def gather(res, matrix, gather_map, transform_op: Optional[Callable] = None):
+    """out[i, :] = op(matrix[map[i], :]). (ref: gather.cuh ``gather``)"""
+    matrix = jnp.asarray(matrix)
+    gather_map = jnp.asarray(gather_map)
+    out = matrix[gather_map, :]
+    return transform_op(out) if transform_op else out
+
+
+def gather_if(res, matrix, gather_map, stencil, pred_op: Callable,
+              transform_op: Optional[Callable] = None):
+    """Gather rows where pred_op(stencil[i]); other output rows are zero.
+    (ref: gather.cuh ``gather_if``)"""
+    gathered = gather(res, matrix, gather_map, transform_op)
+    keep = pred_op(jnp.asarray(stencil)).astype(bool)
+    return jnp.where(keep[:, None], gathered, jnp.zeros_like(gathered))
+
+
+gather_inplace = gather  # (ref: gather_inplace.cuh — functional here)
+
+
+def scatter(res, matrix, scatter_map):
+    """out[map[i], :] = matrix[i, :]. (ref: matrix/scatter.cuh; map must be
+    a permutation of 0..n_rows-1, as in the reference.)"""
+    matrix = jnp.asarray(matrix)
+    scatter_map = jnp.asarray(scatter_map)
+    expects(scatter_map.shape[0] == matrix.shape[0],
+            "scatter: map length %d != n_rows %d", scatter_map.shape[0], matrix.shape[0])
+    return jnp.zeros_like(matrix).at[scatter_map, :].set(matrix)

@@ -1,0 +1,119 @@
+"""Matrix manipulation: slice, reverse, shift, diagonal, triangular, eye,
+linewise op, print.
+
+(ref: cpp/include/raft/matrix/slice.cuh, reverse.cuh, shift.cuh,
+diagonal.cuh, triangular.cuh, init.cuh (eye), linewise_op.cuh +
+matrix/detail/linewise_op.cuh (the vectorized row/col broadcast kernel),
+print.hpp.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.linalg.types import Apply
+
+
+def slice(res, matrix, x1: int, y1: int, x2: int, y2: int):  # noqa: A001
+    """Copy the [x1:x2, y1:y2) submatrix. (ref: slice.cuh ``slice`` with
+    slice_coordinates)"""
+    matrix = jnp.asarray(matrix)
+    expects(0 <= x1 < x2 <= matrix.shape[0] and 0 <= y1 < y2 <= matrix.shape[1],
+            "slice: bad coordinates")
+    return matrix[x1:x2, y1:y2]
+
+
+def reverse(res, matrix, along_rows: bool = True):
+    """Flip row order (along_rows) or column order.
+    (ref: matrix/reverse.cuh ``col_reverse``/``row_reverse``)"""
+    matrix = jnp.asarray(matrix)
+    return matrix[::-1, :] if along_rows else matrix[:, ::-1]
+
+
+col_reverse = lambda res, m: reverse(res, m, along_rows=False)  # noqa: E731
+row_reverse = lambda res, m: reverse(res, m, along_rows=True)  # noqa: E731
+
+
+def shift(res, matrix, offset: int, along_rows: bool = True, fill_value=0):
+    """Shift rows (or columns) by ``offset`` slots, filling vacated lines.
+    (ref: matrix/shift.cuh ``shift``; positive offset shifts toward higher
+    indices.)"""
+    matrix = jnp.asarray(matrix)
+    axis = 0 if along_rows else 1
+    n = matrix.shape[axis]
+    expects(abs(offset) <= n, "shift: offset %d exceeds extent %d", offset, n)
+    rolled = jnp.roll(matrix, offset, axis=axis)
+    idx = jnp.arange(n)
+    if offset >= 0:
+        vacated = idx < offset
+    else:
+        vacated = idx >= n + offset
+    mask = vacated[:, None] if along_rows else vacated[None, :]
+    return jnp.where(mask, jnp.asarray(fill_value, matrix.dtype), rolled)
+
+
+def get_diagonal(res, matrix):
+    """(ref: matrix/diagonal.cuh ``get_diagonal_vector``)"""
+    return jnp.diagonal(jnp.asarray(matrix))
+
+
+def set_diagonal(res, matrix, diag):
+    """(ref: diagonal.cuh ``set_diagonal``)"""
+    matrix = jnp.asarray(matrix)
+    n = min(matrix.shape)
+    idx = jnp.arange(n)
+    return matrix.at[idx, idx].set(jnp.asarray(diag)[:n])
+
+
+def invert_diagonal(res, matrix):
+    """(ref: diagonal.cuh ``invert_diagonal``)"""
+    matrix = jnp.asarray(matrix)
+    n = min(matrix.shape)
+    idx = jnp.arange(n)
+    return matrix.at[idx, idx].set(1.0 / matrix[idx, idx])
+
+
+def upper_triangular(res, matrix):
+    """Extract the upper triangle. (ref: matrix/triangular.cuh)"""
+    return jnp.triu(jnp.asarray(matrix))
+
+
+def lower_triangular(res, matrix):
+    return jnp.tril(jnp.asarray(matrix))
+
+
+def eye(res, n_rows: int, n_cols: Optional[int] = None, dtype=jnp.float32):
+    """Identity. (ref: matrix/init.cuh ``eye``)"""
+    return jnp.eye(n_rows, n_cols if n_cols is not None else n_rows, dtype=dtype)
+
+
+def fill(res, shape, value, dtype=jnp.float32):
+    """(ref: matrix/init.cuh ``fill``)"""
+    return jnp.full(tuple(shape), value, dtype=dtype)
+
+
+def linewise_op(res, matrix, *vecs, op: Callable,
+                apply: Apply = Apply.ALONG_ROWS):
+    """Apply op(row_or_col_element, v0[i], v1[i], ...) line-wise.
+    (ref: matrix/linewise_op.cuh — alongLines=true applies vectors along
+    each row.) ``ALONG_ROWS``: vectors have length n_cols and broadcast over
+    rows; ``ALONG_COLUMNS``: length n_rows, broadcast over columns."""
+    matrix = jnp.asarray(matrix)
+    expand = (lambda v: jnp.asarray(v)[None, :]) if apply == Apply.ALONG_ROWS \
+        else (lambda v: jnp.asarray(v)[:, None])
+    return op(matrix, *[expand(v) for v in vecs])
+
+
+def print_matrix(matrix, name: str = "", h_separator: str = " ",
+                 v_separator: str = "\n") -> str:
+    """Host-side pretty print. (ref: matrix/print.hpp)"""
+    import numpy as np
+
+    arr = np.asarray(matrix)
+    body = v_separator.join(
+        h_separator.join(f"{v}" for v in row) for row in np.atleast_2d(arr)
+    )
+    return f"{name}{v_separator}{body}" if name else body

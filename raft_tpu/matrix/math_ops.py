@@ -1,0 +1,99 @@
+"""Elementwise matrix math with reference naming.
+
+(ref: cpp/include/raft/matrix/power.cuh, sqrt.cuh, ratio.cuh,
+reciprocal.cuh, threshold.cuh, argmax.cuh, argmin.cuh, sign_flip.cuh,
+sample_rows.cuh, col_wise_sort.cuh.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import ensure_resources
+
+
+def weighted_power(res, matrix, weight=1.0):
+    """out = weight * matrix^2. (ref: matrix/power.cuh ``weighted_power``)"""
+    m = jnp.asarray(matrix)
+    return weight * m * m
+
+
+power = weighted_power  # (ref: power.cuh ``power`` — scale=1)
+
+
+def sqrt(res, matrix, weight=1.0):
+    """(ref: matrix/sqrt.cuh ``weighted_sqrt``)"""
+    return weight * jnp.sqrt(jnp.asarray(matrix))
+
+
+def ratio(res, matrix):
+    """Divide by the sum of all elements. (ref: matrix/ratio.cuh)"""
+    m = jnp.asarray(matrix)
+    return m / jnp.sum(m)
+
+
+def reciprocal(res, matrix, scalar=1.0, set_zero: bool = True, thres=1e-15):
+    """out = scalar / matrix, zeroing entries below ``thres`` magnitude.
+    (ref: matrix/reciprocal.cuh)"""
+    m = jnp.asarray(matrix)
+    small = jnp.abs(m) < thres
+    safe = jnp.where(small, jnp.ones_like(m), m)
+    out = scalar / safe
+    return jnp.where(small, jnp.zeros_like(out), out) if set_zero else out
+
+
+def zero_small_values(res, matrix, thres=1e-15):
+    """(ref: matrix/threshold.cuh ``zero_small_values``)"""
+    m = jnp.asarray(matrix)
+    return jnp.where(jnp.abs(m) < thres, jnp.zeros_like(m), m)
+
+
+def argmax(res, matrix):
+    """Per-row argmax. (ref: matrix/argmax.cuh)"""
+    return jnp.argmax(jnp.asarray(matrix), axis=1).astype(jnp.int32)
+
+
+def argmin(res, matrix):
+    """(ref: matrix/argmin.cuh)"""
+    return jnp.argmin(jnp.asarray(matrix), axis=1).astype(jnp.int32)
+
+
+def sign_flip(res, matrix):
+    """Flip the sign of each *column* so its max-|.| element is positive —
+    used to stabilize eigenvector output. (ref: matrix/sign_flip.cuh, used
+    by pca as in linalg/detail/pca.cuh)"""
+    m = jnp.asarray(matrix)
+    pivot = jnp.take_along_axis(m, jnp.argmax(jnp.abs(m), axis=0)[None, :], axis=0)
+    return m * jnp.sign(pivot)
+
+
+def sample_rows(res, matrix, n_samples: int, key=None):
+    """Random row subset without replacement.
+    (ref: matrix/sample_rows.cuh — rng + gather)"""
+    res = ensure_resources(res)
+    matrix = jnp.asarray(matrix)
+    if key is None:
+        key = res.rng.next_key()
+    idx = jax.random.choice(key, matrix.shape[0], shape=(n_samples,), replace=False)
+    return matrix[idx, :]
+
+
+def sort_cols_per_row(res, keys, values: Optional[jnp.ndarray] = None,
+                      ascending: bool = True):
+    """Sort each row's columns by key; optionally permute ``values`` along.
+    (ref: matrix/col_wise_sort.cuh ``sort_cols_per_row`` — cub segmented
+    sort; XLA's lax.sort is the TPU equivalent.) Returns sorted keys, or
+    (sorted_keys, permuted_values)."""
+    keys = jnp.asarray(keys)
+    # stable both ways: descending sorts negated keys rather than reversing
+    # (reversal would invert the relative order of equal keys)
+    order = (jnp.argsort(keys, axis=1, stable=True) if ascending
+             else jnp.argsort(-keys, axis=1, stable=True))
+    sorted_keys = jnp.take_along_axis(keys, order, axis=1)
+    if values is None:
+        return sorted_keys
+    vals = jnp.take_along_axis(jnp.asarray(values), order, axis=1)
+    return sorted_keys, vals

@@ -1,0 +1,85 @@
+"""Batched top-k selection — the flagship matrix primitive.
+
+(ref: cpp/include/raft/matrix/select_k.cuh:75 public API;
+matrix/detail/select_k-inl.cuh:38 ``choose_select_k_algorithm`` learned
+decision tree, applied at :244; radix impl matrix/detail/select_radix.cuh;
+warpsort impl matrix/detail/select_warpsort.cuh.)
+
+Semantics preserved from the reference: batched rows, optional input
+indices (defaults to 0..len-1 per row), ``select_min`` choosing smallest or
+largest, sorted output, stable on the XLA path.
+
+TPU-first algorithm space (no warp shuffles / SM histograms here):
+``XLA_TOPK`` lowers to XLA's fused sort/top-k; ``BITONIC`` / ``RADIX`` are
+Pallas kernels that stream the row in VMEM-sized blocks keeping a k-sized
+result queue (see raft_tpu/ops/select_k_pallas.py). The AUTO heuristic picks
+by (len, k) the way the reference's learned tree does by (rows, cols, k).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.matrix.select_k_types import SelectAlgo
+
+
+def choose_select_k_algorithm(n_rows: int, length: int, k: int) -> SelectAlgo:
+    """Heuristic algorithm choice. (ref: select_k-inl.cuh:38 — a learned
+    decision tree over (rows, cols, k); here a hand heuristic tuned on TPU:
+    XLA top-k is strong for small len or large k; the Pallas streaming
+    kernel wins on long rows with small k where sort bandwidth dominates.)"""
+    if k > 256 or length <= 4096:
+        return SelectAlgo.XLA_TOPK
+    return SelectAlgo.BITONIC
+
+
+def _xla_select_k(in_val, in_idx, k: int, select_min: bool):
+    vals = -in_val if select_min else in_val
+    top_v, top_pos = jax.lax.top_k(vals, k)
+    out_val = -top_v if select_min else top_v
+    out_idx = jnp.take_along_axis(in_idx, top_pos, axis=1)
+    return out_val, out_idx
+
+
+def select_k(
+    res,
+    in_val,
+    in_idx=None,
+    k: int = 1,
+    select_min: bool = True,
+    sorted: bool = True,  # noqa: A002
+    algo: SelectAlgo = SelectAlgo.AUTO,
+) -> Tuple[jax.Array, jax.Array]:
+    """Select the k smallest (or largest) entries per row.
+
+    Returns ``(out_val [batch, k], out_idx [batch, k])``.
+    (ref: matrix/select_k.cuh:75)
+    """
+    in_val = jnp.asarray(in_val)
+    expects(in_val.ndim == 2, "select_k: in_val must be [batch, len]")
+    batch, length = in_val.shape
+    expects(0 < k <= length, "select_k: k=%d out of range for len=%d", k, length)
+    if in_idx is None:
+        in_idx = jnp.broadcast_to(jnp.arange(length, dtype=jnp.int32)[None, :],
+                                  (batch, length))
+    else:
+        in_idx = jnp.asarray(in_idx)
+        expects(in_idx.shape == in_val.shape, "select_k: in_idx shape mismatch")
+
+    if algo == SelectAlgo.AUTO:
+        algo = choose_select_k_algorithm(batch, length, k)
+
+    if algo in (SelectAlgo.BITONIC, SelectAlgo.RADIX):
+        from raft_tpu.ops import select_k_pallas
+
+        try:
+            return select_k_pallas.select_k(in_val, in_idx, k, select_min,
+                                            algo=algo)
+        except NotImplementedError:
+            pass  # fall back to XLA until the kernel covers this config
+
+    return _xla_select_k(in_val, in_idx, k, select_min)

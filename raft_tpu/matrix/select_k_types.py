@@ -1,0 +1,47 @@
+"""select_k algorithm selection types.
+
+(ref: cpp/include/raft/matrix/select_k_types.hpp:28-70 ``enum SelectAlgo``:
+kAuto, kRadix8bits, kRadix11bits, kRadix11bitsExtraPass, kWarpAuto,
+kWarpImmediate, kWarpFiltered, kWarpDistributed, kWarpDistributedShm.)
+
+The TPU algorithm space is different — there are no warp shuffles or shared-
+memory histograms. The variants that exist here:
+
+- ``AUTO``          — heuristic choice (see matrix/select_k.py)
+- ``XLA_TOPK``      — ``jax.lax.top_k`` (XLA's sort-based top-k)
+- ``BITONIC``       — Pallas blockwise bitonic-queue kernel (the TPU
+                      rendering of the warpsort family, ops/select_k_pallas)
+- ``RADIX``         — Pallas multi-pass histogram filtering (the TPU
+                      rendering of radix select; VMEM histograms)
+
+The CUDA names are kept as aliases so reference-written code dispatches
+meaningfully.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class SelectAlgo(enum.Enum):
+    AUTO = "auto"
+    XLA_TOPK = "xla_topk"
+    BITONIC = "bitonic"
+    RADIX = "radix"
+
+    # reference-name aliases → nearest TPU variant
+    @classmethod
+    def from_reference_name(cls, name: str) -> "SelectAlgo":
+        name = name.lower().replace("k", "", 1) if name.startswith("k") else name.lower()
+        mapping = {
+            "auto": cls.AUTO,
+            "radix8bits": cls.RADIX,
+            "radix11bits": cls.RADIX,
+            "radix11bitsextrapass": cls.RADIX,
+            "warpauto": cls.BITONIC,
+            "warpimmediate": cls.BITONIC,
+            "warpfiltered": cls.BITONIC,
+            "warpdistributed": cls.BITONIC,
+            "warpdistributedshm": cls.BITONIC,
+        }
+        return mapping[name]

@@ -1,0 +1,9 @@
+"""raft_tpu.ops — Pallas TPU kernels for hot paths.
+
+(ref: the CUDA kernel layer of the reference — select_radix.cuh /
+select_warpsort.cuh / contractions.cuh / histogram.cuh — re-designed as
+Mosaic/Pallas kernels. Each kernel has an XLA fallback in its caller, so the
+framework is correct on any backend and fast on TPU.)
+"""
+
+from raft_tpu.ops.utils import interpret_mode

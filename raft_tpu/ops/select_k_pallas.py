@@ -1,0 +1,17 @@
+"""Pallas select_k kernels (BITONIC streaming queue, RADIX histogram).
+
+(ref: cpp/include/raft/matrix/detail/select_warpsort.cuh:752 block_kernel /
+util/bitonic_sort.cuh, and matrix/detail/select_radix.cuuh:639 radix_kernel.
+TPU re-design notes: no warp shuffles or SM atomics exist; the warpsort
+queue becomes a VMEM-resident k-sized merge queue updated per VMEM block of
+the row, and radix select becomes a multi-pass VPU histogram over bit
+slices. See SURVEY §7 stage 3 / "hard parts" (a).)
+
+Implemented in Stage I; callers fall back to XLA top_k until then.
+"""
+
+from __future__ import annotations
+
+
+def select_k(in_val, in_idx, k: int, select_min: bool, algo=None):
+    raise NotImplementedError("Pallas select_k lands in Stage I")

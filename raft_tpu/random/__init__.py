@@ -1,0 +1,31 @@
+"""raft_tpu.random — counter-based RNG + dataset generators. (ref:
+cpp/include/raft/random, SURVEY §2.9.)"""
+
+from raft_tpu.random.rng_state import RngState, GeneratorType
+from raft_tpu.random.rng import (
+    uniform,
+    uniform_int,
+    normal,
+    normal_int,
+    normal_table,
+    fill,
+    lognormal,
+    gumbel,
+    logistic,
+    exponential,
+    rayleigh,
+    laplace,
+    cauchy,
+    bernoulli,
+    scaled_bernoulli,
+    discrete,
+    permute,
+    sample_without_replacement,
+)
+from raft_tpu.random.make_blobs import make_blobs
+from raft_tpu.random.make_regression import make_regression
+from raft_tpu.random.multi_variable_gaussian import (
+    multi_variable_gaussian,
+    DecompositionMethod,
+)
+from raft_tpu.random.rmat import rmat_rectangular_gen

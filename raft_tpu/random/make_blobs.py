@@ -1,0 +1,52 @@
+"""Isotropic Gaussian blob generator.
+
+(ref: cpp/include/raft/random/make_blobs.cuh — cluster blobs with optional
+given centers, per-cluster std, shuffle; the standard fixture generator for
+clustering/knn tests and benchmarks.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng_state import _as_key
+
+
+def make_blobs(
+    res,
+    state,
+    n_samples: int,
+    n_features: int,
+    n_clusters: int = 3,
+    cluster_std=1.0,
+    centers=None,
+    center_box: Tuple[float, float] = (-10.0, 10.0),
+    shuffle: bool = True,
+    dtype=jnp.float32,
+):
+    """Returns (X [n_samples, n_features], labels [n_samples]).
+    (ref: make_blobs.cuh ``make_blobs``)"""
+    key = _as_key(state)
+    k_centers, k_labels, k_noise, k_shuffle = jax.random.split(key, 4)
+    if centers is None:
+        centers = jax.random.uniform(
+            k_centers, (n_clusters, n_features), dtype,
+            minval=center_box[0], maxval=center_box[1])
+    else:
+        centers = jnp.asarray(centers, dtype)
+        n_clusters = centers.shape[0]
+    # balanced assignment like the reference (round-robin), then shuffle
+    labels = jnp.arange(n_samples, dtype=jnp.int32) % n_clusters
+    std = jnp.asarray(cluster_std, dtype)
+    per_point_std = std[labels] if std.ndim == 1 else std
+    noise = jax.random.normal(k_noise, (n_samples, n_features), dtype)
+    X = centers[labels] + noise * (
+        per_point_std[:, None] if getattr(per_point_std, "ndim", 0) else per_point_std
+    )
+    if shuffle:
+        perm = jax.random.permutation(k_shuffle, n_samples)
+        X, labels = X[perm], labels[perm]
+    return X, labels

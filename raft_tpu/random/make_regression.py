@@ -1,0 +1,57 @@
+"""Regression dataset generator.
+
+(ref: cpp/include/raft/random/make_regression.cuh — X gaussian, a sparse
+informative coefficient vector, y = X·w + bias + noise; optionally returns
+the ground-truth coefficients.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng_state import _as_key
+
+
+def make_regression(
+    res,
+    state,
+    n_samples: int,
+    n_features: int,
+    n_informative: Optional[int] = None,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    noise: float = 0.0,
+    effective_rank: Optional[int] = None,
+    tail_strength: float = 0.5,
+    dtype=jnp.float32,
+):
+    """Returns (X, y, coef). y has shape [n_samples] when n_targets==1.
+    (ref: make_regression.cuh ``make_regression``)"""
+    if n_informative is None:
+        n_informative = n_features
+    n_informative = min(n_informative, n_features)
+    key = _as_key(state)
+    kx, kw, kn, klr = jax.random.split(key, 4)
+    X = jax.random.normal(kx, (n_samples, n_features), dtype)
+    if effective_rank is not None:
+        # low-rank covariance structure (ref: detail/make_regression low-rank
+        # path): X ← X @ (U diag(s) V^T) with exponentially decaying spectrum
+        rank = min(effective_rank, n_features)
+        i = jnp.arange(n_features, dtype=dtype)
+        s = ((1 - tail_strength) * jnp.exp(-((i / rank) ** 2))
+             + tail_strength * jnp.exp(-i / (10.0 * rank)))
+        q, _ = jnp.linalg.qr(jax.random.normal(klr, (n_features, n_features), dtype))
+        X = X @ (q * s[None, :]) @ q.T
+    w = 100.0 * jax.random.uniform(kw, (n_features, n_targets), dtype)
+    mask = (jnp.arange(n_features) < n_informative)[:, None]
+    w = jnp.where(mask, w, jnp.zeros_like(w))
+    y = X @ w + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(kn, y.shape, dtype)
+    if n_targets == 1:
+        y = y[:, 0]
+        w = w[:, 0]
+    return X, y, w

@@ -1,0 +1,46 @@
+"""Multivariate gaussian sampling.
+
+(ref: cpp/include/raft/random/multi_variable_gaussian.cuh — samples
+x ~ N(mu, Sigma) by factorizing Sigma with Cholesky (or eigendecomposition
+via Jacobi for non-PD matrices) and transforming standard normals.)
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng_state import _as_key
+
+
+class DecompositionMethod(enum.Enum):
+    """(ref: multi_variable_gaussian.cuh ``multi_variable_gaussian_decomposition_method``)"""
+
+    CHOLESKY = "cholesky"
+    JACOBI = "eig"  # eigendecomposition path
+
+
+def multi_variable_gaussian(
+    res,
+    state,
+    n_samples: int,
+    mu,
+    cov,
+    method: DecompositionMethod = DecompositionMethod.CHOLESKY,
+    dtype=jnp.float32,
+):
+    """Returns samples [n_samples, dim]. (ref: multi_variable_gaussian.cuh)"""
+    mu = jnp.asarray(mu, dtype)
+    cov = jnp.asarray(cov, dtype)
+    dim = mu.shape[0]
+    z = jax.random.normal(_as_key(state), (int(n_samples), dim), dtype)
+    if method == DecompositionMethod.CHOLESKY:
+        L = jnp.linalg.cholesky(cov)
+        samples = z @ L.T
+    else:
+        w, v = jnp.linalg.eigh(cov)
+        w = jnp.maximum(w, 0.0)
+        samples = z @ (v * jnp.sqrt(w)[None, :]).T
+    return mu[None, :] + samples

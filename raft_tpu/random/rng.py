@@ -1,0 +1,148 @@
+"""Device RNG distributions.
+
+(ref: cpp/include/raft/random/rng.cuh + random/detail/rng_impl.cuh — uniform/
+uniformInt/normal/normalInt/lognormal/gumbel/logistic/exponential/rayleigh/
+laplace/cauchy/bernoulli/scaled_bernoulli/discrete/fill;
+sample_without_replacement in random/sample_without_replacement.cuh; permute
+in random/permute.cuh.)
+
+All functions take an ``RngState`` / jax key / int seed as the stream
+argument and are pure: same state → same output (counter-based threefry
+underneath, the TPU-native generator).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.random.rng_state import RngState, _as_key
+
+
+def uniform(res, state, shape, low=0.0, high=1.0, dtype=jnp.float32):
+    """(ref: rng.cuh ``uniform``)"""
+    return jax.random.uniform(_as_key(state), tuple(shape), dtype, low, high)
+
+
+def uniform_int(res, state, shape, low, high, dtype=jnp.int32):
+    """(ref: rng.cuh ``uniformInt``; [low, high) as in the reference)"""
+    return jax.random.randint(_as_key(state), tuple(shape), low, high, dtype)
+
+
+def normal(res, state, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    """(ref: rng.cuh ``normal``)"""
+    return mu + sigma * jax.random.normal(_as_key(state), tuple(shape), dtype)
+
+
+def normal_int(res, state, shape, mu, sigma, dtype=jnp.int32):
+    """(ref: rng.cuh ``normalInt`` — rounded normal)"""
+    return jnp.round(normal(res, state, shape, mu, sigma)).astype(dtype)
+
+
+def normal_table(res, state, n_rows, mu_vec, sigma_vec=None, sigma=1.0,
+                 dtype=jnp.float32):
+    """Each column j ~ N(mu_vec[j], sigma_vec[j]). (ref: rng.cuh
+    ``normalTable``)"""
+    mu_vec = jnp.asarray(mu_vec)
+    n_cols = mu_vec.shape[0]
+    z = jax.random.normal(_as_key(state), (int(n_rows), int(n_cols)), dtype)
+    s = jnp.asarray(sigma_vec)[None, :] if sigma_vec is not None else sigma
+    return mu_vec[None, :] + z * s
+
+
+def fill(res, state, shape, value, dtype=jnp.float32):
+    """(ref: rng.cuh ``fill``)"""
+    return jnp.full(tuple(shape), value, dtype=dtype)
+
+
+def lognormal(res, state, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    """(ref: rng.cuh ``lognormal``)"""
+    return jnp.exp(normal(res, state, shape, mu, sigma, dtype))
+
+
+def gumbel(res, state, shape, mu=0.0, beta=1.0, dtype=jnp.float32):
+    """(ref: rng.cuh ``gumbel``)"""
+    return mu + beta * jax.random.gumbel(_as_key(state), tuple(shape), dtype)
+
+
+def logistic(res, state, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    """(ref: rng.cuh ``logistic``)"""
+    return mu + scale * jax.random.logistic(_as_key(state), tuple(shape), dtype)
+
+
+def exponential(res, state, shape, lambda_=1.0, dtype=jnp.float32):
+    """(ref: rng.cuh ``exponential``; rate parameterization)"""
+    return jax.random.exponential(_as_key(state), tuple(shape), dtype) / lambda_
+
+
+def rayleigh(res, state, shape, sigma=1.0, dtype=jnp.float32):
+    """(ref: rng.cuh ``rayleigh``)"""
+    u = jax.random.uniform(_as_key(state), tuple(shape), dtype,
+                           minval=jnp.finfo(dtype).tiny, maxval=1.0)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def laplace(res, state, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    """(ref: rng.cuh ``laplace``)"""
+    return mu + scale * jax.random.laplace(_as_key(state), tuple(shape), dtype)
+
+
+def cauchy(res, state, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    """(ref: rng.cuh ``cauchy``)"""
+    return mu + scale * jax.random.cauchy(_as_key(state), tuple(shape), dtype)
+
+
+def bernoulli(res, state, shape, prob=0.5):
+    """(ref: rng.cuh ``bernoulli``)"""
+    return jax.random.bernoulli(_as_key(state), prob, tuple(shape))
+
+
+def scaled_bernoulli(res, state, shape, prob=0.5, scale=1.0, dtype=jnp.float32):
+    """Draws in {-scale, +scale} with P(-scale) = prob, matching the
+    reference (detail/rng_device.cuh: ``res < prob ? -scale : scale``)."""
+    b = jax.random.bernoulli(_as_key(state), prob, tuple(shape))
+    return jnp.where(b, jnp.asarray(-scale, dtype), jnp.asarray(scale, dtype))
+
+
+def discrete(res, state, shape, weights, dtype=jnp.int32):
+    """Categorical sampling by unnormalized weights.
+    (ref: rng.cuh ``discrete``)"""
+    weights = jnp.asarray(weights, jnp.float32)
+    logits = jnp.log(jnp.where(weights > 0, weights, jnp.finfo(jnp.float32).tiny))
+    return jax.random.categorical(_as_key(state), logits, shape=tuple(shape)).astype(dtype)
+
+
+def permute(res, state, matrix=None, n: Optional[int] = None):
+    """Random row permutation. Returns (perm, permuted_matrix|None).
+    (ref: random/permute.cuh ``permute`` — outputs the permutation vector
+    and optionally the row-shuffled matrix.)"""
+    expects(matrix is not None or n is not None, "permute: need matrix or n")
+    if matrix is not None:
+        matrix = jnp.asarray(matrix)
+        n = matrix.shape[0]
+    perm = jax.random.permutation(_as_key(state), n)
+    out = matrix[perm, :] if matrix is not None else None
+    return perm.astype(jnp.int32), out
+
+
+def sample_without_replacement(res, state, population: int, n_samples: int,
+                               weights=None, dtype=jnp.int32):
+    """Weighted sampling without replacement via Gumbel top-k (the
+    TPU-idiomatic one-shot algorithm; the reference does a device-side
+    weighted reservoir — random/sample_without_replacement.cuh).
+    Returns sampled indices."""
+    expects(n_samples <= population,
+            "sample_without_replacement: n_samples %d > population %d",
+            n_samples, population)
+    key = _as_key(state)
+    if weights is None:
+        return jax.random.choice(key, population, shape=(n_samples,),
+                                 replace=False).astype(dtype)
+    w = jnp.asarray(weights, jnp.float32)
+    logits = jnp.log(jnp.where(w > 0, w, jnp.finfo(jnp.float32).tiny))
+    g = logits + jax.random.gumbel(key, (population,))
+    _, idx = jax.lax.top_k(g, n_samples)
+    return idx.astype(dtype)

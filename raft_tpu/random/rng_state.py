@@ -1,0 +1,69 @@
+"""RNG state vocabulary.
+
+(ref: cpp/include/raft/random/rng_state.hpp:19-40 — ``GeneratorType{GenPhilox,
+GenPC}`` (default PCG) and ``RngState{seed, base_subsequence, type}``; device
+generators in random/detail/rng_device.cuh:426,536; PCG reference impl in
+thirdparty/pcg/pcg_basic.c.)
+
+TPU-native mapping (SURVEY §2.9): counter-based threefry is JAX's native
+generator, the exact analog of Philox on CUDA — ``RngState`` becomes a seed +
+subsequence folded into a ``jax.random`` key. THREEFRY is the default and
+the high-throughput choice on TPU; PCG32 is also provided (host-side, via
+the C++ hostops library when built, else a pure-python fallback) for
+reference-compatible stream semantics where bit-level reproducibility
+against PCG matters.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+
+
+class GeneratorType(enum.Enum):
+    """(ref: rng_state.hpp:19 ``GeneratorType``)"""
+
+    THREEFRY = "threefry"  # TPU-native default (counter-based, like Philox)
+    PHILOX = "threefry"    # alias: JAX's counter-based PRNG plays this role
+    PCG = "pcg"            # host-side PCG32 stream (bit-compatible layout)
+
+
+class RngState:
+    """(ref: rng_state.hpp:29 ``RngState{seed, base_subsequence, type}``)"""
+
+    def __init__(self, seed: int = 0, base_subsequence: int = 0,
+                 type: GeneratorType = GeneratorType.THREEFRY):  # noqa: A002
+        self.seed = int(seed)
+        self.base_subsequence = int(base_subsequence)
+        self.type = type
+
+    def key(self) -> jax.Array:
+        """The jax PRNG key for this state (seed ⊕ subsequence via fold_in)."""
+        k = jax.random.key(self.seed)
+        if self.base_subsequence:
+            k = jax.random.fold_in(k, self.base_subsequence)
+        return k
+
+    def advance(self, n_subsequences: int = 1) -> "RngState":
+        """Advance the stream. (ref: rng_state.hpp ``advance``)"""
+        self.base_subsequence += int(n_subsequences)
+        return self
+
+    def split(self) -> "RngState":
+        """A fresh state on an independent subsequence (functional helper)."""
+        self.advance()
+        return RngState(self.seed, self.base_subsequence, self.type)
+
+    def __repr__(self):
+        return (f"RngState(seed={self.seed}, "
+                f"base_subsequence={self.base_subsequence}, type={self.type.name})")
+
+
+def _as_key(state_or_key):
+    """Accept RngState, a jax key, or an int seed."""
+    if isinstance(state_or_key, RngState):
+        return state_or_key.key()
+    if isinstance(state_or_key, int):
+        return jax.random.key(state_or_key)
+    return state_or_key

@@ -1,0 +1,144 @@
+"""linalg map/eltwise/matvec/reduce_by_key/blas/transpose tests.
+(mirrors cpp/tests/linalg/{map,add,subtract,multiply,divide,power,sqrt,
+eltwise,matrix_vector_op,matrix_vector,reduce_rows_by_key,
+reduce_cols_by_key,gemm_layout,gemv,axpy,dot,transpose}.cu)"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+from raft_tpu.linalg import Apply
+
+rng = np.random.default_rng(7)
+
+
+def test_map_variants(res):
+    a = rng.normal(size=(4, 5)).astype(np.float32)
+    b = rng.normal(size=(4, 5)).astype(np.float32)
+    c = rng.normal(size=(4, 5)).astype(np.float32)
+    np.testing.assert_allclose(linalg.map(res, lambda x, y: x + y, a, b), a + b, rtol=1e-6)
+    np.testing.assert_allclose(linalg.unary_op(res, a, lambda x: x * 2), a * 2, rtol=1e-6)
+    np.testing.assert_allclose(linalg.binary_op(res, a, b, lambda x, y: x * y), a * b, rtol=1e-6)
+    np.testing.assert_allclose(
+        linalg.ternary_op(res, a, b, c, lambda x, y, z: x + y * z), a + b * c, rtol=1e-6
+    )
+
+
+def test_map_offset(res):
+    out = linalg.map_offset(res, (3, 4), lambda i, x: x + i.astype(np.float32),
+                            np.zeros((3, 4), np.float32))
+    np.testing.assert_array_equal(out, np.arange(12).reshape(3, 4))
+
+
+def test_write_only_unary_op(res):
+    out = linalg.write_only_unary_op(res, (2, 3), jnp.float32, lambda i: i * 2)
+    np.testing.assert_array_equal(out, np.arange(6).reshape(2, 3) * 2)
+
+
+def test_eltwise(res):
+    a = rng.normal(size=10).astype(np.float32)
+    b = rng.normal(size=10).astype(np.float32) + 2.0
+    np.testing.assert_allclose(linalg.add(res, a, b), a + b, rtol=1e-6)
+    np.testing.assert_allclose(linalg.subtract(res, a, b), a - b, rtol=1e-6)
+    np.testing.assert_allclose(linalg.multiply(res, a, b), a * b, rtol=1e-6)
+    np.testing.assert_allclose(linalg.divide(res, a, b), a / b, rtol=1e-6)
+    np.testing.assert_allclose(linalg.add_scalar(res, a, 3.0), a + 3, rtol=1e-6)
+    np.testing.assert_allclose(linalg.sqrt(res, np.abs(a)), np.sqrt(np.abs(a)), rtol=1e-6)
+    np.testing.assert_allclose(
+        linalg.power_scalar(res, np.abs(a), 2.0), np.abs(a) ** 2, rtol=1e-5
+    )
+
+
+def test_eltwise_divide_check_zero(res):
+    a = np.array([1.0, 2.0, 3.0], np.float32)
+    b = np.array([2.0, 0.0, 4.0], np.float32)
+    out = np.asarray(linalg.eltwise_divide_check_zero(res, a, b))
+    np.testing.assert_allclose(out, [0.5, 0.0, 0.75], rtol=1e-6)
+
+
+def test_matrix_vector_op(res):
+    m = rng.normal(size=(4, 6)).astype(np.float32)
+    vr = rng.normal(size=6).astype(np.float32)
+    vc = rng.normal(size=4).astype(np.float32)
+    np.testing.assert_allclose(
+        linalg.matrix_vector_op(res, m, vr, lambda a, b: a + b, Apply.ALONG_ROWS),
+        m + vr[None, :], rtol=1e-6)
+    np.testing.assert_allclose(
+        linalg.matrix_vector_op(res, m, vc, lambda a, b: a * b, Apply.ALONG_COLUMNS),
+        m * vc[:, None], rtol=1e-6)
+    np.testing.assert_allclose(linalg.binary_add(res, m, vr), m + vr[None, :], rtol=1e-6)
+    np.testing.assert_allclose(linalg.binary_sub(res, m, vr), m - vr[None, :], rtol=1e-6)
+
+
+def test_matrix_vector_skip_zero(res):
+    m = np.ones((2, 3), np.float32)
+    v = np.array([2.0, 0.0, 4.0], np.float32)
+    np.testing.assert_allclose(linalg.binary_mult_skip_zero(res, m, v),
+                               [[2, 1, 4], [2, 1, 4]])
+    np.testing.assert_allclose(linalg.binary_div_skip_zero(res, m, v),
+                               [[0.5, 1, 0.25], [0.5, 1, 0.25]])
+    np.testing.assert_allclose(
+        linalg.binary_div_skip_zero(res, m, v, return_zero=True),
+        [[0.5, 0, 0.25], [0.5, 0, 0.25]])
+
+
+def test_reduce_rows_by_key(res):
+    m = rng.normal(size=(6, 3)).astype(np.float32)
+    keys = np.array([0, 1, 0, 2, 1, 0])
+    out = np.asarray(linalg.reduce_rows_by_key(res, m, keys, 3))
+    expected = np.stack([m[keys == k].sum(axis=0) for k in range(3)])
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+    # weighted
+    w = np.array([1, 2, 1, 0.5, 1, 3], np.float32)
+    out_w = np.asarray(linalg.reduce_rows_by_key(res, m, keys, 3, weights=w))
+    expected_w = np.stack([(m * w[:, None])[keys == k].sum(axis=0) for k in range(3)])
+    np.testing.assert_allclose(out_w, expected_w, rtol=1e-5)
+
+
+def test_reduce_cols_by_key(res):
+    m = rng.normal(size=(3, 5)).astype(np.float32)
+    keys = np.array([0, 1, 1, 0, 2])
+    out = np.asarray(linalg.reduce_cols_by_key(res, m, keys, 3))
+    expected = np.stack([m[:, keys == k].sum(axis=1) for k in range(3)], axis=1)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_gemm_variants(res):
+    A = rng.normal(size=(4, 3)).astype(np.float32)
+    B = rng.normal(size=(3, 5)).astype(np.float32)
+    C = rng.normal(size=(4, 5)).astype(np.float32)
+    np.testing.assert_allclose(linalg.gemm(res, A, B), A @ B, rtol=1e-5)
+    np.testing.assert_allclose(
+        linalg.gemm(res, A.T, B, trans_a=True), A @ B, rtol=1e-5)
+    np.testing.assert_allclose(
+        linalg.gemm(res, A, B.T, trans_b=True), A @ B, rtol=1e-5)
+    np.testing.assert_allclose(
+        linalg.gemm(res, A, B, C=C, alpha=2.0, beta=0.5), 2 * A @ B + 0.5 * C,
+        rtol=1e-5)
+
+
+def test_gemm_bf16_accumulates_f32(res):
+    A = jnp.ones((128, 128), jnp.bfloat16) * 0.1
+    B = jnp.ones((128, 128), jnp.bfloat16)
+    out = linalg.gemm(res, A, B)
+    assert out.dtype == jnp.bfloat16
+    # 128 * 0.1 = 12.8; bf16 accumulation would drift much further than f32
+    np.testing.assert_allclose(np.asarray(out, np.float32), 12.8, rtol=2e-2)
+
+
+def test_gemv_axpy_dot(res):
+    A = rng.normal(size=(4, 3)).astype(np.float32)
+    x = rng.normal(size=3).astype(np.float32)
+    y = rng.normal(size=4).astype(np.float32)
+    np.testing.assert_allclose(linalg.gemv(res, A, x), A @ x, rtol=1e-5)
+    np.testing.assert_allclose(
+        linalg.gemv(res, A, y, trans_a=True)[: 3], A.T @ y, rtol=1e-5)
+    np.testing.assert_allclose(linalg.axpy(res, 2.0, x, x), 3 * x, rtol=1e-6)
+    np.testing.assert_allclose(linalg.dot(res, x, x), x @ x, rtol=1e-5)
+
+
+def test_transpose_and_range(res):
+    A = rng.normal(size=(3, 4)).astype(np.float32)
+    np.testing.assert_array_equal(linalg.transpose(res, A), A.T)
+    np.testing.assert_array_equal(linalg.range_fill(res, 2, 7), np.arange(2, 7))

@@ -1,0 +1,138 @@
+"""Reduction / norm / normalize / mse tests.
+(mirrors cpp/tests/linalg/{reduce,coalesced_reduction,strided_reduction,
+norm,normalize,map_then_reduce,mean_squared_error}.cu — parameterized
+tolerance-compare vs host reference, same strategy.)"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+from raft_tpu.core import operators as ops
+from raft_tpu.linalg import Apply, NormType
+
+rng = np.random.default_rng(11)
+
+
+@pytest.mark.parametrize("shape", [(8, 32), (33, 17), (1, 5), (64, 1)])
+@pytest.mark.parametrize("apply", [Apply.ALONG_ROWS, Apply.ALONG_COLUMNS])
+def test_reduce_sum(res, shape, apply):
+    data = rng.normal(size=shape).astype(np.float32)
+    out = np.asarray(linalg.reduce(res, data, apply))
+    # reference convention: ALONG_ROWS -> one value per row
+    expected = data.sum(axis=1 if apply == Apply.ALONG_ROWS else 0)
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_reduce_with_ops(res):
+    data = rng.normal(size=(5, 7)).astype(np.float32)
+    # sum of squares with sqrt finalization = L2 norm per row
+    out = np.asarray(
+        linalg.reduce(res, data, Apply.ALONG_ROWS,
+                      main_op=lambda x, _: x * x, final_op=ops.sqrt_op)
+    )
+    np.testing.assert_allclose(out, np.linalg.norm(data, axis=1), rtol=1e-5)
+    # min reduction
+    out_min = np.asarray(
+        linalg.reduce(res, data, Apply.ALONG_COLUMNS, init=np.inf,
+                      reduce_op=ops.min_op)
+    )
+    np.testing.assert_allclose(out_min, data.min(axis=0), rtol=1e-6)
+
+
+def test_reduce_main_op_uses_column_index(res):
+    data = np.ones((3, 4), np.float32)
+    out = np.asarray(
+        linalg.reduce(res, data, Apply.ALONG_ROWS,
+                      main_op=lambda x, j: x * j.astype(np.float32))
+    )
+    np.testing.assert_allclose(out, np.full(3, 0 + 1 + 2 + 3, np.float32))
+
+
+def test_reduce_inplace_accumulate(res):
+    data = np.ones((2, 3), np.float32)
+    prev = np.array([10.0, 20.0], np.float32)
+    out = np.asarray(linalg.reduce(res, data, Apply.ALONG_ROWS,
+                                   inplace_target=prev))
+    np.testing.assert_allclose(out, [13.0, 23.0])
+
+
+def test_reduce_inplace_final_op_ordering(res):
+    # reference ordering: final_op(reduce_op(dots, acc))
+    data = np.full((2, 3), 4.0, np.float32)
+    prev = np.array([9.0, 9.0], np.float32)
+    out = np.asarray(linalg.reduce(res, data, Apply.ALONG_ROWS,
+                                   final_op=ops.sqrt_op, inplace_target=prev))
+    np.testing.assert_allclose(out, np.sqrt([21.0, 21.0]), rtol=1e-6)
+
+
+def test_reduce_1d_vector(res):
+    v = rng.normal(size=17).astype(np.float32)
+    np.testing.assert_allclose(float(linalg.reduce(res, v)), v.sum(), rtol=1e-5)
+
+
+def test_coalesced_and_strided(res):
+    data = rng.normal(size=(6, 9)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(linalg.coalesced_reduction(res, data)), data.sum(axis=1),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(linalg.strided_reduction(res, data)), data.sum(axis=0),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_accumulates_wider(res):
+    data = jnp.full((1, 4096), 0.01, jnp.bfloat16)
+    out = linalg.coalesced_reduction(res, data)
+    # naive bf16 accumulation collapses badly; widened accumulation holds
+    np.testing.assert_allclose(np.asarray(out, np.float32), 40.96, rtol=0.05)
+
+
+def test_map_then_reduce(res):
+    a = rng.normal(size=(4, 4)).astype(np.float32)
+    out = linalg.map_then_reduce(res, a, map_op=ops.sq_op)
+    np.testing.assert_allclose(float(out), (a * a).sum(), rtol=1e-5)
+    # custom reduce: max of abs
+    out2 = linalg.map_reduce(res, a, map_op=ops.abs_op, reduce_op=ops.max_op,
+                             init=0.0)
+    np.testing.assert_allclose(float(out2), np.abs(a).max(), rtol=1e-6)
+
+
+def test_mean_squared_error(res):
+    a = rng.normal(size=100).astype(np.float32)
+    b = rng.normal(size=100).astype(np.float32)
+    np.testing.assert_allclose(
+        float(linalg.mean_squared_error(res, a, b, weight=2.0)),
+        2 * np.mean((a - b) ** 2), rtol=1e-5)
+
+
+@pytest.mark.parametrize("norm_type,expected_fn", [
+    (NormType.L1, lambda d, ax: np.abs(d).sum(axis=ax)),
+    (NormType.L2, lambda d, ax: (d * d).sum(axis=ax)),
+    (NormType.LINF, lambda d, ax: np.abs(d).max(axis=ax)),
+])
+def test_norms(res, norm_type, expected_fn):
+    data = rng.normal(size=(7, 5)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(linalg.row_norm(res, data, norm_type)),
+        expected_fn(data, 1), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(linalg.col_norm(res, data, norm_type)),
+        expected_fn(data, 0), rtol=1e-4)
+
+
+def test_l2_final_sqrt(res):
+    data = rng.normal(size=(4, 6)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(linalg.row_norm(res, data, NormType.L2, final_sqrt=True)),
+        np.linalg.norm(data, axis=1), rtol=1e-5)
+
+
+def test_normalize(res):
+    data = rng.normal(size=(5, 8)).astype(np.float32)
+    out = np.asarray(linalg.normalize(res, data))
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), np.ones(5), rtol=1e-5)
+    # zero row stays zero
+    data[2] = 0
+    out = np.asarray(linalg.normalize(res, data))
+    np.testing.assert_array_equal(out[2], np.zeros(8))

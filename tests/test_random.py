@@ -1,0 +1,179 @@
+"""Random generation tests.
+(mirrors cpp/tests/random/{rng,rng_int,rng_discrete,sample_without_replacement,
+permute,make_blobs,make_regression,multi_variable_gaussian,
+rmat_rectangular_generator}.cu — distribution moment checks vs analytical
+values, same strategy as the reference's statistical asserts.)"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import random as rnd
+from raft_tpu.random import GeneratorType, RngState
+
+N = 20000
+
+
+def state(seed=123):
+    return RngState(seed)
+
+
+def test_rng_state_reproducible(res):
+    a = rnd.uniform(res, state(), (100,))
+    b = rnd.uniform(res, state(), (100,))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = rnd.uniform(res, state().advance(), (100,))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_uniform_moments(res):
+    x = np.asarray(rnd.uniform(res, state(), (N,), low=2.0, high=4.0))
+    assert x.min() >= 2.0 and x.max() < 4.0
+    assert x.mean() == pytest.approx(3.0, abs=0.05)
+
+
+def test_uniform_int_range(res):
+    x = np.asarray(rnd.uniform_int(res, state(), (N,), 5, 15))
+    assert x.min() == 5 and x.max() == 14
+    assert x.mean() == pytest.approx(9.5, abs=0.2)
+
+
+def test_normal_moments(res):
+    x = np.asarray(rnd.normal(res, state(), (N,), mu=1.5, sigma=2.0))
+    assert x.mean() == pytest.approx(1.5, abs=0.06)
+    assert x.std() == pytest.approx(2.0, abs=0.06)
+
+
+def test_normal_table(res):
+    mu = np.array([0.0, 10.0, -5.0], np.float32)
+    sig = np.array([1.0, 0.5, 2.0], np.float32)
+    x = np.asarray(rnd.normal_table(res, state(), N, mu, sig))
+    np.testing.assert_allclose(x.mean(axis=0), mu, atol=0.12)
+    np.testing.assert_allclose(x.std(axis=0), sig, atol=0.12)
+
+
+def test_lognormal(res):
+    x = np.asarray(rnd.lognormal(res, state(), (N,), mu=0.0, sigma=0.5))
+    assert np.log(x).mean() == pytest.approx(0.0, abs=0.03)
+
+
+def test_gumbel_logistic_laplace_cauchy(res):
+    g = np.asarray(rnd.gumbel(res, state(1), (N,), mu=1.0, beta=2.0))
+    assert np.median(g) == pytest.approx(1.0 - 2.0 * np.log(np.log(2)), abs=0.15)
+    lo = np.asarray(rnd.logistic(res, state(2), (N,), mu=3.0, scale=1.0))
+    assert np.median(lo) == pytest.approx(3.0, abs=0.15)
+    la = np.asarray(rnd.laplace(res, state(3), (N,), mu=-1.0, scale=1.0))
+    assert np.median(la) == pytest.approx(-1.0, abs=0.1)
+    ca = np.asarray(rnd.cauchy(res, state(4), (N,), mu=2.0, scale=1.0))
+    assert np.median(ca) == pytest.approx(2.0, abs=0.15)
+
+
+def test_exponential_rayleigh(res):
+    e = np.asarray(rnd.exponential(res, state(5), (N,), lambda_=2.0))
+    assert e.mean() == pytest.approx(0.5, abs=0.03)
+    r = np.asarray(rnd.rayleigh(res, state(6), (N,), sigma=1.0))
+    assert r.mean() == pytest.approx(np.sqrt(np.pi / 2), abs=0.05)
+
+
+def test_bernoulli(res):
+    b = np.asarray(rnd.bernoulli(res, state(7), (N,), prob=0.3))
+    assert b.mean() == pytest.approx(0.3, abs=0.02)
+    sb = np.asarray(rnd.scaled_bernoulli(res, state(8), (N,), prob=0.5, scale=2.0))
+    assert set(np.unique(sb)) == {-2.0, 2.0}
+    # reference sign convention: P(-scale) = prob (rng_device.cuh)
+    sb9 = np.asarray(rnd.scaled_bernoulli(res, state(8), (N,), prob=0.9, scale=1.0))
+    assert (sb9 < 0).mean() == pytest.approx(0.9, abs=0.02)
+
+
+def test_discrete(res):
+    w = np.array([1.0, 0.0, 3.0], np.float32)
+    d = np.asarray(rnd.discrete(res, state(9), (N,), w))
+    counts = np.bincount(d, minlength=3) / N
+    assert counts[1] == 0.0
+    assert counts[2] == pytest.approx(0.75, abs=0.02)
+
+
+def test_fill(res):
+    np.testing.assert_array_equal(
+        np.asarray(rnd.fill(res, state(), (5,), 3.0)), np.full(5, 3.0))
+
+
+def test_permute(res):
+    m = np.arange(50, dtype=np.float32).reshape(10, 5)
+    perm, shuffled = rnd.permute(res, state(10), m)
+    assert sorted(np.asarray(perm).tolist()) == list(range(10))
+    np.testing.assert_array_equal(np.asarray(shuffled), m[np.asarray(perm)])
+
+
+def test_sample_without_replacement(res):
+    idx = np.asarray(rnd.sample_without_replacement(res, state(11), 100, 20))
+    assert len(np.unique(idx)) == 20
+    assert idx.min() >= 0 and idx.max() < 100
+    # weighted: heavy item must always appear
+    w = np.ones(50, np.float32)
+    w[7] = 1e6
+    idx_w = np.asarray(rnd.sample_without_replacement(res, state(12), 50, 5, weights=w))
+    assert 7 in idx_w
+    assert len(np.unique(idx_w)) == 5
+
+
+def test_make_blobs(res):
+    X, y = rnd.make_blobs(res, state(13), 300, 4, n_clusters=3, cluster_std=0.3)
+    assert X.shape == (300, 4) and y.shape == (300,)
+    X, y = np.asarray(X), np.asarray(y)
+    assert set(np.unique(y)) == {0, 1, 2}
+    # within-cluster scatter far below between-cluster distance
+    centers = np.stack([X[y == k].mean(axis=0) for k in range(3)])
+    within = max(X[y == k].std() for k in range(3))
+    between = np.linalg.norm(centers[0] - centers[1])
+    assert within < between
+
+
+def test_make_blobs_given_centers(res):
+    centers = np.array([[0.0, 0.0], [100.0, 100.0]], np.float32)
+    X, y = rnd.make_blobs(res, state(14), 100, 2, centers=centers, cluster_std=0.1)
+    X, y = np.asarray(X), np.asarray(y)
+    np.testing.assert_allclose(X[y == 1].mean(axis=0), [100, 100], atol=0.2)
+
+
+def test_make_regression(res):
+    X, y, w = rnd.make_regression(res, state(15), 500, 10, n_informative=4,
+                                  noise=0.0)
+    X, y, w = np.asarray(X), np.asarray(y), np.asarray(w)
+    assert (w[4:] == 0).all() and (w[:4] != 0).all()
+    np.testing.assert_allclose(y, X @ w, rtol=1e-3, atol=1e-2)
+
+
+def test_make_regression_low_rank(res):
+    X, y, w = rnd.make_regression(res, state(16), 200, 20, effective_rank=3,
+                                  tail_strength=0.01)
+    s = np.linalg.svd(np.asarray(X), compute_uv=False)
+    # spectrum decays: tail energy is small relative to head
+    assert s[10:].sum() < 0.2 * s[:3].sum()
+
+
+def test_multi_variable_gaussian(res):
+    mu = np.array([1.0, -2.0], np.float32)
+    cov = np.array([[2.0, 0.8], [0.8, 1.0]], np.float32)
+    for method in rnd.DecompositionMethod:
+        x = np.asarray(rnd.multi_variable_gaussian(res, state(17), N, mu, cov,
+                                                   method=method))
+        np.testing.assert_allclose(x.mean(axis=0), mu, atol=0.06)
+        np.testing.assert_allclose(np.cov(x.T), cov, atol=0.12)
+
+
+def test_rmat(res):
+    src, dst = rnd.rmat_rectangular_gen(res, state(18), 10000, r_scale=8,
+                                        c_scale=6, a=0.6, b=0.15, c=0.15)
+    src, dst = np.asarray(src), np.asarray(dst)
+    assert src.min() >= 0 and src.max() < 2**8
+    assert dst.min() >= 0 and dst.max() < 2**6
+    # skew: with a=0.6 the low half of the row space is over-represented
+    assert (src < 2**7).mean() > 0.6
+
+
+def test_rmat_per_level_theta(res):
+    # force quadrant 0 at every level → all edges are (0, 0)
+    theta = np.tile(np.array([1.0, 0.0, 0.0, 0.0], np.float32), (8, 1)).ravel()
+    src, dst = rnd.rmat_rectangular_gen(res, state(19), 100, 8, 8, theta=theta)
+    assert np.asarray(src).max() == 0 and np.asarray(dst).max() == 0
