@@ -1,0 +1,7 @@
+"""raft_tpu.label — label compaction / merging. (ref:
+cpp/include/raft/label, SURVEY §2.8.)"""
+
+from raft_tpu.label.classlabels import get_unique_labels, make_monotonic
+from raft_tpu.label.merge_labels import merge_labels
+
+__all__ = ["get_unique_labels", "make_monotonic", "merge_labels"]

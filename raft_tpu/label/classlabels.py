@@ -1,0 +1,36 @@
+"""Label compaction utilities.
+
+(ref: cpp/include/raft/label/classlabels.cuh:31 ``getUniquelabels``,
+:81,104 ``make_monotonic`` — map arbitrary labels onto 0..n_classes-1;
+used to canonicalize cluster/component ids.)
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_unique_labels(res, labels) -> jax.Array:
+    """Sorted unique labels. (ref: classlabels.cuh:31 ``getUniquelabels``;
+    output size is data-dependent → host step, as the reference allocates
+    after a count pass.)"""
+    return jnp.asarray(np.unique(np.asarray(labels)))
+
+
+def make_monotonic(res, labels, classes=None, zero_based: bool = True
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Remap labels onto a dense 0..k-1 (or 1..k) range, keeping order.
+    Returns (monotonic_labels, classes). (ref: classlabels.cuh:81,104)"""
+    labels = jnp.asarray(labels)
+    if classes is None:
+        classes = get_unique_labels(res, labels)
+    # searchsorted requires sorted classes; caller-supplied arrays may not be
+    classes = jnp.sort(jnp.asarray(classes))
+    mono = jnp.searchsorted(classes, labels).astype(jnp.int32)
+    if not zero_based:
+        mono = mono + 1
+    return mono, classes

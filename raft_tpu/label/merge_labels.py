@@ -1,0 +1,38 @@
+"""Merge two labelings via min-equivalence iteration.
+
+(ref: cpp/include/raft/label/merge_labels.cuh ``merge_labels`` — given two
+labelings of the same points (e.g. connected components from two partial
+views), iterate label[i] ← min over equivalence classes until fixpoint —
+the building block for distributed connected components.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def merge_labels(res, labels_a, labels_b, max_iters: int = 100) -> jax.Array:
+    """Return the labeling of the finest common coarsening (each output
+    label = min label over the connected equivalence classes induced by
+    'same label in a' ∪ 'same label in b'). Labels must be in 0..n-1."""
+    a = jnp.asarray(labels_a, jnp.int32)
+    b = jnp.asarray(labels_b, jnp.int32)
+    n = a.shape[0]
+    out = jnp.minimum(a, b)
+
+    def body(state):
+        out, _, it = state
+        # propagate min through both partitions
+        min_a = jax.ops.segment_min(out, a, num_segments=n)
+        out1 = jnp.minimum(out, min_a[a])
+        min_b = jax.ops.segment_min(out1, b, num_segments=n)
+        out2 = jnp.minimum(out1, min_b[b])
+        return out2, jnp.any(out2 != out), it + 1
+
+    def cond(state):
+        return state[1] & (state[2] < max_iters)
+
+    out, _, _ = jax.lax.while_loop(
+        cond, body, (out, jnp.asarray(True), jnp.asarray(0, jnp.int32)))
+    return out

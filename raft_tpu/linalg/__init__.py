@@ -40,3 +40,41 @@ from raft_tpu.linalg.reduce_by_key import reduce_rows_by_key, reduce_cols_by_key
 from raft_tpu.linalg.blas import gemm, gemv, axpy, dot
 from raft_tpu.linalg.transpose import transpose, transpose_inplace
 from raft_tpu.linalg.init import range_fill
+from raft_tpu.linalg.qr import qr_get_q, qr_get_qr
+from raft_tpu.linalg.eig import eig_dc, eig_dc_selective, eig_jacobi
+from raft_tpu.linalg.svd import (
+    svd_qr,
+    svd_qr_transpose_right_vec,
+    svd_eig,
+    svd_jacobi,
+    svd_reconstruction,
+    evaluate_svd_by_percentage,
+)
+from raft_tpu.linalg.rsvd import (
+    randomized_svd,
+    rsvd_fixed_rank,
+    rsvd_fixed_rank_symmetric,
+    rsvd_perc,
+)
+from raft_tpu.linalg.lstsq import (
+    lstsq_svd_qr,
+    lstsq_svd_jacobi,
+    lstsq_eig,
+    lstsq_qr,
+)
+from raft_tpu.linalg.cholesky import cholesky_r1_update
+from raft_tpu.linalg.pca import (
+    ParamsPCA,
+    PCAModel,
+    Solver,
+    pca_fit,
+    pca_transform,
+    pca_inverse_transform,
+)
+from raft_tpu.linalg.tsvd import (
+    ParamsTSVD,
+    TSVDModel,
+    tsvd_fit,
+    tsvd_transform,
+    tsvd_inverse_transform,
+)

@@ -1,0 +1,41 @@
+"""Cholesky rank-1 expansion update.
+
+(ref: cpp/include/raft/linalg/cholesky_r1_update.cuh — given the Cholesky
+factor L of the leading (k−1)×(k−1) block of A and A's k-th column, compute
+the k-th row/column of L without refactorizing; used by incremental
+algorithms that grow a kernel/covariance matrix one column at a time.)
+
+Functional TPU rendering: ``cholesky_r1_update(L_prev, a_col)`` returns the
+expanded k×k lower factor. The triangular solve is XLA's blocked
+``solve_triangular``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular
+
+from raft_tpu.core.error import expects
+
+
+def cholesky_r1_update(res, L_prev, a_col, eps: float = 0.0):
+    """Expand an existing factor by one row/column.
+
+    L_prev: [k-1, k-1] lower-triangular factor of A[:k-1, :k-1]
+    a_col:  [k] — the new column A[:k, k-1] (last entry is the diagonal)
+    Returns L: [k, k]. (ref: cholesky_r1_update.cuh)
+    """
+    a_col = jnp.asarray(a_col)
+    k = a_col.shape[0]
+    if k == 1:
+        return jnp.sqrt(jnp.maximum(a_col, eps)).reshape(1, 1)
+    L_prev = jnp.asarray(L_prev)
+    expects(L_prev.shape == (k - 1, k - 1), "cholesky_r1_update: shape mismatch")
+    l_row = solve_triangular(L_prev, a_col[: k - 1], lower=True)
+    d2 = a_col[k - 1] - jnp.dot(l_row, l_row)
+    d = jnp.sqrt(jnp.maximum(d2, eps if eps > 0 else 0.0))
+    L = jnp.zeros((k, k), L_prev.dtype)
+    L = L.at[: k - 1, : k - 1].set(L_prev)
+    L = L.at[k - 1, : k - 1].set(l_row)
+    L = L.at[k - 1, k - 1].set(d)
+    return L

@@ -1,0 +1,62 @@
+"""Least squares solvers.
+
+(ref: cpp/include/raft/linalg/lstsq.cuh — ``lstsq_svd_qr``
+(detail/lstsq.cuh:111 ``lstsqSvdQR`` via gesvd), ``lstsq_svd_jacobi``
+(:171 via gesvdj), ``lstsq_eig`` (normal equations + eigendecomposition),
+``lstsq_qr`` (QR + triangular solve).)
+
+All solve min_w ‖A w − b‖₂ for A [m×n], m ≥ n, returning w [n].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular
+
+from raft_tpu.core.error import expects
+from raft_tpu.linalg.eig import eig_jacobi
+from raft_tpu.linalg.svd import svd_jacobi
+
+
+def _pinv_solve(u, s, v, b, rcond=1e-7):
+    cutoff = rcond * jnp.max(s)
+    inv_s = jnp.where(s > cutoff, 1.0 / jnp.where(s > cutoff, s, 1.0), 0.0)
+    return v @ (inv_s * (u.T @ b))
+
+
+def lstsq_svd_qr(res, A, b):
+    """(ref: lstsq.cuh ``lstsq_svd_qr``)"""
+    A = jnp.asarray(A)
+    b = jnp.asarray(b)
+    u, s, vt = jnp.linalg.svd(A, full_matrices=False)
+    return _pinv_solve(u, s, vt.T, b)
+
+
+def lstsq_svd_jacobi(res, A, b, tol: float = 1e-7, sweeps: int = 15):
+    """(ref: lstsq.cuh ``lstsq_svd_jacobi``)"""
+    A = jnp.asarray(A)
+    b = jnp.asarray(b)
+    U, S, V = svd_jacobi(res, A, tol=tol, sweeps=sweeps)
+    return _pinv_solve(U, S, V, b)
+
+
+def lstsq_eig(res, A, b):
+    """Normal equations via eigendecomposition: w = (AᵀA)⁻¹ Aᵀ b.
+    (ref: lstsq.cuh ``lstsq_eig`` — covariance + eig path)"""
+    A = jnp.asarray(A)
+    b = jnp.asarray(b)
+    G = A.T @ A
+    w_eig, v = jnp.linalg.eigh(G)
+    rhs = A.T @ b
+    cutoff = 1e-7 * jnp.max(jnp.abs(w_eig))
+    inv_w = jnp.where(jnp.abs(w_eig) > cutoff, 1.0 / jnp.where(jnp.abs(w_eig) > cutoff, w_eig, 1.0), 0.0)
+    return v @ (inv_w * (v.T @ rhs))
+
+
+def lstsq_qr(res, A, b):
+    """QR + back-substitution. (ref: lstsq.cuh ``lstsq_qr``)"""
+    A = jnp.asarray(A)
+    b = jnp.asarray(b)
+    expects(A.shape[0] >= A.shape[1], "lstsq_qr: need m >= n")
+    q, r = jnp.linalg.qr(A, mode="reduced")
+    return solve_triangular(r, q.T @ b, lower=False)

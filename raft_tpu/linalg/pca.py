@@ -1,0 +1,96 @@
+"""PCA fit/transform (moved into raft from cuML in 26.04).
+
+(ref: cpp/include/raft/linalg/pca.cuh:41 ``pca_fit`` /
+``pca_transform`` / ``pca_inverse_transform``; params
+linalg/pca_types.hpp:21-34 ``paramsPCA`` + ``solver::COV_EIG_DC /
+COV_EIG_JACOBI``; impl linalg/detail/pca.cuh: mean-center → covariance →
+eigDC/eigJacobi → descending sort → sign flip → variance bookkeeping.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.linalg.eig import eig_dc, eig_jacobi
+from raft_tpu.matrix.math_ops import sign_flip
+
+
+class Solver(enum.Enum):
+    """(ref: pca_types.hpp ``solver``)"""
+
+    COV_EIG_DC = "cov_eig_dc"
+    COV_EIG_JACOBI = "cov_eig_jacobi"
+
+
+@dataclasses.dataclass
+class ParamsPCA:
+    """(ref: pca_types.hpp:34 ``paramsPCA``)"""
+
+    n_components: int
+    whiten: bool = False
+    algorithm: Solver = Solver.COV_EIG_DC
+    tol: float = 1e-7  # jacobi tolerance
+    n_iterations: int = 15  # jacobi sweeps
+
+
+class PCAModel(NamedTuple):
+    """Outputs of pca_fit (the reference fills caller buffers; we return a
+    named bundle)."""
+
+    components: jnp.ndarray        # [n_components, n_features]
+    explained_var: jnp.ndarray     # [n_components]
+    explained_var_ratio: jnp.ndarray
+    singular_vals: jnp.ndarray
+    mu: jnp.ndarray                # [n_features]
+    noise_vars: jnp.ndarray        # scalar
+
+
+def pca_fit(res, X, prms: ParamsPCA) -> PCAModel:
+    """(ref: pca.cuh:41 ``pca_fit``; pipeline detail/pca.cuh)"""
+    X = jnp.asarray(X)
+    n, p = X.shape
+    expects(0 < prms.n_components <= p, "pca_fit: bad n_components")
+    mu = jnp.mean(X, axis=0)
+    Xc = X - mu[None, :]
+    cov = (Xc.T @ Xc) / (n - 1)
+    if prms.algorithm == Solver.COV_EIG_JACOBI:
+        w, v = eig_jacobi(res, cov, tol=prms.tol, sweeps=prms.n_iterations)
+    else:
+        w, v = eig_dc(res, cov)
+    # descending order
+    w = w[::-1]
+    v = v[:, ::-1]
+    w = jnp.maximum(w, 0.0)
+    components = sign_flip(res, v).T[: prms.n_components]
+    explained_var = w[: prms.n_components]
+    total_var = jnp.sum(w)
+    explained_var_ratio = explained_var / total_var
+    singular_vals = jnp.sqrt(explained_var * (n - 1))
+    k = prms.n_components
+    noise_vars = jnp.where(k < p, jnp.sum(w[k:]) / jnp.maximum(p - k, 1), 0.0)
+    return PCAModel(components, explained_var, explained_var_ratio,
+                    singular_vals, mu, noise_vars)
+
+
+def pca_transform(res, X, model: PCAModel, prms: ParamsPCA):
+    """(ref: pca.cuh ``pca_transform``)"""
+    X = jnp.asarray(X)
+    t = (X - model.mu[None, :]) @ model.components.T
+    if prms.whiten:
+        scale = jnp.sqrt(jnp.maximum(model.explained_var, 1e-12))
+        t = t / scale[None, :]
+    return t
+
+
+def pca_inverse_transform(res, T, model: PCAModel, prms: ParamsPCA):
+    """(ref: pca.cuh ``pca_inverse_transform``)"""
+    T = jnp.asarray(T)
+    if prms.whiten:
+        scale = jnp.sqrt(jnp.maximum(model.explained_var, 1e-12))
+        T = T * scale[None, :]
+    return T @ model.components + model.mu[None, :]

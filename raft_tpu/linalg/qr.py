@@ -1,0 +1,21 @@
+"""QR decomposition.
+
+(ref: cpp/include/raft/linalg/qr.cuh — ``qrGetQ`` / ``qrGetQR`` over
+cuSOLVER geqrf/orgqr. TPU path: XLA's QR (Householder, MXU-blocked) via
+``jnp.linalg.qr``.)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def qr_get_q(res, A):
+    """Q factor only (reduced). (ref: qr.cuh ``qrGetQ``)"""
+    q, _ = jnp.linalg.qr(jnp.asarray(A), mode="reduced")
+    return q
+
+
+def qr_get_qr(res, A):
+    """(Q, R) reduced factorization. (ref: qr.cuh ``qrGetQR``)"""
+    return jnp.linalg.qr(jnp.asarray(A), mode="reduced")

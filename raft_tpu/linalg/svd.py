@@ -1,0 +1,82 @@
+"""SVD family.
+
+(ref: cpp/include/raft/linalg/svd.cuh:195,332 — ``svd_qr`` (cusolver
+gesvd), ``svd_eig`` (via eigendecomposition of the Gram matrix),
+``svd_jacobi`` (gesvdj), ``svd_qr_transpose_right_vec``, plus
+``svd_reconstruction`` / ``evaluate_svd_by_percentage`` validation helpers
+and sign flip.)
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.linalg.eig import eig_jacobi
+
+
+def svd_qr(res, A, gen_left_vec: bool = True, gen_right_vec: bool = True):
+    """Full thin SVD; returns (U, S, V) with V as columns (not Vᵀ),
+    matching the reference's output convention. (ref: svd.cuh:195)"""
+    A = jnp.asarray(A)
+    u, s, vt = jnp.linalg.svd(A, full_matrices=False)
+    return (u if gen_left_vec else None), s, (vt.T if gen_right_vec else None)
+
+
+def svd_qr_transpose_right_vec(res, A):
+    """(U, S, Vᵀ) variant. (ref: svd.cuh ``svd_qr_transpose_right_vec``)"""
+    A = jnp.asarray(A)
+    u, s, vt = jnp.linalg.svd(A, full_matrices=False)
+    return u, s, vt
+
+
+def svd_eig(res, A, gen_left_vec: bool = True):
+    """SVD via eigendecomposition of AᵀA — fast when n_rows >> n_cols.
+    (ref: svd.cuh:332 ``svd_eig``; detail uses cov + eigDC.) Returns
+    (U, S, V) with singular values DESCENDING like svd_qr."""
+    A = jnp.asarray(A)
+    n, p = A.shape
+    expects(n >= p, "svd_eig: requires n_rows >= n_cols")
+    G = (A.T @ A).astype(A.dtype)
+    w, v = jnp.linalg.eigh(G)  # ascending
+    w = w[::-1]
+    v = v[:, ::-1]
+    s = jnp.sqrt(jnp.maximum(w, 0.0))
+    U = None
+    if gen_left_vec:
+        safe = jnp.where(s > 0, s, jnp.ones_like(s))
+        U = (A @ v) / safe[None, :]
+        U = jnp.where(s[None, :] > 0, U, jnp.zeros_like(U))
+    return U, s, v
+
+
+def svd_jacobi(res, A, tol: float = 1e-7, sweeps: int = 15,
+               gen_left_vec: bool = True):
+    """SVD via Jacobi eigensolver on the Gram matrix.
+    (ref: svd.cuh ``svdJacobi`` → gesvdj)"""
+    A = jnp.asarray(A)
+    G = A.T @ A
+    w, v = eig_jacobi(res, G, tol=tol, sweeps=sweeps)
+    w = w[::-1]
+    v = v[:, ::-1]
+    s = jnp.sqrt(jnp.maximum(w, 0.0))
+    U = None
+    if gen_left_vec:
+        safe = jnp.where(s > 0, s, jnp.ones_like(s))
+        U = (A @ v) / safe[None, :]
+    return U, s, v
+
+
+def svd_reconstruction(res, U, S, V):
+    """U diag(S) Vᵀ. (ref: svd.cuh ``svd_reconstruction``)"""
+    return (jnp.asarray(U) * jnp.asarray(S)[None, :]) @ jnp.asarray(V).T
+
+
+def evaluate_svd_by_percentage(res, A, U, S, V, percent: float = 1e-2) -> bool:
+    """Is the reconstruction within percent·‖A‖_F?
+    (ref: svd.cuh ``evaluate_svd_by_percentage``)"""
+    A = jnp.asarray(A)
+    err = jnp.linalg.norm(A - svd_reconstruction(res, U, S, V))
+    return bool(err <= percent * jnp.linalg.norm(A))

@@ -1,0 +1,70 @@
+"""Truncated SVD fit/transform (moved into raft from cuML in 26.04).
+
+(ref: cpp/include/raft/linalg/tsvd.cuh ``tsvd_fit`` /
+``tsvd_transform`` / ``tsvd_inverse_transform``; params
+linalg/pca_types.hpp ``paramsTSVD``; impl linalg/detail/tsvd.cuh — like PCA
+but without mean-centering: eig of XᵀX.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.linalg.eig import eig_dc, eig_jacobi
+from raft_tpu.linalg.pca import Solver
+from raft_tpu.matrix.math_ops import sign_flip
+
+
+@dataclasses.dataclass
+class ParamsTSVD:
+    """(ref: pca_types.hpp ``paramsTSVD``)"""
+
+    n_components: int
+    algorithm: Solver = Solver.COV_EIG_DC
+    tol: float = 1e-7
+    n_iterations: int = 15
+
+
+class TSVDModel(NamedTuple):
+    components: jnp.ndarray       # [n_components, n_features]
+    explained_var: jnp.ndarray
+    explained_var_ratio: jnp.ndarray
+    singular_vals: jnp.ndarray
+
+
+def tsvd_fit(res, X, prms: ParamsTSVD) -> TSVDModel:
+    """(ref: tsvd.cuh ``tsvd_fit``)"""
+    X = jnp.asarray(X)
+    n, p = X.shape
+    expects(0 < prms.n_components <= p, "tsvd_fit: bad n_components")
+    G = X.T @ X
+    if prms.algorithm == Solver.COV_EIG_JACOBI:
+        w, v = eig_jacobi(res, G, tol=prms.tol, sweeps=prms.n_iterations)
+    else:
+        w, v = eig_dc(res, G)
+    w = jnp.maximum(w[::-1], 0.0)
+    v = v[:, ::-1]
+    components = sign_flip(res, v).T[: prms.n_components]
+    singular_vals = jnp.sqrt(w[: prms.n_components])
+    # explained variance of the projected coordinates (population variance,
+    # as the reference computes from the transform)
+    T = X @ components.T
+    explained_var = jnp.var(T, axis=0)
+    total_var = jnp.sum(jnp.var(X, axis=0))
+    explained_var_ratio = explained_var / total_var
+    return TSVDModel(components, explained_var, explained_var_ratio,
+                     singular_vals)
+
+
+def tsvd_transform(res, X, model: TSVDModel):
+    """(ref: tsvd.cuh ``tsvd_transform``)"""
+    return jnp.asarray(X) @ model.components.T
+
+
+def tsvd_inverse_transform(res, T, model: TSVDModel):
+    """(ref: tsvd.cuh ``tsvd_inverse_transform``)"""
+    return jnp.asarray(T) @ model.components

@@ -1,0 +1,9 @@
+"""raft_tpu.solver — linear assignment. (ref: cpp/include/raft/solver,
+SURVEY §2.7.)"""
+
+from raft_tpu.solver.linear_assignment import (
+    LinearAssignmentProblem,
+    solve_lap,
+)
+
+__all__ = ["LinearAssignmentProblem", "solve_lap"]

@@ -1,0 +1,14 @@
+"""raft_tpu.sparse — sparse formats, linalg, solvers. (ref:
+cpp/include/raft/sparse, SURVEY §2.5.)"""
+
+from raft_tpu.core.sparse_types import COOMatrix, COOStructure, CSRMatrix, CSRStructure
+from raft_tpu.sparse import convert
+from raft_tpu.sparse import linalg
+from raft_tpu.sparse import matrix
+from raft_tpu.sparse import op
+from raft_tpu.sparse import solver
+
+__all__ = [
+    "COOMatrix", "COOStructure", "CSRMatrix", "CSRStructure",
+    "convert", "linalg", "matrix", "op", "solver",
+]

@@ -1,0 +1,94 @@
+"""Sparse format conversions.
+
+(ref: cpp/include/raft/sparse/convert/csr.cuh:202 (coo↔csr),
+convert/coo.cuh, convert/dense.cuh, convert/detail/adj_to_csr.cuh,
+convert/detail/bitmap_to_csr.cuh (344 LoC), detail/bitset_to_csr.cuh.)
+
+TPU notes: conversions that preserve nnz (coo↔csr, sorting) are fully
+vectorized jax (static shapes). Conversions that *discover* nnz
+(dense→sparse, bitmap→csr) have data-dependent output shapes, which XLA
+cannot express — those run through host numpy exactly once at data-prep
+time (the reference likewise launches count kernels + allocs before its
+fill kernels; here the host does the counting).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.bitset import BitmapView, BitsetView
+from raft_tpu.core.error import expects
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+
+
+def sorted_coo_to_csr(coo: COOMatrix) -> CSRMatrix:
+    """COO (sorted by row) → CSR. (ref: convert/csr.cuh ``sorted_coo_to_csr``)"""
+    counts = jnp.bincount(coo.rows, length=coo.shape[0])
+    indptr = jnp.concatenate([jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)])
+    return CSRMatrix(indptr.astype(jnp.int32), coo.cols, coo.values, coo.shape)
+
+
+def coo_to_csr(coo: COOMatrix) -> CSRMatrix:
+    """General COO → CSR (sorts by (row, col) first).
+    (ref: convert/csr.cuh ``coo_to_csr``)"""
+    order = jnp.lexsort((coo.cols, coo.rows))
+    sorted_coo = COOMatrix(coo.rows[order], coo.cols[order], coo.values[order],
+                           coo.shape)
+    return sorted_coo_to_csr(sorted_coo)
+
+
+def csr_to_coo(csr: CSRMatrix) -> COOMatrix:
+    """(ref: convert/coo.cuh ``csr_to_coo`` — indptr expansion)"""
+    return COOMatrix(csr.row_ids(), csr.indices, csr.values, csr.shape)
+
+
+def dense_to_csr(dense) -> CSRMatrix:
+    """(ref: convert/dense.cuh; host nnz discovery, see module note)"""
+    return CSRMatrix.from_dense(np.asarray(dense))
+
+
+def dense_to_coo(dense) -> COOMatrix:
+    return COOMatrix.from_dense(np.asarray(dense))
+
+
+def csr_to_dense(csr: CSRMatrix) -> jax.Array:
+    """(ref: convert/dense.cuh ``csr_to_dense``)"""
+    return csr.to_dense()
+
+
+def coo_to_dense(coo: COOMatrix) -> jax.Array:
+    return coo.to_dense()
+
+
+def adj_to_csr(adj) -> CSRMatrix:
+    """Boolean adjacency matrix → CSR of ones.
+    (ref: convert/detail/adj_to_csr.cuh)"""
+    adj = np.asarray(adj).astype(bool)
+    r, c = np.nonzero(adj)
+    indptr = np.zeros(adj.shape[0] + 1, np.int32)
+    np.add.at(indptr, r + 1, 1)
+    return CSRMatrix(jnp.asarray(np.cumsum(indptr, dtype=np.int32)),
+                     jnp.asarray(c, jnp.int32),
+                     jnp.ones((len(c),), jnp.float32), adj.shape)
+
+
+def bitmap_to_csr(bitmap: BitmapView) -> CSRMatrix:
+    """2-D bitmap → CSR of ones. (ref: convert/detail/bitmap_to_csr.cuh)"""
+    dense = np.asarray(bitmap.to_dense())
+    return adj_to_csr(dense)
+
+
+def bitset_to_csr(bitset: BitsetView, n_repeat: int = 1) -> CSRMatrix:
+    """Bitset → CSR with the bitset as each of ``n_repeat`` identical rows.
+    (ref: convert/detail/bitset_to_csr.cuh — the bitset is broadcast as
+    repeated rows of the output.)"""
+    bits = np.asarray(bitset.to_dense())
+    (cols,) = np.nonzero(bits)
+    nnz_row = len(cols)
+    indptr = np.arange(n_repeat + 1, dtype=np.int32) * nnz_row
+    all_cols = np.tile(cols.astype(np.int32), n_repeat)
+    return CSRMatrix(jnp.asarray(indptr), jnp.asarray(all_cols),
+                     jnp.ones((nnz_row * n_repeat,), jnp.float32),
+                     (n_repeat, bitset.n_bits))

@@ -1,0 +1,83 @@
+"""Sparse element/row operations.
+
+(ref: cpp/include/raft/sparse/op/ — detail/filter.cuh (276, remove zeros),
+op/reduce.cuh (duplicate reduction), op/row_op.cuh, op/slice.cuh (csr row
+slice), op/sort.cuh (coo sort).)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+
+
+def coo_sort(coo: COOMatrix) -> COOMatrix:
+    """Sort by (row, col). (ref: op/sort.cuh ``coo_sort``)"""
+    order = jnp.lexsort((coo.cols, coo.rows))
+    return COOMatrix(coo.rows[order], coo.cols[order], coo.values[order],
+                     coo.shape)
+
+
+def coo_remove_zeros(coo: COOMatrix, eps: float = 0.0) -> COOMatrix:
+    """Drop entries with |value| <= eps. Output nnz is data-dependent →
+    host step, like the reference's count-then-fill.
+    (ref: op/detail/filter.cuh ``coo_remove_zeros``)"""
+    vals = np.asarray(coo.values)
+    keep = np.abs(vals) > eps
+    return COOMatrix(
+        jnp.asarray(np.asarray(coo.rows)[keep]),
+        jnp.asarray(np.asarray(coo.cols)[keep]),
+        jnp.asarray(vals[keep]),
+        coo.shape,
+    )
+
+
+def max_duplicates(coo: COOMatrix) -> COOMatrix:
+    """Reduce duplicate (row, col) entries keeping the max.
+    (ref: op/reduce.cuh ``max_duplicates``)"""
+    return _reduce_duplicates(coo, "max")
+
+
+def sum_duplicates(coo: COOMatrix) -> COOMatrix:
+    """(ref: op/reduce.cuh duplicate sum / ``compute_duplicates_mask``)"""
+    return _reduce_duplicates(coo, "sum")
+
+
+def _reduce_duplicates(coo: COOMatrix, how: str) -> COOMatrix:
+    r = np.asarray(coo.rows)
+    c = np.asarray(coo.cols)
+    keys = r.astype(np.int64) * coo.shape[1] + c
+    uniq, inverse = np.unique(keys, return_inverse=True)
+    seg = jnp.asarray(inverse)
+    if how == "max":
+        vals = jax.ops.segment_max(coo.values, seg, num_segments=len(uniq))
+    else:
+        vals = jax.ops.segment_sum(coo.values, seg, num_segments=len(uniq))
+    return COOMatrix(
+        jnp.asarray((uniq // coo.shape[1]).astype(np.int32)),
+        jnp.asarray((uniq % coo.shape[1]).astype(np.int32)),
+        vals, coo.shape)
+
+
+def csr_row_op(csr: CSRMatrix, op: Callable) -> CSRMatrix:
+    """Apply ``op(row_id, value) -> value`` to every nonzero.
+    (ref: op/row_op.cuh ``csr_row_op`` — per-row lambda over the row's
+    span; the functional rendering passes the row id per element.)"""
+    return csr.with_values(op(csr.row_ids(), csr.values))
+
+
+def csr_row_slice(csr: CSRMatrix, start_row: int, stop_row: int) -> CSRMatrix:
+    """Rows [start_row, stop_row). (ref: op/slice.cuh
+    ``csr_row_slice_indptr`` / ``csr_row_slice_populate``)"""
+    expects(0 <= start_row < stop_row <= csr.shape[0], "csr_row_slice: bad range")
+    indptr = np.asarray(csr.indptr)
+    lo, hi = int(indptr[start_row]), int(indptr[stop_row])
+    new_indptr = jnp.asarray(indptr[start_row:stop_row + 1] - lo)
+    return CSRMatrix(new_indptr, csr.indices[lo:hi], csr.values[lo:hi],
+                     (stop_row - start_row, csr.shape[1]))

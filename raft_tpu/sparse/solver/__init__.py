@@ -1,0 +1,19 @@
+"""raft_tpu.sparse.solver — Lanczos, randomized SVD, MST. (ref:
+cpp/include/raft/sparse/solver, SURVEY §2.5.)"""
+
+from raft_tpu.sparse.solver.lanczos import lanczos_compute_eigenpairs
+from raft_tpu.sparse.solver.lanczos_types import LANCZOS_WHICH, LanczosSolverConfig
+from raft_tpu.sparse.solver.cholesky_qr import cholesky_qr, cholesky_qr2
+from raft_tpu.sparse.solver.randomized_svds import (
+    SvdsConfig,
+    randomized_svds,
+    sign_correction,
+)
+from raft_tpu.sparse.solver.mst import GraphCOO, MSTResult, mst
+
+__all__ = [
+    "lanczos_compute_eigenpairs", "LANCZOS_WHICH", "LanczosSolverConfig",
+    "cholesky_qr", "cholesky_qr2",
+    "SvdsConfig", "randomized_svds", "sign_correction",
+    "GraphCOO", "MSTResult", "mst",
+]

@@ -1,0 +1,34 @@
+"""Cholesky-QR orthonormalization.
+
+(ref: cpp/include/raft/sparse/solver/detail/cholesky_qr.cuh (159 LoC) —
+``cholesky_qr2``: Q = Y R⁻¹ with R from chol(YᵀY), applied twice for
+numerical robustness; the orthonormalization kernel of the randomized
+sparse SVD.) Pure MXU work on TPU: one syrk-shaped matmul + a triangular
+solve per pass.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular
+
+
+def cholesky_qr(Y) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Single-pass Cholesky QR: returns (Q, R)."""
+    Y = jnp.asarray(Y)
+    G = Y.T @ Y
+    # jitter for near-rank-deficient sketches (the reference relies on the
+    # second pass to clean up; the jitter guards chol failure outright)
+    eps = jnp.finfo(Y.dtype).eps * jnp.trace(G)
+    R = jnp.linalg.cholesky(G + eps * jnp.eye(G.shape[0], dtype=Y.dtype)).T
+    Q = solve_triangular(R.T, Y.T, lower=True).T
+    return Q, R
+
+
+def cholesky_qr2(Y) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Two-pass Cholesky QR (CholeskyQR2). (ref: detail/cholesky_qr.cuh)"""
+    Q1, R1 = cholesky_qr(Y)
+    Q, R2 = cholesky_qr(Q1)
+    return Q, R2 @ R1

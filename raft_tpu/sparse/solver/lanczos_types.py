@@ -1,0 +1,33 @@
+"""Lanczos solver configuration.
+
+(ref: cpp/include/raft/sparse/solver/lanczos_types.hpp:20
+``LANCZOS_WHICH::{LA,LM,SA,SM}`` and :40 ``lanczos_solver_config
+{n_components, max_iterations, ncv, tolerance, which, seed}``.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+
+class LANCZOS_WHICH(enum.Enum):
+    """(ref: lanczos_types.hpp:20)"""
+
+    LA = "LA"  # largest algebraic
+    LM = "LM"  # largest magnitude
+    SA = "SA"  # smallest algebraic
+    SM = "SM"  # smallest magnitude
+
+
+@dataclasses.dataclass
+class LanczosSolverConfig:
+    """(ref: lanczos_types.hpp:40 ``lanczos_solver_config``)"""
+
+    n_components: int
+    max_iterations: int = 1000
+    ncv: Optional[int] = None
+    tolerance: float = 1e-6
+    which: LANCZOS_WHICH = LANCZOS_WHICH.SA
+    seed: int = 42

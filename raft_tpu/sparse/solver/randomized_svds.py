@@ -1,0 +1,76 @@
+"""Randomized SVD for sparse matrices (added to raft in 26.06).
+
+(ref: cpp/include/raft/sparse/solver/randomized_svds.cuh public API with
+config sparse/solver/svds_config.hpp; impl detail/randomized_svds.cuh
+(241 LoC): Gaussian sketch → cholesky_qr2 (detail/cholesky_qr.cuh) → power
+iterations (:135-151) → small SVD; sign correction in
+detail/svds_sign_correction.cuh. Runtime entry ``randomized_svds`` in
+cpp/src/raft_runtime; python binding
+python/pylibraft/pylibraft/sparse/linalg/svds.pyx:73.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.resources import ensure_resources
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+from raft_tpu.sparse.linalg import spmm, transpose as sp_transpose
+from raft_tpu.sparse.solver.cholesky_qr import cholesky_qr2
+
+Sparse = Union[COOMatrix, CSRMatrix]
+
+
+@dataclasses.dataclass
+class SvdsConfig:
+    """(ref: sparse/solver/svds_config.hpp)"""
+
+    n_components: int
+    n_oversamples: int = 10
+    n_power_iters: int = 2
+    seed: int = 42
+
+
+def sign_correction(U, V):
+    """Deterministic sign convention: make the largest-|.| entry of each
+    left singular vector positive. (ref: detail/svds_sign_correction.cuh)"""
+    pivot = jnp.take_along_axis(U, jnp.argmax(jnp.abs(U), axis=0)[None, :], axis=0)
+    signs = jnp.sign(jnp.where(pivot == 0, jnp.ones_like(pivot), pivot))
+    return U * signs, V * signs
+
+
+def randomized_svds(res, A: Sparse, config: SvdsConfig
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Truncated SVD of a sparse matrix. Returns (U [m,k], S [k], V [n,k]).
+    (ref: sparse/solver/randomized_svds.cuh ``randomized_svds``)"""
+    res = ensure_resources(res)
+    k = config.n_components
+    m, n = A.shape
+    expects(0 < k <= min(m, n), "randomized_svds: bad n_components")
+    ell = min(k + config.n_oversamples, min(m, n))
+    dtype = A.values.dtype
+
+    if isinstance(A, COOMatrix):
+        from raft_tpu.sparse.convert import coo_to_csr
+
+        A = coo_to_csr(A)
+    At = sp_transpose(res, A)
+
+    key = jax.random.key(config.seed)
+    omega = jax.random.normal(key, (n, ell), dtype)
+    Y = spmm(res, A, omega)                    # m × ell
+    Q, _ = cholesky_qr2(Y)
+    for _ in range(config.n_power_iters):      # subspace iteration
+        Z, _ = cholesky_qr2(spmm(res, At, Q))  # n × ell
+        Q, _ = cholesky_qr2(spmm(res, A, Z))   # m × ell
+    B = spmm(res, At, Q).T                     # ell × n  (= Qᵀ A)
+    Ub, S, Vt = jnp.linalg.svd(B, full_matrices=False)
+    U = (Q @ Ub)[:, :k]
+    V = Vt.T[:, :k]
+    U, V = sign_correction(U, V)
+    return U, S[:k], V

@@ -1,0 +1,18 @@
+"""raft_tpu.spectral — graph spectral analysis. (ref:
+cpp/include/raft/spectral, SURVEY §2.6.)"""
+
+from raft_tpu.spectral.matrix_wrappers import (
+    SparseMatrix,
+    LaplacianMatrix,
+    ModularityMatrix,
+)
+from raft_tpu.spectral.analysis import (
+    analyze_partition,
+    analyze_modularity,
+    fit_embedding,
+)
+
+__all__ = [
+    "SparseMatrix", "LaplacianMatrix", "ModularityMatrix",
+    "analyze_partition", "analyze_modularity", "fit_embedding",
+]

@@ -1,0 +1,81 @@
+"""Partition / modularity analysis + spectral embedding.
+
+(ref: cpp/include/raft/spectral/partition.cuh:38 ``analyzePartition``
+(edge-cut + cost via indicator vectors, detail/partition.hpp:81-85),
+modularity_maximization.cuh:31 ``analyzeModularity``. The eigensolver+
+kmeans *clustering* driver left for cuVS; what remains — and is rebuilt
+here — is the analysis plus the BASELINE "spectral embedding" pipeline:
+``compute_graph_laplacian`` + ``lanczos_compute_eigenpairs`` (SURVEY §2.6).)
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+from raft_tpu.spectral.matrix_wrappers import LaplacianMatrix, ModularityMatrix
+
+Sparse = Union[COOMatrix, CSRMatrix]
+
+
+def analyze_partition(res, A: Sparse, n_clusters: int, clusters
+                      ) -> Tuple[float, float]:
+    """Returns (edge_cut, cost); cost = Σ_i cut(i)/|cluster_i|.
+    (ref: spectral/partition.cuh:38 ``analyzePartition``)"""
+    clusters = jnp.asarray(clusters)
+    L = LaplacianMatrix(res, A)
+    dtype = L.diagonal.dtype
+    edge_cut = jnp.asarray(0.0, dtype)
+    cost = jnp.asarray(0.0, dtype)
+    for i in range(n_clusters):
+        w = (clusters == i).astype(dtype)
+        size = jnp.sum(w)
+        part_cut = jnp.dot(w, L.mv(w))
+        nonempty = size > 0
+        cost = cost + jnp.where(nonempty, part_cut / jnp.where(nonempty, size, 1.0), 0.0)
+        edge_cut = edge_cut + jnp.where(nonempty, part_cut / 2.0, 0.0)
+    return float(edge_cut), float(cost)
+
+
+def analyze_modularity(res, A: Sparse, n_clusters: int, clusters) -> float:
+    """Modularity = Σ_i w_iᵀ B w_i / ‖d‖₁.
+    (ref: modularity_maximization.cuh:31 ``analyzeModularity``;
+    detail normalizes by the L1 norm of the degree vector = 2m.)"""
+    clusters = jnp.asarray(clusters)
+    B = ModularityMatrix(res, A)
+    dtype = B.degree.dtype
+    total = jnp.asarray(0.0, dtype)
+    for i in range(n_clusters):
+        w = (clusters == i).astype(dtype)
+        total = total + jnp.dot(w, B.mv(w))
+    return float(total / B.edge_sum)
+
+
+def fit_embedding(res, A: Sparse, n_components: int, ncv=None,
+                  tolerance: float = 1e-5, max_iterations: int = 2000,
+                  seed: int = 42, drop_first: bool = True,
+                  normalized: bool = True):
+    """Spectral embedding: smallest eigenvectors of the graph Laplacian.
+
+    The BASELINE config-4 pipeline (COO Laplacian + Lanczos). Returns
+    (eigenvalues, embedding [n, n_components]).
+    """
+    from raft_tpu.sparse.linalg import compute_graph_laplacian, laplacian_normalized
+    from raft_tpu.sparse.solver.lanczos import lanczos_compute_eigenpairs
+    from raft_tpu.sparse.solver.lanczos_types import LANCZOS_WHICH, LanczosSolverConfig
+
+    k = n_components + (1 if drop_first else 0)
+    if normalized:
+        L, _ = laplacian_normalized(res, A)
+    else:
+        L = compute_graph_laplacian(res, A)
+    config = LanczosSolverConfig(
+        n_components=k, max_iterations=max_iterations, ncv=ncv,
+        tolerance=tolerance, which=LANCZOS_WHICH.SA, seed=seed)
+    vals, vecs = lanczos_compute_eigenpairs(res, L, config)
+    if drop_first:
+        return vals[1:], vecs[:, 1:]
+    return vals, vecs

@@ -1,0 +1,71 @@
+"""Materialization-free spectral operators.
+
+(ref: cpp/include/raft/spectral/detail/matrix_wrappers.hpp —
+``sparse_matrix_t:132`` (CSR view + ``mv()`` dispatch,
+``sparse_mv_alg_t:64``), ``laplacian_matrix_t:325`` (L·x = D·x − A·x
+without materializing L), ``modularity_matrix_t:400``
+(B·x = A·x − (d·x)·d / 2m).)
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+from raft_tpu.sparse.linalg import spmv
+
+Sparse = Union[COOMatrix, CSRMatrix]
+
+
+class SparseMatrix:
+    """CSR/COO wrapper with ``mv``. (ref: matrix_wrappers.hpp:132)"""
+
+    def __init__(self, res, A: Sparse):
+        self.res = res
+        self.A = A
+        self.shape = A.shape
+
+    def mv(self, x, alpha=1.0, beta=0.0, y=None):
+        out = alpha * spmv(self.res, self.A, x)
+        if y is not None and beta != 0.0:
+            out = out + beta * jnp.asarray(y)
+        return out
+
+
+class LaplacianMatrix(SparseMatrix):
+    """L·x = D·x − A·x, degree precomputed, L never materialized.
+    (ref: matrix_wrappers.hpp:325 ``laplacian_matrix_t``)"""
+
+    def __init__(self, res, A: Sparse):
+        super().__init__(res, A)
+        ones = jnp.ones((A.shape[1],), A.values.dtype)
+        self.diagonal = spmv(res, A, ones)  # degree vector
+
+    def mv(self, x, alpha=1.0, beta=0.0, y=None):
+        lx = self.diagonal * x - spmv(self.res, self.A, x)
+        out = alpha * lx
+        if y is not None and beta != 0.0:
+            out = out + beta * jnp.asarray(y)
+        return out
+
+
+class ModularityMatrix(SparseMatrix):
+    """B·x = A·x − (d·x)·d / 2m. (ref: matrix_wrappers.hpp:400
+    ``modularity_matrix_t``)"""
+
+    def __init__(self, res, A: Sparse):
+        super().__init__(res, A)
+        ones = jnp.ones((A.shape[1],), A.values.dtype)
+        self.degree = spmv(res, A, ones)
+        self.edge_sum = jnp.sum(self.degree)  # = 2m for symmetric A
+
+    def mv(self, x, alpha=1.0, beta=0.0, y=None):
+        bx = spmv(self.res, self.A, x) - \
+            (jnp.dot(self.degree, x) / self.edge_sum) * self.degree
+        out = alpha * bx
+        if y is not None and beta != 0.0:
+            out = out + beta * jnp.asarray(y)
+        return out

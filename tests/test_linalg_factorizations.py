@@ -1,0 +1,175 @@
+"""Factorization tests: qr/eig/svd/rsvd/lstsq/cholesky_r1/pca/tsvd.
+(mirrors cpp/tests/linalg/{qr,eig,eig_sel,svd,rsvd,lstsq,cholesky_r1_update,
+pca,tsvd}.cu — tolerance-compare vs numpy/composition identities.)"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+from raft_tpu.linalg import ParamsPCA, ParamsTSVD, Solver
+
+rng = np.random.default_rng(21)
+
+
+def random_spd(n):
+    a = rng.normal(size=(n, n)).astype(np.float32)
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+def test_qr(res):
+    A = rng.normal(size=(10, 4)).astype(np.float32)
+    q = np.asarray(linalg.qr_get_q(res, A))
+    np.testing.assert_allclose(q.T @ q, np.eye(4), atol=1e-5)
+    q2, r = linalg.qr_get_qr(res, A)
+    np.testing.assert_allclose(np.asarray(q2) @ np.asarray(r), A, atol=1e-5)
+    assert np.allclose(np.tril(np.asarray(r), -1), 0)
+
+
+def test_eig_dc(res):
+    A = random_spd(8)
+    w, v = linalg.eig_dc(res, A)
+    w, v = np.asarray(w), np.asarray(v)
+    assert (np.diff(w) >= -1e-4).all()  # ascending
+    np.testing.assert_allclose(A @ v, v * w[None, :], atol=1e-3 * np.abs(w).max())
+
+
+def test_eig_dc_selective(res):
+    A = random_spd(10)
+    w_all = np.linalg.eigvalsh(A)
+    w, v = linalg.eig_dc_selective(res, A, 3, which="largest")
+    np.testing.assert_allclose(np.asarray(w), w_all[-3:], rtol=1e-4)
+    w_s, _ = linalg.eig_dc_selective(res, A, 2, which="smallest")
+    np.testing.assert_allclose(np.asarray(w_s), w_all[:2], rtol=1e-4)
+
+
+@pytest.mark.parametrize("n", [2, 5, 16])
+def test_eig_jacobi_matches_eigh(res, n):
+    A = random_spd(n)
+    w_ref = np.linalg.eigvalsh(A)
+    w, v = linalg.eig_jacobi(res, A, sweeps=20)
+    w, v = np.asarray(w), np.asarray(v)
+    np.testing.assert_allclose(w, w_ref, rtol=5e-4, atol=1e-3)
+    # eigenvector property
+    np.testing.assert_allclose(A @ v, v * w[None, :], atol=5e-2)
+    # orthogonality
+    np.testing.assert_allclose(v.T @ v, np.eye(n), atol=1e-3)
+
+
+def test_svd_qr(res):
+    A = rng.normal(size=(12, 5)).astype(np.float32)
+    U, S, V = linalg.svd_qr(res, A)
+    recon = np.asarray(linalg.svd_reconstruction(res, U, S, V))
+    np.testing.assert_allclose(recon, A, atol=1e-4)
+    assert linalg.evaluate_svd_by_percentage(res, A, U, S, V, percent=1e-3)
+    U2, S2, Vt = linalg.svd_qr_transpose_right_vec(res, A)
+    np.testing.assert_allclose(np.asarray(Vt), np.asarray(V).T, atol=1e-6)
+
+
+def test_svd_eig_matches_svd(res):
+    A = rng.normal(size=(30, 6)).astype(np.float32)
+    s_ref = np.linalg.svd(A, compute_uv=False)
+    U, S, V = linalg.svd_eig(res, A)
+    np.testing.assert_allclose(np.asarray(S), s_ref, rtol=2e-3)
+    recon = np.asarray(linalg.svd_reconstruction(res, U, S, V))
+    np.testing.assert_allclose(recon, A, atol=2e-3)
+
+
+def test_svd_jacobi(res):
+    A = rng.normal(size=(20, 5)).astype(np.float32)
+    s_ref = np.linalg.svd(A, compute_uv=False)
+    U, S, V = linalg.svd_jacobi(res, A, sweeps=20)
+    np.testing.assert_allclose(np.asarray(S), s_ref, rtol=2e-3)
+
+
+def test_randomized_svd_low_rank(res):
+    # exactly rank-5 matrix: rsvd must recover the spectrum
+    B = rng.normal(size=(100, 5)).astype(np.float32)
+    C = rng.normal(size=(5, 40)).astype(np.float32)
+    A = B @ C
+    s_ref = np.linalg.svd(A, compute_uv=False)
+    U, S, V = linalg.randomized_svd(res, A, k=5, p=5, n_iters=3)
+    np.testing.assert_allclose(np.asarray(S), s_ref[:5], rtol=1e-3)
+    recon = (np.asarray(U) * np.asarray(S)) @ np.asarray(V).T
+    np.testing.assert_allclose(recon, A, atol=1e-2 * np.abs(A).max())
+
+
+def test_rsvd_variants(res):
+    A = rng.normal(size=(60, 30)).astype(np.float32)
+    s_ref = np.linalg.svd(A, compute_uv=False)
+    U, S, V = linalg.rsvd_fixed_rank(res, A, k=8, p=10, n_iters=4)
+    np.testing.assert_allclose(np.asarray(S), s_ref[:8], rtol=0.05)
+    U, S, V = linalg.rsvd_perc(res, A, sv_perc=0.2, p_perc=0.3, n_iters=4)
+    assert S.shape[0] == 6  # 0.2 * 30
+    sym = random_spd(20)
+    U, S, V = linalg.rsvd_fixed_rank_symmetric(res, sym, k=4)
+    w_ref = np.sort(np.linalg.eigvalsh(sym))[::-1]
+    np.testing.assert_allclose(np.asarray(S), w_ref[:4], rtol=0.05)
+
+
+@pytest.mark.parametrize("solver", ["svd_qr", "svd_jacobi", "eig", "qr"])
+def test_lstsq(res, solver):
+    A = rng.normal(size=(50, 6)).astype(np.float32)
+    w_true = rng.normal(size=6).astype(np.float32)
+    b = A @ w_true
+    fn = {"svd_qr": linalg.lstsq_svd_qr, "svd_jacobi": linalg.lstsq_svd_jacobi,
+          "eig": linalg.lstsq_eig, "qr": linalg.lstsq_qr}[solver]
+    w = np.asarray(fn(res, A, b))
+    np.testing.assert_allclose(w, w_true, rtol=5e-3, atol=5e-3)
+
+
+def test_cholesky_r1_update(res):
+    A = random_spd(6)
+    L_ref = np.linalg.cholesky(A)
+    # build up incrementally
+    L = linalg.cholesky_r1_update(res, None, A[:1, 0])
+    for k in range(2, 7):
+        L = linalg.cholesky_r1_update(res, L, A[:k, k - 1])
+    np.testing.assert_allclose(np.asarray(L), L_ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("solver", [Solver.COV_EIG_DC, Solver.COV_EIG_JACOBI])
+def test_pca_fit_transform(res, solver):
+    # data with a dominant direction
+    base = rng.normal(size=(200, 3)).astype(np.float32)
+    X = np.hstack([base * np.array([10.0, 2.0, 0.5], np.float32), base[:, :1]])
+    prms = ParamsPCA(n_components=2, algorithm=solver)
+    model = linalg.pca_fit(res, X, prms)
+    assert model.components.shape == (2, 4)
+    ev = np.asarray(model.explained_var)
+    assert ev[0] >= ev[1] >= 0
+    assert float(np.asarray(model.explained_var_ratio).sum()) <= 1.0 + 1e-5
+    T = linalg.pca_transform(res, X, model, prms)
+    X_rec = np.asarray(linalg.pca_inverse_transform(res, T, model, prms))
+    # 2 components capture nearly everything in this construction
+    rel = np.linalg.norm(X_rec - X) / np.linalg.norm(X)
+    assert rel < 0.15
+    # compare against numpy PCA (eigh of covariance)
+    Xc = X - X.mean(axis=0)
+    w_ref = np.sort(np.linalg.eigvalsh(np.cov(Xc.T)))[::-1]
+    np.testing.assert_allclose(ev, w_ref[:2].astype(np.float32), rtol=2e-2)
+
+
+def test_pca_whiten_roundtrip(res):
+    X = rng.normal(size=(100, 5)).astype(np.float32) * np.arange(1, 6, dtype=np.float32)
+    prms = ParamsPCA(n_components=5, whiten=True)
+    model = linalg.pca_fit(res, X, prms)
+    T = np.asarray(linalg.pca_transform(res, X, model, prms))
+    np.testing.assert_allclose(T.std(axis=0), np.ones(5), rtol=0.1)
+    X_rec = np.asarray(linalg.pca_inverse_transform(res, T, model, prms))
+    np.testing.assert_allclose(X_rec, X, atol=1e-2)
+
+
+def test_tsvd(res):
+    X = rng.normal(size=(80, 6)).astype(np.float32)
+    prms = ParamsTSVD(n_components=3)
+    model = linalg.tsvd_fit(res, X, prms)
+    s_ref = np.linalg.svd(X, compute_uv=False)
+    np.testing.assert_allclose(np.asarray(model.singular_vals), s_ref[:3], rtol=1e-3)
+    T = linalg.tsvd_transform(res, X, model)
+    assert T.shape == (80, 3)
+    X_rec = np.asarray(linalg.tsvd_inverse_transform(res, T, model))
+    # best rank-3 approximation error bound
+    err = np.linalg.norm(X_rec - X)
+    opt = np.sqrt((s_ref[3:] ** 2).sum())
+    assert err <= opt * 1.01

@@ -1,0 +1,325 @@
+"""Solver tests: Lanczos eigsh, randomized sparse SVD, MST, LAP, spectral,
+label. (mirrors cpp/tests/sparse/solver/{lanczos,mst}.cu,
+tests/sparse/spectral_matrix.cu, tests/lap/lap.cu,
+tests/label/{label,merge_labels}.cu, and pylibraft test_sparse.py's
+scipy-comparison strategy.)"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import label, solver, spectral
+from raft_tpu.sparse import COOMatrix, CSRMatrix
+from raft_tpu.sparse.solver import (
+    LANCZOS_WHICH,
+    LanczosSolverConfig,
+    SvdsConfig,
+    cholesky_qr2,
+    lanczos_compute_eigenpairs,
+    mst,
+    randomized_svds,
+)
+
+rng = np.random.default_rng(41)
+
+
+def random_sym_sparse(n, density=0.1, seed=0, shift=0.0):
+    r = np.random.default_rng(seed)
+    dense = r.normal(size=(n, n)).astype(np.float32)
+    dense[r.random((n, n)) > density] = 0
+    dense = (dense + dense.T) / 2
+    dense += shift * np.eye(n, dtype=np.float32)
+    return dense
+
+
+# ---- Lanczos ----
+@pytest.mark.parametrize("which", [LANCZOS_WHICH.SA, LANCZOS_WHICH.LA,
+                                   LANCZOS_WHICH.LM, LANCZOS_WHICH.SM])
+def test_lanczos_which(res, which):
+    dense = random_sym_sparse(60, 0.2, seed=1)
+    w_ref = np.linalg.eigvalsh(dense)
+    csr = CSRMatrix.from_dense(dense)
+    cfg = LanczosSolverConfig(n_components=4, ncv=25, tolerance=1e-6,
+                              which=which, max_iterations=600, seed=7)
+    vals, vecs = lanczos_compute_eigenpairs(res, csr, cfg)
+    vals = np.asarray(vals)
+    if which == LANCZOS_WHICH.SA:
+        expect = w_ref[:4]
+    elif which == LANCZOS_WHICH.LA:
+        expect = w_ref[-4:]
+    elif which == LANCZOS_WHICH.LM:
+        expect = np.sort(w_ref[np.argsort(-np.abs(w_ref))[:4]])
+    else:
+        expect = np.sort(w_ref[np.argsort(np.abs(w_ref))[:4]])
+    np.testing.assert_allclose(vals, expect, rtol=1e-3, atol=1e-3)
+    # eigenpair property
+    vecs = np.asarray(vecs)
+    for i in range(4):
+        resid = dense @ vecs[:, i] - vals[i] * vecs[:, i]
+        assert np.linalg.norm(resid) < 1e-2 * max(1.0, np.abs(w_ref).max())
+
+
+def test_lanczos_coo_and_dense_operands(res):
+    dense = random_sym_sparse(40, 0.3, seed=2, shift=2.0)
+    w_ref = np.linalg.eigvalsh(dense)
+    cfg = LanczosSolverConfig(n_components=3, ncv=20, tolerance=1e-6, seed=3)
+    for A in (COOMatrix.from_dense(dense), jnp.asarray(dense)):
+        vals, _ = lanczos_compute_eigenpairs(res, A, cfg)
+        np.testing.assert_allclose(np.asarray(vals), w_ref[:3], rtol=1e-3,
+                                   atol=1e-3)
+
+
+def test_lanczos_vs_scipy_style_laplacian(res):
+    # spectral-embedding-like spectrum: laplacian of a two-community graph
+    n = 50
+    adj = np.zeros((n, n), np.float32)
+    r = np.random.default_rng(4)
+    for block in (range(0, 25), range(25, 50)):
+        for i in block:
+            for j in block:
+                if i < j and r.random() < 0.4:
+                    adj[i, j] = adj[j, i] = 1.0
+    adj[0, 25] = adj[25, 0] = 1.0  # single bridge
+    L = np.diag(adj.sum(1)) - adj
+    w_ref = np.linalg.eigvalsh(L)
+    cfg = LanczosSolverConfig(n_components=3, ncv=24, tolerance=1e-7,
+                              which=LANCZOS_WHICH.SA, seed=5,
+                              max_iterations=2000)
+    vals, vecs = lanczos_compute_eigenpairs(res, CSRMatrix.from_dense(L), cfg)
+    np.testing.assert_allclose(np.asarray(vals), w_ref[:3], atol=2e-3)
+    # fiedler vector separates the communities
+    fiedler = np.asarray(vecs[:, 1])
+    assert (fiedler[:25] > 0).all() != (fiedler[25:] > 0).all()
+
+
+def test_lanczos_validation(res):
+    from raft_tpu.core import LogicError
+
+    with pytest.raises(LogicError):
+        lanczos_compute_eigenpairs(
+            res, jnp.eye(5), LanczosSolverConfig(n_components=5))
+
+
+# ---- randomized sparse svds ----
+def test_cholesky_qr2():
+    Y = rng.normal(size=(50, 8)).astype(np.float32)
+    Q, R = cholesky_qr2(Y)
+    np.testing.assert_allclose(np.asarray(Q.T @ Q), np.eye(8), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(Q @ R), Y, rtol=1e-3, atol=1e-3)
+
+
+def test_randomized_svds(res):
+    r = np.random.default_rng(6)
+    dense = r.normal(size=(80, 40)).astype(np.float32)
+    dense[r.random((80, 40)) > 0.3] = 0
+    s_ref = np.linalg.svd(dense, compute_uv=False)
+    csr = CSRMatrix.from_dense(dense)
+    U, S, V = randomized_svds(res, csr, SvdsConfig(n_components=5,
+                                                   n_oversamples=10,
+                                                   n_power_iters=4))
+    np.testing.assert_allclose(np.asarray(S), s_ref[:5], rtol=0.05)
+    # singular triplet property
+    for i in range(3):
+        lhs = dense @ np.asarray(V)[:, i]
+        rhs = np.asarray(S)[i] * np.asarray(U)[:, i]
+        np.testing.assert_allclose(lhs, rhs, atol=0.05 * s_ref[0])
+    # sign correction determinism: largest-|.| entry of each U col positive
+    U = np.asarray(U)
+    piv = U[np.abs(U).argmax(axis=0), np.arange(U.shape[1])]
+    assert (piv > 0).all()
+
+
+# ---- MST ----
+def test_mst_simple_graph(res):
+    # weighted graph with known MST
+    n = 5
+    edges = [(0, 1, 1.0), (1, 2, 2.0), (0, 2, 4.0), (2, 3, 3.0), (3, 4, 1.5),
+             (1, 4, 5.0)]
+    dense = np.zeros((n, n), np.float32)
+    for u, v, w in edges:
+        dense[u, v] = dense[v, u] = w
+    result = mst(res, CSRMatrix.from_dense(dense))
+    total = float(np.asarray(result.mst.weights).sum())
+    # MST: 1.0 + 2.0 + 3.0 + 1.5 = 7.5
+    assert total == pytest.approx(7.5)
+    assert result.mst.n_edges == n - 1
+    assert len(np.unique(np.asarray(result.color))) == 1
+
+
+def test_mst_matches_scipy(res):
+    from scipy.sparse import csr_matrix as scipy_csr
+    from scipy.sparse.csgraph import minimum_spanning_tree
+
+    n = 40
+    r = np.random.default_rng(8)
+    dense = np.abs(r.normal(size=(n, n))).astype(np.float32)
+    dense = (dense + dense.T) / 2
+    np.fill_diagonal(dense, 0)
+    # sparsify but keep connected: add a cycle
+    mask = r.random((n, n)) < 0.15
+    mask |= mask.T
+    for i in range(n):
+        mask[i, (i + 1) % n] = mask[(i + 1) % n, i] = True
+    dense = dense * mask
+    result = mst(res, CSRMatrix.from_dense(dense))
+    total = float(np.asarray(result.mst.weights).sum())
+    ref_total = minimum_spanning_tree(scipy_csr(dense.astype(np.float64))).sum()
+    assert total == pytest.approx(float(ref_total), rel=1e-5)
+    assert result.mst.n_edges == n - 1
+
+
+def test_mst_equal_weight_triangle(res):
+    # equal weights: the undirected tie-break must prevent a 3-cycle pick
+    dense = np.zeros((3, 3), np.float32)
+    for u, v in [(0, 1), (1, 2), (2, 0)]:
+        dense[u, v] = dense[v, u] = 1.0
+    result = mst(res, CSRMatrix.from_dense(dense))
+    assert result.mst.n_edges == 2
+    assert float(np.asarray(result.mst.weights).sum()) == pytest.approx(2.0)
+
+
+def test_mst_forest_disconnected(res):
+    dense = np.zeros((4, 4), np.float32)
+    dense[0, 1] = dense[1, 0] = 1.0
+    dense[2, 3] = dense[3, 2] = 2.0
+    result = mst(res, CSRMatrix.from_dense(dense))
+    assert result.mst.n_edges == 2
+    assert len(np.unique(np.asarray(result.color))) == 2
+
+
+# ---- LAP ----
+def test_lap_known_solution(res):
+    cost = np.array([[4.0, 1.0, 3.0], [2.0, 0.0, 5.0], [3.0, 2.0, 2.0]],
+                    np.float32)
+    lap = solver.LinearAssignmentProblem(res, 3)
+    assign, obj = lap.solve(cost)
+    # optimal: r0->c1(1), r1->c0(2), r2->c2(2) = 5
+    assert float(obj) == pytest.approx(5.0)
+    assert sorted(np.asarray(assign).tolist()) == [0, 1, 2]
+
+
+def test_lap_matches_scipy(res):
+    from scipy.optimize import linear_sum_assignment
+
+    for seed in range(3):
+        r = np.random.default_rng(seed)
+        cost = r.integers(0, 100, size=(12, 12)).astype(np.float32)
+        assign, obj = solver.solve_lap(res, cost)
+        ri, ci = linear_sum_assignment(cost)
+        ref = cost[ri, ci].sum()
+        assert float(obj) == pytest.approx(float(ref))
+
+
+def test_lap_float_costs(res):
+    from scipy.optimize import linear_sum_assignment
+
+    for seed in range(20):
+        r = np.random.default_rng(100 + seed)
+        cost = r.random((8, 8)).astype(np.float32)
+        _, obj = solver.solve_lap(res, cost)
+        ri, ci = linear_sum_assignment(cost)
+        ref = float(cost[ri, ci].sum())
+        assert float(obj) == pytest.approx(ref, abs=8 * 1e-5)
+
+
+def test_lap_batched(res):
+    r = np.random.default_rng(9)
+    costs = r.integers(0, 50, size=(4, 8, 8)).astype(np.float32)
+    lap = solver.LinearAssignmentProblem(res, 8, batchsize=4)
+    assign, obj = lap.solve(costs)
+    assert assign.shape == (4, 8)
+    from scipy.optimize import linear_sum_assignment
+
+    for b in range(4):
+        ri, ci = linear_sum_assignment(costs[b])
+        assert float(obj[b]) == pytest.approx(float(costs[b][ri, ci].sum()))
+
+
+# ---- spectral ----
+def two_block_graph(n=20):
+    adj = np.zeros((n, n), np.float32)
+    half = n // 2
+    r = np.random.default_rng(10)
+    for i in range(n):
+        for j in range(i + 1, n):
+            same = (i < half) == (j < half)
+            if same and r.random() < 0.8:
+                adj[i, j] = adj[j, i] = 1.0
+    adj[0, half] = adj[half, 0] = 1.0
+    return adj
+
+
+def test_laplacian_modularity_operators(res):
+    adj = two_block_graph()
+    csr = CSRMatrix.from_dense(adj)
+    x = rng.normal(size=adj.shape[0]).astype(np.float32)
+    L = spectral.LaplacianMatrix(res, csr)
+    L_dense = np.diag(adj.sum(1)) - adj
+    np.testing.assert_allclose(np.asarray(L.mv(x)), L_dense @ x, rtol=1e-4,
+                               atol=1e-4)
+    B = spectral.ModularityMatrix(res, csr)
+    d = adj.sum(1)
+    B_dense = adj - np.outer(d, d) / d.sum()
+    np.testing.assert_allclose(np.asarray(B.mv(x)), B_dense @ x, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_analyze_partition_and_modularity(res):
+    adj = two_block_graph()
+    n = adj.shape[0]
+    csr = CSRMatrix.from_dense(adj)
+    good = (np.arange(n) >= n // 2).astype(np.int32)
+    bad = (np.arange(n) % 2).astype(np.int32)
+    cut_good, cost_good = spectral.analyze_partition(res, csr, 2, good)
+    cut_bad, cost_bad = spectral.analyze_partition(res, csr, 2, bad)
+    assert cut_good < cut_bad  # community split cuts fewer edges
+    # edge cut of the good split is the single bridge
+    assert cut_good == pytest.approx(1.0, abs=1e-4)
+    mod_good = spectral.analyze_modularity(res, csr, 2, good)
+    mod_bad = spectral.analyze_modularity(res, csr, 2, bad)
+    assert mod_good > mod_bad > -1.0
+
+
+def test_fit_embedding(res):
+    adj = two_block_graph()
+    csr = CSRMatrix.from_dense(adj)
+    vals, emb = spectral.fit_embedding(res, csr, n_components=2, ncv=16,
+                                       tolerance=1e-7)
+    emb = np.asarray(emb)
+    assert emb.shape == (adj.shape[0], 2)
+    # first embedding dim (fiedler of normalized laplacian) separates blocks
+    f = emb[:, 0]
+    half = adj.shape[0] // 2
+    assert (f[:half] > 0).all() != (f[half:] > 0).all()
+
+
+# ---- label ----
+def test_make_monotonic(res):
+    labels = np.array([10, 3, 10, 7, 3])
+    mono, classes = label.make_monotonic(res, labels)
+    np.testing.assert_array_equal(np.asarray(classes), [3, 7, 10])
+    np.testing.assert_array_equal(np.asarray(mono), [2, 0, 2, 1, 0])
+    mono1, _ = label.make_monotonic(res, labels, zero_based=False)
+    np.testing.assert_array_equal(np.asarray(mono1), [3, 1, 3, 2, 1])
+
+
+def test_make_monotonic_unsorted_classes(res):
+    mono, _ = label.make_monotonic(res, np.array([0, 1, 2]),
+                                   classes=np.array([2, 0, 1]))
+    np.testing.assert_array_equal(np.asarray(mono), [0, 1, 2])
+
+
+def test_merge_labels(res):
+    # a: {0,1} {2,3} {4}; b: {1,2} {3} {0} {4} → merged: {0,1,2,3} {4}
+    a = np.array([0, 0, 2, 2, 4], np.int32)
+    b = np.array([0, 1, 1, 3, 4], np.int32)
+    merged = np.asarray(label.merge_labels(res, a, b))
+    assert merged[0] == merged[1] == merged[2] == merged[3]
+    assert merged[4] != merged[0]
+    # transitive chain across the two labelings; max_iters bounds the work
+    chain_a = np.array([0, 0, 2, 2, 4, 4, 6, 6], np.int32)
+    chain_b = np.array([0, 1, 1, 3, 3, 5, 5, 7], np.int32)
+    full = np.asarray(label.merge_labels(res, chain_a, chain_b))
+    assert (full == 0).all()
+    partial = np.asarray(label.merge_labels(res, chain_a, chain_b, max_iters=1))
+    assert not (partial == 0).all()

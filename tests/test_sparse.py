@@ -1,0 +1,291 @@
+"""Sparse format/linalg/op/matrix tests.
+(mirrors cpp/tests/sparse/{convert_coo,convert_csr,csr_transpose,degree,
+norm,normalize,add,symmetrize,filter,sort,row_op,slice,spmm,sddmm,
+masked_matmul,laplacian,select_k_csr,preprocess}.cu)"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import BitmapView, Bitset
+from raft_tpu.linalg import NormType
+from raft_tpu.sparse import COOMatrix, CSRMatrix, convert, linalg, matrix, op
+
+rng = np.random.default_rng(31)
+
+
+def random_sparse(m, n, density=0.3, seed=0):
+    r = np.random.default_rng(seed)
+    dense = r.normal(size=(m, n)).astype(np.float32)
+    dense[r.random((m, n)) > density] = 0
+    return dense
+
+
+# ---- convert ----
+def test_coo_csr_roundtrip():
+    dense = random_sparse(6, 5)
+    coo = COOMatrix.from_dense(dense)
+    csr = convert.coo_to_csr(coo)
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), dense)
+    coo2 = convert.csr_to_coo(csr)
+    np.testing.assert_allclose(np.asarray(coo2.to_dense()), dense)
+
+
+def test_coo_to_csr_unsorted():
+    # deliberately unsorted COO
+    rows = jnp.array([2, 0, 1, 0], jnp.int32)
+    cols = jnp.array([1, 2, 0, 0], jnp.int32)
+    vals = jnp.array([1.0, 2.0, 3.0, 4.0], jnp.float32)
+    csr = convert.coo_to_csr(COOMatrix(rows, cols, vals, (3, 3)))
+    expected = np.zeros((3, 3), np.float32)
+    expected[2, 1], expected[0, 2], expected[1, 0], expected[0, 0] = 1, 2, 3, 4
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), expected)
+    np.testing.assert_array_equal(np.asarray(csr.indptr), [0, 2, 3, 4])
+
+
+def test_dense_csr_roundtrip():
+    dense = random_sparse(4, 7)
+    csr = convert.dense_to_csr(dense)
+    np.testing.assert_allclose(np.asarray(convert.csr_to_dense(csr)), dense)
+
+
+def test_adj_to_csr():
+    adj = np.array([[0, 1, 0], [1, 0, 1], [0, 0, 0]], bool)
+    csr = convert.adj_to_csr(adj)
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), adj.astype(np.float32))
+
+
+def test_bitmap_to_csr():
+    mat = np.zeros((3, 8), bool)
+    mat[0, 3] = mat[2, 7] = mat[2, 0] = True
+    bm = BitmapView.from_dense(mat)
+    csr = convert.bitmap_to_csr(bm)
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), mat.astype(np.float32))
+
+
+def test_bitset_to_csr():
+    bits = np.zeros(10, bool)
+    bits[[1, 4, 9]] = True
+    bs = Bitset.from_dense(bits)
+    csr = convert.bitset_to_csr(bs, n_repeat=3)
+    dense = np.asarray(csr.to_dense())
+    assert dense.shape == (3, 10)
+    for i in range(3):
+        np.testing.assert_array_equal(dense[i], bits.astype(np.float32))
+
+
+# ---- linalg ----
+def test_spmv_spmm():
+    dense = random_sparse(8, 6)
+    csr = CSRMatrix.from_dense(dense)
+    x = rng.normal(size=6).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(linalg.spmv(None, csr, x)),
+                               dense @ x, rtol=1e-5, atol=1e-5)
+    B = rng.normal(size=(6, 4)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(linalg.spmm(None, csr, B)),
+                               dense @ B, rtol=1e-5, atol=1e-5)
+    # COO path
+    coo = COOMatrix.from_dense(dense)
+    np.testing.assert_allclose(np.asarray(linalg.spmv(None, coo, x)),
+                               dense @ x, rtol=1e-5, atol=1e-5)
+
+
+def test_sddmm():
+    A = rng.normal(size=(5, 4)).astype(np.float32)
+    B = rng.normal(size=(4, 6)).astype(np.float32)
+    mask_dense = (random_sparse(5, 6, 0.4, seed=3) != 0).astype(np.float32)
+    structure = CSRMatrix.from_dense(mask_dense)
+    out = linalg.sddmm(None, A, B, structure)
+    expected = (A @ B) * mask_dense
+    np.testing.assert_allclose(np.asarray(out.to_dense()), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_sddmm_alpha_beta():
+    A = rng.normal(size=(3, 2)).astype(np.float32)
+    B = rng.normal(size=(2, 3)).astype(np.float32)
+    base = random_sparse(3, 3, 0.5, seed=4)
+    structure = CSRMatrix.from_dense(base)
+    out = linalg.sddmm(None, A, B, structure, alpha=2.0, beta=0.5)
+    mask = (base != 0).astype(np.float32)
+    expected = (2 * (A @ B) + 0.5 * base) * mask
+    np.testing.assert_allclose(np.asarray(out.to_dense()), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_masked_matmul():
+    A = rng.normal(size=(4, 8)).astype(np.float32)
+    B = rng.normal(size=(5, 8)).astype(np.float32)
+    mask = rng.random((4, 5)) < 0.5
+    bm = BitmapView.from_dense(mask)
+    out = linalg.masked_matmul(None, A, B, bm)
+    expected = (A @ B.T) * mask
+    np.testing.assert_allclose(np.asarray(out.to_dense()), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_add():
+    d1 = random_sparse(5, 5, 0.3, seed=5)
+    d2 = random_sparse(5, 5, 0.3, seed=6)
+    out = linalg.add(None, CSRMatrix.from_dense(d1), CSRMatrix.from_dense(d2))
+    np.testing.assert_allclose(np.asarray(out.to_dense()), d1 + d2, rtol=1e-5, atol=1e-6)
+
+
+def test_degree_norm_normalize():
+    dense = random_sparse(6, 4, 0.5, seed=7)
+    csr = CSRMatrix.from_dense(dense)
+    np.testing.assert_array_equal(np.asarray(linalg.degree(None, csr)),
+                                  (dense != 0).sum(axis=1))
+    np.testing.assert_allclose(np.asarray(linalg.row_norm(None, csr, NormType.L1)),
+                               np.abs(dense).sum(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(linalg.row_norm(None, csr, NormType.L2)),
+                               (dense ** 2).sum(axis=1), rtol=1e-5)
+    normed = linalg.row_normalize(None, csr, NormType.L1)
+    out = np.asarray(normed.to_dense())
+    sums = np.abs(out).sum(axis=1)
+    nonzero_rows = np.abs(dense).sum(axis=1) > 0
+    np.testing.assert_allclose(sums[nonzero_rows], 1.0, rtol=1e-5)
+
+
+def test_transpose():
+    dense = random_sparse(4, 6, 0.4, seed=8)
+    t = linalg.transpose(None, CSRMatrix.from_dense(dense))
+    assert t.shape == (6, 4)
+    np.testing.assert_allclose(np.asarray(t.to_dense()), dense.T)
+
+
+def test_symmetrize():
+    dense = random_sparse(5, 5, 0.4, seed=9)
+    sym = linalg.symmetrize(None, CSRMatrix.from_dense(dense))
+    np.testing.assert_allclose(np.asarray(sym.to_dense()), dense + dense.T,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_laplacian():
+    adj = np.abs(random_sparse(6, 6, 0.4, seed=10))
+    np.fill_diagonal(adj, 0)
+    csr = CSRMatrix.from_dense(adj)
+    L = linalg.compute_graph_laplacian(None, csr)
+    expected = np.diag(adj.sum(axis=1)) - adj
+    np.testing.assert_allclose(np.asarray(L.to_dense()), expected, rtol=1e-5, atol=1e-6)
+
+
+def test_laplacian_ignores_existing_diagonal():
+    adj = np.abs(random_sparse(5, 5, 0.5, seed=11))
+    np.fill_diagonal(adj, 7.0)  # reference kernel treats diagonal as zero
+    L = linalg.compute_graph_laplacian(None, CSRMatrix.from_dense(adj))
+    off = adj - np.diag(np.diag(adj))
+    expected = np.diag(off.sum(axis=1)) - off
+    np.testing.assert_allclose(np.asarray(L.to_dense()), expected, rtol=1e-5, atol=1e-6)
+
+
+def test_laplacian_normalized():
+    adj = (np.abs(random_sparse(8, 8, 0.4, seed=12)) > 0).astype(np.float32)
+    adj = np.maximum(adj, adj.T)
+    np.fill_diagonal(adj, 0)
+    csr = CSRMatrix.from_dense(adj)
+    Ln, d_inv_sqrt = linalg.laplacian_normalized(None, csr)
+    deg = adj.sum(axis=1)
+    safe = np.where(deg == 0, 1, deg)
+    D = 1.0 / np.sqrt(safe)
+    expected = (np.diag(deg) - adj) * D[:, None] * D[None, :]
+    np.testing.assert_allclose(np.asarray(Ln.to_dense()), expected, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(d_inv_sqrt), D, rtol=1e-5)
+
+
+# ---- op ----
+def test_coo_sort_and_dedup():
+    coo = COOMatrix(jnp.array([1, 0, 1], jnp.int32), jnp.array([0, 1, 0], jnp.int32),
+                    jnp.array([2.0, 3.0, 5.0], jnp.float32), (2, 2))
+    s = op.coo_sort(coo)
+    assert np.asarray(s.rows).tolist() == [0, 1, 1]
+    summed = op.sum_duplicates(coo)
+    assert summed.nnz == 2
+    np.testing.assert_allclose(np.asarray(summed.to_dense()), [[0, 3], [7, 0]])
+    maxed = op.max_duplicates(coo)
+    np.testing.assert_allclose(np.asarray(maxed.to_dense()), [[0, 3], [5, 0]])
+
+
+def test_remove_zeros():
+    coo = COOMatrix(jnp.array([0, 1, 1], jnp.int32), jnp.array([0, 0, 1], jnp.int32),
+                    jnp.array([0.0, 2.0, 1e-9], jnp.float32), (2, 2))
+    out = op.coo_remove_zeros(coo, eps=1e-6)
+    assert out.nnz == 1
+    np.testing.assert_allclose(np.asarray(out.to_dense()), [[0, 0], [2, 0]])
+
+
+def test_csr_row_op_and_slice():
+    dense = random_sparse(6, 4, 0.6, seed=13)
+    csr = CSRMatrix.from_dense(dense)
+    scaled = op.csr_row_op(csr, lambda row, v: v * (row + 1).astype(v.dtype))
+    expected = dense * np.arange(1, 7)[:, None]
+    np.testing.assert_allclose(np.asarray(scaled.to_dense()), expected, rtol=1e-5)
+    sub = op.csr_row_slice(csr, 2, 5)
+    np.testing.assert_allclose(np.asarray(sub.to_dense()), dense[2:5], rtol=1e-6)
+
+
+# ---- matrix ----
+def test_sparse_select_k():
+    dense = random_sparse(5, 20, 0.5, seed=14)
+    csr = CSRMatrix.from_dense(dense)
+    out_v, out_i = matrix.select_k(None, csr, k=3, select_min=False)
+    out_v, out_i = np.asarray(out_v), np.asarray(out_i)
+    for r in range(5):
+        nz = dense[r][dense[r] != 0]
+        expect = np.sort(nz)[::-1][:3]
+        got = out_v[r][out_v[r] != -np.inf]
+        np.testing.assert_allclose(got, expect[: len(got)], rtol=1e-5)
+        # indices point at the right values
+        for j, idx in enumerate(out_i[r]):
+            if idx >= 0:
+                assert dense[r, idx] == pytest.approx(out_v[r, j])
+
+
+def test_sparse_select_k_padding():
+    dense = np.zeros((3, 6), np.float32)
+    dense[0, 1] = 5.0  # row 0 has a single nonzero; row 1 none
+    dense[2, :3] = [1.0, 2.0, 3.0]
+    csr = CSRMatrix.from_dense(dense)
+    out_v, out_i = matrix.select_k(None, csr, k=2, select_min=True)
+    out_v, out_i = np.asarray(out_v), np.asarray(out_i)
+    assert out_v[0, 0] == 5.0 and out_v[0, 1] == np.inf and out_i[0, 1] == -1
+    assert (out_i[1] == -1).all()
+    np.testing.assert_allclose(out_v[2], [1.0, 2.0])
+
+
+def test_sparse_diagonal_ops():
+    dense = random_sparse(5, 5, 0.6, seed=15)
+    np.fill_diagonal(dense, [1, 2, 0, 4, 5])
+    csr = CSRMatrix.from_dense(dense)
+    np.testing.assert_allclose(np.asarray(matrix.diagonal(None, csr)),
+                               np.diag(dense), rtol=1e-6)
+    scaled = matrix.scale_by_diagonal_symmetric(None, csr, np.arange(1, 6, dtype=np.float32))
+    d = np.arange(1, 6, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(scaled.to_dense()),
+                               dense * d[:, None] * d[None, :], rtol=1e-5)
+
+
+def test_tfidf():
+    counts = np.array([[2.0, 0, 3.0], [2.0, 2.0, 0], [0, 0, 4.0]], np.float32)
+    coo = COOMatrix.from_dense(counts)
+    out = matrix.encode_tfidf(None, coo)
+    dense_out = np.asarray(out.to_dense())
+    n_rows = 3
+    df = np.array([2, 1, 2], np.float32)  # docs containing each term
+    for r, c in zip(*np.nonzero(counts)):
+        tf = np.log(counts[r, c])
+        idf = np.log(n_rows / df[c] + 1.0)
+        assert dense_out[r, c] == pytest.approx(tf * idf, rel=1e-5)
+
+
+def test_bm25():
+    counts = np.array([[2.0, 0, 3.0], [2.0, 2.0, 0], [0, 0, 4.0]], np.float32)
+    csr = CSRMatrix.from_dense(counts)
+    k1, b = 1.6, 0.75
+    out = matrix.encode_bm25(None, csr, k_param=k1, b_param=b)
+    dense_out = np.asarray(out.to_dense())
+    df = np.array([2, 1, 2], np.float32)
+    row_len = counts.sum(axis=1)
+    avg_len = counts.sum() / 3
+    for r, c in zip(*np.nonzero(counts)):
+        tf = np.log(counts[r, c])
+        idf = np.log(3 / df[c] + 1.0)
+        bm = ((k1 + 1) * tf) / (k1 * ((1 - b) + b * row_len[r] / avg_len) + tf)
+        assert dense_out[r, c] == pytest.approx(idf * bm, rel=1e-5)
