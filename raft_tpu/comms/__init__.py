@@ -1,0 +1,18 @@
+"""raft_tpu.comms — the NCCL/UCX comms vocabulary over jax.lax collectives.
+(ref: cpp/include/raft/comms + core/comms.hpp, SURVEY §2.11/§3.2.)"""
+
+from raft_tpu.comms.comms import DataType, Op, Status, MeshComms, get_type
+from raft_tpu.comms.host_comms import HostComms
+from raft_tpu.comms.session import (
+    Comms,
+    initialize_distributed,
+    inject_comms_on_handle,
+    local_handle,
+)
+from raft_tpu.comms import test_battery
+
+__all__ = [
+    "DataType", "Op", "Status", "MeshComms", "HostComms", "get_type",
+    "Comms", "initialize_distributed", "inject_comms_on_handle",
+    "local_handle", "test_battery",
+]

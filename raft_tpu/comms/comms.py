@@ -1,0 +1,206 @@
+"""The comms vocabulary — RAFT's ``comms_t`` re-imagined for the TPU mesh.
+
+(ref: cpp/include/raft/core/comms.hpp:25-26 ``datatype_t``/``op_t`` enums,
+:115-226 ``comms_iface`` (size/rank/comm_split/barrier/sync_stream, host
+isend/irecv/waitall, collectives {allreduce, bcast, reduce, allgather,
+allgatherv, gather, gatherv, reducescatter}, device p2p {device_send,
+device_recv, device_sendrecv, device_multicast_sendrecv},
+group_start/group_end), :234 typed proxy ``comms_t``.)
+
+TPU-native mapping (SURVEY §2.11): a communicator is a NAMED MESH AXIS.
+Collectives lower to ``jax.lax`` collectives over ICI when called inside a
+``shard_map``-traced region — the SPMD analog of every rank calling
+``ncclAllReduce`` on its stream. ``comm_split`` with a static color becomes
+axis selection on a reshaped mesh (sub-communicators are the other axes of
+a 2-D+ mesh, the reference's row/col ``subcomm`` pattern). Host p2p and
+group_start/end exist for API parity: inside one traced SPMD program,
+grouping is XLA's job, and p2p is ``ppermute``.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+class DataType(enum.Enum):
+    """(ref: core/comms.hpp:25 ``datatype_t``)"""
+
+    CHAR = "int8"
+    UINT8 = "uint8"
+    INT32 = "int32"
+    UINT32 = "uint32"
+    INT64 = "int64"
+    UINT64 = "uint64"
+    FLOAT32 = "float32"
+    FLOAT64 = "float64"
+    BFLOAT16 = "bfloat16"  # TPU addition
+
+
+def get_type(x) -> DataType:
+    """T → datatype_t. (ref: core/comms.hpp ``get_type<T>()``)"""
+    return DataType(str(jnp.asarray(x).dtype))
+
+
+class Op(enum.Enum):
+    """(ref: core/comms.hpp:26 ``op_t``)"""
+
+    SUM = "sum"
+    PROD = "prod"
+    MIN = "min"
+    MAX = "max"
+
+
+class Status(enum.Enum):
+    """(ref: core/comms.hpp ``status_t`` — returned by sync_stream)"""
+
+    SUCCESS = 0
+    ERROR = 1
+    ABORT = 2
+
+
+def _psum_like(x, op: Op, axis_name):
+    if op == Op.SUM:
+        return jax.lax.psum(x, axis_name)
+    if op == Op.MAX:
+        return jax.lax.pmax(x, axis_name)
+    if op == Op.MIN:
+        return jax.lax.pmin(x, axis_name)
+    # PROD via exp/log is lossy; use all_gather+prod (small arrays) instead
+    gathered = jax.lax.all_gather(x, axis_name)
+    return jnp.prod(gathered, axis=0)
+
+
+class MeshComms:
+    """SPMD communicator over a named mesh axis — valid inside a
+    ``shard_map`` region whose mesh carries ``axis_name``.
+
+    Each method is the traced-per-shard analog of the reference's
+    per-rank comms call (ref: comms/detail/std_comms.hpp collectives →
+    NCCL; here → XLA collectives over ICI).
+    """
+
+    def __init__(self, axis_name: str, size: Optional[int] = None):
+        self.axis_name = axis_name
+        self._size = size
+
+    # -- topology ---------------------------------------------------------
+    def get_size(self):
+        """(ref: comms_iface::get_size)"""
+        if self._size is not None:
+            return self._size
+        return jax.lax.axis_size(self.axis_name)
+
+    def get_rank(self):
+        """(ref: comms_iface::get_rank)"""
+        return jax.lax.axis_index(self.axis_name)
+
+    def comm_split(self, other_axis: str, size: Optional[int] = None) -> "MeshComms":
+        """Sub-communicator along another mesh axis: ranks sharing this
+        axis's index form the new clique. Pass ``size`` to keep the static
+        size (needed by p2p's permutation table).
+        (ref: comms_iface::comm_split via ncclCommSplit; here: pick the
+        other axis of the 2-D mesh.)"""
+        return MeshComms(other_axis, size=size)
+
+    def barrier(self, token=None):
+        """SPMD barrier: a zero-cost psum dependency.
+        (ref: comms_iface::barrier)"""
+        t = jnp.zeros((), jnp.int32) if token is None else token
+        return jax.lax.psum(t, self.axis_name)
+
+    def sync_stream(self, *arrays) -> Status:
+        """Inside a traced region this is a no-op (XLA orders the program);
+        kept for vocabulary parity. (ref: comms_iface::sync_stream)"""
+        return Status.SUCCESS
+
+    # -- collectives -------------------------------------------------------
+    def allreduce(self, x, op: Op = Op.SUM):
+        """(ref: comms_iface::allreduce → ncclAllReduce)"""
+        return _psum_like(x, op, self.axis_name)
+
+    def bcast(self, x, root: int = 0):
+        """Broadcast from root as masked psum — O(|x|) memory per device,
+        no [size, |x|] all-gather transient. (ref: comms_iface::bcast(2))"""
+        is_root = jax.lax.axis_index(self.axis_name) == root
+        masked = jnp.where(is_root, x, jnp.zeros_like(x))
+        return jax.lax.psum(masked, self.axis_name)
+
+    def reduce(self, x, root: int = 0, op: Op = Op.SUM):
+        """All ranks compute; non-root results are zeroed to mirror the
+        root-only-output contract. (ref: comms_iface::reduce)"""
+        full = _psum_like(x, op, self.axis_name)
+        is_root = jax.lax.axis_index(self.axis_name) == root
+        return jnp.where(is_root, full, jnp.zeros_like(full))
+
+    def allgather(self, x):
+        """(ref: comms_iface::allgather)"""
+        return jax.lax.all_gather(x, self.axis_name)
+
+    def allgatherv(self, x, counts: Sequence[int]):
+        """Variable-size allgather: shards are padded to max(counts) by the
+        caller; this returns the concatenation with padding stripped.
+        (ref: comms_iface::allgatherv — static counts, like the reference's
+        host-provided recvcounts.)"""
+        gathered = jax.lax.all_gather(x, self.axis_name)  # [size, maxlen, ...]
+        parts = [gathered[i, : counts[i]] for i in range(len(counts))]
+        return jnp.concatenate(parts, axis=0)
+
+    def gather(self, x, root: int = 0):
+        """(ref: comms_iface::gather; non-root gets zeros)"""
+        gathered = jax.lax.all_gather(x, self.axis_name)
+        is_root = jax.lax.axis_index(self.axis_name) == root
+        return jnp.where(is_root, gathered, jnp.zeros_like(gathered))
+
+    def gatherv(self, x, counts: Sequence[int], root: int = 0):
+        """(ref: comms_iface::gatherv)"""
+        out = self.allgatherv(x, counts)
+        is_root = jax.lax.axis_index(self.axis_name) == root
+        return jnp.where(is_root, out, jnp.zeros_like(out))
+
+    def reducescatter(self, x, op: Op = Op.SUM):
+        """Each rank gets its slice of the reduction.
+        (ref: comms_iface::reducescatter)"""
+        expects(op == Op.SUM, "reducescatter: SUM only (like psum_scatter)")
+        return jax.lax.psum_scatter(x, self.axis_name, tiled=True)
+
+    # -- device p2p ---------------------------------------------------------
+    def device_send(self, x, dst: int):
+        """Paired send/recv become one ppermute — see device_sendrecv.
+        (ref: comms_iface::device_send)"""
+        return self.device_sendrecv(x, dst, src=None)
+
+    def device_recv(self, x_from_permute):
+        return x_from_permute
+
+    def device_sendrecv(self, x, dst, src=None):
+        """Send shard to ``dst`` while receiving from whoever targets us.
+        dst may be an int (uniform shift pattern) or a list of (src, dst)
+        pairs. (ref: comms_iface::device_sendrecv → here ppermute on ICI)"""
+        size = self._size
+        expects(size is not None,
+                "device_sendrecv needs MeshComms(axis, size=...) for the "
+                "static permutation table")
+        if isinstance(dst, int):
+            perm = [(i, (i + dst) % size) for i in range(size)]
+        else:
+            perm = list(dst)
+        return jax.lax.ppermute(x, self.axis_name, perm)
+
+    def device_multicast_sendrecv(self, x, dsts: Optional[Sequence[int]] = None):
+        """One shard to many ranks: all_gather then select is the XLA-native
+        multicast. (ref: comms_iface::device_multicast_sendrecv)"""
+        return jax.lax.all_gather(x, self.axis_name)
+
+    # -- grouping -----------------------------------------------------------
+    def group_start(self):
+        """No-op: XLA fuses/schedules collectives inside one program.
+        (ref: comms_iface::group_start)"""
+
+    def group_end(self):
+        """(ref: comms_iface::group_end)"""

@@ -1,0 +1,134 @@
+"""Host-level communicator: the handle-injected object.
+
+(ref: cpp/include/raft/comms/std_comms.hpp:60 ``build_comms_nccl_only`` /
+:108 ``build_comms_nccl_ucx`` building a ``comms_t`` that raft-dask injects
+into each worker's handle via ``resource::set_comms``
+(core/resource/comms.hpp). In the reference, every process owns one rank
+and calls collectives from host code; under JAX's single-controller SPMD
+model the host-side equivalent drives ``shard_map`` programs over a mesh —
+one call covers all ranks at once. On multi-host (``jax.distributed``) the
+same object spans processes, with XLA routing ICI/DCN.)
+
+``HostComms`` takes rank-sharded ``jax.Array``s (axis 0 = ranks) or plain
+per-rank stacks and applies the collective across the communicator axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.core.error import expects
+from raft_tpu.comms.comms import MeshComms, Op, Status
+
+
+class HostComms:
+    """Host-side comms over a mesh axis, mirroring ``comms_t`` usage from
+    host code. Data layout contract: axis 0 of the input is the rank axis
+    (length = communicator size)."""
+
+    def __init__(self, mesh: Mesh, axis_name: str = "x"):
+        expects(axis_name in mesh.axis_names, "axis %r not in mesh", axis_name)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.size = mesh.shape[axis_name]
+
+    # topology (host view)
+    def get_size(self) -> int:
+        return self.size
+
+    def get_rank_array(self):
+        """Per-rank ranks, as a sanity probe of the SPMD identity."""
+        return self._run(lambda c, x: x + c.get_rank(),
+                         jnp.zeros((self.size, 1), jnp.int32))
+
+    def comm_split(self, other_axis: str) -> "HostComms":
+        """(ref: comm_split → sub-mesh axis; requires a multi-axis mesh)"""
+        return HostComms(self.mesh, other_axis)
+
+    def sync_stream(self, *arrays) -> Status:
+        """Block on dispatched work with cancellation polling — the host-side
+        sync_stream (ref: std_comms::sync_stream →
+        interruptible::synchronize)."""
+        from raft_tpu.core import interruptible
+
+        if arrays:
+            interruptible.synchronize(*arrays)
+        return Status.SUCCESS
+
+    def barrier(self) -> None:
+        """(ref: comms_iface::barrier; multi-host: sync_global_devices).
+        A multi-host sync failure propagates — silently degrading to a
+        local barrier would turn a distributed failure into a race."""
+        try:
+            from jax.experimental import multihost_utils
+        except ImportError:
+            multihost_utils = None
+        if multihost_utils is not None and jax.process_count() > 1:
+            multihost_utils.sync_global_devices("raft_tpu_barrier")
+            return
+        jax.block_until_ready(
+            self._run(lambda c, x: c.barrier(x), jnp.zeros((self.size,), jnp.int32)))
+
+    # -- machinery ---------------------------------------------------------
+    def _sharding(self, rest_ndim: int):
+        spec = P(self.axis_name, *([None] * rest_ndim))
+        return NamedSharding(self.mesh, spec)
+
+    def _run(self, fn, x, out_extra_rank: int = 0):
+        """shard_map ``fn(MeshComms, shard)`` over the rank axis. The
+        per-shard output rank is (x.ndim − 1) + out_extra_rank (collectives
+        like allgather add one axis)."""
+        x = jnp.asarray(x)
+        expects(x.shape[0] == self.size,
+                "HostComms: axis 0 (=%d) must equal comm size %d",
+                x.shape[0], self.size)
+        comms = MeshComms(self.axis_name, size=self.size)
+        in_spec = P(self.axis_name, *([None] * (x.ndim - 1)))
+        out_spec = P(self.axis_name,
+                     *([None] * (x.ndim - 1 + out_extra_rank)))
+
+        def shard_fn(xs):
+            return fn(comms, xs[0])[None]
+
+        return jax.shard_map(shard_fn, mesh=self.mesh, in_specs=(in_spec,),
+                             out_specs=out_spec)(x)
+
+    # -- collectives (axis 0 = rank) ----------------------------------------
+    def allreduce(self, x, op: Op = Op.SUM):
+        return self._run(lambda c, s: c.allreduce(s, op), x)
+
+    def bcast(self, x, root: int = 0):
+        return self._run(lambda c, s: c.bcast(s, root), x)
+
+    def reduce(self, x, root: int = 0, op: Op = Op.SUM):
+        return self._run(lambda c, s: c.reduce(s, root, op), x)
+
+    def allgather(self, x):
+        return self._run(lambda c, s: c.allgather(s), x, out_extra_rank=1)
+
+    def gather(self, x, root: int = 0):
+        return self._run(lambda c, s: c.gather(s, root), x, out_extra_rank=1)
+
+    def allgatherv(self, x, counts: Sequence[int]):
+        counts = tuple(int(c) for c in counts)
+        return self._run(lambda c, s: c.allgatherv(s, counts), x)
+
+    def gatherv(self, x, counts: Sequence[int], root: int = 0):
+        counts = tuple(int(c) for c in counts)
+        return self._run(lambda c, s: c.gatherv(s, counts, root), x)
+
+    def reducescatter(self, x, op: Op = Op.SUM):
+        return self._run(lambda c, s: c.reducescatter(s, op), x)
+
+    def device_sendrecv(self, x, shift: int = 1):
+        return self._run(lambda c, s: c.device_sendrecv(s, shift), x)
+
+    def device_multicast_sendrecv(self, x):
+        return self._run(lambda c, s: c.device_multicast_sendrecv(s), x,
+                         out_extra_rank=1)

@@ -1,0 +1,107 @@
+"""MNMG session — the raft-dask ``Comms`` equivalent without Dask.
+
+(ref: python/raft-dask/raft_dask/common/comms.py:28 ``class Comms`` —
+NCCL-uniqueId rendezvous + per-worker handle injection (SURVEY §3.2), and
+``local_handle`` (comms.py:236). On TPU, rendezvous is
+``jax.distributed.initialize`` (DCN bootstrap replacing the NCCL uniqueId
+broadcast); the clique is a ``Mesh`` over all devices; injection is
+``resources.set_comms`` exactly like ``inject_comms_on_handle``.)
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.resources import DeviceResources, Resources
+from raft_tpu.core.resource_types import ResourceType
+from raft_tpu.comms.host_comms import HostComms
+
+_sessions: dict = {}
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap. (ref: the NCCL uniqueId rendezvous in
+    Comms.init / nccl.pyx:110 → here jax.distributed.initialize, which
+    uses the coordinator for the same role.) No-op when single-process."""
+    if coordinator_address is None:
+        return
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
+
+
+class Comms:
+    """Session object building the communicator clique and injecting it
+    into handles. (ref: raft_dask Comms.init — comms.py:161.)"""
+
+    def __init__(self, devices: Optional[Sequence] = None,
+                 axis_names: Tuple[str, ...] = ("x",),
+                 mesh_shape: Optional[Tuple[int, ...]] = None,
+                 verbose: bool = False):
+        self.session_id = uuid.uuid4().hex[:16]
+        self._devices = list(devices) if devices is not None else jax.devices()
+        self.axis_names = axis_names
+        self.mesh_shape = mesh_shape
+        self.mesh: Optional[Mesh] = None
+        self.handle: Optional[DeviceResources] = None
+        self.nccl_initialized = False  # vocabulary parity
+        self.ucx_initialized = False
+
+    def init(self, workers: Optional[Sequence] = None) -> None:
+        """Build the mesh + comms and inject into a fresh handle.
+        (ref: comms.py:161 ``Comms.init`` → _func_init_all per worker;
+        single-controller SPMD needs one handle for the whole clique.)"""
+        devs = list(workers) if workers is not None else self._devices
+        n = len(devs)
+        shape = self.mesh_shape if self.mesh_shape is not None else (n,)
+        expects(int(np.prod(shape)) == n,
+                "Comms.init: mesh shape %s != device count %d", shape, n)
+        self.mesh = Mesh(np.array(devs).reshape(shape), self.axis_names)
+        self.handle = DeviceResources(device=devs[0])
+        self.handle.set_mesh(self.mesh)
+        primary = HostComms(self.mesh, self.axis_names[0])
+        self.handle.set_comms(primary)
+        # sub-communicators for every additional mesh axis
+        # (ref: resource::set_subcomm, core/resource/sub_comms.hpp)
+        for ax in self.axis_names[1:]:
+            self.handle.set_subcomm(ax, HostComms(self.mesh, ax))
+        self.handle.set_resource(ResourceType.ROOT_RANK, 0)
+        self.nccl_initialized = True
+        _sessions[self.session_id] = self
+
+    def destroy(self) -> None:
+        """(ref: comms.py:209 ``Comms.destroy`` — elasticity model: tear
+        down and re-create after cluster changes.)"""
+        _sessions.pop(self.session_id, None)
+        self.mesh = None
+        self.handle = None
+        self.nccl_initialized = False
+
+    @property
+    def comms(self) -> HostComms:
+        expects(self.handle is not None, "Comms not initialized")
+        return self.handle.get_comms()
+
+
+def local_handle(session_id: str) -> Optional[DeviceResources]:
+    """Fetch the session's injected handle. (ref: comms.py:236
+    ``local_handle(sessionId)``)"""
+    s = _sessions.get(session_id)
+    return s.handle if s else None
+
+
+def inject_comms_on_handle(handle: Resources, mesh: Mesh,
+                           axis_name: str = "x",
+                           subcomm_axes: Sequence[str] = ()) -> None:
+    """(ref: python/raft-dask/.../comms_utils.pyx:248,278
+    ``inject_comms_on_handle[_coll_only]``)"""
+    handle.set_mesh(mesh)
+    handle.set_comms(HostComms(mesh, axis_name))
+    for ax in subcomm_axes:
+        handle.set_subcomm(ax, HostComms(mesh, ax))

@@ -1,0 +1,16 @@
+"""raft_tpu.distance — pairwise distances + fused L2-NN / brute-force KNN.
+(The pre-cuVS RAFT distance surface required by BASELINE, SURVEY §7
+stage 10.)"""
+
+from raft_tpu.distance.types import DistanceType, METRIC_NAMES
+from raft_tpu.distance.pairwise import pairwise_distance
+from raft_tpu.distance.fused_l2nn import (
+    fused_l2_nn,
+    fused_l2_nn_argmin,
+    knn,
+)
+
+__all__ = [
+    "DistanceType", "METRIC_NAMES", "pairwise_distance",
+    "fused_l2_nn", "fused_l2_nn_argmin", "knn",
+]

@@ -1,0 +1,170 @@
+"""Fused L2 nearest-neighbor and brute-force KNN.
+
+(ref: the pre-cuVS ``raft::distance::fusedL2NN`` — per-query argmin over a
+distance matrix that is never materialized — and brute-force knn
+(distance + matrix::select_k). BASELINE config 2: "fused L2-NN + select_k
+top-64 on 1M×128". Rebuilt TPU-first per SURVEY §7 stage 10.)
+
+Design: stream over column tiles of Y. Each tile does one MXU contraction
+X·Y_tileᵀ plus norm corrections, then folds into a running (value, index)
+minimum — or a running top-k via merge-and-reselect for knn. Peak memory is
+[n, tile] + [n, k], never [n, m]; the tile size comes from the handle's
+workspace budget (the reference sizes its smem tiles the same way,
+linalg/detail/contractions.cuh). The per-tile loop is a ``lax.fori_loop``
+over a static tile count so the whole sweep is one compiled program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.kvp import KeyValuePair
+from raft_tpu.core.resources import ensure_resources
+
+
+def _pad_rows(y, tile):
+    """Pad to a tile multiple with zeros; padded columns are masked out via
+    the m_real bound in every sweep (zeros keep the matmul NaN-free)."""
+    m = y.shape[0]
+    pad = (-m) % tile
+    if pad:
+        y = jnp.concatenate([y, jnp.zeros((pad, y.shape[1]), y.dtype)])
+    return y, m + pad
+
+
+@partial(jax.jit, static_argnames=("tile", "sqrt"))
+def _fused_l2nn(x, y_padded, m_real: jax.Array, tile: int, sqrt: bool):
+    n = x.shape[0]
+    xx = jnp.sum(x * x, axis=1)
+    n_tiles = y_padded.shape[0] // tile
+
+    def body(i, carry):
+        best_v, best_i = carry
+        yt = jax.lax.dynamic_slice_in_dim(y_padded, i * tile, tile, axis=0)
+        yy = jnp.sum(yt * yt, axis=1)
+        d2 = xx[:, None] + yy[None, :] - 2.0 * jnp.matmul(
+            x, yt.T, preferred_element_type=jnp.float32)
+        col = i * tile + jnp.arange(tile)
+        valid = col[None, :] < m_real
+        d2 = jnp.where(valid, d2, jnp.inf)
+        tv = jnp.min(d2, axis=1)
+        ti = jnp.argmin(d2, axis=1).astype(jnp.int32) + i * tile
+        take = (tv < best_v) | ((tv == best_v) & (ti < best_i))
+        return (jnp.where(take, tv, best_v), jnp.where(take, ti, best_i))
+
+    best_v = jnp.full((n,), jnp.inf, jnp.float32)
+    best_i = jnp.full((n,), jnp.iinfo(jnp.int32).max, jnp.int32)
+    best_v, best_i = jax.lax.fori_loop(0, n_tiles, body, (best_v, best_i))
+    best_v = jnp.maximum(best_v, 0.0)
+    if sqrt:
+        best_v = jnp.sqrt(best_v)
+    return best_v, best_i
+
+
+def fused_l2_nn_argmin(res, x, y, sqrt: bool = False,
+                       tile: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
+    """For each row of x, the nearest row of y under (squared) L2.
+    Returns (min_dist [n], argmin [n]). (ref: pre-cuVS fusedL2NN /
+    pylibraft.distance.fused_l2_nn_argmin)"""
+    res = ensure_resources(res)
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    expects(x.shape[1] == y.shape[1], "fused_l2_nn: dim mismatch")
+    if tile is None:
+        # [n, tile] f32 intermediate within workspace budget
+        tile = max(128, min(y.shape[0],
+                            res.workspace.allocation_limit // (8 * max(x.shape[0], 1))))
+        tile = min(tile, 8192)
+    y_padded, _ = _pad_rows(y, tile)
+    return _fused_l2nn(x, y_padded, jnp.asarray(y.shape[0]), int(tile), sqrt)
+
+
+def fused_l2_nn(res, x, y, sqrt: bool = False) -> KeyValuePair:
+    """KVP-returning variant mirroring the reference's out type."""
+    v, i = fused_l2_nn_argmin(res, x, y, sqrt)
+    return KeyValuePair(key=i, value=v)
+
+
+def _merge_topk(best_v, best_i, tile_v, tile_i, k: int, select_min: bool):
+    """Merge a running top-k with a new tile and reselect (delegates to the
+    one top-k implementation in matrix/select_k)."""
+    from raft_tpu.matrix.select_k import _xla_select_k
+
+    allv = jnp.concatenate([best_v, tile_v], axis=1)
+    alli = jnp.concatenate([best_i, tile_i], axis=1)
+    return _xla_select_k(allv, alli, k, select_min)
+
+
+@partial(jax.jit, static_argnames=("k", "tile"))
+def _knn_sweep(x_sq, x, y_padded, m_real, k: int, tile: int):
+    n = x.shape[0]
+    n_tiles = y_padded.shape[0] // tile
+
+    def body(i, carry):
+        best_v, best_i = carry                         # [n, k]
+        yt = jax.lax.dynamic_slice_in_dim(y_padded, i * tile, tile, axis=0)
+        yy = jnp.sum(yt * yt, axis=1)
+        d2 = x_sq[:, None] + yy[None, :] - 2.0 * jnp.matmul(
+            x, yt.T, preferred_element_type=jnp.float32)
+        col = i * tile + jnp.arange(tile, dtype=jnp.int32)
+        valid = col[None, :] < m_real
+        d2 = jnp.where(valid, d2, jnp.inf)
+        return _merge_topk(best_v, best_i, d2,
+                           jnp.broadcast_to(col[None, :], d2.shape), k, True)
+
+    best_v = jnp.full((n, k), jnp.inf, jnp.float32)
+    best_i = jnp.full((n, k), -1, jnp.int32)
+    return jax.lax.fori_loop(0, n_tiles, body, (best_v, best_i))
+
+
+def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
+        tile: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
+    """Brute-force k nearest neighbors: streamed fused distance + top-k.
+    Returns (distances [nq, k], indices [nq, k]), nearest first.
+    (ref: pre-cuVS brute_force::knn = pairwise distance + select_k, fused)"""
+    res = ensure_resources(res)
+    index = jnp.asarray(index, jnp.float32)
+    queries = jnp.asarray(queries, jnp.float32)
+    expects(metric in ("sqeuclidean", "euclidean", "l2", "inner_product"),
+            "knn: unsupported metric %r", metric)
+    expects(k <= index.shape[0], "knn: k larger than index size")
+    if tile is None:
+        tile = max(128, min(index.shape[0],
+                            res.workspace.allocation_limit
+                            // (8 * max(queries.shape[0], 1))))
+        tile = min(tile, 8192)
+    y_padded, _ = _pad_rows(index, int(tile))
+    if metric == "inner_product":
+        return _ip_sweep(queries, y_padded, jnp.asarray(index.shape[0]),
+                         k, int(tile))
+    x_sq = jnp.sum(queries * queries, axis=1)
+    dists, idx = _knn_sweep(x_sq, queries, y_padded,
+                            jnp.asarray(index.shape[0]), k, int(tile))
+    if metric in ("euclidean", "l2"):
+        dists = jnp.sqrt(jnp.maximum(dists, 0.0))
+    return dists, idx
+
+
+@partial(jax.jit, static_argnames=("k", "tile"))
+def _ip_sweep(x, y_padded, m_real, k: int, tile: int):
+    n = x.shape[0]
+    n_tiles = y_padded.shape[0] // tile
+
+    def body(i, carry):
+        best_v, best_i = carry
+        yt = jax.lax.dynamic_slice_in_dim(y_padded, i * tile, tile, axis=0)
+        ip = jnp.matmul(x, yt.T, preferred_element_type=jnp.float32)
+        col = i * tile + jnp.arange(tile, dtype=jnp.int32)
+        valid = col[None, :] < m_real
+        ip = jnp.where(valid, ip, -jnp.inf)
+        return _merge_topk(best_v, best_i, ip,
+                           jnp.broadcast_to(col[None, :], ip.shape), k, False)
+
+    best_v = jnp.full((n, k), -jnp.inf, jnp.float32)
+    best_i = jnp.full((n, k), -1, jnp.int32)
+    return jax.lax.fori_loop(0, n_tiles, body, (best_v, best_i))

@@ -1,0 +1,155 @@
+"""Pairwise distances.
+
+(ref: the pre-cuVS ``raft::distance::pairwise_distance`` surface, built on
+the contraction tiling substrate that survives at
+cpp/include/raft/linalg/detail/contractions.cuh:313 — rebuilt TPU-first per
+SURVEY §7 stage 10 / BASELINE configs 1-2.)
+
+TPU design: "expanded" metrics (L2/cosine/correlation/IP/hellinger/russell-
+rao/jaccard/dice) contract on the MXU as X·Yᵀ plus rank-1 norm corrections —
+that's where the 10M×256 GB/s target comes from. "Unexpanded" metrics
+(L1/Linf/Canberra/Minkowski/Hamming/KL/JS/BrayCurtis) need the |x−y| form;
+they are computed in row tiles sized to the workspace budget so the
+[tile, n, d] broadcast intermediate stays in HBM bounds (the role the
+reference's smem tiling policies play — SURVEY §2.3 contractions row).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.resources import ensure_resources
+from raft_tpu.distance.types import METRIC_NAMES, DistanceType
+
+
+def _as_type(metric: Union[str, DistanceType]) -> DistanceType:
+    if isinstance(metric, DistanceType):
+        return metric
+    expects(metric in METRIC_NAMES, "unknown metric %r", metric)
+    return METRIC_NAMES[metric]
+
+
+def _expanded_l2(x, y, sqrt: bool):
+    xx = jnp.sum(x * x, axis=1)[:, None]
+    yy = jnp.sum(y * y, axis=1)[None, :]
+    d2 = xx + yy - 2.0 * jnp.matmul(x, y.T, preferred_element_type=jnp.float32)
+    d2 = jnp.maximum(d2, 0.0)
+    return jnp.sqrt(d2) if sqrt else d2
+
+
+def _cosine(x, y):
+    xn = jnp.sqrt(jnp.sum(x * x, axis=1))[:, None]
+    yn = jnp.sqrt(jnp.sum(y * y, axis=1))[None, :]
+    denom = jnp.maximum(xn * yn, 1e-30)
+    sim = jnp.matmul(x, y.T, preferred_element_type=jnp.float32) / denom
+    return 1.0 - sim
+
+
+def _correlation(x, y):
+    xc = x - jnp.mean(x, axis=1, keepdims=True)
+    yc = y - jnp.mean(y, axis=1, keepdims=True)
+    return _cosine(xc, yc)
+
+
+def _tile_rows(res, x, y, body, out_dtype=jnp.float32):
+    """Apply ``body(x_tile, y) -> [tile, m]`` over row tiles of x, sized by
+    the workspace budget (the contraction-tiling stand-in)."""
+    res = ensure_resources(res)
+    n, d = x.shape
+    m = y.shape[0]
+    row_bytes = (m * d + m) * 4
+    tile = max(1, min(n, res.workspace.batch_rows(row_bytes)))
+    if tile >= n:
+        return body(x, y)
+    outs = []
+    for start in range(0, n, tile):
+        outs.append(body(x[start:start + tile], y))
+    return jnp.concatenate(outs, axis=0)
+
+
+def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclidean",
+                      p: float = 2.0) -> jax.Array:
+    """Full [n, m] distance matrix. (ref: pre-cuVS
+    raft::distance::pairwise_distance; pylibraft.distance.pairwise_distance)"""
+    x = jnp.asarray(x)
+    y = x if y is None else jnp.asarray(y)
+    expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1],
+            "pairwise_distance: inputs must be [n,d],[m,d]")
+    t = _as_type(metric)
+
+    if t == DistanceType.L2Expanded:
+        return _expanded_l2(x, y, sqrt=False)
+    if t == DistanceType.L2SqrtExpanded:
+        return _expanded_l2(x, y, sqrt=True)
+    if t == DistanceType.InnerProduct:
+        return jnp.matmul(x, y.T, preferred_element_type=jnp.float32)
+    if t == DistanceType.CosineExpanded:
+        return _cosine(x, y)
+    if t == DistanceType.CorrelationExpanded:
+        return _correlation(x, y)
+    if t == DistanceType.HellingerExpanded:
+        ip = jnp.matmul(jnp.sqrt(jnp.abs(x)), jnp.sqrt(jnp.abs(y)).T,
+                        preferred_element_type=jnp.float32)
+        return jnp.sqrt(jnp.maximum(1.0 - jnp.minimum(ip, 1.0), 0.0))
+    if t == DistanceType.RussellRaoExpanded:
+        d = x.shape[1]
+        ip = jnp.matmul((x != 0).astype(jnp.float32), (y != 0).astype(jnp.float32).T,
+                        preferred_element_type=jnp.float32)
+        return (d - ip) / d
+    if t in (DistanceType.JaccardExpanded, DistanceType.DiceExpanded):
+        xb = (x != 0).astype(jnp.float32)
+        yb = (y != 0).astype(jnp.float32)
+        inter = jnp.matmul(xb, yb.T, preferred_element_type=jnp.float32)
+        nx = jnp.sum(xb, axis=1)[:, None]
+        ny = jnp.sum(yb, axis=1)[None, :]
+        if t == DistanceType.JaccardExpanded:
+            union = jnp.maximum(nx + ny - inter, 1e-30)
+            return 1.0 - inter / union
+        return 1.0 - 2.0 * inter / jnp.maximum(nx + ny, 1e-30)
+
+    # unexpanded (broadcast) metrics, row-tiled
+    def body(xt, yt):
+        diff = xt[:, None, :] - yt[None, :, :]
+        if t == DistanceType.L2Unexpanded:
+            return jnp.sum(diff * diff, axis=2)
+        if t == DistanceType.L2SqrtUnexpanded:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=2))
+        if t == DistanceType.L1:
+            return jnp.sum(jnp.abs(diff), axis=2)
+        if t == DistanceType.Linf:
+            return jnp.max(jnp.abs(diff), axis=2)
+        if t == DistanceType.LpUnexpanded:
+            return jnp.sum(jnp.abs(diff) ** p, axis=2) ** (1.0 / p)
+        if t == DistanceType.Canberra:
+            denom = jnp.abs(xt)[:, None, :] + jnp.abs(yt)[None, :, :]
+            safe = jnp.where(denom == 0, 1.0, denom)
+            return jnp.sum(jnp.where(denom == 0, 0.0, jnp.abs(diff) / safe), axis=2)
+        if t == DistanceType.HammingUnexpanded:
+            return jnp.mean((xt[:, None, :] != yt[None, :, :]).astype(jnp.float32), axis=2)
+        if t == DistanceType.BrayCurtis:
+            num = jnp.sum(jnp.abs(diff), axis=2)
+            den = jnp.sum(jnp.abs(xt[:, None, :] + yt[None, :, :]), axis=2)
+            return num / jnp.maximum(den, 1e-30)
+        if t == DistanceType.KLDivergence:
+            xs = xt[:, None, :]
+            ys = yt[None, :, :]
+            ratio = jnp.where((xs > 0) & (ys > 0), xs / jnp.where(ys > 0, ys, 1.0), 1.0)
+            return jnp.sum(jnp.where(xs > 0, xs * jnp.log(ratio), 0.0), axis=2)
+        if t == DistanceType.JensenShannon:
+            xs = xt[:, None, :]
+            ys = yt[None, :, :]
+            m = 0.5 * (xs + ys)
+
+            def _kl(a, b):
+                r = jnp.where((a > 0) & (b > 0), a / jnp.where(b > 0, b, 1.0), 1.0)
+                return jnp.where(a > 0, a * jnp.log(r), 0.0)
+
+            js = 0.5 * jnp.sum(_kl(xs, m) + _kl(ys, m), axis=2)
+            return jnp.sqrt(jnp.maximum(js, 0.0))
+        raise NotImplementedError(t)
+
+    return _tile_rows(res, x, y, body)

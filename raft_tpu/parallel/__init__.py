@@ -1,0 +1,16 @@
+"""raft_tpu.parallel — mesh/sharding helpers + SNMG handle. (ref: the
+reference's MNMG machinery, SURVEY §2.12.)"""
+
+from raft_tpu.parallel.mesh import (
+    make_mesh,
+    submesh,
+    shard_rows,
+    replicated,
+    shard_array,
+)
+from raft_tpu.parallel.snmg import DeviceResourcesSNMG
+
+__all__ = [
+    "make_mesh", "submesh", "shard_rows", "replicated", "shard_array",
+    "DeviceResourcesSNMG",
+]

@@ -1,0 +1,61 @@
+"""Mesh / sharding helpers.
+
+(ref: the reference's device-topology machinery — raft-dask worker→rank
+mapping (comms.py:144 ``worker_info``), SNMG per-device resources
+(core/device_resources_snmg.hpp:36), sub-communicator grids
+(core/resource/sub_comms.hpp). TPU-native: a ``jax.sharding.Mesh`` over
+named axes IS the topology; these helpers build meshes, sub-meshes, and
+shardings the way the reference builds cliques and sub-cliques.)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from raft_tpu.core.error import expects
+
+
+def make_mesh(shape: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named mesh. ``shape`` maps axis name → size (one '-1' entry
+    may infer its size from the device count). Default: 1-D "x" mesh over
+    all devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if not shape:
+        return Mesh(np.array(devs), ("x",))
+    names = tuple(shape.keys())
+    sizes = list(shape.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devs) // known
+    expects(int(np.prod(sizes)) == len(devs),
+            "make_mesh: shape %s != %d devices", dict(zip(names, sizes)), len(devs))
+    return Mesh(np.array(devs).reshape(sizes), names)
+
+
+def submesh(mesh: Mesh, axis: str, index: int) -> Mesh:
+    """The sub-mesh at a fixed coordinate of ``axis`` — comm_split with a
+    static color. (ref: core/comms.hpp:123 ``comm_split``)"""
+    expects(axis in mesh.axis_names, "submesh: unknown axis %r", axis)
+    ax = mesh.axis_names.index(axis)
+    devs = np.take(mesh.devices, index, axis=ax)
+    names = tuple(n for n in mesh.axis_names if n != axis)
+    return Mesh(devs, names)
+
+
+def shard_rows(mesh: Mesh, axis: str = "x") -> NamedSharding:
+    """Rank-shard axis 0 (the OPG data model — one shard per rank)."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_array(x, mesh: Mesh, axis: str = "x"):
+    """Place a host array rank-sharded over the mesh."""
+    return jax.device_put(x, shard_rows(mesh, axis))

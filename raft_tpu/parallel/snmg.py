@@ -1,0 +1,56 @@
+"""Single-controller multi-device handle (the SNMG pattern).
+
+(ref: cpp/include/raft/core/device_resources_snmg.hpp:36-154 ``class
+device_resources_snmg`` — a vector of per-GPU resources + root rank +
+device setter; core/resource/multi_gpu.hpp; core/device_setter.hpp. Under
+JAX's single controller, per-device handles exist for host-side bookkeeping
+while computation runs SPMD over the mesh, so this handle owns BOTH: one
+child ``DeviceResources`` per device and the shared mesh/comms.)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.resource_types import ResourceType
+from raft_tpu.core.resources import DeviceResources
+from raft_tpu.comms.host_comms import HostComms
+
+
+class DeviceResourcesSNMG(DeviceResources):
+    """(ref: device_resources_snmg.hpp:36)"""
+
+    def __init__(self, devices: Optional[Sequence] = None, root_rank: int = 0,
+                 seed: int = 0):
+        devs = list(devices) if devices is not None else jax.devices()
+        expects(len(devs) >= 1, "SNMG: need at least one device")
+        expects(0 <= root_rank < len(devs), "SNMG: bad root rank")
+        super().__init__(device=devs[root_rank], seed=seed)
+        self._devices = devs
+        self._children: List[DeviceResources] = [
+            DeviceResources(device=d, seed=seed + i) for i, d in enumerate(devs)
+        ]
+        mesh = Mesh(np.array(devs), ("x",))
+        self.set_mesh(mesh)
+        self.set_comms(HostComms(mesh, "x"))
+        self.set_resource(ResourceType.ROOT_RANK, root_rank)
+        self.set_resource(ResourceType.MULTI_DEVICE, devs)
+
+    @property
+    def root_rank(self) -> int:
+        return self.get_resource(ResourceType.ROOT_RANK)
+
+    def device_resources(self, rank: int) -> DeviceResources:
+        """Per-device child handle. (ref: snmg ``set_device``/operator[])"""
+        return self._children[rank]
+
+    def device_count(self) -> int:
+        return len(self._devices)
+
+    def is_root_rank(self, rank: int) -> bool:
+        return rank == self.root_rank

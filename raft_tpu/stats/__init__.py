@@ -1,0 +1,51 @@
+"""raft_tpu.stats — statistics & model metrics. (ref:
+cpp/include/raft/stats, SURVEY §2.10.)"""
+
+from raft_tpu.stats.moments import (
+    sum_stat,
+    mean,
+    mean_center,
+    mean_add,
+    vars_,
+    stddev,
+    meanvar,
+    weighted_mean,
+    cov,
+    minmax,
+)
+from raft_tpu.stats.histogram import (
+    HistType,
+    IdentityBinner,
+    histogram,
+    value_histogram,
+)
+from raft_tpu.stats.metrics import (
+    accuracy,
+    r2_score,
+    RegressionMetrics,
+    regression_metrics,
+    mean_squared_error,
+)
+from raft_tpu.stats.cluster import (
+    contingency_matrix,
+    get_contingency_matrix_shape,
+    rand_index,
+    adjusted_rand_index,
+    entropy,
+    mutual_info_score,
+    homogeneity_score,
+    completeness_score,
+    v_measure,
+    kl_divergence,
+)
+from raft_tpu.stats.embed import (
+    silhouette_score,
+    silhouette_score_batched,
+    trustworthiness_score,
+    neighborhood_recall,
+)
+from raft_tpu.stats.model_select import (
+    dispersion,
+    IC_Type,
+    information_criterion_batched,
+)

@@ -1,0 +1,131 @@
+"""Clustering comparison metrics.
+
+(ref: cpp/include/raft/stats/ — contingency_matrix.cuh
+(detail/contingencyMatrix.cuh 305), adjusted_rand_index.cuh
+(detail/adjusted_rand_index.cuh 196), rand_index.cuh,
+mutual_info_score.cuh, entropy.cuh, completeness_score.cuh,
+homogeneity_score.cuh, v_measure.cuh, kl_divergence.cuh.)
+
+All are built from one contingency matrix the way the reference builds
+them; values match sklearn's definitions (which the reference tests
+against).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def get_contingency_matrix_shape(res, a, b) -> Tuple[int, int]:
+    """(ref: contingency_matrix.cuh ``getContingencyMatrixWorkspaceSize``
+    companion — bins are 0..max)"""
+    import numpy as np
+
+    return int(np.asarray(a).max()) + 1, int(np.asarray(b).max()) + 1
+
+
+def contingency_matrix(res, a, b, n_classes_a: Optional[int] = None,
+                       n_classes_b: Optional[int] = None):
+    """Counts[ i, j ] = |{k : a[k]=i ∧ b[k]=j}|.
+    (ref: stats/contingency_matrix.cuh ``contingency_matrix``)"""
+    a = jnp.asarray(a, jnp.int32)
+    b = jnp.asarray(b, jnp.int32)
+    if n_classes_a is None or n_classes_b is None:
+        ca, cb = get_contingency_matrix_shape(res, a, b)
+        n_classes_a = n_classes_a or ca
+        n_classes_b = n_classes_b or cb
+    flat = a * n_classes_b + b
+    counts = jnp.bincount(flat, length=n_classes_a * n_classes_b)
+    return counts.reshape(n_classes_a, n_classes_b)
+
+
+def _comb2(x):
+    return x * (x - 1) / 2.0
+
+
+def rand_index(res, a, b) -> float:
+    """(ref: stats/rand_index.cuh ``rand_index``)"""
+    cm = contingency_matrix(res, a, b).astype(jnp.float64 if jax.config.x64_enabled else jnp.float32)
+    n = jnp.sum(cm)
+    sum_sq = jnp.sum(cm * cm)
+    sum_rows_sq = jnp.sum(jnp.sum(cm, axis=1) ** 2)
+    sum_cols_sq = jnp.sum(jnp.sum(cm, axis=0) ** 2)
+    # pairs agreeing: same-same + diff-diff
+    agree = _comb2(n) + sum_sq - 0.5 * (sum_rows_sq + sum_cols_sq)
+    return float(agree / _comb2(n))
+
+
+def adjusted_rand_index(res, a, b) -> float:
+    """(ref: stats/adjusted_rand_index.cuh)"""
+    cm = contingency_matrix(res, a, b).astype(jnp.float32)
+    n = jnp.sum(cm)
+    sum_comb = jnp.sum(_comb2(cm))
+    comb_a = jnp.sum(_comb2(jnp.sum(cm, axis=1)))
+    comb_b = jnp.sum(_comb2(jnp.sum(cm, axis=0)))
+    expected = comb_a * comb_b / _comb2(n)
+    max_index = 0.5 * (comb_a + comb_b)
+    denom = max_index - expected
+    if float(denom) == 0.0:
+        return 1.0
+    return float((sum_comb - expected) / denom)
+
+
+def entropy(res, labels, n_classes: Optional[int] = None) -> float:
+    """Shannon entropy of a labeling (nats). (ref: stats/entropy.cuh)"""
+    labels = jnp.asarray(labels, jnp.int32)
+    if n_classes is None:
+        import numpy as np
+
+        n_classes = int(np.asarray(labels).max()) + 1
+    counts = jnp.bincount(labels, length=n_classes).astype(jnp.float32)
+    p = counts / counts.sum()
+    return float(-jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0)))
+
+
+def mutual_info_score(res, a, b) -> float:
+    """(ref: stats/mutual_info_score.cuh)"""
+    cm = contingency_matrix(res, a, b).astype(jnp.float32)
+    n = jnp.sum(cm)
+    pij = cm / n
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    ratio = jnp.where(pij > 0, pij / (pi * pj), 1.0)
+    return float(jnp.sum(jnp.where(pij > 0, pij * jnp.log(ratio), 0.0)))
+
+
+def homogeneity_score(res, truth, pred) -> float:
+    """(ref: stats/homogeneity_score.cuh) 1 − H(C|K)/H(C)."""
+    h_c = entropy(res, truth)
+    if h_c == 0.0:
+        return 1.0
+    mi = mutual_info_score(res, truth, pred)
+    return mi / h_c
+
+
+def completeness_score(res, truth, pred) -> float:
+    """(ref: stats/completeness_score.cuh) 1 − H(K|C)/H(K)."""
+    h_k = entropy(res, pred)
+    if h_k == 0.0:
+        return 1.0
+    mi = mutual_info_score(res, truth, pred)
+    return mi / h_k
+
+
+def v_measure(res, truth, pred, beta: float = 1.0) -> float:
+    """(ref: stats/v_measure.cuh)"""
+    h = homogeneity_score(res, truth, pred)
+    c = completeness_score(res, truth, pred)
+    if h + c == 0.0:
+        return 0.0
+    return (1 + beta) * h * c / (beta * h + c)
+
+
+def kl_divergence(res, p, q) -> float:
+    """Σ p log(p/q) over two distributions. (ref: stats/kl_divergence.cuh)"""
+    p = jnp.asarray(p, jnp.float32)
+    q = jnp.asarray(q, jnp.float32)
+    ratio = jnp.where((p > 0) & (q > 0), p / jnp.where(q > 0, q, 1.0), 1.0)
+    return float(jnp.sum(jnp.where(p > 0, p * jnp.log(ratio), 0.0)))

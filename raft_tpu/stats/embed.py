@@ -1,0 +1,121 @@
+"""Embedding-quality metrics: silhouette, trustworthiness, neighborhood
+recall.
+
+(ref: cpp/include/raft/stats/silhouette_score.cuh:37 (+ batched variant
+detail/batched/silhouette_score.cuh — computes its own pairwise distances
+internally), trustworthiness_score (detail/trustworthiness_score.cuh 211,
+takes precomputed knn indices), neighborhood_recall
+(detail/neighborhood_recall.cuh).)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.pairwise import pairwise_distance
+
+
+def silhouette_score(res, X, labels, n_clusters: Optional[int] = None,
+                     metric: str = "sqeuclidean") -> float:
+    """Mean silhouette coefficient. (ref: stats/silhouette_score.cuh:37)"""
+    X = jnp.asarray(X)
+    labels = jnp.asarray(labels, jnp.int32)
+    n = X.shape[0]
+    if n_clusters is None:
+        import numpy as np
+
+        n_clusters = int(np.asarray(labels).max()) + 1
+    D = pairwise_distance(res, X, X, metric=metric)
+    onehot = jax.nn.one_hot(labels, n_clusters, dtype=D.dtype)  # [n, k]
+    cluster_sizes = jnp.sum(onehot, axis=0)                     # [k]
+    # mean distance of point i to each cluster: [n, k]
+    sums = D @ onehot
+    own = labels
+    own_size = cluster_sizes[own]
+    # a(i): mean intra-cluster distance excluding self (D[ii]=0)
+    a = jnp.where(own_size > 1,
+                  jnp.take_along_axis(sums, own[:, None], axis=1)[:, 0]
+                  / jnp.maximum(own_size - 1, 1), 0.0)
+    # b(i): min over other clusters of mean distance
+    means = sums / jnp.maximum(cluster_sizes[None, :], 1)
+    means = jnp.where(cluster_sizes[None, :] > 0, means, jnp.inf)
+    means = means.at[jnp.arange(n), own].set(jnp.inf)
+    b = jnp.min(means, axis=1)
+    s = jnp.where(own_size > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0)
+    return float(jnp.mean(s))
+
+
+def silhouette_score_batched(res, X, labels, n_clusters: Optional[int] = None,
+                             metric: str = "sqeuclidean",
+                             chunk: int = 1024) -> float:
+    """Tiled variant that never materializes the full n×n distance matrix.
+    (ref: detail/batched/silhouette_score.cuh)"""
+    X = jnp.asarray(X)
+    labels = jnp.asarray(labels, jnp.int32)
+    n = X.shape[0]
+    if n_clusters is None:
+        import numpy as np
+
+        n_clusters = int(np.asarray(labels).max()) + 1
+    onehot = jax.nn.one_hot(labels, n_clusters, dtype=X.dtype)
+    cluster_sizes = jnp.sum(onehot, axis=0)
+    total = jnp.zeros((), X.dtype)  # device accumulator: chunks stay async
+    for start in range(0, n, chunk):
+        Xc = X[start:start + chunk]
+        lc = labels[start:start + chunk]
+        D = pairwise_distance(res, Xc, X, metric=metric)
+        sums = D @ onehot
+        own_size = cluster_sizes[lc]
+        a = jnp.where(own_size > 1,
+                      jnp.take_along_axis(sums, lc[:, None], axis=1)[:, 0]
+                      / jnp.maximum(own_size - 1, 1), 0.0)
+        means = sums / jnp.maximum(cluster_sizes[None, :], 1)
+        means = jnp.where(cluster_sizes[None, :] > 0, means, jnp.inf)
+        means = means.at[jnp.arange(Xc.shape[0]), lc].set(jnp.inf)
+        b = jnp.min(means, axis=1)
+        s = jnp.where(own_size > 1,
+                      (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0)
+        total = total + jnp.sum(s)
+    return float(total) / n
+
+
+def trustworthiness_score(res, X, X_embedded, n_neighbors: int = 5,
+                          metric: str = "sqeuclidean") -> float:
+    """How much an embedding preserves local structure (1 = perfect).
+    (ref: stats/trustworthiness_score.cuh — same definition as sklearn;
+    the reference takes precomputed embedded-space knn, here both ranks are
+    computed internally via pairwise distances.)"""
+    X = jnp.asarray(X)
+    E = jnp.asarray(X_embedded)
+    n = X.shape[0]
+    k = n_neighbors
+    expects(k < n / 2, "trustworthiness: n_neighbors must be < n/2")
+    D_orig = pairwise_distance(res, X, X, metric=metric)
+    D_emb = pairwise_distance(res, E, E, metric=metric)
+    big = jnp.inf
+    D_orig = D_orig.at[jnp.arange(n), jnp.arange(n)].set(big)
+    D_emb = D_emb.at[jnp.arange(n), jnp.arange(n)].set(big)
+    # rank of j in i's original neighbor ordering (0 = nearest)
+    orig_order = jnp.argsort(D_orig, axis=1)
+    ranks = jnp.zeros((n, n), jnp.int32)
+    ranks = jax.vmap(lambda r, o: r.at[o].set(jnp.arange(n, dtype=jnp.int32)))(
+        ranks, orig_order)
+    # k nearest in the embedding
+    _, emb_knn = jax.lax.top_k(-D_emb, k)
+    r = jnp.take_along_axis(ranks, emb_knn, axis=1).astype(jnp.float32)
+    penalty = jnp.sum(jnp.maximum(r - k + 1, 0.0) * (r >= k))
+    norm = 2.0 / (n * k * (2.0 * n - 3.0 * k - 1.0))
+    return float(1.0 - norm * penalty)
+
+
+def neighborhood_recall(res, indices, ref_indices) -> float:
+    """Mean |knn ∩ ref_knn| / k. (ref: stats/neighborhood_recall.cuh)"""
+    a = jnp.asarray(indices)
+    b = jnp.asarray(ref_indices)
+    expects(a.shape == b.shape, "neighborhood_recall: shape mismatch")
+    hits = (a[:, :, None] == b[:, None, :]).any(axis=2)
+    return float(jnp.mean(hits.astype(jnp.float32)))

@@ -1,0 +1,64 @@
+"""Histogram.
+
+(ref: cpp/include/raft/stats/histogram.cuh + detail/histogram.cuh (487 LoC,
+multi-strategy: global-atomics / shared-memory variants picked by
+``HistType``). On TPU there are no atomics; the one strategy that maps well
+is binning + segment-sum (sorted scatter-add), which XLA schedules
+efficiently — the HistType enum is kept for API parity and ignored.)
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class HistType(enum.Enum):
+    """(ref: stats/histogram.cuh ``HistType`` — strategy hints; one TPU
+    strategy serves all.)"""
+
+    Auto = "auto"
+    GlobalAtomics = "auto"
+    SmemBits = "auto"
+
+
+class IdentityBinner:
+    """(ref: stats/histogram.cuh ``IdentityBinner`` — data are bin ids)"""
+
+    def __call__(self, x, row):
+        return x.astype(jnp.int32)
+
+
+def histogram(res, data, n_bins: int, binner: Optional[Callable] = None,
+              hist_type: HistType = HistType.Auto):
+    """Batched histogram: data [n, batch] → counts [n_bins, batch].
+    1-D input gives [n_bins]. (ref: stats/histogram.cuh ``histogram`` —
+    same column-batched layout.)"""
+    data = jnp.asarray(data)
+    one_d = data.ndim == 1
+    if one_d:
+        data = data[:, None]
+    if binner is None:
+        binner = IdentityBinner()
+    cols = jnp.arange(data.shape[1])
+    bins = binner(data, cols[None, :])
+    bins = jnp.clip(bins, 0, n_bins - 1)
+
+    def col_hist(b):
+        return jnp.bincount(b, length=n_bins)
+
+    out = jax.vmap(col_hist, in_axes=1, out_axes=1)(bins)
+    return out[:, 0] if one_d else out
+
+
+def value_histogram(res, values, n_bins: int, lo=None, hi=None):
+    """Convenience equal-width binning over a value range."""
+    values = jnp.asarray(values)
+    lo = jnp.min(values) if lo is None else lo
+    hi = jnp.max(values) if hi is None else hi
+    width = jnp.maximum((hi - lo) / n_bins, 1e-30)
+    bins = jnp.clip(((values - lo) / width).astype(jnp.int32), 0, n_bins - 1)
+    return histogram(res, bins, n_bins)

@@ -1,0 +1,50 @@
+"""Classification / regression metrics.
+
+(ref: cpp/include/raft/stats/ — accuracy.cuh, r2_score.cuh,
+regression_metrics.cuh, mean_squared_error.cuh.)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from raft_tpu.linalg.reduce import mean_squared_error  # re-export (ref: stats/mean_squared_error.cuh)  # noqa: F401
+
+
+def accuracy(res, predictions, ref_predictions) -> float:
+    """Fraction of exact matches. (ref: stats/accuracy.cuh
+    ``accuracy_score``)"""
+    p = jnp.asarray(predictions)
+    r = jnp.asarray(ref_predictions)
+    return float(jnp.mean((p == r).astype(jnp.float32)))
+
+
+def r2_score(res, y, y_hat) -> float:
+    """(ref: stats/r2_score.cuh)"""
+    y = jnp.asarray(y)
+    y_hat = jnp.asarray(y_hat)
+    ss_res = jnp.sum((y - y_hat) ** 2)
+    ss_tot = jnp.sum((y - jnp.mean(y)) ** 2)
+    return float(1.0 - ss_res / ss_tot)
+
+
+class RegressionMetrics(NamedTuple):
+    """(ref: stats/regression_metrics.cuh out params)"""
+
+    mean_abs_error: float
+    mean_squared_error: float
+    median_abs_error: float
+
+
+def regression_metrics(res, predictions, ref_predictions) -> RegressionMetrics:
+    """(ref: stats/regression_metrics.cuh ``regression_metrics``)"""
+    p = jnp.asarray(predictions, jnp.float32)
+    r = jnp.asarray(ref_predictions, jnp.float32)
+    err = p - r
+    return RegressionMetrics(
+        float(jnp.mean(jnp.abs(err))),
+        float(jnp.mean(err * err)),
+        float(jnp.median(jnp.abs(err))),
+    )

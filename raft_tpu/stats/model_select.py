@@ -1,0 +1,53 @@
+"""Dispersion and information criteria.
+
+(ref: cpp/include/raft/stats/dispersion.cuh — between-cluster dispersion
+from centroids + cluster sizes; stats/information_criterion.cuh — batched
+AIC/AICc/BIC from log-likelihoods.)
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def dispersion(res, centroids, cluster_sizes, global_centroid=None,
+               n_points: Optional[int] = None) -> float:
+    """sqrt(Σ_k n_k ‖μ_k − μ‖²) — the between-group dispersion used by
+    e.g. the gap statistic. (ref: stats/dispersion.cuh ``dispersion``
+    — returns the sqrt of accumulated weighted squared deviations.)"""
+    centroids = jnp.asarray(centroids)
+    sizes = jnp.asarray(cluster_sizes, centroids.dtype)
+    if n_points is None:
+        n_points = float(jnp.sum(sizes))
+    if global_centroid is None:
+        global_centroid = (sizes[:, None] * centroids).sum(axis=0) / n_points
+    g = jnp.asarray(global_centroid)
+    dev = centroids - g[None, :]
+    return float(jnp.sqrt(jnp.sum(sizes * jnp.sum(dev * dev, axis=1))))
+
+
+class IC_Type(enum.Enum):
+    """(ref: stats/information_criterion.cuh ``IC_Type``)"""
+
+    AIC = "aic"
+    AICc = "aicc"
+    BIC = "bic"
+
+
+def information_criterion_batched(res, loglikelihood, ic_type: IC_Type,
+                                  n_params: int, batch_size: int,
+                                  n_samples: int):
+    """Batched AIC/AICc/BIC. (ref: stats/information_criterion.cuh
+    ``information_criterion_batched``)"""
+    ll = jnp.asarray(loglikelihood, jnp.float32)
+    p = float(n_params)
+    n = float(n_samples)
+    base = -2.0 * ll
+    if ic_type == IC_Type.AIC:
+        return base + 2.0 * p
+    if ic_type == IC_Type.AICc:
+        return base + 2.0 * p + 2.0 * p * (p + 1.0) / jnp.maximum(n - p - 1.0, 1e-30)
+    return base + p * jnp.log(n)

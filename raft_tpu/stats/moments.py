@@ -1,0 +1,103 @@
+"""Statistical moments.
+
+(ref: cpp/include/raft/stats/ — mean.cuh, mean_center.cuh (center/add),
+stddev.cuh, vars.cuh, meanvar.cuh (detail/meanvar.cuh 222), sum.cuh,
+weighted_mean.cuh (row/col variants), cov.cuh (gemm-based), minmax.cuh
+(detail/minmax.cuh 228).)
+
+Convention: like the reference, reductions are over rows by default —
+one statistic per column — with ``sample`` selecting the n−1 normalizer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+
+
+def sum_stat(res, data, along_rows: bool = True):
+    """(ref: stats/sum.cuh ``sum``)"""
+    return jnp.sum(jnp.asarray(data), axis=0 if along_rows else 1)
+
+
+def mean(res, data, sample: bool = False):
+    """Column means. (ref: stats/mean.cuh; ``sample`` divides by n-1)"""
+    data = jnp.asarray(data)
+    n = data.shape[0]
+    denom = (n - 1) if sample else n
+    return jnp.sum(data, axis=0) / denom
+
+
+def mean_center(res, data, mu=None):
+    """(ref: stats/mean_center.cuh ``meanCenter``)"""
+    data = jnp.asarray(data)
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    return data - jnp.asarray(mu)[None, :]
+
+
+def mean_add(res, data, mu):
+    """(ref: stats/mean_center.cuh ``meanAdd``)"""
+    return jnp.asarray(data) + jnp.asarray(mu)[None, :]
+
+
+def vars_(res, data, mu=None, sample: bool = False):
+    """Column variances. (ref: stats/vars.cuh ``vars``)"""
+    data = jnp.asarray(data)
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    mu = jnp.asarray(mu)
+    n = data.shape[0]
+    denom = (n - 1) if sample else n
+    return jnp.sum((data - mu[None, :]) ** 2, axis=0) / denom
+
+
+def stddev(res, data, mu=None, sample: bool = False):
+    """(ref: stats/stddev.cuh)"""
+    return jnp.sqrt(vars_(res, data, mu, sample))
+
+
+def meanvar(res, data, sample: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused mean+variance. (ref: stats/meanvar.cuh — single-pass kernel;
+    XLA fuses the two reductions the same way.)"""
+    data = jnp.asarray(data)
+    mu = jnp.mean(data, axis=0)
+    return mu, vars_(res, data, mu, sample)
+
+
+def weighted_mean(res, data, weights, along_rows: bool = True):
+    """Weighted mean. ``along_rows=True`` averages over rows (one value per
+    column, weights sized n_rows). (ref: stats/weighted_mean.cuh
+    ``rowWeightedMean``/``colWeightedMean``)"""
+    data = jnp.asarray(data)
+    w = jnp.asarray(weights)
+    if along_rows:
+        expects(w.shape[0] == data.shape[0], "weighted_mean: weight length")
+        return (w[:, None] * data).sum(axis=0) / w.sum()
+    expects(w.shape[0] == data.shape[1], "weighted_mean: weight length")
+    return (data * w[None, :]).sum(axis=1) / w.sum()
+
+
+def cov(res, data, mu=None, sample: bool = True, stable: bool = False):
+    """Covariance matrix of rows-as-observations. (ref: stats/cov.cuh —
+    gemm-based; ``stable`` recenters explicitly first like the reference.)"""
+    data = jnp.asarray(data)
+    n = data.shape[0]
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    mu = jnp.asarray(mu)
+    denom = (n - 1) if sample else n
+    if stable:
+        c = data - mu[None, :]
+        return jnp.matmul(c.T, c, preferred_element_type=jnp.float32) / denom
+    g = jnp.matmul(data.T, data, preferred_element_type=jnp.float32)
+    return (g - n * jnp.outer(mu, mu)) / denom
+
+
+def minmax(res, data) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-column (min, max). (ref: stats/minmax.cuh)"""
+    data = jnp.asarray(data)
+    return jnp.min(data, axis=0), jnp.max(data, axis=0)

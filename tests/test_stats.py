@@ -1,0 +1,174 @@
+"""Stats tests vs numpy/sklearn-definition references.
+(mirrors cpp/tests/stats/*.cu — moment checks, metric identities.)"""
+
+import numpy as np
+import pytest
+
+from raft_tpu import stats
+
+rng = np.random.default_rng(61)
+
+
+def test_moments(res):
+    X = rng.normal(loc=2.0, size=(200, 5)).astype(np.float32)
+    np.testing.assert_allclose(stats.mean(res, X), X.mean(axis=0), rtol=1e-4)
+    np.testing.assert_allclose(stats.sum_stat(res, X), X.sum(axis=0), rtol=1e-4)
+    np.testing.assert_allclose(stats.vars_(res, X, sample=True),
+                               X.var(axis=0, ddof=1), rtol=1e-3)
+    np.testing.assert_allclose(stats.stddev(res, X), X.std(axis=0), rtol=1e-3)
+    mu, var = stats.meanvar(res, X, sample=True)
+    np.testing.assert_allclose(mu, X.mean(axis=0), rtol=1e-4)
+    np.testing.assert_allclose(var, X.var(axis=0, ddof=1), rtol=1e-3)
+    centered = np.asarray(stats.mean_center(res, X))
+    np.testing.assert_allclose(centered.mean(axis=0), np.zeros(5), atol=1e-5)
+    np.testing.assert_allclose(stats.mean_add(res, centered, X.mean(axis=0)),
+                               X, rtol=1e-4)
+
+
+def test_weighted_mean(res):
+    X = rng.normal(size=(10, 4)).astype(np.float32)
+    w = np.abs(rng.normal(size=10)).astype(np.float32)
+    np.testing.assert_allclose(stats.weighted_mean(res, X, w),
+                               (w[:, None] * X).sum(0) / w.sum(), rtol=1e-4)
+    wc = np.abs(rng.normal(size=4)).astype(np.float32)
+    np.testing.assert_allclose(stats.weighted_mean(res, X, wc, along_rows=False),
+                               (X * wc).sum(1) / wc.sum(), rtol=1e-4)
+
+
+def test_cov(res):
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    ref = np.cov(X.T)
+    np.testing.assert_allclose(stats.cov(res, X), ref, rtol=1e-2, atol=1e-3)
+    np.testing.assert_allclose(stats.cov(res, X, stable=True), ref, rtol=1e-2,
+                               atol=1e-3)
+
+
+def test_minmax(res):
+    X = rng.normal(size=(50, 3)).astype(np.float32)
+    lo, hi = stats.minmax(res, X)
+    np.testing.assert_array_equal(lo, X.min(axis=0))
+    np.testing.assert_array_equal(hi, X.max(axis=0))
+
+
+def test_histogram(res):
+    data = rng.integers(0, 10, size=(1000, 3)).astype(np.int32)
+    h = np.asarray(stats.histogram(res, data, 10))
+    assert h.shape == (10, 3)
+    for c in range(3):
+        np.testing.assert_array_equal(h[:, c], np.bincount(data[:, c], minlength=10))
+    # 1-D and value binning
+    vals = rng.normal(size=5000).astype(np.float32)
+    hv = np.asarray(stats.value_histogram(res, vals, 20))
+    assert hv.sum() == 5000
+
+
+def test_classification_metrics(res):
+    p = np.array([1, 2, 3, 4, 5])
+    r = np.array([1, 2, 0, 4, 0])
+    assert stats.accuracy(res, p, r) == pytest.approx(0.6)
+    y = rng.normal(size=100).astype(np.float32)
+    y_hat = y + 0.1 * rng.normal(size=100).astype(np.float32)
+    ss_res = ((y - y_hat) ** 2).sum()
+    ss_tot = ((y - y.mean()) ** 2).sum()
+    assert stats.r2_score(res, y, y_hat) == pytest.approx(1 - ss_res / ss_tot,
+                                                          rel=1e-4)
+    m = stats.regression_metrics(res, y_hat, y)
+    assert m.mean_abs_error == pytest.approx(np.abs(y_hat - y).mean(), rel=1e-4)
+    assert m.mean_squared_error == pytest.approx(((y_hat - y) ** 2).mean(), rel=1e-4)
+    assert m.median_abs_error == pytest.approx(np.median(np.abs(y_hat - y)), rel=1e-3)
+
+
+def test_contingency_and_rand(res):
+    a = np.array([0, 0, 1, 1, 2, 2])
+    b = np.array([0, 0, 1, 2, 2, 2])
+    cm = np.asarray(stats.contingency_matrix(res, a, b))
+    assert cm.shape == (3, 3)
+    assert cm.sum() == 6
+    assert cm[0, 0] == 2 and cm[2, 2] == 2 and cm[1, 1] == 1 and cm[1, 2] == 1
+
+    from sklearn.metrics import adjusted_rand_score, rand_score
+
+    assert stats.rand_index(res, a, b) == pytest.approx(rand_score(a, b), rel=1e-5)
+    assert stats.adjusted_rand_index(res, a, b) == pytest.approx(
+        adjusted_rand_score(a, b), rel=1e-4)
+
+
+def test_info_metrics_vs_sklearn(res):
+    from sklearn.metrics import (completeness_score, homogeneity_score,
+                                 mutual_info_score, v_measure_score)
+
+    a = rng.integers(0, 4, 200)
+    b = rng.integers(0, 3, 200)
+    assert stats.mutual_info_score(res, a, b) == pytest.approx(
+        mutual_info_score(a, b), abs=1e-5)
+    assert stats.homogeneity_score(res, a, b) == pytest.approx(
+        homogeneity_score(a, b), abs=1e-5)
+    assert stats.completeness_score(res, a, b) == pytest.approx(
+        completeness_score(a, b), abs=1e-5)
+    assert stats.v_measure(res, a, b) == pytest.approx(
+        v_measure_score(a, b), abs=1e-5)
+
+
+def test_entropy_kl(res):
+    labels = np.array([0, 0, 0, 0])
+    assert stats.entropy(res, labels) == pytest.approx(0.0, abs=1e-7)
+    labels2 = np.array([0, 1, 0, 1])
+    assert stats.entropy(res, labels2) == pytest.approx(np.log(2), rel=1e-5)
+    p = np.array([0.5, 0.5], np.float32)
+    q = np.array([0.9, 0.1], np.float32)
+    ref = (p * np.log(p / q)).sum()
+    assert stats.kl_divergence(res, p, q) == pytest.approx(ref, rel=1e-4)
+
+
+def test_silhouette_vs_sklearn(res):
+    from sklearn.metrics import silhouette_score as sk_sil
+
+    X = np.vstack([rng.normal(0, 0.5, (30, 4)), rng.normal(5, 0.5, (30, 4))]
+                  ).astype(np.float32)
+    labels = np.repeat([0, 1], 30)
+    ours = stats.silhouette_score(res, X, labels, metric="euclidean")
+    ref = sk_sil(X, labels, metric="euclidean")
+    assert ours == pytest.approx(ref, abs=1e-3)
+    ours_b = stats.silhouette_score_batched(res, X, labels, metric="euclidean",
+                                            chunk=17)
+    assert ours_b == pytest.approx(ref, abs=1e-3)
+
+
+def test_trustworthiness_vs_sklearn(res):
+    from sklearn.manifold import trustworthiness as sk_trust
+
+    X = rng.normal(size=(60, 8)).astype(np.float32)
+    # identity embedding → 1.0
+    assert stats.trustworthiness_score(res, X, X, 5) == pytest.approx(1.0, abs=1e-5)
+    E = X[:, :2] + 0.5 * rng.normal(size=(60, 2)).astype(np.float32)
+    ours = stats.trustworthiness_score(res, X, E, 5, metric="euclidean")
+    ref = sk_trust(X, E, n_neighbors=5)
+    assert ours == pytest.approx(ref, abs=1e-3)
+
+
+def test_neighborhood_recall(res):
+    a = np.array([[0, 1, 2], [3, 4, 5]])
+    b = np.array([[0, 2, 9], [5, 4, 3]])
+    # row0: 2/3 overlap, row1: 3/3
+    assert stats.neighborhood_recall(res, a, b) == pytest.approx(5 / 6, rel=1e-5)
+
+
+def test_dispersion(res):
+    centroids = np.array([[0.0, 0.0], [4.0, 0.0]], np.float32)
+    sizes = np.array([10, 10], np.float32)
+    # global centroid (2,0); each centroid 4 away squared → 10*4+10*4 = 80
+    assert stats.dispersion(res, centroids, sizes) == pytest.approx(np.sqrt(80.0),
+                                                                    rel=1e-5)
+
+
+def test_information_criterion(res):
+    ll = np.array([-100.0, -50.0], np.float32)
+    aic = np.asarray(stats.information_criterion_batched(
+        res, ll, stats.IC_Type.AIC, n_params=3, batch_size=2, n_samples=50))
+    np.testing.assert_allclose(aic, -2 * ll + 6)
+    bic = np.asarray(stats.information_criterion_batched(
+        res, ll, stats.IC_Type.BIC, n_params=3, batch_size=2, n_samples=50))
+    np.testing.assert_allclose(bic, -2 * ll + 3 * np.log(50), rtol=1e-6)
+    aicc = np.asarray(stats.information_criterion_batched(
+        res, ll, stats.IC_Type.AICc, n_params=3, batch_size=2, n_samples=50))
+    np.testing.assert_allclose(aicc, -2 * ll + 6 + 24 / 46, rtol=1e-6)
