@@ -1,0 +1,139 @@
+// raft_tpu native host operations.
+//
+// (ref: the reference's compiled host-side pieces — thirdparty/pcg/
+// pcg_basic.c (PCG32, C, public-domain algorithm re-implemented here from
+// the PCG paper's specification: 64-bit LCG state, XSH-RR output), and the
+// host reference implementations its tests use for device-result
+// verification (cpp/tests/test_utils.cuh naive loops). The TPU framework
+// keeps the same split: JAX/XLA owns device compute, this library owns
+// host-side stream-compatible RNG and fast verification kernels, loaded
+// via ctypes (no pybind11 in this image).)
+//
+// Build: make -C cpp   (g++ -O3 -shared -fPIC)
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// ---------------- PCG32 (XSH-RR 64/32) ----------------
+// State transition: LCG with Knuth multiplier; output: xorshift-high +
+// random rotate, per the PCG specification.
+struct pcg32_state {
+  uint64_t state;
+  uint64_t inc;
+};
+
+static inline uint32_t pcg32_next(pcg32_state* s) {
+  uint64_t old = s->state;
+  s->state = old * 6364136223846793005ULL + s->inc;
+  uint32_t xorshifted = (uint32_t)(((old >> 18u) ^ old) >> 27u);
+  uint32_t rot = (uint32_t)(old >> 59u);
+  return (xorshifted >> rot) | (xorshifted << ((32u - rot) & 31u));
+}
+
+void pcg32_init(pcg32_state* s, uint64_t seed, uint64_t stream) {
+  s->state = 0U;
+  s->inc = (stream << 1u) | 1u;
+  pcg32_next(s);
+  s->state += seed;
+  pcg32_next(s);
+}
+
+void pcg32_fill_uint32(uint64_t seed, uint64_t stream, uint32_t* out,
+                       int64_t n) {
+  pcg32_state s;
+  pcg32_init(&s, seed, stream);
+  for (int64_t i = 0; i < n; ++i) out[i] = pcg32_next(&s);
+}
+
+void pcg32_fill_uniform(uint64_t seed, uint64_t stream, float* out,
+                        int64_t n) {
+  pcg32_state s;
+  pcg32_init(&s, seed, stream);
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = (float)(pcg32_next(&s) >> 8) * (1.0f / 16777216.0f);
+}
+
+// ---------------- host select_k verification ----------------
+// Partial-sort top-k per row (ref: the host reference loops the select_k
+// tests compare against). select_min: smallest-k ascending; else
+// largest-k descending. Ties broken by index (stable).
+void host_select_k(const float* in, int64_t n_rows, int64_t row_len,
+                   int64_t k, int select_min, float* out_val,
+                   int32_t* out_idx) {
+  if (k > row_len) k = row_len;  // clamp like the python fallback
+  std::vector<int32_t> idx(row_len);
+  for (int64_t r = 0; r < n_rows; ++r) {
+    const float* row = in + r * row_len;
+    std::iota(idx.begin(), idx.end(), 0);
+    auto cmp_min = [row](int32_t a, int32_t b) {
+      if (row[a] != row[b]) return row[a] < row[b];
+      return a < b;
+    };
+    auto cmp_max = [row](int32_t a, int32_t b) {
+      if (row[a] != row[b]) return row[a] > row[b];
+      return a < b;
+    };
+    if (select_min)
+      std::partial_sort(idx.begin(), idx.begin() + k, idx.end(), cmp_min);
+    else
+      std::partial_sort(idx.begin(), idx.begin() + k, idx.end(), cmp_max);
+    for (int64_t j = 0; j < k; ++j) {
+      out_val[r * k + j] = row[idx[j]];
+      out_idx[r * k + j] = idx[j];
+    }
+  }
+}
+
+// ---------------- host pairwise L2 verification ----------------
+void host_pairwise_l2(const float* x, const float* y, int64_t n, int64_t m,
+                      int64_t d, int sqrt_out, float* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    for (int64_t j = 0; j < m; ++j) {
+      double acc = 0.0;
+      for (int64_t c = 0; c < d; ++c) {
+        double diff = (double)x[i * d + c] - (double)y[j * d + c];
+        acc += diff * diff;
+      }
+      out[i * m + j] = (float)(sqrt_out ? std::sqrt(acc) : acc);
+    }
+  }
+}
+
+// ---------------- COO coalesce (sort + sum duplicates) ----------------
+// Returns the number of unique entries; out arrays must be sized nnz.
+int64_t host_coo_coalesce(const int32_t* rows, const int32_t* cols,
+                          const float* vals, int64_t nnz, int32_t n_cols,
+                          int32_t* out_rows, int32_t* out_cols,
+                          float* out_vals) {
+  std::vector<int64_t> order(nnz);
+  std::iota(order.begin(), order.end(), 0);
+  auto key = [&](int64_t i) {
+    return (int64_t)rows[i] * n_cols + cols[i];
+  };
+  std::sort(order.begin(), order.end(),
+            [&](int64_t a, int64_t b) { return key(a) < key(b); });
+  int64_t out_n = -1;
+  int64_t prev_key = -1;
+  for (int64_t t = 0; t < nnz; ++t) {
+    int64_t i = order[t];
+    int64_t k = key(i);
+    if (k != prev_key) {
+      ++out_n;
+      out_rows[out_n] = rows[i];
+      out_cols[out_n] = cols[i];
+      out_vals[out_n] = vals[i];
+      prev_key = k;
+    } else {
+      out_vals[out_n] += vals[i];
+    }
+  }
+  return out_n + 1;
+}
+
+}  // extern "C"

@@ -1,0 +1,20 @@
+"""raft_tpu.compat — the pylibraft-compatible API surface.
+
+(ref: python/pylibraft — SURVEY §7: "keep the pylibraft API names
+(eigsh, svds, rmat, DeviceResources) as the compat surface".)
+"""
+
+from raft_tpu.compat.pylibraft import (
+    DeviceResources,
+    Handle,
+    auto_sync_handle,
+    device_ndarray,
+    eigsh,
+    rmat,
+    svds,
+)
+
+__all__ = [
+    "DeviceResources", "Handle", "auto_sync_handle", "device_ndarray",
+    "eigsh", "svds", "rmat",
+]

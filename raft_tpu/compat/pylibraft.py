@@ -1,0 +1,162 @@
+"""pylibraft-compatible API.
+
+(ref: python/pylibraft/pylibraft — ``DeviceResources``
+(common/handle.pyx:21-123), deprecated ``Handle`` (:125),
+``@auto_sync_handle`` (:196), ``device_ndarray``
+(common/device_ndarray.py:16-157), ``sparse.linalg.eigsh``
+(sparse/linalg/lanczos.pyx:100), ``svds`` (sparse/linalg/svds.pyx:73),
+``random.rmat`` (random/rmat_rectangular_generator.pyx).)
+
+A pylibraft user should be able to switch imports to
+``raft_tpu.compat`` and keep their code.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import DeviceResources, Handle, ensure_resources
+
+
+def auto_sync_handle(fn):
+    """Decorator: default handle when none given, block on the result
+    before returning — pylibraft's synchronous call contract.
+    (ref: common/handle.pyx:196 ``@auto_sync_handle``)"""
+
+    @functools.wraps(fn)
+    def wrapper(*args, handle: Optional[DeviceResources] = None, **kwargs):
+        handle = ensure_resources(handle)
+        out = fn(*args, handle=handle, **kwargs)
+        jax.block_until_ready(out)
+        return out
+
+    return wrapper
+
+
+class device_ndarray:  # noqa: N801 — pylibraft spelling
+    """NumPy-like device array. (ref: common/device_ndarray.py:16 — a
+    device buffer with numpy semantics; here backed by a jax.Array.)"""
+
+    def __init__(self, np_arr):
+        self._array = jnp.asarray(np_arr)
+
+    @classmethod
+    def empty(cls, shape, dtype=np.float32, order="C"):
+        return cls(jnp.zeros(shape, dtype=dtype))
+
+    @classmethod
+    def zeros(cls, shape, dtype=np.float32):
+        return cls(jnp.zeros(shape, dtype=dtype))
+
+    @classmethod
+    def ones(cls, shape, dtype=np.float32):
+        return cls(jnp.ones(shape, dtype=dtype))
+
+    @property
+    def shape(self):
+        return self._array.shape
+
+    @property
+    def dtype(self):
+        return self._array.dtype
+
+    @property
+    def ndim(self):
+        return self._array.ndim
+
+    def copy_to_host(self) -> np.ndarray:
+        """(ref: device_ndarray.copy_to_host)"""
+        return np.asarray(self._array)
+
+    def to_jax(self) -> jax.Array:
+        return self._array
+
+    def __array__(self, dtype=None):
+        host = self.copy_to_host()
+        return host.astype(dtype) if dtype is not None else host
+
+    def __repr__(self):
+        return f"device_ndarray(shape={self.shape}, dtype={self.dtype})"
+
+
+def _unwrap(x):
+    return x.to_jax() if isinstance(x, device_ndarray) else jnp.asarray(x)
+
+
+def eigsh(A, k: int = 6, which: str = "SA", v0=None, ncv: Optional[int] = None,
+          maxiter: int = 10000, tol: float = 0.0, seed: int = 42,
+          handle: Optional[DeviceResources] = None):
+    """scipy.sparse.linalg.eigsh-compatible Lanczos.
+    (ref: sparse/linalg/lanczos.pyx:100 — same signature/defaults; accepts
+    scipy sparse, raft_tpu sparse types, device_ndarray or dense.)
+    Returns (eigenvalues, eigenvectors)."""
+    from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+    from raft_tpu.sparse.solver.lanczos import lanczos_compute_eigenpairs
+    from raft_tpu.sparse.solver.lanczos_types import LANCZOS_WHICH, LanczosSolverConfig
+
+    handle = ensure_resources(handle)
+    if isinstance(A, (COOMatrix, CSRMatrix)):
+        op = A
+    elif hasattr(A, "tocoo"):  # scipy sparse
+        coo = A.tocoo()
+        op = COOMatrix(jnp.asarray(coo.row, jnp.int32),
+                       jnp.asarray(coo.col, jnp.int32),
+                       jnp.asarray(coo.data.astype(np.float32)), coo.shape)
+    else:
+        op = _unwrap(A)
+    config = LanczosSolverConfig(
+        n_components=k, max_iterations=maxiter, ncv=ncv,
+        tolerance=tol if tol > 0 else 1e-6, which=LANCZOS_WHICH[which],
+        seed=seed)
+    vals, vecs = lanczos_compute_eigenpairs(handle, op, config, v0=v0)
+    jax.block_until_ready(vecs)
+    return vals, vecs
+
+
+def svds(A, k: int, n_oversamples: int = 10, n_power_iters: int = 2,
+         seed: int = 42, handle: Optional[DeviceResources] = None):
+    """Sparse randomized SVD. (ref: sparse/linalg/svds.pyx:73)
+    Returns (U, S, V)."""
+    from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+    from raft_tpu.sparse.convert import coo_to_csr
+    from raft_tpu.sparse.solver.randomized_svds import SvdsConfig, randomized_svds
+
+    handle = ensure_resources(handle)
+    if hasattr(A, "tocoo"):
+        coo = A.tocoo()
+        A = coo_to_csr(COOMatrix(jnp.asarray(coo.row, jnp.int32),
+                                 jnp.asarray(coo.col, jnp.int32),
+                                 jnp.asarray(coo.data.astype(np.float32)),
+                                 coo.shape))
+    elif isinstance(A, COOMatrix):
+        A = coo_to_csr(A)
+    out = randomized_svds(handle, A, SvdsConfig(
+        n_components=k, n_oversamples=n_oversamples,
+        n_power_iters=n_power_iters, seed=seed))
+    jax.block_until_ready(out)
+    return out
+
+
+def rmat(out, theta, r_scale: int, c_scale: int, seed: int = 12345,
+         handle: Optional[DeviceResources] = None):
+    """R-MAT edge generator, pylibraft signature: fills ``out`` [n_edges, 2]
+    (returned, since jax arrays are immutable).
+    (ref: random/rmat_rectangular_generator.pyx ``rmat``)"""
+    from raft_tpu.random.rmat import rmat_rectangular_gen
+    from raft_tpu.random.rng_state import RngState
+
+    handle = ensure_resources(handle)
+    n_edges = out.shape[0] if hasattr(out, "shape") else int(out)
+    src, dst = rmat_rectangular_gen(handle, RngState(seed), n_edges, r_scale,
+                                    c_scale, theta=theta)
+    result = jnp.stack([src, dst], axis=1)
+    jax.block_until_ready(result)
+    if isinstance(out, device_ndarray):
+        out._array = result
+        return out
+    return result

@@ -1,0 +1,15 @@
+"""raft_tpu.models — estimator-style wrappers over the primitive layer.
+
+The reference is a primitives library; its "models" are the composite
+pipelines downstream RAPIDS products assemble (PCA/TSVD fit-transform,
+spectral embedding, brute-force KNN). These wrappers are those pipelines
+with a scikit-learn-shaped API, and they are the flagship entry points the
+driver compile-checks (__graft_entry__).
+"""
+
+from raft_tpu.models.pca import PCA
+from raft_tpu.models.tsvd import TruncatedSVD
+from raft_tpu.models.spectral_embedding import SpectralEmbedding
+from raft_tpu.models.knn import NearestNeighbors
+
+__all__ = ["PCA", "TruncatedSVD", "SpectralEmbedding", "NearestNeighbors"]

@@ -1,0 +1,40 @@
+"""Brute-force nearest neighbors estimator — the flagship compute path
+(fused distance + top-k; BASELINE config 2). (ref: the pre-cuVS
+brute_force knn surface.)"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.distance.fused_l2nn import knn as _knn
+
+
+class NearestNeighbors:
+    def __init__(self, n_neighbors: int = 5, metric: str = "sqeuclidean",
+                 res: Optional[Resources] = None):
+        self.res = ensure_resources(res)
+        self.n_neighbors = n_neighbors
+        self.metric = metric
+        self._index = None
+
+    def fit(self, X) -> "NearestNeighbors":
+        self._index = jnp.asarray(X, jnp.float32)
+        return self
+
+    def kneighbors(self, queries, n_neighbors: Optional[int] = None
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        k = n_neighbors or self.n_neighbors
+        return _knn(self.res, self._index, queries, k, metric=self.metric)
+
+    def kneighbors_graph(self, queries):
+        """KNN as a CSR adjacency (for spectral embedding pipelines)."""
+        from raft_tpu.core.sparse_types import CSRMatrix
+
+        d, i = self.kneighbors(queries)
+        nq, k = i.shape
+        indptr = jnp.arange(nq + 1, dtype=jnp.int32) * k
+        return CSRMatrix(indptr, i.reshape(-1), d.reshape(-1),
+                         (nq, self._index.shape[0]))

@@ -1,0 +1,65 @@
+"""PCA estimator. (ref: the cuML-style PCA the reference's linalg/pca.cuh
+serves — linalg/pca_types.hpp params; estimator shape follows sklearn.)"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.linalg.pca import (
+    ParamsPCA,
+    PCAModel,
+    Solver,
+    pca_fit,
+    pca_inverse_transform,
+    pca_transform,
+)
+
+
+class PCA:
+    def __init__(self, n_components: int, whiten: bool = False,
+                 solver: Solver = Solver.COV_EIG_DC,
+                 res: Optional[Resources] = None):
+        self.res = ensure_resources(res)
+        self.prms = ParamsPCA(n_components=n_components, whiten=whiten,
+                              algorithm=solver)
+        self.model: Optional[PCAModel] = None
+
+    def fit(self, X) -> "PCA":
+        self.model = pca_fit(self.res, X, self.prms)
+        return self
+
+    def transform(self, X):
+        return pca_transform(self.res, X, self.model, self.prms)
+
+    def fit_transform(self, X):
+        return self.fit(X).transform(X)
+
+    def inverse_transform(self, T):
+        return pca_inverse_transform(self.res, T, self.model, self.prms)
+
+    @property
+    def components_(self):
+        return self.model.components
+
+    @property
+    def explained_variance_(self):
+        return self.model.explained_var
+
+    @property
+    def explained_variance_ratio_(self):
+        return self.model.explained_var_ratio
+
+    @property
+    def singular_values_(self):
+        return self.model.singular_vals
+
+    @property
+    def mean_(self):
+        return self.model.mu
+
+    @property
+    def noise_variance_(self):
+        return self.model.noise_vars

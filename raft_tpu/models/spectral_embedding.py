@@ -1,0 +1,42 @@
+"""Spectral embedding estimator — the BASELINE config-4 pipeline
+(COO Laplacian + Lanczos) as a model. (ref: spectral analysis layer +
+sparse/solver/lanczos; SURVEY §2.6 note that the BASELINE "spectral
+embedding" = compute_graph_laplacian + lanczos_compute_eigenpairs.)"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+from raft_tpu.spectral.analysis import fit_embedding
+
+
+class SpectralEmbedding:
+    def __init__(self, n_components: int = 2, normalized: bool = True,
+                 drop_first: bool = True, ncv: Optional[int] = None,
+                 tolerance: float = 1e-5, max_iterations: int = 2000,
+                 seed: int = 42, res: Optional[Resources] = None):
+        self.res = ensure_resources(res)
+        self.n_components = n_components
+        self.normalized = normalized
+        self.drop_first = drop_first
+        self.ncv = ncv
+        self.tolerance = tolerance
+        self.max_iterations = max_iterations
+        self.seed = seed
+        self.eigenvalues_ = None
+        self.embedding_ = None
+
+    def fit(self, adjacency: Union[COOMatrix, CSRMatrix]) -> "SpectralEmbedding":
+        vals, emb = fit_embedding(
+            self.res, adjacency, self.n_components, ncv=self.ncv,
+            tolerance=self.tolerance, max_iterations=self.max_iterations,
+            seed=self.seed, drop_first=self.drop_first,
+            normalized=self.normalized)
+        self.eigenvalues_ = vals
+        self.embedding_ = emb
+        return self
+
+    def fit_transform(self, adjacency):
+        return self.fit(adjacency).embedding_

@@ -1,0 +1,52 @@
+"""TruncatedSVD estimator. (ref: linalg/tsvd.cuh pipeline.)"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.linalg.pca import Solver
+from raft_tpu.linalg.tsvd import (
+    ParamsTSVD,
+    TSVDModel,
+    tsvd_fit,
+    tsvd_inverse_transform,
+    tsvd_transform,
+)
+
+
+class TruncatedSVD:
+    def __init__(self, n_components: int, solver: Solver = Solver.COV_EIG_DC,
+                 res: Optional[Resources] = None):
+        self.res = ensure_resources(res)
+        self.prms = ParamsTSVD(n_components=n_components, algorithm=solver)
+        self.model: Optional[TSVDModel] = None
+
+    def fit(self, X) -> "TruncatedSVD":
+        self.model = tsvd_fit(self.res, X, self.prms)
+        return self
+
+    def transform(self, X):
+        return tsvd_transform(self.res, X, self.model)
+
+    def fit_transform(self, X):
+        return self.fit(X).transform(X)
+
+    def inverse_transform(self, T):
+        return tsvd_inverse_transform(self.res, T, self.model)
+
+    @property
+    def components_(self):
+        return self.model.components
+
+    @property
+    def explained_variance_(self):
+        return self.model.explained_var
+
+    @property
+    def explained_variance_ratio_(self):
+        return self.model.explained_var_ratio
+
+    @property
+    def singular_values_(self):
+        return self.model.singular_vals

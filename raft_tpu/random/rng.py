@@ -23,7 +23,20 @@ from raft_tpu.random.rng_state import RngState, _as_key
 
 
 def uniform(res, state, shape, low=0.0, high=1.0, dtype=jnp.float32):
-    """(ref: rng.cuh ``uniform``)"""
+    """(ref: rng.cuh ``uniform``). An ``RngState`` with
+    ``GeneratorType.PCG`` draws from the reference-compatible PCG32 stream
+    (native hostops library; ref: thirdparty/pcg/pcg_basic.c) — for
+    bit-level stream parity with the reference's default generator."""
+    from raft_tpu.random.rng_state import GeneratorType, RngState
+
+    if isinstance(state, RngState) and state.type == GeneratorType.PCG:
+        from raft_tpu import native
+
+        n = 1
+        for s in shape:
+            n *= s
+        u = native.pcg32_uniform(state.seed, n, stream=state.base_subsequence)
+        return (jnp.asarray(u.reshape(tuple(shape)), dtype) * (high - low) + low)
     return jax.random.uniform(_as_key(state), tuple(shape), dtype, low, high)
 
 

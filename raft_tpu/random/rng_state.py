@@ -61,8 +61,14 @@ class RngState:
 
 
 def _as_key(state_or_key):
-    """Accept RngState, a jax key, or an int seed."""
+    """Accept RngState, a jax key, or an int seed. A PCG-typed state is
+    refused here: only ``uniform`` implements the PCG stream, and silently
+    substituting threefry would break the bit-parity contract."""
     if isinstance(state_or_key, RngState):
+        if state_or_key.type == GeneratorType.PCG:
+            raise NotImplementedError(
+                "GeneratorType.PCG is only supported by random.uniform(); "
+                "use THREEFRY for other distributions")
         return state_or_key.key()
     if isinstance(state_or_key, int):
         return jax.random.key(state_or_key)

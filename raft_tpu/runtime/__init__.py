@@ -1,0 +1,19 @@
+"""raft_tpu.runtime — the stable non-templated entry points.
+
+(ref: cpp/include/raft_runtime/ + cpp/src/ — the compiled ``libraft.so``
+surface: ``raft::runtime::solver::lanczos_solver`` (4 type combos,
+cpp/src/raft_runtime/solver/lanczos_solver.cuh:11), ``randomized_svds``
+(float/double), ``rmat_rectangular_generator`` (4 combos). In the reference
+these exist so Cython can call pre-compiled code; the TPU analog is an
+AOT-compiled, shape-specialized executable cached on the handle
+(``CompileCache``) — compile once per (shape, dtype) signature, reuse across
+calls, exactly the role of the explicit template instantiation.)
+"""
+
+from raft_tpu.runtime.entry_points import (
+    lanczos_solver,
+    randomized_svds,
+    rmat_rectangular_generator,
+)
+
+__all__ = ["lanczos_solver", "randomized_svds", "rmat_rectangular_generator"]

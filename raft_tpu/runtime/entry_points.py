@@ -1,0 +1,63 @@
+"""Runtime entry points (the libraft.so surface).
+
+(ref: cpp/include/raft_runtime/solver/lanczos.hpp:23 ``lanczos_solver``;
+raft_runtime/random/rmat_rectangular_generator.hpp; the randomized_svds
+instantiations in cpp/src. See package docstring for the AOT-cache design.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import ensure_resources
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+
+
+def lanczos_solver(res, rows, cols, vals, n: int, n_components: int,
+                   max_iterations: int = 1000, ncv: Optional[int] = None,
+                   tolerance: float = 1e-6, which: str = "SA", seed: int = 42,
+                   v0=None) -> Tuple[jax.Array, jax.Array]:
+    """Flat-argument Lanczos entry (the ABI the Cython layer called).
+    (ref: raft_runtime/solver/lanczos.hpp:23 — COO rows/cols/vals in,
+    eigenpairs out.)"""
+    from raft_tpu.sparse.solver.lanczos import lanczos_compute_eigenpairs
+    from raft_tpu.sparse.solver.lanczos_types import LANCZOS_WHICH, LanczosSolverConfig
+
+    res = ensure_resources(res)
+    A = COOMatrix(jnp.asarray(rows, jnp.int32), jnp.asarray(cols, jnp.int32),
+                  jnp.asarray(vals), (n, n))
+    config = LanczosSolverConfig(
+        n_components=n_components, max_iterations=max_iterations, ncv=ncv,
+        tolerance=tolerance, which=LANCZOS_WHICH[which], seed=seed)
+    return lanczos_compute_eigenpairs(res, A, config, v0=v0)
+
+
+def randomized_svds(res, indptr, indices, vals, shape: Tuple[int, int],
+                    n_components: int, n_oversamples: int = 10,
+                    n_power_iters: int = 2, seed: int = 42):
+    """Flat-argument sparse randomized SVD entry.
+    (ref: raft_runtime ``randomized_svds`` float/double instantiations.)"""
+    from raft_tpu.sparse.solver.randomized_svds import SvdsConfig
+    from raft_tpu.sparse.solver.randomized_svds import randomized_svds as _svds
+
+    res = ensure_resources(res)
+    A = CSRMatrix(jnp.asarray(indptr, jnp.int32), jnp.asarray(indices, jnp.int32),
+                  jnp.asarray(vals), shape)
+    return _svds(res, A, SvdsConfig(n_components=n_components,
+                                    n_oversamples=n_oversamples,
+                                    n_power_iters=n_power_iters, seed=seed))
+
+
+def rmat_rectangular_generator(res, theta, r_scale: int, c_scale: int,
+                               n_edges: int, seed: int = 42):
+    """(ref: raft_runtime/random/rmat_rectangular_generator.hpp — the 4
+    type-combo instantiations collapse into one dtype-generic entry.)"""
+    from raft_tpu.random.rmat import rmat_rectangular_gen
+    from raft_tpu.random.rng_state import RngState
+
+    res = ensure_resources(res)
+    return rmat_rectangular_gen(res, RngState(seed), n_edges, r_scale,
+                                c_scale, theta=theta)

@@ -52,6 +52,14 @@ def sum_duplicates(coo: COOMatrix) -> COOMatrix:
 def _reduce_duplicates(coo: COOMatrix, how: str) -> COOMatrix:
     r = np.asarray(coo.rows)
     c = np.asarray(coo.cols)
+    if how == "sum" and coo.values.dtype == jnp.float32:
+        # native host coalesce fast path (cpp/hostops.cpp host_coo_coalesce)
+        from raft_tpu import native
+
+        out_r, out_c, out_v = native.host_coo_coalesce(
+            r, c, np.asarray(coo.values), coo.shape[1])
+        return COOMatrix(jnp.asarray(out_r), jnp.asarray(out_c),
+                         jnp.asarray(out_v), coo.shape)
     keys = r.astype(np.int64) * coo.shape[1] + c
     uniq, inverse = np.unique(keys, return_inverse=True)
     seg = jnp.asarray(inverse)

@@ -1,0 +1,180 @@
+"""Models / runtime entry points / pylibraft compat / native hostops tests.
+(mirrors pylibraft tests: test_handle.py, test_device_ndarray.py,
+test_sparse.py (eigsh vs scipy), test_random.py (rmat); plus the runtime
+instantiation surface of cpp/src.)"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from raft_tpu import models, native, runtime
+from raft_tpu.compat import (
+    DeviceResources,
+    auto_sync_handle,
+    device_ndarray,
+    eigsh,
+    rmat,
+    svds,
+)
+
+rng = np.random.default_rng(71)
+
+
+# ---- models ----
+def test_pca_model(res):
+    scales = np.array([10, 8, 6, 0.3, 0.2, 0.1, 0.05, 0.01], np.float32)
+    X = rng.normal(size=(100, 8)).astype(np.float32) * scales
+    m = models.PCA(n_components=3, res=res).fit(X)
+    assert m.components_.shape == (3, 8)
+    T = m.transform(X)
+    assert T.shape == (100, 3)
+    Xr = np.asarray(m.inverse_transform(T))
+    assert np.linalg.norm(Xr - X) / np.linalg.norm(X) < 0.2
+    ev = np.asarray(m.explained_variance_ratio_)
+    assert (np.diff(ev) <= 1e-6).all()
+
+
+def test_tsvd_model(res):
+    X = rng.normal(size=(60, 6)).astype(np.float32)
+    m = models.TruncatedSVD(n_components=2, res=res).fit(X)
+    s_ref = np.linalg.svd(X, compute_uv=False)[:2]
+    np.testing.assert_allclose(np.asarray(m.singular_values_), s_ref, rtol=1e-3)
+
+
+def test_spectral_embedding_model(res):
+    n = 30
+    adj = np.zeros((n, n), np.float32)
+    r = np.random.default_rng(1)
+    for i in range(n):
+        for j in range(i + 1, n):
+            if (i < 15) == (j < 15) and r.random() < 0.7:
+                adj[i, j] = adj[j, i] = 1.0
+    adj[0, 15] = adj[15, 0] = 1.0
+    from raft_tpu.sparse import CSRMatrix
+
+    m = models.SpectralEmbedding(n_components=2, ncv=16, res=res)
+    emb = np.asarray(m.fit_transform(CSRMatrix.from_dense(adj)))
+    assert emb.shape == (30, 2)
+    f = emb[:, 0]
+    assert (f[:15] > 0).all() != (f[15:] > 0).all()
+
+
+def test_knn_model(res):
+    X = rng.normal(size=(200, 16)).astype(np.float32)
+    nn = models.NearestNeighbors(n_neighbors=4, res=res).fit(X)
+    d, i = nn.kneighbors(X[:10])
+    assert np.asarray(i).shape == (10, 4)
+    assert (np.asarray(i)[:, 0] == np.arange(10)).all()
+    g = nn.kneighbors_graph(X[:10])
+    assert g.shape == (10, 200) and g.nnz == 40
+
+
+# ---- runtime entry points ----
+def test_runtime_lanczos(res):
+    d = rng.normal(size=(40, 40)).astype(np.float32)
+    d = (d + d.T) / 2
+    coo = sp.coo_matrix(d)
+    vals, vecs = runtime.lanczos_solver(res, coo.row, coo.col, coo.data,
+                                        40, 3, ncv=20)
+    w_ref = np.linalg.eigvalsh(d)[:3]
+    np.testing.assert_allclose(np.asarray(vals), w_ref, atol=1e-3)
+
+
+def test_runtime_svds_and_rmat(res):
+    m = sp.random(50, 30, density=0.3, random_state=0, dtype=np.float32).tocsr()
+    U, S, V = runtime.randomized_svds(res, m.indptr, m.indices, m.data,
+                                      (50, 30), 4, n_power_iters=3)
+    s_ref = np.linalg.svd(m.toarray(), compute_uv=False)[:4]
+    np.testing.assert_allclose(np.asarray(S), s_ref, rtol=0.05)
+    src, dst = runtime.rmat_rectangular_generator(res, None, 6, 6, 500)
+    assert np.asarray(src).max() < 64 and np.asarray(dst).max() < 64
+
+
+# ---- pylibraft compat ----
+def test_device_resources_compat():
+    h = DeviceResources()
+    assert h.platform == "cpu"
+
+
+def test_device_ndarray():
+    a = device_ndarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert a.shape == (2, 3) and a.ndim == 2
+    np.testing.assert_array_equal(a.copy_to_host(), np.arange(6).reshape(2, 3))
+    np.testing.assert_array_equal(np.asarray(a), a.copy_to_host())
+    z = device_ndarray.zeros((3,))
+    assert z.copy_to_host().sum() == 0
+
+
+def test_auto_sync_handle():
+    calls = {}
+
+    @auto_sync_handle
+    def fn(x, handle=None):
+        calls["handle"] = handle
+        import jax.numpy as jnp
+
+        return jnp.asarray(x) * 2
+
+    out = fn(np.ones(3))
+    assert calls["handle"] is not None
+    np.testing.assert_array_equal(np.asarray(out), [2, 2, 2])
+
+
+def test_eigsh_scipy_compat(res):
+    from scipy.sparse.linalg import eigsh as scipy_eigsh
+
+    d = rng.normal(size=(50, 50)).astype(np.float32)
+    d = (d + d.T) / 2
+    A = sp.csr_matrix(d * (np.abs(d) > 0.5))
+    dense = A.toarray()
+    vals, vecs = eigsh(A, k=4, which="SA", ncv=24, tol=1e-6, handle=res)
+    ref_vals = scipy_eigsh(dense.astype(np.float64), k=4, which="SA")[0]
+    np.testing.assert_allclose(np.sort(np.asarray(vals)), np.sort(ref_vals),
+                               atol=2e-3)
+    assert vecs.shape == (50, 4)
+
+
+def test_svds_scipy_compat(res):
+    A = sp.random(60, 40, density=0.2, random_state=1, dtype=np.float32)
+    U, S, V = svds(A, k=3, n_power_iters=4, handle=res)
+    s_ref = np.linalg.svd(A.toarray(), compute_uv=False)[:3]
+    np.testing.assert_allclose(np.asarray(S), s_ref, rtol=0.05)
+
+
+def test_rmat_compat(res):
+    out = device_ndarray.zeros((1000, 2), dtype=np.int32)
+    result = rmat(out, None, 8, 8, seed=3, handle=res)
+    arr = result.copy_to_host()
+    assert arr.shape == (1000, 2)
+    assert arr.max() < 256
+
+
+# ---- native hostops ----
+def test_native_pcg_bit_exact():
+    a = native.pcg32_uint32(123, 32, stream=5)
+    b = native._pcg32_python(123, 5, 32)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_native_select_k_and_pairwise():
+    v = rng.normal(size=(6, 50)).astype(np.float32)
+    ov, oi = native.host_select_k(v, 4, select_min=True)
+    np.testing.assert_allclose(ov, np.sort(v, axis=1)[:, :4], rtol=1e-6)
+    x = rng.normal(size=(5, 8)).astype(np.float32)
+    y = rng.normal(size=(7, 8)).astype(np.float32)
+    from scipy.spatial.distance import cdist
+
+    np.testing.assert_allclose(native.host_pairwise_l2(x, y),
+                               cdist(x, y, "sqeuclidean"), rtol=1e-5)
+
+
+def test_pcg_generator_type(res):
+    from raft_tpu.random import GeneratorType, RngState, uniform
+
+    st = RngState(7, type=GeneratorType.PCG)
+    u = np.asarray(uniform(res, st, (1000,)))
+    assert 0 <= u.min() and u.max() < 1
+    assert u.mean() == pytest.approx(0.5, abs=0.05)
+    # same state → same stream
+    u2 = np.asarray(uniform(res, RngState(7, type=GeneratorType.PCG), (1000,)))
+    np.testing.assert_array_equal(u, u2)
