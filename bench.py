@@ -40,22 +40,18 @@ def main():
         n_index, dim, n_queries, k, tile = 50_000, 64, 256, 64, 8192
         reps = 1
 
+    from raft_tpu.benchmark import Fixture
+
     X, _ = make_blobs(res, RngState(0), n_index, dim, n_clusters=64,
                       cluster_std=2.0)
     Q = X[:n_queries]
     jax.block_until_ready(X)
 
-    # warmup / compile
-    d, i = distance.knn(res, X, Q, k=k, tile=tile)
-    jax.block_until_ready((d, i))
-
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        d, i = distance.knn(res, X, Q, k=k, tile=tile)
-        jax.block_until_ready((d, i))
-        times.append(time.perf_counter() - t0)
-    dt = min(times)
+    # Fixture forces completion with a one-element fetch and subtracts the
+    # transport round-trip (tunneled devices may return from
+    # block_until_ready before execution finishes).
+    fx = Fixture(res=res, reps=reps)
+    dt = fx.run(lambda q: distance.knn(res, X, q, k=k, tile=tile), Q)["seconds"]
 
     eff_bytes = n_queries * n_index * 4.0
     gbps = eff_bytes / dt / 1e9

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Primitive micro-benchmarks.
+
+(ref: cpp/bench/prims/ — the benchmark list in SURVEY §4.3: linalg {add,
+map_then_reduce, masked_matmul, matrix_vector_op, norm, normalize, reduce,
+reduce_rows_by_key, sddmm, transpose}, matrix {argmin, gather, select_k},
+random {make_blobs, permute, rng, subsample}, sparse {convert}, core
+{bitset, copy}. Run: python benchmarks/bench_prims.py [--small])
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true",
+                    help="small sizes (CI / CPU smoke)")
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+
+    import raft_tpu
+    from raft_tpu import linalg, matrix, sparse, stats
+    from raft_tpu.benchmark import Fixture
+    from raft_tpu.random import RngState, make_blobs, permute, uniform
+    from raft_tpu.sparse import CSRMatrix
+
+    res = raft_tpu.device_resources()
+    small = args.small or res.platform != "tpu"
+    n, d = (100_000, 128) if not small else (10_000, 64)
+    fx = Fixture(res=res, reps=3)
+    X, _ = make_blobs(res, RngState(0), n, d, n_clusters=16)
+    fbytes = n * d * 4
+
+    rows = []
+
+    def rec(name, r, nbytes):
+        rows.append((name, r["seconds"] * 1e3, nbytes / r["seconds"] / 1e9))
+
+    rec("linalg.add", fx.run(lambda a: linalg.add(res, a, a), X), 2 * fbytes)
+    rec("linalg.reduce(rows)", fx.run(lambda a: linalg.reduce(res, a), X), fbytes)
+    rec("linalg.map_then_reduce",
+        fx.run(lambda a: linalg.map_then_reduce(res, a, map_op=lambda x: x * x), X),
+        fbytes)
+    rec("linalg.norm(L2,rows)", fx.run(lambda a: linalg.row_norm(res, a), X), fbytes)
+    rec("linalg.normalize", fx.run(lambda a: linalg.normalize(res, a), X), 2 * fbytes)
+    rec("linalg.matrix_vector_op",
+        fx.run(lambda a: linalg.binary_add(res, a, jnp.ones((d,), jnp.float32)), X),
+        2 * fbytes)
+    keys = jnp.asarray(np.random.default_rng(0).integers(0, 16, n))
+    rec("linalg.reduce_rows_by_key",
+        fx.run(lambda a: linalg.reduce_rows_by_key(res, a, keys, 16), X), fbytes)
+    rec("linalg.transpose", fx.run(lambda a: linalg.transpose(res, a) + 0.0, X),
+        2 * fbytes)
+    rec("matrix.argmin", fx.run(lambda a: matrix.argmin(res, a), X), fbytes)
+    idx = jnp.asarray(np.random.default_rng(1).integers(0, n, n // 2))
+    rec("matrix.gather", fx.run(lambda a: matrix.gather(res, a, idx), X),
+        fbytes // 2 * 3)
+    rec("matrix.select_k(64)",
+        fx.run(lambda a: matrix.select_k(res, a.reshape(-1, d * 64), k=64)[0],
+               X[: (n // 64) * 64]), fbytes)
+    rec("random.make_blobs",
+        fx.run(lambda s: make_blobs(res, RngState(1), n, d)[0], X), fbytes)
+    rec("random.rng.uniform",
+        fx.run(lambda s: uniform(res, RngState(2), (n, d)), X), fbytes)
+    rec("random.permute", fx.run(lambda a: permute(res, RngState(3), a)[1], X),
+        2 * fbytes)
+    rec("stats.histogram",
+        fx.run(lambda a: stats.value_histogram(res, a.ravel(), 64), X), fbytes)
+
+    dense = np.array(X[:2048, :64])
+    dense[np.random.default_rng(2).random(dense.shape) > 0.1] = 0
+    csr = CSRMatrix.from_dense(dense)
+    B = jnp.asarray(np.random.default_rng(3).normal(size=(64, 32)).astype(np.float32))
+    rec("sparse.spmm", fx.run(lambda b: sparse.linalg.spmm(res, csr, b), B),
+        csr.nnz * 4 * 32)
+    mask = np.zeros((2048, 32), np.float32)
+    mask[np.random.default_rng(4).random(mask.shape) < 0.1] = 1
+    structure = CSRMatrix.from_dense(mask)
+    rec("sparse.sddmm",
+        fx.run(lambda b: sparse.linalg.sddmm(res, jnp.asarray(dense), b,
+                                             structure).values, B),
+        structure.nnz * 4)
+
+    print(f"{'benchmark':<28}{'ms':>10}{'GB/s':>10}")
+    for name, ms, gbs in rows:
+        print(f"{name:<28}{ms:>10.3f}{gbs:>10.1f}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

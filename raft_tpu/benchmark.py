@@ -1,0 +1,75 @@
+"""Micro-benchmark harness.
+
+(ref: cpp/bench/prims/common/benchmark.hpp:59,99 — the google-benchmark
+``fixture`` with RMM pool option and ``cuda_event_timer`` for device-time
+measurement, plus data generators like ``BlobsFixture:176``. The TPU
+equivalent measures device time by forcing completion with a one-element
+fetch and subtracting the transport round-trip (tunneled devices may
+return from block_until_ready before execution finishes — measured fact on
+the axon transport).)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import ensure_resources
+
+
+class Fixture:
+    """(ref: bench/prims/common/benchmark.hpp ``class fixture``)"""
+
+    def __init__(self, res=None, reps: int = 5, warmup: int = 1):
+        self.res = ensure_resources(res)
+        self.reps = reps
+        self.warmup = warmup
+        self._rtt: Optional[float] = None
+
+    def _measure_rtt(self, probe) -> float:
+        if self._rtt is None:
+            trivial = jax.jit(lambda x: x.ravel()[0] * 2.0)
+            float(np.asarray(trivial(probe)))  # compile
+            t0 = time.perf_counter()
+            float(np.asarray(trivial(probe)))
+            self._rtt = time.perf_counter() - t0
+        return self._rtt
+
+    def run(self, fn: Callable, *args) -> Dict[str, float]:
+        """Time fn(*args); returns {"seconds", "rtt"} with transport
+        round-trip subtracted. (ref: ``cuda_event_timer`` role)"""
+        out = fn(*args)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        float(np.asarray(leaf).ravel()[0])  # compile + completion
+        rtt = self._measure_rtt(jax.tree_util.tree_leaves(args)[0])
+        times = []
+        for _ in range(self.reps):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            float(np.asarray(leaf).ravel()[0])
+            times.append(time.perf_counter() - t0)
+        return {"seconds": max(min(times) - rtt, 1e-9), "rtt": rtt}
+
+    def throughput(self, fn: Callable, nbytes: float, *args) -> Dict[str, float]:
+        r = self.run(fn, *args)
+        r["gb_per_s"] = nbytes / r["seconds"] / 1e9
+        return r
+
+
+class BlobsFixture(Fixture):
+    """(ref: benchmark.hpp ``BlobsFixture:176``)"""
+
+    def __init__(self, n_samples: int, n_features: int, n_clusters: int = 8,
+                 seed: int = 0, **kw):
+        super().__init__(**kw)
+        from raft_tpu.random import RngState, make_blobs
+
+        self.X, self.labels = make_blobs(
+            self.res, RngState(seed), n_samples, n_features,
+            n_clusters=n_clusters)
+        jax.block_until_ready(self.X)

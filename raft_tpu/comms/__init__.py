@@ -10,9 +10,11 @@ from raft_tpu.comms.session import (
     local_handle,
 )
 from raft_tpu.comms import test_battery
+from raft_tpu.comms.mpi import detect_mpi_environment, initialize_mpi_comms
 
 __all__ = [
     "DataType", "Op", "Status", "MeshComms", "HostComms", "get_type",
     "Comms", "initialize_distributed", "inject_comms_on_handle",
-    "local_handle", "test_battery",
+    "local_handle", "test_battery", "detect_mpi_environment",
+    "initialize_mpi_comms",
 ]

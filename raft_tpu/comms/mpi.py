@@ -1,0 +1,53 @@
+"""MPI-launched multi-host bootstrap.
+
+(ref: cpp/include/raft/comms/mpi_comms.hpp ``initialize_mpi_comms`` +
+comms/detail/mpi_comms.hpp:99-121 — MPI provides rank/size/rendezvous and
+NCCL is derived from the MPI communicator by broadcasting the uniqueId.
+The TPU analog: when launched under mpirun/srun, read the launcher's
+environment for (rank, size, coordinator) and hand them to
+``jax.distributed.initialize`` — the coordinator plays the uniqueId
+broadcast role; the resulting global device set forms the mesh.)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from raft_tpu.core.error import expects
+
+
+def detect_mpi_environment() -> Optional[Tuple[int, int]]:
+    """(rank, size) from OpenMPI/MPICH/SLURM launcher env, or None."""
+    for rank_var, size_var in (
+        ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
+        ("PMI_RANK", "PMI_SIZE"),
+        ("SLURM_PROCID", "SLURM_NTASKS"),
+    ):
+        if rank_var in os.environ and size_var in os.environ:
+            return int(os.environ[rank_var]), int(os.environ[size_var])
+    return None
+
+
+def initialize_mpi_comms(coordinator_address: Optional[str] = None,
+                         coordinator_port: int = 8476):
+    """Bootstrap jax.distributed from an MPI-style launch and return the
+    initialized (rank, size). (ref: comms/mpi_comms.hpp
+    ``initialize_mpi_comms``)"""
+    import jax
+
+    env = detect_mpi_environment()
+    expects(env is not None,
+            "initialize_mpi_comms: no MPI launcher environment detected")
+    rank, size = env
+    if coordinator_address is None:
+        # every rank must agree on rank 0's address; the local HOSTNAME
+        # would differ per host, so it must come from the launcher env
+        host = os.environ.get("RAFT_TPU_COORDINATOR")
+        expects(host is not None or size == 1,
+                "initialize_mpi_comms: set RAFT_TPU_COORDINATOR to rank 0's "
+                "host (or pass coordinator_address) for multi-host launches")
+        coordinator_address = f"{host or 'localhost'}:{coordinator_port}"
+    jax.distributed.initialize(coordinator_address, num_processes=size,
+                               process_id=rank)
+    return rank, size

@@ -66,6 +66,18 @@ from raft_tpu.core.memory import (
     ResourceMonitor,
     device_memory_stats,
 )
+from raft_tpu.core.manager import (
+    DeviceResourcesManager,
+    get_device_resources,
+    get_device_resources_manager,
+)
+from raft_tpu.core.buffers import (
+    TemporaryDeviceBuffer,
+    MmapMemoryResource,
+    device_span,
+    host_span,
+    memory_type_dispatcher,
+)
 
 __all__ = [
     "RaftException", "LogicError", "DeviceError", "OutOfMemoryError",
@@ -83,4 +95,8 @@ __all__ = [
     "deserialize_scalar", "mdspan_to_bytes", "mdspan_from_bytes",
     "MemoryTracker", "StatisticsAdaptor", "NotifyingAdaptor",
     "ResourceMonitor", "device_memory_stats",
+    "DeviceResourcesManager", "get_device_resources",
+    "get_device_resources_manager",
+    "TemporaryDeviceBuffer", "MmapMemoryResource", "device_span",
+    "host_span", "memory_type_dispatcher",
 ]

@@ -1,0 +1,104 @@
+"""Temporary device buffers, spans, mmap-backed host memory, and
+memory-type dispatch.
+
+(ref: cpp/include/raft/core/temporary_device_buffer.hpp — device temp
+holding a possibly-host mdspan's data; core/span.hpp /
+device_span.hpp / host_span.hpp; mr/mmap_memory_resource.hpp:86 —
+file-backed host allocations for larger-than-RAM staging;
+util/memory_type_dispatcher.cuh — dispatch a callable by an mdbuffer's
+memory type.)
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import tempfile
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.mdarray import MdBuffer, MdSpan, MemoryType, wrap
+from raft_tpu.core.resources import ensure_resources
+
+
+class TemporaryDeviceBuffer:
+    """Ensure data is device-resident for a scope; mirrors back on request.
+    (ref: core/temporary_device_buffer.hpp — the write-back semantics are
+    explicit here since jax arrays are immutable.)"""
+
+    def __init__(self, res, data, write_back: bool = False):
+        self._res = ensure_resources(res)
+        self._src = data
+        self._write_back = write_back
+        src_arr = data.as_jax() if isinstance(data, MdSpan) else jnp.asarray(data)
+        self._device_arr = jax.device_put(src_arr, self._res.device)
+
+    def view(self) -> jax.Array:
+        """(ref: temporary_device_buffer::view)"""
+        return self._device_arr
+
+    def update(self, new_value) -> None:
+        self._device_arr = jnp.asarray(new_value)
+
+    def release(self):
+        """Return the (possibly updated) host copy when write_back."""
+        if self._write_back:
+            return np.asarray(self._device_arr)
+        return self._device_arr
+
+
+# ---- spans (ref: core/span.hpp — std::span vocabulary) ----
+def device_span(arr) -> MdSpan:
+    """(ref: core/device_span.hpp)"""
+    return wrap(jnp.asarray(arr), MemoryType.DEVICE)
+
+
+def host_span(arr) -> MdSpan:
+    """(ref: core/host_span.hpp)"""
+    return wrap(np.asarray(arr), MemoryType.HOST)
+
+
+class MmapMemoryResource:
+    """File-backed host allocations (larger-than-RAM staging buffers).
+    (ref: mr/mmap_memory_resource.hpp:86 — mmap'd allocations, optionally
+    backed by a named file for persistence/huge pages.)"""
+
+    def __init__(self, directory: Optional[str] = None):
+        self.directory = directory
+
+    def allocate(self, shape, dtype=np.float32,
+                 filename: Optional[str] = None) -> np.ndarray:
+        """Returns a numpy array backed by an mmap'd file."""
+        dtype = np.dtype(dtype)
+        if filename is None:
+            fd, filename = tempfile.mkstemp(dir=self.directory,
+                                            suffix=".raft_tpu.mmap")
+            os.close(fd)
+        arr = np.memmap(filename, dtype=dtype, mode="w+", shape=tuple(shape))
+        return arr
+
+    @staticmethod
+    def deallocate(arr: np.ndarray) -> None:
+        if isinstance(arr, np.memmap):
+            path = arr.filename
+            del arr
+            if path and os.path.exists(path):
+                os.unlink(path)
+
+
+def memory_type_dispatcher(buf: "MdBuffer | MdSpan | Any",
+                           device_fn: Callable,
+                           host_fn: Optional[Callable] = None):
+    """Dispatch a callable by where the data lives, converting through
+    MdBuffer when only one variant exists.
+    (ref: util/memory_type_dispatcher.cuh)"""
+    if not isinstance(buf, MdBuffer):
+        buf = MdBuffer(buf)
+    if buf.memory_type == MemoryType.HOST and host_fn is not None:
+        return host_fn(buf.view().as_numpy())
+    if buf.memory_type == MemoryType.HOST:
+        return device_fn(buf.view(MemoryType.DEVICE).as_jax())
+    return device_fn(buf.view().as_jax())

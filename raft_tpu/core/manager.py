@@ -1,0 +1,94 @@
+"""Process-global device resources manager.
+
+(ref: cpp/include/raft/core/device_resources_manager.hpp:75-562 ``struct
+device_resources_manager`` — a process singleton configured once (stream
+pools per device, RMM pool sizes), after which ``get_device_resources()``
+hands out per-thread handles round-robin. The TPU analog keeps the
+configure-then-serve lifecycle: options are set before first use
+(workspace budgets, seed policy, device set), then per-thread handles are
+served round-robin over devices, sharing the process-wide compile cache.)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.logger import log_warn
+from raft_tpu.core.resource_types import ResourceType
+from raft_tpu.core.resources import CompileCache, DeviceResources
+
+
+class DeviceResourcesManager:
+    """(ref: device_resources_manager.hpp:75)"""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._initialized = False
+        self._devices: Optional[List] = None
+        self._workspace_limit: Optional[int] = None
+        self._base_seed = 0
+        self._handles: Dict[int, DeviceResources] = {}
+        self._thread_slots: Dict[int, int] = {}
+        self._next_slot = 0
+        self._shared_cache = CompileCache()
+
+    # -- configuration (before first get) ---------------------------------
+    def _check_not_initialized(self, what: str):
+        if self._initialized:
+            log_warn("device_resources_manager: %s ignored after first use",
+                     what)
+            return False
+        return True
+
+    def set_devices(self, devices: Sequence) -> None:
+        with self._lock:
+            if self._check_not_initialized("set_devices"):
+                self._devices = list(devices)
+
+    def set_workspace_allocation_limit(self, nbytes: int) -> None:
+        """(ref: set_workspace_memory_resource / pool options)"""
+        with self._lock:
+            if self._check_not_initialized("set_workspace_allocation_limit"):
+                self._workspace_limit = int(nbytes)
+
+    def set_base_seed(self, seed: int) -> None:
+        with self._lock:
+            if self._check_not_initialized("set_base_seed"):
+                self._base_seed = int(seed)
+
+    # -- serving -----------------------------------------------------------
+    def get_device_resources(self) -> DeviceResources:
+        """Per-thread handle, devices assigned round-robin.
+        (ref: device_resources_manager.hpp ``get_device_resources()``)"""
+        tid = threading.get_ident()
+        with self._lock:
+            self._initialized = True
+            devices = self._devices if self._devices is not None else jax.devices()
+            slot = self._thread_slots.get(tid)
+            if slot is None:
+                slot = self._next_slot % len(devices)
+                self._thread_slots[tid] = slot
+                self._next_slot += 1
+            if slot not in self._handles:
+                h = DeviceResources(device=devices[slot],
+                                    seed=self._base_seed + slot,
+                                    workspace_limit=self._workspace_limit)
+                h.set_resource(ResourceType.COMPILE_CACHE, self._shared_cache)
+                self._handles[slot] = h
+            return self._handles[slot]
+
+
+_manager = DeviceResourcesManager()
+
+
+def get_device_resources_manager() -> DeviceResourcesManager:
+    return _manager
+
+
+def get_device_resources() -> DeviceResources:
+    """(ref: ``raft::device_resources_manager::get_device_resources()``)"""
+    return _manager.get_device_resources()

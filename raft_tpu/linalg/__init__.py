@@ -78,3 +78,4 @@ from raft_tpu.linalg.tsvd import (
     tsvd_transform,
     tsvd_inverse_transform,
 )
+from raft_tpu.linalg.contractions import KernelPolicy, tiled_contraction

@@ -1,0 +1,71 @@
+"""The tiled contraction substrate.
+
+(ref: cpp/include/raft/linalg/contractions.cuh + detail/contractions.cuh
+(313 LoC) — the ``KernelPolicy`` smem-tiling base that the pre-cuVS
+pairwise-distance kernels were built on; SURVEY §7 stage 10 names it the
+substrate to rebuild.)
+
+TPU-first rendering: the "policy" is the workspace-budgeted tile plan, and
+the inner loop is an MXU contraction with a user epilogue — the same shape
+as the reference's ``ldgXY/stsXY`` accumulate loop, but the compiler owns
+the VMEM staging. ``tiled_contraction`` is what pairwise_distance and the
+fused sweeps specialize.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import ensure_resources
+
+
+class KernelPolicy:
+    """Tile plan (ref: contractions.cuh ``KernelPolicy`` — smem tile
+    extents become VMEM-friendly row/column tile sizes)."""
+
+    def __init__(self, m_tile: int = 1024, n_tile: int = 8192):
+        self.m_tile = int(m_tile)
+        self.n_tile = int(n_tile)
+
+    @classmethod
+    def from_workspace(cls, res, n_cols: int, bytes_per_elem: int = 4
+                       ) -> "KernelPolicy":
+        res = ensure_resources(res)
+        budget = res.workspace.allocation_limit
+        n_tile = max(128, min(8192, budget // (2 * bytes_per_elem * max(n_cols, 1))))
+        return cls(m_tile=1024, n_tile=n_tile)
+
+
+def tiled_contraction(res, x, y, epilogue: Callable,
+                      policy: Optional[KernelPolicy] = None,
+                      accumulate: Optional[Callable] = None, init=None):
+    """Compute ``epilogue(x_tile·yᵀ_tile, x_tile, y_tile)`` over row tiles
+    of x and fold results with ``accumulate`` (or concatenate when None).
+
+    epilogue(ip [mt, nt], x_tile [mt, d], y_tile [nt, d]) -> per-tile out.
+    """
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if policy is None:
+        policy = KernelPolicy.from_workspace(res, x.shape[1])
+    outs = []
+    acc = init
+    for m0 in range(0, x.shape[0], policy.m_tile):
+        xt = x[m0:m0 + policy.m_tile]
+        row_outs = []
+        for n0 in range(0, y.shape[0], policy.n_tile):
+            yt = y[n0:n0 + policy.n_tile]
+            ip = jnp.matmul(xt, yt.T, preferred_element_type=jnp.float32)
+            out = epilogue(ip, xt, yt)
+            if accumulate is None:
+                row_outs.append(out)
+            else:
+                acc = accumulate(acc, out, m0, n0)
+        if accumulate is None:
+            outs.append(jnp.concatenate(row_outs, axis=1))
+    if accumulate is None:
+        return jnp.concatenate(outs, axis=0)
+    return acc

@@ -127,6 +127,8 @@ def lanczos_compute_eigenpairs(
 
     j0 = 0
     n_steps = 0
+    best_resid = None
+    stagnant = 0
     with nvtx.annotate("lanczos_compute_eigenpairs"):
         while True:
             interruptible.yield_()  # cancellation point per restart cycle
@@ -136,9 +138,35 @@ def lanczos_compute_eigenpairs(
             idx = _select(theta, config.which, k)
             resid = jnp.abs(beta_last * S[ncv - 1, idx])
             scale = jnp.maximum(jnp.max(jnp.abs(theta)), 1e-30)
-            if bool(jnp.all(resid <= config.tolerance * scale)) or \
-                    n_steps >= config.max_iterations:
+            max_resid = float(jnp.max(resid))
+            if bool(jnp.all(resid <= config.tolerance * scale)):
                 break
+            if n_steps >= config.max_iterations:
+                from raft_tpu.core.logger import log_warn
+
+                log_warn("lanczos: max_iterations=%d reached with relative "
+                         "residual %.3e > tolerance %.3e",
+                         config.max_iterations, max_resid / float(scale),
+                         config.tolerance)
+                break
+            # stop on stagnation: when the residual stops improving on its
+            # best for many cycles the fp32 floor has been reached and
+            # further restarts only burn cycles
+            if best_resid is None or max_resid < 0.99 * best_resid:
+                best_resid = max_resid if best_resid is None else min(
+                    best_resid, max_resid)
+                stagnant = 0
+            else:
+                stagnant += 1
+                if stagnant >= 10:
+                    from raft_tpu.core.logger import log_warn
+
+                    log_warn("lanczos: residual stagnated at %.3e (relative "
+                             "%.3e > tolerance %.3e) — fp32 floor reached, "
+                             "returning best available eigenpairs",
+                             max_resid, max_resid / float(scale),
+                             config.tolerance)
+                    break
             # thick restart: wanted ritz vectors + the residual direction
             S_sel = S[:, idx]                      # [ncv, k]
             ritz = S_sel.T @ V[:ncv]               # [k, n]

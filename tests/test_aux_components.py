@@ -1,0 +1,144 @@
+"""Aux component tests: resources manager, temporary buffers, spans, mmap
+MR, memory-type dispatch, contraction substrate, MPI env detection,
+benchmark fixture. (mirrors cpp/tests/core/device_resources_manager.cpp,
+temporary_device_buffer tests, mr tests, and the bench fixture role.)"""
+
+import os
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+from raft_tpu.benchmark import BlobsFixture, Fixture
+from raft_tpu.comms.mpi import detect_mpi_environment
+from raft_tpu.core import (
+    DeviceResourcesManager,
+    MdBuffer,
+    MmapMemoryResource,
+    TemporaryDeviceBuffer,
+    device_span,
+    get_device_resources,
+    host_span,
+    memory_type_dispatcher,
+)
+
+rng = np.random.default_rng(91)
+
+
+def test_manager_round_robin():
+    mgr = DeviceResourcesManager()
+    mgr.set_base_seed(5)
+    mgr.set_workspace_allocation_limit(1 << 22)
+    handles = {}
+
+    def worker(i):
+        handles[i] = mgr.get_device_resources()
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    devices_used = {h.device for h in handles.values()}
+    assert len(devices_used) == 4  # spread across the 8-device cpu platform
+    # same thread gets the same handle back
+    h1 = mgr.get_device_resources()
+    h2 = mgr.get_device_resources()
+    assert h1 is h2
+    assert h1.workspace.allocation_limit == 1 << 22
+    # config after first use is ignored (with a warning, not an error)
+    mgr.set_base_seed(99)
+    # shared compile cache across handles
+    any_handle = next(iter(handles.values()))
+    assert any_handle.compile_cache is h1.compile_cache
+
+
+def test_global_manager():
+    h = get_device_resources()
+    assert h is get_device_resources()
+
+
+def test_temporary_device_buffer(res):
+    src = np.arange(6, dtype=np.float32).reshape(2, 3)
+    buf = TemporaryDeviceBuffer(res, src, write_back=True)
+    v = buf.view()
+    assert isinstance(v, jnp.ndarray)
+    buf.update(v * 2)
+    out = buf.release()
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_array_equal(out, src * 2)
+
+
+def test_spans():
+    d = device_span(jnp.arange(4.0))
+    h = host_span(np.arange(4.0))
+    assert d.memory_type.name == "DEVICE"
+    assert h.memory_type.name == "HOST"
+    np.testing.assert_array_equal(d.as_numpy(), h.as_numpy())
+
+
+def test_mmap_memory_resource():
+    mr = MmapMemoryResource()
+    arr = mr.allocate((100, 4), np.float32)
+    arr[:] = 7.0
+    arr.flush()
+    assert os.path.exists(arr.filename)
+    path = arr.filename
+    MmapMemoryResource.deallocate(arr)
+    assert not os.path.exists(path)
+
+
+def test_memory_type_dispatcher():
+    calls = []
+
+    def dev_fn(x):
+        calls.append("device")
+        return x * 2
+
+    def host_fn(x):
+        calls.append("host")
+        return x * 3
+
+    out = memory_type_dispatcher(np.ones(3), dev_fn, host_fn)
+    assert calls == ["host"] and float(np.asarray(out)[0]) == 3.0
+    out = memory_type_dispatcher(jnp.ones(3), dev_fn, host_fn)
+    assert calls[-1] == "device" and float(out[0]) == 2.0
+    # host data with only a device fn → converted through MdBuffer
+    out = memory_type_dispatcher(np.ones(3), dev_fn)
+    assert float(out[0]) == 2.0
+
+
+def test_tiled_contraction(res):
+    x = rng.normal(size=(40, 16)).astype(np.float32)
+    y = rng.normal(size=(70, 16)).astype(np.float32)
+    pol = linalg.KernelPolicy(m_tile=16, n_tile=32)
+    out = linalg.tiled_contraction(
+        res, x, y, epilogue=lambda ip, xt, yt: ip, policy=pol)
+    np.testing.assert_allclose(np.asarray(out), x @ y.T, rtol=1e-4, atol=1e-4)
+    # accumulate mode: global sum of products
+    total = linalg.tiled_contraction(
+        res, x, y, epilogue=lambda ip, xt, yt: jnp.sum(ip), policy=pol,
+        accumulate=lambda acc, o, m0, n0: acc + o, init=jnp.float32(0))
+    np.testing.assert_allclose(float(total), (x @ y.T).sum(), rtol=1e-4)
+
+
+def test_detect_mpi_environment(monkeypatch):
+    monkeypatch.delenv("OMPI_COMM_WORLD_RANK", raising=False)
+    monkeypatch.delenv("PMI_RANK", raising=False)
+    monkeypatch.delenv("SLURM_PROCID", raising=False)
+    assert detect_mpi_environment() is None
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "2")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "8")
+    assert detect_mpi_environment() == (2, 8)
+
+
+def test_benchmark_fixture(res):
+    fx = Fixture(res=res, reps=2)
+    r = fx.run(lambda x: x * 2.0, jnp.ones((128, 128)))
+    assert r["seconds"] > 0
+    r2 = fx.throughput(lambda x: x + 1.0, 128 * 128 * 4, jnp.ones((128, 128)))
+    assert "gb_per_s" in r2
+    bf = BlobsFixture(512, 8, res=res)
+    assert bf.X.shape == (512, 8)

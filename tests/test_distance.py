@@ -114,6 +114,31 @@ def test_knn_bruteforce(res):
         assert set(np.asarray(i)[r].tolist()) == set(ref_i[r].tolist())
 
 
+def test_knn_certified_approx_path(res):
+    # small tile forces the certified-approx fast path; result must be
+    # EXACT regardless (fallback covers uncertified queries)
+    x = rng.normal(size=(40, 8)).astype(np.float32)
+    y = rng.normal(size=(4096, 8)).astype(np.float32)
+    d, i = distance.knn(res, y, x, k=7, tile=128)
+    D = cdist(x, y, "sqeuclidean")
+    ref_i = np.argsort(D, axis=1)[:, :7]
+    ref_d = np.take_along_axis(D, ref_i, axis=1)
+    np.testing.assert_allclose(np.sort(np.asarray(d), axis=1), ref_d,
+                               atol=1e-3, rtol=1e-4)
+    for r in range(40):
+        assert set(np.asarray(i)[r].tolist()) == set(ref_i[r].tolist())
+
+
+def test_knn_certification_fallback(res):
+    # all-equal rows: massive ties → certification fails (count >> k) →
+    # the exact merge sweep must take over and still return k neighbors
+    y = np.ones((4096, 8), np.float32)
+    x = np.ones((5, 8), np.float32)
+    d, i = distance.knn(res, y, x, k=3, tile=128)
+    np.testing.assert_allclose(np.asarray(d), np.zeros((5, 3)), atol=1e-5)
+    assert (np.asarray(i) >= 0).all() and (np.asarray(i) < 4096).all()
+
+
 def test_knn_inner_product(res):
     x = rng.normal(size=(10, 8)).astype(np.float32)
     y = rng.normal(size=(100, 8)).astype(np.float32)
