@@ -44,14 +44,15 @@ class Fixture:
         round-trip subtracted. (ref: ``cuda_event_timer`` role)"""
         out = fn(*args)
         leaf = jax.tree_util.tree_leaves(out)[0]
-        float(np.asarray(leaf).ravel()[0])  # compile + completion
+        float(np.asarray(leaf.ravel()[0]))  # compile + completion (scalar fetch)
         rtt = self._measure_rtt(jax.tree_util.tree_leaves(args)[0])
         times = []
         for _ in range(self.reps):
             t0 = time.perf_counter()
             out = fn(*args)
             leaf = jax.tree_util.tree_leaves(out)[0]
-            float(np.asarray(leaf).ravel()[0])
+            # device-side index first: fetch ONE scalar, not the whole leaf
+            float(np.asarray(leaf.ravel()[0]))
             times.append(time.perf_counter() - t0)
         return {"seconds": max(min(times) - rtt, 1e-9), "rtt": rtt}
 

@@ -291,6 +291,16 @@ class DeviceResources(Resources):
         self.add_resource_factory(ResourceType.HOST_MEMORY_KIND, lambda r: "pinned_host")
 
 
+def _device_resources_reduce(self):
+    # Pickling recreates a FRESH handle (resources are process-local), the
+    # contract pylibraft documents for its DeviceResources
+    # (ref: common/handle.pyx:113-123). type(self) keeps subclasses
+    # (e.g. DeviceResourcesSNMG) reconstructing as themselves.
+    return (type(self), ())
+
+
+DeviceResources.__reduce__ = _device_resources_reduce
+
 # legacy alias (ref: core/handle.hpp ``handle_t``)
 Handle = DeviceResources
 

@@ -74,7 +74,16 @@ def _tile_rows(res, x, y, body, out_dtype=jnp.float32):
 def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclidean",
                       p: float = 2.0) -> jax.Array:
     """Full [n, m] distance matrix. (ref: pre-cuVS
-    raft::distance::pairwise_distance; pylibraft.distance.pairwise_distance)"""
+    raft::distance::pairwise_distance; pylibraft.distance.pairwise_distance)
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.distance import pairwise_distance
+    >>> x = np.array([[0.0, 0.0], [3.0, 4.0]])
+    >>> np.asarray(pairwise_distance(None, x, metric="euclidean")).round(1).tolist()
+    [[0.0, 5.0], [5.0, 0.0]]
+    """
     x = jnp.asarray(x)
     y = x if y is None else jnp.asarray(y)
     expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1],

@@ -61,6 +61,14 @@ def select_k(
 
     Returns ``(out_val [batch, k], out_idx [batch, k])``.
     (ref: matrix/select_k.cuh:75)
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.matrix import select_k
+    >>> vals, idx = select_k(None, np.array([[3.0, 1.0, 2.0]]), k=2)
+    >>> np.asarray(vals).tolist(), np.asarray(idx).tolist()
+    ([[1.0, 2.0]], [[1, 2]])
     """
     in_val = jnp.asarray(in_val)
     expects(in_val.ndim == 2, "select_k: in_val must be [batch, len]")
@@ -77,6 +85,9 @@ def select_k(
         algo = choose_select_k_algorithm(batch, length, k)
 
     if algo in (SelectAlgo.BITONIC, SelectAlgo.RADIX):
+        # BITONIC is an alias of the one Pallas kernel (radix): the
+        # warpsort-family names map here for API parity, but no separate
+        # bitonic-queue kernel exists on TPU (see select_k_types docstring)
         from raft_tpu.ops import select_k_pallas
 
         try:

@@ -9,10 +9,12 @@ memory histograms. The variants that exist here:
 
 - ``AUTO``          — heuristic choice (see matrix/select_k.py)
 - ``XLA_TOPK``      — ``jax.lax.top_k`` (XLA's sort-based top-k)
-- ``BITONIC``       — Pallas blockwise bitonic-queue kernel (the TPU
-                      rendering of the warpsort family, ops/select_k_pallas)
-- ``RADIX``         — Pallas multi-pass histogram filtering (the TPU
-                      rendering of radix select; VMEM histograms)
+- ``RADIX``         — the Pallas kernel: multi-pass digit-histogram
+                      filtering in VMEM (ops/select_k_pallas)
+- ``BITONIC``       — ALIAS of RADIX. The warpsort-family names map here
+                      for API parity; on TPU the one custom kernel is the
+                      radix design (no warp shuffles exist to build a
+                      bitonic queue from)
 
 The CUDA names are kept as aliases so reference-written code dispatches
 meaningfully.

@@ -185,6 +185,9 @@ def select_k(in_val, in_idx, k: int, select_min: bool, algo=None
     batch, length = in_val.shape
     if k > 256 or length < 1024:
         raise NotImplementedError("pallas select_k targets k<=256, len>=1024")
+    if length >= 1 << 24:
+        # indices accumulate through f32 one-hot sums, exact only < 2^24
+        raise NotImplementedError("pallas select_k: row length must be < 2^24")
     chunk = 2048 if length >= 2048 else 1024
     pad = round_up(length, chunk) - length
     if pad:

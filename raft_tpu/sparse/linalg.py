@@ -37,7 +37,16 @@ def _as_coo_parts(A: Sparse):
 
 def spmv(res, A: Sparse, x) -> jax.Array:
     """y = A @ x. (ref: cusparseSpMV wrappers; the Lanczos hot loop's matvec
-    — sparse/solver/detail/lanczos.cuh:263-271.)"""
+    — sparse/solver/detail/lanczos.cuh:263-271.)
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.sparse import CSRMatrix, linalg
+    >>> A = CSRMatrix.from_dense(np.array([[1.0, 0.0], [0.0, 2.0]]))
+    >>> np.asarray(linalg.spmv(None, A, np.array([3.0, 4.0]))).tolist()
+    [3.0, 8.0]
+    """
     rows, cols, vals, shape = _as_coo_parts(A)
     x = jnp.asarray(x)
     return jax.ops.segment_sum(vals * x[cols], rows, num_segments=shape[0])

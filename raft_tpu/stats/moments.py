@@ -24,7 +24,15 @@ def sum_stat(res, data, along_rows: bool = True):
 
 
 def mean(res, data, sample: bool = False):
-    """Column means. (ref: stats/mean.cuh; ``sample`` divides by n-1)"""
+    """Column means. (ref: stats/mean.cuh; ``sample`` divides by n-1)
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.stats import mean
+    >>> np.asarray(mean(None, np.array([[1.0, 2.0], [3.0, 4.0]]))).tolist()
+    [2.0, 3.0]
+    """
     data = jnp.asarray(data)
     n = data.shape[0]
     denom = (n - 1) if sample else n

@@ -1,0 +1,43 @@
+"""Doctest harvesting — every docstring example runs as a test.
+(mirrors python/pylibraft/pylibraft/tests/test_doctests.py, which walks the
+package and executes all docstring examples.)"""
+
+import doctest
+import importlib
+import pkgutil
+
+import pytest
+
+import raft_tpu
+
+_SKIP_MODULES = {
+    # driver/TPU-session entry points with import side effects
+    "raft_tpu.native",
+}
+
+
+def _iter_modules():
+    for info in pkgutil.walk_packages(raft_tpu.__path__,
+                                      prefix="raft_tpu."):
+        if info.name in _SKIP_MODULES:
+            continue
+        yield info.name
+
+
+@pytest.mark.parametrize("module_name", sorted(_iter_modules()))
+def test_docstring_examples(module_name):
+    mod = importlib.import_module(module_name)
+    results = doctest.testmod(mod, verbose=False,
+                              optionflags=doctest.NORMALIZE_WHITESPACE)
+    assert results.failed == 0, f"{results.failed} doctest failures in {module_name}"
+
+
+def test_doctests_are_harvested():
+    """At least the seeded examples must be found (guards against the
+    walker silently collecting nothing)."""
+    total = 0
+    for name in _iter_modules():
+        mod = importlib.import_module(name)
+        finder = doctest.DocTestFinder()
+        total += sum(len(t.examples) for t in finder.find(mod))
+    assert total >= 8
