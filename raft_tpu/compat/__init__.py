@@ -6,6 +6,8 @@
 
 from raft_tpu.compat.pylibraft import (
     DeviceResources,
+    ai_wrapper,
+    cai_wrapper,
     Handle,
     auto_sync_handle,
     device_ndarray,
@@ -16,5 +18,5 @@ from raft_tpu.compat.pylibraft import (
 
 __all__ = [
     "DeviceResources", "Handle", "auto_sync_handle", "device_ndarray",
-    "eigsh", "svds", "rmat",
+    "ai_wrapper", "cai_wrapper", "eigsh", "svds", "rmat",
 ]

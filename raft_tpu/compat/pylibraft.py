@@ -88,6 +88,67 @@ def _unwrap(x):
     return x.to_jax() if isinstance(x, device_ndarray) else jnp.asarray(x)
 
 
+class ai_wrapper:  # noqa: N801 — pylibraft spelling
+    """Adapter over any object exposing the numpy ``__array_interface__``
+    (or buffer protocol). (ref: pylibraft/common/ai_wrapper.py — shape/
+    dtype introspection + zero-copy handoff into primitives.)"""
+
+    def __init__(self, obj):
+        self._np = np.asarray(obj)
+
+    @property
+    def shape(self):
+        return self._np.shape
+
+    @property
+    def dtype(self):
+        return self._np.dtype
+
+    @property
+    def c_contiguous(self) -> bool:
+        return self._np.flags["C_CONTIGUOUS"]
+
+    def to_jax(self) -> jax.Array:
+        return jnp.asarray(self._np)
+
+
+class cai_wrapper:  # noqa: N801 — pylibraft spelling
+    """Device-array adapter. (ref: pylibraft/common/cai_wrapper.py — wraps
+    ``__cuda_array_interface__`` objects; the TPU analog accepts anything
+    speaking dlpack — jax/torch/cupy arrays — falling back to a host copy
+    for strided/exotic layouts dlpack can't express zero-copy.)"""
+
+    def __init__(self, obj):
+        if isinstance(obj, device_ndarray):
+            self._jax = obj.to_jax()
+        elif isinstance(obj, jax.Array):
+            self._jax = obj
+        else:
+            self._jax = None
+            if hasattr(obj, "__dlpack__"):
+                try:
+                    self._jax = jnp.from_dlpack(obj)
+                except Exception:
+                    self._jax = None  # non-compact striding → copy below
+            if self._jax is None:
+                self._jax = jnp.asarray(np.asarray(obj))
+
+    @property
+    def shape(self):
+        return self._jax.shape
+
+    @property
+    def dtype(self):
+        return np.dtype(self._jax.dtype)
+
+    @property
+    def c_contiguous(self) -> bool:
+        return True  # jax arrays are logically dense row-major
+
+    def to_jax(self) -> jax.Array:
+        return self._jax
+
+
 def eigsh(A, k: int = 6, which: str = "SA", v0=None, ncv: Optional[int] = None,
           maxiter: int = 10000, tol: float = 0.0, seed: int = 42,
           handle: Optional[DeviceResources] = None):

@@ -71,6 +71,12 @@ from raft_tpu.core.manager import (
     get_device_resources,
     get_device_resources_manager,
 )
+from raft_tpu.core.platform import (
+    backend,
+    is_tpu_available,
+    accelerator_count,
+    assert_accelerator,
+)
 from raft_tpu.core.buffers import (
     TemporaryDeviceBuffer,
     MmapMemoryResource,
@@ -99,4 +105,5 @@ __all__ = [
     "get_device_resources_manager",
     "TemporaryDeviceBuffer", "MmapMemoryResource", "device_span",
     "host_span", "memory_type_dispatcher",
+    "backend", "is_tpu_available", "accelerator_count", "assert_accelerator",
 ]

@@ -1,0 +1,43 @@
+"""Platform capability guards.
+
+(ref: cpp/include/raft/core/stream_view.hpp + core/cuda_support.hpp — the
+``RAFT_DISABLE_CUDA`` machinery that lets core compile and run without an
+accelerator (proved by the reference's NOCUDA CORE_TEST build,
+cpp/tests/CMakeLists.txt:122-125). The JAX analog: every raft_tpu
+primitive already runs on the CPU backend (the whole test suite is the
+"no-accelerator build check"); these helpers expose the capability query
+the reference spells ``is_device_accessible`` / stream_view's
+``cuda_used``.)
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def backend() -> str:
+    """Active default backend name ("tpu", "cpu", ...)."""
+    return jax.default_backend()
+
+
+def is_tpu_available() -> bool:
+    """(ref: cuda_support.hpp ``CUDA_ENABLED`` role)"""
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def accelerator_count() -> int:
+    try:
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+    except RuntimeError:
+        return 0
+
+
+def assert_accelerator() -> None:
+    """Loud guard for code paths that require real TPU hardware."""
+    from raft_tpu.core.error import expects
+
+    expects(is_tpu_available(), "this operation requires a TPU device "
+            "(current backend: %s)", backend())

@@ -149,6 +149,41 @@ def test_rmat_compat(res):
     assert arr.max() < 256
 
 
+def test_array_interface_wrappers():
+    import jax.numpy as jnp
+
+    from raft_tpu.compat import ai_wrapper, cai_wrapper
+
+    a = ai_wrapper(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert a.shape == (2, 3) and a.dtype == np.float32 and a.c_contiguous
+    np.testing.assert_array_equal(np.asarray(a.to_jax()), np.arange(6).reshape(2, 3))
+    c = cai_wrapper(jnp.ones((4,)))
+    assert c.shape == (4,) and c.dtype == np.float32
+    # strided input: dlpack refuses non-compact layouts → copy fallback
+    sliced = np.arange(10, dtype=np.float32)[::2]
+    np.testing.assert_array_equal(np.asarray(cai_wrapper(sliced).to_jax()),
+                                  [0, 2, 4, 6, 8])
+    # dlpack path (torch cpu tensor, optional dependency)
+    torch = pytest.importorskip("torch")
+    t = torch.arange(4, dtype=torch.float32)
+    c2 = cai_wrapper(t)
+    np.testing.assert_array_equal(np.asarray(c2.to_jax()), [0, 1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(cai_wrapper(t[::2]).to_jax()),
+                                  [0, 2])
+
+
+def test_platform_guards():
+    from raft_tpu.core import (accelerator_count, assert_accelerator, backend,
+                               is_tpu_available)
+    from raft_tpu.core.error import LogicError
+
+    assert backend() == "cpu"
+    assert not is_tpu_available()
+    assert accelerator_count() == 0
+    with pytest.raises(LogicError):
+        assert_accelerator()
+
+
 # ---- native hostops ----
 def test_native_pcg_bit_exact():
     a = native.pcg32_uint32(123, 32, stream=5)
