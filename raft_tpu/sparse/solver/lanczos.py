@@ -45,8 +45,7 @@ def _matvec(A, x):
     return A @ x
 
 
-@partial(jax.jit, static_argnames=("ncv",))
-def _restart_cycle(A, V, T0, j0, ncv: int):
+def _restart_cycle_impl(A, V, T0, j0, ncv: int):
     """Build Krylov columns j0..ncv-1 with two-pass full
     reorthogonalization, then Rayleigh-Ritz. Returns
     (theta, S, V, beta_last) — V[ncv] is the normalized residual vector."""
@@ -77,6 +76,9 @@ def _restart_cycle(A, V, T0, j0, ncv: int):
     return theta, S, V, beta_last
 
 
+_restart_cycle = jax.jit(_restart_cycle_impl, static_argnames=("ncv",))
+
+
 def _select(theta, which: LANCZOS_WHICH, k: int):
     """Indices (ascending positions) of the k wanted ritz values."""
     if which == LANCZOS_WHICH.SA:
@@ -88,6 +90,66 @@ def _select(theta, which: LANCZOS_WHICH, k: int):
     else:  # SM
         idx = jnp.sort(jnp.argsort(jnp.abs(theta))[:k])
     return idx
+
+
+def _residual_estimate(theta, S, beta_last, idx, ncv: int):
+    """Ritz residual bound |β·S[m−1,i]| + spectrum scale (shared by both
+    solve paths)."""
+    resid = jnp.abs(beta_last * S[ncv - 1, idx])
+    scale = jnp.maximum(jnp.max(jnp.abs(theta)), 1e-30)
+    return resid, scale
+
+
+def _restart_state(theta, S, V, idx, k: int, ncv: int):
+    """Thick restart: wanted ritz vectors + residual direction, projected
+    T (shared by both solve paths)."""
+    ritz = S[:, idx].T @ V[:ncv]
+    V2 = jnp.zeros_like(V).at[:k].set(ritz).at[k].set(V[ncv])
+    T0 = jnp.zeros((ncv, ncv), V.dtype).at[
+        jnp.arange(k), jnp.arange(k)].set(theta[idx])
+    return V2, T0
+
+
+def _extract_eigvecs(S, V, idx, ncv: int):
+    """Final ritz-vector extraction (shared by both solve paths)."""
+    eigvecs = (S[:, idx].T @ V[:ncv]).T
+    return eigvecs / jnp.linalg.norm(eigvecs, axis=0, keepdims=True)
+
+
+@partial(jax.jit, static_argnames=("ncv", "k", "which"))
+def _solve_jitted(A, V0, tol, max_steps, ncv: int, k: int,
+                  which: LANCZOS_WHICH):
+    """The whole thick-restart loop as ONE compiled program
+    (``lax.while_loop`` over cycles) — no per-cycle host dispatch.
+    Returns (vals, vecs, max_relative_residual) so the caller can warn on
+    non-convergence. tol/max_steps are traced operands: changing them does
+    not recompile."""
+    dtype = V0.dtype
+    theta, S, V, beta_last = _restart_cycle_impl(
+        A, V0, jnp.zeros((ncv, ncv), dtype), jnp.asarray(0, jnp.int32), ncv)
+
+    def _rel_resid(theta, S, beta_last):
+        idx = _select(theta, which, k)
+        resid, scale = _residual_estimate(theta, S, beta_last, idx, ncv)
+        return jnp.max(resid) / scale
+
+    def cond(state):
+        theta, S, V, beta_last, steps = state
+        return (_rel_resid(theta, S, beta_last) > tol) & (steps < max_steps)
+
+    def body(state):
+        theta, S, V, beta_last, steps = state
+        idx = _select(theta, which, k)
+        V2, T0 = _restart_state(theta, S, V, idx, k, ncv)
+        theta, S, V, beta_last = _restart_cycle_impl(
+            A, V2, T0, jnp.asarray(k, jnp.int32), ncv)
+        return theta, S, V, beta_last, steps + (ncv - k)
+
+    theta, S, V, beta_last, _ = jax.lax.while_loop(
+        cond, body, (theta, S, V, beta_last, jnp.asarray(ncv, jnp.int32)))
+    idx = _select(theta, which, k)
+    eigvecs = _extract_eigvecs(S, V, idx, ncv)
+    return theta[idx], eigvecs, _rel_resid(theta, S, beta_last)
 
 
 def lanczos_compute_eigenpairs(
@@ -125,6 +187,21 @@ def lanczos_compute_eigenpairs(
     V = V.at[0].set(v0 / jnp.linalg.norm(v0))
     T0 = jnp.zeros((ncv, ncv), dtype)
 
+    if config.jit_loop:
+        with nvtx.annotate("lanczos_compute_eigenpairs[jit]"):
+            vals, vecs, rel_resid = _solve_jitted(
+                A, V, jnp.asarray(config.tolerance, dtype),
+                jnp.asarray(config.max_iterations, jnp.int32),
+                ncv, k, config.which)
+        rr = float(rel_resid)
+        if rr > config.tolerance:
+            from raft_tpu.core.logger import log_warn
+
+            log_warn("lanczos[jit]: stopped with relative residual %.3e > "
+                     "tolerance %.3e (max_iterations=%d)", rr,
+                     config.tolerance, config.max_iterations)
+        return vals, vecs
+
     j0 = 0
     n_steps = 0
     best_resid = None
@@ -136,8 +213,7 @@ def lanczos_compute_eigenpairs(
                 A, V, T0, jnp.asarray(j0, jnp.int32), ncv)
             n_steps += ncv - j0
             idx = _select(theta, config.which, k)
-            resid = jnp.abs(beta_last * S[ncv - 1, idx])
-            scale = jnp.maximum(jnp.max(jnp.abs(theta)), 1e-30)
+            resid, scale = _residual_estimate(theta, S, beta_last, idx, ncv)
             max_resid = float(jnp.max(resid))
             if bool(jnp.all(resid <= config.tolerance * scale)):
                 break
@@ -169,15 +245,7 @@ def lanczos_compute_eigenpairs(
                              max_resid, max_resid / float(scale),
                              config.tolerance)
                     break
-            # thick restart: wanted ritz vectors + the residual direction
-            S_sel = S[:, idx]                      # [ncv, k]
-            ritz = S_sel.T @ V[:ncv]               # [k, n]
-            V = jnp.zeros_like(V).at[:k].set(ritz).at[k].set(V[ncv])
-            T0 = jnp.zeros((ncv, ncv), dtype).at[
-                jnp.arange(k), jnp.arange(k)].set(theta[idx])
+            V, T0 = _restart_state(theta, S, V, idx, k, ncv)
             j0 = k
 
-    S_sel = S[:, idx]
-    eigvecs = (S_sel.T @ V[:ncv]).T                # [n, k]
-    eigvecs = eigvecs / jnp.linalg.norm(eigvecs, axis=0, keepdims=True)
-    return theta[idx], eigvecs
+    return theta[idx], _extract_eigvecs(S, V, idx, ncv)

@@ -13,7 +13,13 @@ from typing import Optional
 
 
 class LANCZOS_WHICH(enum.Enum):
-    """(ref: lanczos_types.hpp:20)"""
+    """(ref: lanczos_types.hpp:20)
+
+    Note on SM: like the reference, SM selects smallest-magnitude ritz
+    values from the same Krylov process — WITHOUT shift-invert. Interior
+    eigenvalues converge slowly (or stall) for ill-conditioned spectra;
+    extremal modes (SA/LA/LM) are the well-conditioned ones.
+    """
 
     LA = "LA"  # largest algebraic
     LM = "LM"  # largest magnitude
@@ -23,7 +29,14 @@ class LANCZOS_WHICH(enum.Enum):
 
 @dataclasses.dataclass
 class LanczosSolverConfig:
-    """(ref: lanczos_types.hpp:40 ``lanczos_solver_config``)"""
+    """(ref: lanczos_types.hpp:40 ``lanczos_solver_config``)
+
+    ``jit_loop=True`` compiles the whole thick-restart loop into ONE
+    program (``lax.while_loop`` over cycles) — no per-cycle host dispatch,
+    the right mode for remote/tunneled devices — at the cost of host-side
+    cancellation points and the stagnation heuristic (bounded by
+    max_iterations instead).
+    """
 
     n_components: int
     max_iterations: int = 1000
@@ -31,3 +44,4 @@ class LanczosSolverConfig:
     tolerance: float = 1e-6
     which: LANCZOS_WHICH = LANCZOS_WHICH.SA
     seed: int = 42
+    jit_loop: bool = False
