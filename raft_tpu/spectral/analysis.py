@@ -57,7 +57,7 @@ def analyze_modularity(res, A: Sparse, n_clusters: int, clusters) -> float:
 def fit_embedding(res, A: Sparse, n_components: int, ncv=None,
                   tolerance: float = 1e-5, max_iterations: int = 2000,
                   seed: int = 42, drop_first: bool = True,
-                  normalized: bool = True):
+                  normalized: bool = True, jit_loop: bool = False):
     """Spectral embedding: smallest eigenvectors of the graph Laplacian.
 
     The BASELINE config-4 pipeline (COO Laplacian + Lanczos). Returns
@@ -72,9 +72,13 @@ def fit_embedding(res, A: Sparse, n_components: int, ncv=None,
         L, _ = laplacian_normalized(res, A)
     else:
         L = compute_graph_laplacian(res, A)
+    # jit_loop=True compiles the whole solve into one program (best for
+    # remote/tunneled devices); the host loop (default) keeps cancellation
+    # points and the stagnation early-exit for large zero clusters
     config = LanczosSolverConfig(
         n_components=k, max_iterations=max_iterations, ncv=ncv,
-        tolerance=tolerance, which=LANCZOS_WHICH.SA, seed=seed)
+        tolerance=tolerance, which=LANCZOS_WHICH.SA, seed=seed,
+        jit_loop=jit_loop)
     vals, vecs = lanczos_compute_eigenpairs(res, L, config)
     if drop_first:
         return vals[1:], vecs[:, 1:]
