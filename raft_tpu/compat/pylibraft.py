@@ -149,11 +149,12 @@ class cai_wrapper:  # noqa: N801 — pylibraft spelling
         return self._jax
 
 
-def eigsh(A, k: int = 6, which: str = "SA", v0=None, ncv: Optional[int] = None,
-          maxiter: int = 10000, tol: float = 0.0, seed: int = 42,
+def eigsh(A, k: int = 6, which: str = "LM", v0=None, ncv: Optional[int] = None,
+          maxiter: Optional[int] = None, tol: float = 0.0, seed: int = 42,
           handle: Optional[DeviceResources] = None):
     """scipy.sparse.linalg.eigsh-compatible Lanczos.
-    (ref: sparse/linalg/lanczos.pyx:100 — same signature/defaults; accepts
+    (ref: sparse/linalg/lanczos.pyx:100 — same signature/defaults:
+    which="LM", maxiter=None → 10·n, tol=0 → machine eps; accepts
     scipy sparse, raft_tpu sparse types, device_ndarray or dense.)
     Returns (eigenvalues, eigenvectors)."""
     from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
@@ -170,10 +171,19 @@ def eigsh(A, k: int = 6, which: str = "SA", v0=None, ncv: Optional[int] = None,
                        jnp.asarray(coo.data.astype(np.float32)), coo.shape)
     else:
         op = _unwrap(A)
+    n = op.shape[0]
+    if maxiter is None:
+        maxiter = 10 * n  # (ref: lanczos.pyx:174-175)
+    # tol=0 → machine eps OF THE OPERAND DTYPE (ref: lanczos.pyx:176-177) —
+    # sparse inputs are f32 here, but a dense f64 operand (x64 mode) keeps
+    # its dtype through the solver
+    op_dtype = np.dtype(getattr(op, "dtype", np.float32))
+    if not np.issubdtype(op_dtype, np.floating):
+        op_dtype = np.dtype(np.float32)
     config = LanczosSolverConfig(
         n_components=k, max_iterations=maxiter, ncv=ncv,
-        tolerance=tol if tol > 0 else 1e-6, which=LANCZOS_WHICH[which],
-        seed=seed)
+        tolerance=tol if tol > 0 else float(np.finfo(op_dtype).eps),
+        which=LANCZOS_WHICH[which.upper()], seed=seed)
     vals, vecs = lanczos_compute_eigenpairs(handle, op, config, v0=v0)
     jax.block_until_ready(vecs)
     return vals, vecs

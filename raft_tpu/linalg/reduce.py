@@ -6,7 +6,9 @@ The reference picks between coalesced (thin/medium/thick policies,
 linalg/detail/coalesced_reduction-inl.cuh:22-141 incl. a Kahan-sum variant)
 and strided kernels based on layout × direction; XLA owns that scheduling on
 TPU, so both spellings lower to an axis reduction. The semantic surface kept:
-``main_op`` applied per element (with column index), reduction via ``op`` from
+``main_op`` applied per element (with the index along the reduction axis —
+column index for ALONG_ROWS, row index for ALONG_COLUMNS, as in the
+reference's coalesced/strided kernel pair), reduction via ``op`` from
 ``init``, ``final_op`` on the result, optional ``inplace`` accumulate, and
 the reference's row-major × along-rows/columns convention.)
 
@@ -56,7 +58,10 @@ def reduce(
 ):
     """General matrix reduction. (ref: linalg/reduce.cuh ``reduce``)
 
-    ``main_op(value, column_index)`` per element; associative ``reduce_op``
+    ``main_op(value, reduction_axis_index)`` per element — the column index
+    for ALONG_ROWS, the row index for ALONG_COLUMNS (matching
+    detail/coalesced_reduction-inl.cuh / strided_reduction.cuh:41);
+    associative ``reduce_op``
     folds with ``init``; if ``inplace_target`` is given it is folded in
     BEFORE ``final_op`` — matching the reference's
     ``final_op(reduce_op(dots, acc))`` ordering
@@ -64,8 +69,17 @@ def reduce(
     """
     data = jnp.asarray(data)
     axis = _axis_for(apply, data.ndim)
-    col_idx = jnp.arange(data.shape[1])[None, :] if data.ndim == 2 else jnp.arange(data.shape[0])
-    mapped = main_op(data, jnp.broadcast_to(col_idx, data.shape))
+    # main_op receives the index ALONG THE REDUCTION AXIS, matching the
+    # reference: coalesced kernels pass the column index (ALONG_ROWS,
+    # detail/coalesced_reduction-inl.cuh), strided kernels pass the row
+    # index (ALONG_COLUMNS, detail/strided_reduction.cuh:41).
+    if data.ndim == 1:
+        red_idx = jnp.arange(data.shape[0])
+    elif axis == 1:
+        red_idx = jnp.arange(data.shape[1])[None, :]
+    else:
+        red_idx = jnp.arange(data.shape[0])[:, None]
+    mapped = main_op(data, jnp.broadcast_to(red_idx, data.shape))
     acc_dtype = accumulate_dtype
     if acc_dtype is None and mapped.dtype in (jnp.bfloat16, jnp.float16):
         acc_dtype = jnp.float32

@@ -93,7 +93,16 @@ def select_k(
         try:
             return select_k_pallas.select_k(in_val, in_idx, k, select_min,
                                             algo=algo)
-        except NotImplementedError:
-            pass  # config outside the kernel's envelope (k>256 or short rows)
+        except NotImplementedError as e:
+            # config outside the kernel's envelope (k>256 or short rows):
+            # warn loudly — the caller asked for this algorithm by name, and
+            # silently measuring the XLA path instead would invalidate
+            # benchmarks/tests of the Pallas kernel
+            import warnings
+
+            warnings.warn(
+                f"select_k: explicit algo={algo.name} outside the Pallas "
+                f"kernel envelope ({e}); falling back to XLA top-k",
+                RuntimeWarning, stacklevel=2)
 
     return _xla_select_k(in_val, in_idx, k, select_min)

@@ -49,6 +49,18 @@ def test_reduce_main_op_uses_column_index(res):
     np.testing.assert_allclose(out, np.full(3, 0 + 1 + 2 + 3, np.float32))
 
 
+def test_reduce_main_op_uses_row_index_along_columns(res):
+    # ALONG_COLUMNS reduces down rows; the reference's strided kernel hands
+    # main_op the index along the REDUCTION axis — the row index
+    # (detail/strided_reduction.cuh:41)
+    data = np.ones((3, 4), np.float32)
+    out = np.asarray(
+        linalg.reduce(res, data, Apply.ALONG_COLUMNS,
+                      main_op=lambda x, j: x * j.astype(np.float32))
+    )
+    np.testing.assert_allclose(out, np.full(4, 0 + 1 + 2, np.float32))
+
+
 def test_reduce_inplace_accumulate(res):
     data = np.ones((2, 3), np.float32)
     prev = np.array([10.0, 20.0], np.float32)

@@ -134,6 +134,21 @@ def test_eigsh_scipy_compat(res):
     assert vecs.shape == (50, 4)
 
 
+def test_eigsh_default_which_is_LM(res):
+    # (ref: lanczos.pyx:100 defaults which="LM", tol=0 → machine eps) — a
+    # drop-in caller with no kwargs must get the LARGEST-magnitude end, not
+    # SA (an earlier default here that silently flipped the spectrum)
+    from scipy.sparse.linalg import eigsh as scipy_eigsh
+
+    d = rng.normal(size=(40, 40)).astype(np.float32)
+    d = (d + d.T) / 2
+    A = sp.csr_matrix(d * (np.abs(d) > 0.5))
+    vals, _ = eigsh(A, k=3, ncv=20, handle=res)
+    ref_vals = scipy_eigsh(A.toarray().astype(np.float64), k=3, which="LM")[0]
+    np.testing.assert_allclose(
+        np.sort(np.abs(np.asarray(vals))), np.sort(np.abs(ref_vals)), atol=5e-3)
+
+
 def test_svds_scipy_compat(res):
     A = sp.random(60, 40, density=0.2, random_state=1, dtype=np.float32)
     U, S, V = svds(A, k=3, n_power_iters=4, handle=res)

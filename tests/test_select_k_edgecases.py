@@ -52,9 +52,11 @@ def test_infinities(res):
 
 def test_large_k_beyond_kernel_envelope(res):
     # k > 256 exceeds the Pallas kernel envelope; the API must still work
-    # (XLA path), mirroring select_large_k.cu
+    # (XLA path), mirroring select_large_k.cu — and must WARN, since the
+    # caller asked for the Pallas algorithm by name
     v = rng.normal(size=(2, 2048)).astype(np.float32)
-    ov, oi = matrix.select_k(res, v, k=500, algo=SelectAlgo.RADIX)
+    with pytest.warns(RuntimeWarning, match="outside the Pallas"):
+        ov, oi = matrix.select_k(res, v, k=500, algo=SelectAlgo.RADIX)
     np.testing.assert_allclose(np.asarray(ov), np.sort(v, axis=1)[:, :500],
                                rtol=1e-6)
 
