@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""TPU smoke lane for Pallas kernels: compile + run every custom kernel
+NON-interpreted on the real chip and record pass/fail (+ wall time) per
+kernel to ``PALLAS_SMOKE.json``.
+
+Why this exists: CI runs on the virtual CPU mesh where every Pallas call
+takes ``interpret=True`` — semantics are covered, Mosaic lowering is not.
+A lowering regression would ship green without this lane. Run it whenever
+the TPU tunnel is healthy:
+
+    python benchmarks/pallas_smoke.py
+
+Self-protects like bench.py: a subprocess init probe with a timeout, so a
+wedged transport can never hang the caller; without a TPU it reports
+``skipped`` per kernel rather than faking a result.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import traceback
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(__file__), os.pardir, "PALLAS_SMOKE.json")
+
+
+def _device_init_healthy(timeout_s: int = 150) -> bool:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()[0].platform == 'tpu'"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _smoke_select_k_radix():
+    import jax.numpy as jnp
+
+    from raft_tpu.ops import select_k_pallas
+
+    v = jnp.asarray(np.random.default_rng(0).normal(size=(4, 4096)),
+                    jnp.float32)
+    ov, oi = select_k_pallas.select_k(v, None, 32, True)
+    ref = np.sort(np.asarray(v), axis=1)[:, :32]
+    np.testing.assert_allclose(np.asarray(ov), ref, rtol=1e-6)
+
+
+def _smoke_fused_l2_topk():
+    from raft_tpu.distance.knn_fused import knn_fused
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(256, 128)).astype(np.float32)
+    y = rng.normal(size=(16384, 128)).astype(np.float32)
+    for passes in (1, 3):
+        vals, ids = knn_fused(x, y, k=16, passes=passes)
+        d2 = ((x[:, None, :] - y[np.asarray(ids)]) ** 2).sum(-1)
+        np.testing.assert_allclose(np.asarray(vals), d2, rtol=1e-3,
+                                   atol=1e-3)
+
+
+def _smoke_spmv_tiled():
+    import scipy.sparse as sp
+
+    from raft_tpu.sparse import CSRMatrix, linalg, prepare_spmv
+
+    m = sp.random(4096, 4096, density=0.01, random_state=2,
+                  dtype=np.float32, format="csr")
+    A = CSRMatrix(np.asarray(m.indptr, np.int32),
+                  np.asarray(m.indices, np.int32),
+                  m.data.astype(np.float32), m.shape)
+    x = np.random.default_rng(3).normal(size=4096).astype(np.float32)
+    y = np.asarray(linalg.spmv(None, prepare_spmv(A), x))
+    ref = m @ x
+    np.testing.assert_allclose(y, ref, rtol=2e-4, atol=2e-4)
+
+
+KERNELS = {
+    "select_k_radix": _smoke_select_k_radix,
+    "fused_l2_topk": _smoke_fused_l2_topk,
+    "spmv_tiled": _smoke_spmv_tiled,
+}
+
+
+def main():
+    results = {}
+    on_tpu = _device_init_healthy()
+    if not on_tpu:
+        results = {name: {"status": "skipped",
+                          "reason": "no healthy TPU backend"}
+                   for name in KERNELS}
+    else:
+        import jax
+
+        assert jax.devices()[0].platform == "tpu"
+        for name, fn in KERNELS.items():
+            t0 = time.time()
+            try:
+                fn()
+                results[name] = {"status": "pass",
+                                 "seconds": round(time.time() - t0, 2)}
+            except Exception:
+                results[name] = {"status": "fail",
+                                 "error": traceback.format_exc()[-2000:]}
+    payload = {"platform": "tpu" if on_tpu else "none", "kernels": results}
+    with open(OUT, "w") as f:
+        json.dump(payload, f, indent=1)
+    print(json.dumps(payload))
+    return 0 if all(r.get("status") != "fail" for r in results.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,24 +26,37 @@ def _ranks(comms: HostComms):
     return np.arange(comms.size)
 
 
+def _fetch(x) -> np.ndarray:
+    """Materialize a (possibly multi-process-sharded) result on every
+    host — the multihost analog of the reference tests' cudaMemcpy-back.
+    (np.asarray alone cannot read non-addressable shards.)"""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
 def perform_test_comm_allreduce(comms: HostComms) -> bool:
     """(ref: detail/test.hpp:31 — each rank contributes 1; expect size.)"""
     x = jnp.ones((comms.size, 1), jnp.float32)
-    out = np.asarray(comms.allreduce(x, Op.SUM))
+    out = _fetch(comms.allreduce(x, Op.SUM))
     return bool((out == comms.size).all())
 
 
 def perform_test_comm_bcast(comms: HostComms, root: int = 0) -> bool:
     """(ref: detail/test.hpp:62 — root's value lands everywhere.)"""
     x = jnp.asarray(_ranks(comms)[:, None] + 100.0, jnp.float32)
-    out = np.asarray(comms.bcast(x, root=root))
+    out = _fetch(comms.bcast(x, root=root))
     return bool((out == 100.0 + root).all())
 
 
 def perform_test_comm_reduce(comms: HostComms, root: int = 0) -> bool:
     """(ref: detail/test.hpp:97)"""
     x = jnp.asarray(_ranks(comms)[:, None], jnp.float32)
-    out = np.asarray(comms.reduce(x, root=root, op=Op.SUM))
+    out = _fetch(comms.reduce(x, root=root, op=Op.SUM))
     want = _ranks(comms).sum()
     ok_root = out[root, 0] == want
     others = np.delete(out[:, 0], root)
@@ -53,7 +66,7 @@ def perform_test_comm_reduce(comms: HostComms, root: int = 0) -> bool:
 def perform_test_comm_allgather(comms: HostComms) -> bool:
     """(ref: detail/test.hpp:133 — every rank sees every rank's value.)"""
     x = jnp.asarray(_ranks(comms)[:, None], jnp.float32)
-    out = np.asarray(comms.allgather(x))  # [size, size, 1]
+    out = _fetch(comms.allgather(x))  # [size, size, 1]
     return bool(all((out[r, :, 0] == _ranks(comms)).all()
                     for r in range(comms.size)))
 
@@ -61,7 +74,7 @@ def perform_test_comm_allgather(comms: HostComms) -> bool:
 def perform_test_comm_gather(comms: HostComms, root: int = 0) -> bool:
     """(ref: detail/test.hpp:170)"""
     x = jnp.asarray(_ranks(comms)[:, None], jnp.float32)
-    out = np.asarray(comms.gather(x, root=root))
+    out = _fetch(comms.gather(x, root=root))
     return bool((out[root, :, 0] == _ranks(comms)).all())
 
 
@@ -73,7 +86,7 @@ def perform_test_comm_gatherv(comms: HostComms, root: int = 0) -> bool:
     x = np.zeros((size, maxlen), np.float32)
     for r in range(size):
         x[r, : counts[r]] = r
-    out = np.asarray(comms.gatherv(jnp.asarray(x), counts, root=root))
+    out = _fetch(comms.gatherv(jnp.asarray(x), counts, root=root))
     expected = np.concatenate([np.full(c, r) for r, c in enumerate(counts)])
     return bool((out[root] == expected).all())
 
@@ -82,7 +95,7 @@ def perform_test_comm_reducescatter(comms: HostComms) -> bool:
     """(ref: detail/test.hpp:266 — each rank gets its slice of the sum.)"""
     size = comms.size
     x = jnp.ones((size, size), jnp.float32)
-    out = np.asarray(comms.reducescatter(x, Op.SUM))  # [size, 1]
+    out = _fetch(comms.reducescatter(x, Op.SUM))  # [size, 1]
     return bool((out == size).all())
 
 
@@ -91,7 +104,7 @@ def perform_test_comm_device_sendrecv(comms: HostComms) -> bool:
     test_pointToPoint_device_sendrecv; also covers :301/:366 — host p2p and
     send-or-recv collapse into the same ppermute on an SPMD mesh.)"""
     x = jnp.asarray(_ranks(comms)[:, None], jnp.float32)
-    out = np.asarray(comms.device_sendrecv(x, shift=1))
+    out = _fetch(comms.device_sendrecv(x, shift=1))
     expected = np.roll(_ranks(comms), 1)  # rank r receives from r-1
     return bool((out[:, 0] == expected).all())
 
@@ -99,7 +112,7 @@ def perform_test_comm_device_sendrecv(comms: HostComms) -> bool:
 def perform_test_comm_device_multicast_sendrecv(comms: HostComms) -> bool:
     """(ref: detail/test.hpp:454)"""
     x = jnp.asarray(_ranks(comms)[:, None], jnp.float32)
-    out = np.asarray(comms.device_multicast_sendrecv(x))
+    out = _fetch(comms.device_multicast_sendrecv(x))
     return bool(all((out[r, :, 0] == _ranks(comms)).all()
                     for r in range(comms.size)))
 
@@ -115,9 +128,9 @@ def perform_test_comm_split(comms: HostComms, row_axis: str, col_axis: str) -> b
     col_comms = HostComms(mesh, col_axis)
     # allreduce along rows only: each column-group sums independently
     x = jnp.ones((rows, 1), jnp.float32)
-    out_r = np.asarray(row_comms.allreduce(x))
+    out_r = _fetch(row_comms.allreduce(x))
     x2 = jnp.ones((cols, 1), jnp.float32)
-    out_c = np.asarray(col_comms.allreduce(x2))
+    out_c = _fetch(col_comms.allreduce(x2))
     return bool((out_r == rows).all() and (out_c == cols).all())
 
 

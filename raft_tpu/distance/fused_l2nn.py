@@ -205,16 +205,58 @@ def _knn_certified_approx(x, y_padded, m_real, k: int, tile: int):
 
 
 def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
-        tile: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
-    """Brute-force k nearest neighbors: streamed fused distance + top-k.
-    Returns (distances [nq, k], indices [nq, k]), nearest first.
-    (ref: pre-cuVS brute_force::knn = pairwise distance + select_k, fused)"""
+        tile: Optional[int] = None, algo: str = "auto"
+        ) -> Tuple[jax.Array, jax.Array]:
+    """Brute-force k nearest neighbors. Returns (distances [nq, k],
+    indices [nq, k]), nearest first.
+    (ref: pre-cuVS brute_force::knn = pairwise distance + select_k, fused)
+
+    ``algo``:
+      - ``"auto"``: the fused Pallas pipeline (certified-exact slotted
+        top-k, see knn_fused) on TPU when shapes fit its envelope;
+        the streamed XLA sweep otherwise.
+      - ``"fused"`` / ``"fused_fast"``: force the Pallas pipeline
+        (bf16x3 exact / 1-pass bf16).
+      - ``"streamed"``: force the streamed XLA sweep.
+
+    ``tile`` sizes the streamed sweep only; the fused pipeline has its own
+    tiling and bounds its workspace by chunking queries internally.
+    """
     res = ensure_resources(res)
     index = jnp.asarray(index, jnp.float32)
     queries = jnp.asarray(queries, jnp.float32)
     expects(metric in ("sqeuclidean", "euclidean", "l2", "inner_product"),
             "knn: unsupported metric %r", metric)
     expects(k <= index.shape[0], "knn: k larger than index size")
+    expects(algo in ("auto", "fused", "fused_fast", "streamed"),
+            "knn: unknown algo %r", algo)
+    n = index.shape[0]
+
+    forced_fused = algo in ("fused", "fused_fast")
+    expects(not (forced_fused and metric == "inner_product"),
+            "knn: the fused pipeline is L2-only")
+    # the fused pipeline's candidate pool with its default tiling
+    # (T=2048, g=32) holds 8·ceil(n/2048) entries per query — mirror
+    # knn_fused's own envelope so auto never round-trips an exception
+    fused_pool = 8 * -(-max(n, 2048) // 2048)
+    auto_fused = (algo == "auto" and metric != "inner_product"
+                  and jax.default_backend() == "tpu"
+                  and queries.shape[1] <= 512 and n >= 4096
+                  and k <= fused_pool)
+    if forced_fused or auto_fused:
+        from raft_tpu.distance.knn_fused import knn_fused
+
+        try:
+            dists, idx = knn_fused(
+                queries, index, k,
+                passes=1 if algo == "fused_fast" else 3)
+            if metric in ("euclidean", "l2"):
+                dists = jnp.sqrt(jnp.maximum(dists, 0.0))
+            return dists, idx
+        except NotImplementedError:
+            if algo != "auto":
+                raise
+
     if tile is None:
         tile = max(128, min(index.shape[0],
                             res.workspace.allocation_limit
@@ -225,7 +267,6 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
         return _ip_sweep(queries, y_padded, jnp.asarray(index.shape[0]),
                          k, int(tile))
     x_sq = jnp.sum(queries * queries, axis=1)
-    n = index.shape[0]
     use_certified = n >= 16 * int(tile) and k <= 256
     if use_certified:
         dists, idx = _knn_certified_approx(

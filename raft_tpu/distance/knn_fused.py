@@ -1,0 +1,269 @@
+"""Certified fused KNN — the flagship TPU pipeline.
+
+(ref: the reference's fused distance→select path: brute-force knn =
+pairwise distance + matrix::select_k, with select_radix.cuh /
+select_warpsort.cuh consuming distance tiles; BASELINE config 2.)
+
+Pipeline (all one jit program):
+
+1. ``ops.fused_l2_topk_pallas`` streams index tiles through VMEM: MXU
+   contraction + per-slot (min, argmin, 2nd-min) fold. Distance tiles
+   never touch HBM — only the [Q, S] slot summary does.
+2. A grouped top-2 fold (XLA, pure compare/selects) compresses the S
+   slot-mins to a 2·(S/g) candidate pool per query, tracking slot ids and
+   the per-group 3rd-min.
+3. ``top_k`` picks C = k + pad pool entries; their points are rescored
+   EXACTLY (f32, HIGHEST precision) and the final top-k is taken on exact
+   values.
+4. EXACTNESS CERTIFICATE, per query: every point outside the candidate
+   set has kernel-distance ≥ B = min(slot-2nd-min, group-3rd-min, C-th
+   pool value); with |kernel − exact| ≤ E, ``B − E ≥ θ*`` (θ* = exact
+   k-th candidate distance) proves no point can beat the returned top-k.
+   The bound needs NO second distance pass — it falls out of the fold.
+5. Queries that fail the certificate (two true neighbors sharing a slot:
+   ~k²/2S per query) are re-solved by an exact f32 streamed sweep — a
+   small static batch, ~1/16th of a full pass — and scattered back. If
+   more than the static budget fail, the whole batch falls back (cond).
+
+Modes:
+- ``passes=3`` (exact): bf16 hi/lo split contraction (hi·hi + hi·lo +
+  lo·hi) ⇒ f32-grade kernel distances; E is a rigorous norm-based bound,
+  so the result is certified exact w.r.t. f32 distances.
+- ``passes=1`` (fast): single bf16 contraction; E = 0, so the certificate
+  guarantees exactness w.r.t. the bf16 score function; recall vs f32 is
+  empirical (≥0.99 typical — measured in benchmarks/).
+
+Precision contract: the score function is the EXPANDED squared L2,
+``‖x‖² + ‖y‖² − 2x·y``, evaluated in f32 — the same functional form the
+reference's fusedL2NN/pairwise kernels evaluate on GPU. Like the
+reference, expanded f32 carries cancellation noise of order
+``ulp(‖x‖² + ‖y‖²)`` when true distances are tiny relative to the norms
+(near-duplicate points); "certified exact" means exact top-k of THAT
+score function, with returned values within ulp-noise of the infinite-
+precision expanded scores (validated in tests against an f64 oracle).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops.fused_l2_topk_pallas import (
+    _LANES, fused_l2_slot_topk, split_hi_lo)
+
+# static fixup batch: queries whose certificate failed re-run exactly
+_FIXUP_BATCH = 128
+# pool oversampling beyond k before exact rescoring
+_POOL_PAD = 32
+# query-chunk bound: the [Q, S] slot arrays + [Q, C, d] rescore gather are
+# sized by Q — queries are processed in chunks of this many (≈1 GB peak at
+# the 1M×128 BASELINE shape), the fused path's analog of the streamed
+# path's workspace-budgeted tile
+_Q_CHUNK = 2048
+
+
+def _err_bound_coeff(d: int) -> float:
+    """Analytic upper bound on |d2_kernel − d2_exact| / (‖x‖·‖y‖) for the
+    bf16x3 mode. Components (unit roundoffs: bf16 2⁻⁹, f32 2⁻²⁴):
+      - dropped lo·lo term: Σ|lo(x)||lo(y)| ≤ 2⁻¹⁸·‖x‖‖y‖
+      - bf16 re-rounding of the lo factors: ≤ 2·2⁻¹⁸·‖x‖‖y‖
+      - f32 accumulation, textbook bound d·2⁻²⁴·Σ|x·y| per matmul, three
+        matmuls: ≤ 3d·2⁻²⁴·‖x‖‖y‖
+      - norm/addition rounding of xx + yy − 2S: ≤ ~2⁻²²·‖x‖‖y‖ scale
+    Doubled for d2 = 2·S_err and doubled again as safety margin; the
+    margin's only cost is fixup rate, but the BOUND ITSELF must hold for
+    the exactness certificate to be sound."""
+    return 2.0 ** -15 + d * 2.0 ** -21
+
+
+def _fold_group_top2(m1, i1, g: int):
+    """[Q, S] → per-group-of-g (top-2 values with slot-min point ids,
+    3rd-min value). Pure compare/select fold — no sort."""
+    Q, S = m1.shape
+    g = min(g, S)
+    G = S // g
+    v = m1.reshape(Q, G, g)
+    pid = i1.reshape(Q, G, g)
+    inf = jnp.full((Q, G), jnp.inf, m1.dtype)
+    a1, a2, a3 = inf, inf, inf
+    id1 = jnp.full((Q, G), -1, jnp.int32)
+    id2 = jnp.full((Q, G), -1, jnp.int32)
+    for r in range(g):
+        c = v[:, :, r]
+        cid = pid[:, :, r]
+        lt1 = c < a1
+        lt2 = c < a2
+        lt3 = c < a3
+        a3 = jnp.where(lt2, a2, jnp.where(lt3, c, a3))
+        id2 = jnp.where(lt1, id1, jnp.where(lt2, cid, id2))
+        a2 = jnp.where(lt1, a1, jnp.where(lt2, c, a2))
+        id1 = jnp.where(lt1, cid, id1)
+        a1 = jnp.minimum(a1, c)
+    return a1, id1, a2, id2, a3
+
+
+def _pad_rows_to(y, mult: int):
+    from raft_tpu.distance.fused_l2nn import _pad_rows
+
+    return _pad_rows(y, mult)[0]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "T", "Qb", "g", "passes"))
+def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Certified fused KNN on pre-padded operands.
+
+    x [Q, d] f32 (Q % Qb == 0, d % 128 == 0 — caller pads), y [m, d] f32
+    un-padded rows; returns exact (d2 [Q, k] ascending, ids [Q, k]).
+    """
+    Q, d = x.shape
+    m = y.shape[0]
+    yp = _pad_rows_to(y, T)
+    M = yp.shape[0]
+
+    y_hi, y_lo = split_hi_lo(yp)
+    xx = jnp.sum(x * x, axis=1, keepdims=True)                  # [Q,1] f32
+    yy = jnp.sum(yp * yp, axis=1)[None, :]                      # [1,M] f32
+    m_real = jnp.full((1,), m, jnp.int32)
+
+    m1, i1, m2min = fused_l2_slot_topk(
+        x, y_hi, y_lo, xx, yy, m_real, T=T, Qb=Qb, passes=passes)
+    S = m1.shape[1]
+
+    a1, id1, a2, id2, a3 = _fold_group_top2(m1, i1, g)
+    pool_v = jnp.concatenate([a1, a2], axis=1)                  # [Q, 2G]
+    pool_id = jnp.concatenate([id1, id2], axis=1)
+
+    C = min(k + _POOL_PAD, pool_v.shape[1])
+    neg_top, pos = jax.lax.top_k(-pool_v, C)                    # ascending
+    cand_v_hat = -neg_top                                       # kernel vals
+    cand_pid = jnp.take_along_axis(pool_id, pos, axis=1)        # point ids
+
+    # exact f32 rescore of the C candidates (gather + HIGHEST contraction)
+    safe_pid = jnp.maximum(cand_pid, 0)
+    yc = jnp.take(y, safe_pid, axis=0)                          # [Q, C, d]
+    d2c = (xx + jnp.sum(yc * yc, axis=2)
+           - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
+                              precision=jax.lax.Precision.HIGHEST))
+    d2c = jnp.where((cand_pid >= 0) & jnp.isfinite(cand_v_hat),
+                    jnp.maximum(d2c, 0.0), jnp.inf)
+    neg_k, ord_k = jax.lax.top_k(-d2c, k)
+    vals = -neg_k                                               # exact, asc
+    ids = jnp.take_along_axis(cand_pid, ord_k, axis=1)
+
+    # ---- certificate ----
+    theta = vals[:, k - 1]
+    bound = jnp.minimum(jnp.min(m2min, axis=1), jnp.min(a3, axis=1))
+    bound = jnp.minimum(bound, cand_v_hat[:, C - 1])
+    if passes == 3:
+        ymax = jnp.sqrt(jnp.max(yy))
+        err = _err_bound_coeff(d) * jnp.sqrt(xx[:, 0]) * ymax
+    else:
+        err = jnp.zeros((Q,), jnp.float32)
+    certified = bound >= theta + err                            # [Q] bool
+    failed = ~certified
+    n_fail = jnp.sum(failed.astype(jnp.int32))
+
+    # ---- fixup: exact f32 sweep for failed queries ----
+    def exact_rows(xq):
+        """Exact streamed top-k for a [F, d] query block (f32 HIGHEST)."""
+        xs = jnp.sum(xq * xq, axis=1)
+        n_tiles = M // T
+
+        def body(j, carry):
+            bv, bi = carry
+            yt = jax.lax.dynamic_slice_in_dim(yp, j * T, T, axis=0)
+            d2 = (xs[:, None] + jnp.sum(yt * yt, axis=1)[None, :]
+                  - 2.0 * jax.lax.dot_general(
+                      xq, yt, (((1,), (1,)), ((), ())),
+                      precision=jax.lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32))
+            col = j * T + jnp.arange(T, dtype=jnp.int32)
+            d2 = jnp.where(col[None, :] < m, jnp.maximum(d2, 0.0), jnp.inf)
+            av = jnp.concatenate([bv, d2], axis=1)
+            ai = jnp.concatenate(
+                [bi, jnp.broadcast_to(col[None, :], d2.shape)], axis=1)
+            nt, np_ = jax.lax.top_k(-av, k)
+            return -nt, jnp.take_along_axis(ai, np_, axis=1)
+
+        bv = jnp.full((xq.shape[0], k), jnp.inf, jnp.float32)
+        bi = jnp.full((xq.shape[0], k), -1, jnp.int32)
+        return jax.lax.fori_loop(0, n_tiles, body, (bv, bi))
+
+    def no_fixup(operand):
+        vals, ids = operand
+        return vals, ids
+
+    def small_fixup(operand):
+        vals, ids = operand
+        _, fidx = jax.lax.top_k(failed.astype(jnp.int32), _FIXUP_BATCH)
+        fv, fi = exact_rows(x[fidx])
+        # padded rows of fidx are healthy queries — recomputing them
+        # exactly and writing back is harmless (same answer)
+        return vals.at[fidx].set(fv), ids.at[fidx].set(fi)
+
+    def full_fallback(operand):
+        return exact_rows(x)
+
+    if Q <= _FIXUP_BATCH:
+        vals, ids = jax.lax.cond(
+            n_fail > 0, full_fallback, no_fixup, (vals, ids))
+    else:
+        vals, ids = jax.lax.cond(
+            n_fail == 0, no_fixup,
+            lambda op: jax.lax.cond(
+                n_fail <= _FIXUP_BATCH, small_fixup, full_fallback, op),
+            (vals, ids))
+    return vals, ids
+
+
+def knn_fused(x, y, k: int, passes: int = 3,
+              T: int = 2048, Qb: int = 256, g: int = 32
+              ) -> Tuple[jax.Array, jax.Array]:
+    """Certified fused brute-force KNN (squared-L2, ascending).
+
+    Returns (d2 [Q, k] f32 exact, ids [Q, k] int32). ``passes=3`` is
+    certified-exact w.r.t. f32 distances; ``passes=1`` trades that for
+    ~3× contraction speed (exact w.r.t. bf16 scores). See module doc.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    Q, d = x.shape
+    m = y.shape[0]
+    if k > m:
+        raise ValueError(f"knn_fused: k={k} > index size {m}")
+    n_tiles = (max(m, T) + T - 1) // T
+    S = n_tiles * _LANES
+    pool = 2 * (S // min(g, S))
+    if k > pool:
+        raise NotImplementedError(
+            f"knn_fused: k={k} too large for pool size {pool} "
+            f"(shrink g or T, or use the streamed path)")
+    if d > 512:
+        raise NotImplementedError("knn_fused targets d <= 512 (VMEM tile)")
+    if S % min(g, S) != 0:
+        raise NotImplementedError(
+            f"knn_fused: group size g={g} must divide the slot count {S}")
+    if Q > _Q_CHUNK:
+        # bound the [Q, S] slot arrays / rescore gather: chunk the queries
+        outs = [knn_fused(x[s:s + _Q_CHUNK], y, k, passes=passes,
+                          T=T, Qb=Qb, g=g)
+                for s in range(0, Q, _Q_CHUNK)]
+        return (jnp.concatenate([o[0] for o in outs]),
+                jnp.concatenate([o[1] for o in outs]))
+    # pad feature dim to the lane width, queries to the block size
+    dpad = (-d) % _LANES
+    if dpad:
+        zx = jnp.zeros((Q, dpad), jnp.float32)
+        x = jnp.concatenate([x, zx], axis=1)
+        y = jnp.concatenate([y, jnp.zeros((m, dpad), jnp.float32)], axis=1)
+    Qb = min(Qb, ((Q + 7) // 8) * 8)
+    qpad = (-Q) % Qb
+    if qpad:
+        x = jnp.concatenate([x, jnp.zeros((qpad, x.shape[1]), x.dtype)])
+    vals, ids = _knn_fused(x, y, k=k, T=T, Qb=Qb, g=g, passes=passes)
+    return vals[:Q], ids[:Q]

@@ -55,13 +55,16 @@ def _correlation(x, y):
     return _cosine(xc, yc)
 
 
-def _tile_rows(res, x, y, body, out_dtype=jnp.float32):
+def _tile_rows(res, x, y, body, row_bytes: Optional[int] = None):
     """Apply ``body(x_tile, y) -> [tile, m]`` over row tiles of x, sized by
-    the workspace budget (the contraction-tiling stand-in)."""
+    the workspace budget (the contraction-tiling stand-in). ``row_bytes``
+    is the caller's true per-row peak; default assumes a [tile, m, d]
+    broadcast."""
     res = ensure_resources(res)
     n, d = x.shape
     m = y.shape[0]
-    row_bytes = (m * d + m) * 4
+    if row_bytes is None:
+        row_bytes = (m * d + m) * 4
     tile = max(1, min(n, res.workspace.batch_rows(row_bytes)))
     if tile >= n:
         return body(x, y)
@@ -120,45 +123,97 @@ def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclid
             return 1.0 - inter / union
         return 1.0 - 2.0 * inter / jnp.maximum(nx + ny, 1e-30)
 
-    # unexpanded (broadcast) metrics, row-tiled
-    def body(xt, yt):
-        diff = xt[:, None, :] - yt[None, :, :]
-        if t == DistanceType.L2Unexpanded:
-            return jnp.sum(diff * diff, axis=2)
-        if t == DistanceType.L2SqrtUnexpanded:
-            return jnp.sqrt(jnp.sum(diff * diff, axis=2))
-        if t == DistanceType.L1:
-            return jnp.sum(jnp.abs(diff), axis=2)
-        if t == DistanceType.Linf:
-            return jnp.max(jnp.abs(diff), axis=2)
+    # unexpanded (broadcast-form) metrics: every one of them accumulates
+    # elementwise over features, so the [tile, m, d] broadcast is folded
+    # over FEATURE CHUNKS with a [tile, m]-shaped carry — the d-axis
+    # analog of the reference's k-blocked smem policy
+    # (linalg/detail/contractions.cuh:313). Peak temp = [tile, m, dc].
+    return _unexpanded(res, x, y, t, p)
+
+
+_FEATURE_CHUNK = 32
+
+
+def _kl_term(a, b):
+    r = jnp.where((a > 0) & (b > 0), a / jnp.where(b > 0, b, 1.0), 1.0)
+    return jnp.where(a > 0, a * jnp.log(r), 0.0)
+
+
+def _unexpanded(res, x, y, t: DistanceType, p: float) -> jax.Array:
+    n, d = x.shape
+    m = y.shape[0]
+    acc_dtype = jnp.promote_types(jnp.promote_types(x.dtype, y.dtype),
+                                  jnp.float32)
+    if d == 0:
+        return jnp.zeros((n, m), acc_dtype)
+    dc = min(_FEATURE_CHUNK, d)
+    dpad = (-d) % dc
+    if dpad:
+        # zero features are identities for every unexpanded metric's
+        # per-feature term (Canberra/KL/JS mask zero operands; Hamming's
+        # finalize divides by the ORIGINAL d)
+        x = jnp.concatenate([x, jnp.zeros((n, dpad), x.dtype)], axis=1)
+        y = jnp.concatenate([y, jnp.zeros((m, dpad), y.dtype)], axis=1)
+    n_chunks = x.shape[1] // dc
+
+    n_acc = 2 if t == DistanceType.BrayCurtis else 1
+    combine = (jnp.maximum if t == DistanceType.Linf else jnp.add)
+
+    def chunk_terms(xs, ys):
+        """Per-feature terms on a [tile, m, dc] broadcast."""
+        diff = xs - ys
+        if t in (DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded):
+            return (diff * diff,)
+        if t == DistanceType.L1 or t == DistanceType.Linf:
+            return (jnp.abs(diff),)
         if t == DistanceType.LpUnexpanded:
-            return jnp.sum(jnp.abs(diff) ** p, axis=2) ** (1.0 / p)
+            return (jnp.abs(diff) ** p,)
         if t == DistanceType.Canberra:
-            denom = jnp.abs(xt)[:, None, :] + jnp.abs(yt)[None, :, :]
+            denom = jnp.abs(xs) + jnp.abs(ys)
             safe = jnp.where(denom == 0, 1.0, denom)
-            return jnp.sum(jnp.where(denom == 0, 0.0, jnp.abs(diff) / safe), axis=2)
+            return (jnp.where(denom == 0, 0.0, jnp.abs(diff) / safe),)
         if t == DistanceType.HammingUnexpanded:
-            return jnp.mean((xt[:, None, :] != yt[None, :, :]).astype(jnp.float32), axis=2)
+            return ((xs != ys).astype(acc_dtype),)
         if t == DistanceType.BrayCurtis:
-            num = jnp.sum(jnp.abs(diff), axis=2)
-            den = jnp.sum(jnp.abs(xt[:, None, :] + yt[None, :, :]), axis=2)
-            return num / jnp.maximum(den, 1e-30)
+            return (jnp.abs(diff), jnp.abs(xs + ys))
         if t == DistanceType.KLDivergence:
-            xs = xt[:, None, :]
-            ys = yt[None, :, :]
-            ratio = jnp.where((xs > 0) & (ys > 0), xs / jnp.where(ys > 0, ys, 1.0), 1.0)
-            return jnp.sum(jnp.where(xs > 0, xs * jnp.log(ratio), 0.0), axis=2)
+            return (_kl_term(xs, ys),)
         if t == DistanceType.JensenShannon:
-            xs = xt[:, None, :]
-            ys = yt[None, :, :]
-            m = 0.5 * (xs + ys)
-
-            def _kl(a, b):
-                r = jnp.where((a > 0) & (b > 0), a / jnp.where(b > 0, b, 1.0), 1.0)
-                return jnp.where(a > 0, a * jnp.log(r), 0.0)
-
-            js = 0.5 * jnp.sum(_kl(xs, m) + _kl(ys, m), axis=2)
-            return jnp.sqrt(jnp.maximum(js, 0.0))
+            mid = 0.5 * (xs + ys)
+            return (_kl_term(xs, mid) + _kl_term(ys, mid),)
         raise NotImplementedError(t)
 
-    return _tile_rows(res, x, y, body)
+    def finalize(accs):
+        a = accs[0]
+        if t == DistanceType.L2SqrtUnexpanded:
+            return jnp.sqrt(a)
+        if t == DistanceType.LpUnexpanded:
+            return a ** (1.0 / p)
+        if t == DistanceType.HammingUnexpanded:
+            return a / d
+        if t == DistanceType.BrayCurtis:
+            return a / jnp.maximum(accs[1], 1e-30)
+        if t == DistanceType.JensenShannon:
+            return jnp.sqrt(jnp.maximum(0.5 * a, 0.0))
+        return a
+
+    def body(xt, yt):
+        tile = xt.shape[0]
+
+        reduce_chunk = jnp.max if t == DistanceType.Linf else jnp.sum
+
+        def step(c, accs):
+            xs = jax.lax.dynamic_slice_in_dim(xt, c * dc, dc, axis=1)
+            ys = jax.lax.dynamic_slice_in_dim(yt, c * dc, dc, axis=1)
+            terms = chunk_terms(xs[:, None, :], ys[None, :, :])
+            return tuple(combine(acc, reduce_chunk(term, axis=2))
+                         for acc, term in zip(accs, terms))
+
+        init = tuple(jnp.zeros((tile, m), acc_dtype)
+                     for _ in range(n_acc))
+        return finalize(jax.lax.fori_loop(0, n_chunks, step, init))
+
+    # budget by the true peak: [tile, m, dc] chunk temps + [tile, m] accs
+    itemsize = jnp.dtype(acc_dtype).itemsize
+    return _tile_rows(res, x, y, body,
+                      row_bytes=(m * dc * 3 + m * (n_acc + 1)) * itemsize)

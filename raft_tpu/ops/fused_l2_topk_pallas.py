@@ -1,0 +1,198 @@
+"""Fused L2 distance + slotted top-k candidate kernel (Pallas/Mosaic).
+
+The TPU rendering of the reference's fused distance→select pipeline:
+(ref: cpp/include/raft/matrix/detail/select_radix.cuh:639 radix_kernel,
+select_warpsort.cuh:752 warpsort queues, and the tiling substrate
+cpp/include/raft/linalg/detail/contractions.cuh:1 — the role "distance
+tiles are consumed by the selector without round-tripping global memory").
+
+Design (TPU-first, not a translation):
+
+- Grid ``(n_query_blocks, n_tiles)``; the index tile loop is the inner,
+  sequential grid dimension, so VMEM-revisited output blocks accumulate
+  across tiles (the Mosaic idiom replacing CUDA's global-memory atomics).
+- Each cell contracts ``X_block[Qb,d] @ Y_tile[T,d]ᵀ`` on the MXU in
+  bfloat16 (1 pass, ``passes=1``) or with a hi/lo bf16 split
+  (``passes=3``: hi·hi + hi·lo + lo·hi — f32-grade accuracy at 3× bf16
+  cost, the TPU replacement for fp32 SGEMM), then forms
+  ``d2 = xx + yy − 2S`` with exact f32 norm corrections.
+- The [Qb, T] distance tile NEVER leaves VMEM. It is folded lane-chunk by
+  lane-chunk into per-slot running (min, argmin, 2nd-min) — a "slot" is a
+  (tile, lane-class) bucket; the fold is pure VPU compare/selects, the
+  scan-free replacement for warp-shuffle insertion sorts.
+- Outputs: per-slot min ``m1 [Q, S]`` + its index ``i1 [Q, S]``, plus a
+  per-query running min over slots of the slot 2nd-min (``m2min [Q, LANES]``
+  — folded over tiles in-place). ``m2min`` powers the EXACTNESS
+  CERTIFICATE in raft_tpu.distance.knn_fused: every non-candidate point is
+  ≥ its slot's 2nd-min, so ``min_slots m2 ≥ θ`` proves the candidate top-k
+  is the true top-k (see knn_fused for the fixup path when it fails).
+
+Padded index rows are masked to +inf inside the kernel (the caller passes
+the real row count); padded rows therefore never pollute slots.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.ops.utils import interpret_mode
+
+_LANES = 128
+
+
+def _fused_kernel(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
+                  m1_ref, i1_ref, m2min_ref,
+                  *, T: int, Qb: int, ylo_ref=None):
+    """One (query-block, index-tile) cell. ``ylo_ref`` present ⇒ bf16x3."""
+    j = pl.program_id(1)
+    n_chunks = T // _LANES
+
+    x = x_ref[...]                                   # [Qb, d] f32
+    yhi = yhi_ref[...]                               # [T, d] bf16
+    xhi = x.astype(jnp.bfloat16)
+    s = jax.lax.dot_general(
+        xhi, yhi, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [Qb, T]
+    if ylo_ref is not None:
+        xlo = (x - xhi.astype(jnp.float32)).astype(jnp.bfloat16)
+        ylo = ylo_ref[...]
+        s = s + jax.lax.dot_general(
+            xhi, ylo, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        s = s + jax.lax.dot_general(
+            xlo, yhi, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    d2 = xx_ref[...] + yy_ref[...] - 2.0 * s         # [Qb,1]+[1,T]-[Qb,T]
+
+    # mask padded index rows (global col ≥ m_real) to +inf
+    col = j * T + jax.lax.broadcasted_iota(jnp.int32, (Qb, T), 1)
+    d2 = jnp.where(col < m_real_ref[0], d2, jnp.inf)
+
+    # fold the T columns into LANES slots, keeping per-slot top-2 + argmin-1.
+    # slot class c collects columns {c, c+128, c+256, ...} of this tile
+    # (chunk r contributes its lane c as global column j*T + r*128 + c).
+    inf = jnp.full((Qb, _LANES), jnp.inf, jnp.float32)
+    a1, a2 = inf, inf
+    i1 = jnp.full((Qb, _LANES), -1, jnp.int32)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (Qb, _LANES), 1)
+    for r in range(n_chunks):
+        c = d2[:, r * _LANES:(r + 1) * _LANES]
+        ci = j * T + r * _LANES + lane
+        lt1 = c < a1
+        a2 = jnp.where(lt1, a1, jnp.minimum(a2, c))
+        a1 = jnp.where(lt1, c, a1)
+        i1 = jnp.where(lt1, ci, i1)
+
+    m1_ref[...] = a1
+    i1_ref[...] = i1
+    # running min over slots of the slot-2nd-min (certificate input);
+    # the m2min output block is revisited by every tile of this q-block
+    @pl.when(j == 0)
+    def _():
+        m2min_ref[...] = a2
+
+    @pl.when(j != 0)
+    def _():
+        m2min_ref[...] = jnp.minimum(m2min_ref[...], a2)
+
+
+@functools.partial(jax.jit, static_argnames=("T", "Qb", "passes"))
+def fused_l2_slot_topk(x, y_hi, y_lo, xx, yy, m_real,
+                       T: int, Qb: int, passes: int
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Run the fused kernel.
+
+    Args:
+      x: [Q, d] f32 queries (Q a multiple of Qb).
+      y_hi, y_lo: [M, d] bf16 hi/lo split of the padded index (M a multiple
+        of T); ``y_lo`` is only DMA'd/read when passes == 3.
+      xx, yy: exact f32 squared norms, [Q, 1] and [1, M] (padded rows'
+        yy = 0 — they are masked in-kernel anyway).
+      m_real: [1] int32 — real (unpadded) index row count.
+      T: index tile length; Qb: query block; passes: 1 (bf16) or 3 (bf16x3).
+
+    Returns:
+      m1 [Q, S] f32, i1 [Q, S] int32, m2min [Q, LANES] f32 with
+      S = (M // T) * LANES; slot s = (tile = s // LANES) × (lane-class =
+      s % LANES); i1 holds GLOBAL index-row ids; padded-only slots keep
+      m1 = +inf, i1 = -1.
+    """
+    Q, d = x.shape
+    M = y_hi.shape[0]
+    n_tiles = M // T
+    nq = Q // Qb
+    S = n_tiles * _LANES
+
+    in_specs = [
+        pl.BlockSpec((Qb, d), lambda i, j, *_: (i, 0),
+                     memory_space=pltpu.VMEM),          # x
+        pl.BlockSpec((T, d), lambda i, j, *_: (j, 0),
+                     memory_space=pltpu.VMEM),          # y_hi
+        pl.BlockSpec((Qb, 1), lambda i, j, *_: (i, 0),
+                     memory_space=pltpu.VMEM),          # xx
+        pl.BlockSpec((1, T), lambda i, j, *_: (0, j),
+                     memory_space=pltpu.VMEM),          # yy
+    ]
+    operands = [x, y_hi, xx, yy]
+    if passes == 3:
+        in_specs.insert(2, pl.BlockSpec((T, d), lambda i, j, *_: (j, 0),
+                                        memory_space=pltpu.VMEM))  # y_lo
+        operands.insert(2, y_lo)
+
+        def kernel(m_real_ref, x_ref, yhi_ref, ylo_ref, xx_ref, yy_ref,
+                   m1_ref, i1_ref, m2min_ref):
+            _fused_kernel(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
+                          m1_ref, i1_ref, m2min_ref, T=T, Qb=Qb,
+                          ylo_ref=ylo_ref)
+    else:
+        kernel = functools.partial(_fused_kernel, T=T, Qb=Qb, ylo_ref=None)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nq, n_tiles),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((Qb, _LANES), lambda i, j, *_: (i, j),
+                         memory_space=pltpu.VMEM),          # m1
+            pl.BlockSpec((Qb, _LANES), lambda i, j, *_: (i, j),
+                         memory_space=pltpu.VMEM),          # i1
+            pl.BlockSpec((Qb, _LANES), lambda i, j, *_: (i, 0),
+                         memory_space=pltpu.VMEM),          # m2min (revisited)
+        ],
+    )
+    m1, i1, m2min = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((Q, S), jnp.float32),
+            jax.ShapeDtypeStruct((Q, S), jnp.int32),
+            jax.ShapeDtypeStruct((Q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * Q * M * d * passes,
+            bytes_accessed=(Q * d * 4 + M * d * 2 * (2 if passes == 3 else 1)
+                            + Q * S * 8),
+            transcendentals=0,
+        ),
+        interpret=interpret_mode(),
+    )(m_real, *operands)
+    return m1, i1, m2min
+
+
+def split_hi_lo(y: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Split f32 into bf16 hi + bf16 lo with y ≈ hi + lo (bf16x3 operand
+    prep; the dropped lo·lo term is O(2⁻¹⁸·‖x‖‖y‖))."""
+    y = jnp.asarray(y, jnp.float32)
+    hi = y.astype(jnp.bfloat16)
+    lo = (y - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo

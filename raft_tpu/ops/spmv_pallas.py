@@ -1,0 +1,156 @@
+"""Tiled-ELL SpMV kernels (Pallas/Mosaic) — the cusparse-SpMV role on TPU.
+
+(ref: cpp/include/raft/sparse/detail/cusparse_wrappers.h:1 — the SpMV/SpMM
+surface the reference gets from cusparse — and the Lanczos matvec dispatch
+cpp/include/raft/sparse/solver/detail/lanczos.cuh:263-271.)
+
+TPU-first re-design: GPUs do SpMV with hardware-threaded gather + atomic
+scatter; TPUs have neither. Instead the matrix is re-laid-out ONCE
+(raft_tpu.sparse.tiled.tile_csr) into fixed-size nonzero chunks whose
+column (resp. row) footprint is a single tile, and both irregular sides
+become per-chunk LANE-SELECT FOLDS — broadcast-compare + select + reduce,
+all plain VPU ops every Mosaic version lowers:
+
+- gather kernel: chunk c holds E nonzeros of one column tile; the x-tile
+  for that chunk is chosen by a scalar-prefetched block index (data-
+  dependent BlockSpec index_map — the Pallas idiom replacing pointer
+  chasing). ``contrib[e] = val[e] · Σ_c [col[e] = c]·x_tile[c]``.
+- a static permutation (XLA take) reorders contributions to row order —
+  the permutation is precomputed host-side at conversion.
+- scatter kernel: chunk c holds E contributions of one row tile; the
+  output block (again scalar-prefetch-indexed) is zero-initialized on
+  first visit and accumulated across the tile's consecutive chunks —
+  Mosaic's sequential grid makes the revisited VMEM block the TPU
+  replacement for CUDA's atomicAdd.
+
+Layout note: x tiles and y tiles are carried TRANSPOSED ([C, n_tiles] /
+[R, n_tiles]) so both kernels reduce along the natural axis (sublanes for
+gather, lanes for scatter) with no in-kernel relayout.
+
+Pad entries carry value 0 (gather side) / row_local = R (scatter side), so
+they contribute nothing. Row tiles with no nonzeros are never visited by
+the grid; the caller zero-fills them via the conversion's visited mask.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.ops.utils import interpret_mode
+
+_EB = 512    # sub-block of the chunk folded at a time (bounds VMEM temps)
+
+
+def _gather_kernel(col_tile_ref, vals_ref, cols_ref, xt_ref, out_ref,
+                   *, E: int, C: int):
+    xt = xt_ref[...]                                   # [C, 1]
+    parts = []
+    for b in range(E // _EB):
+        cols = cols_ref[:, b * _EB:(b + 1) * _EB]      # [1, EB]
+        onehot = (jnp.broadcast_to(cols, (C, _EB))
+                  == jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0))
+        parts.append(jnp.sum(jnp.where(onehot, xt, 0.0), axis=0,
+                             keepdims=True))           # [1, EB]
+    out_ref[...] = vals_ref[...] * jnp.concatenate(parts, axis=1)
+
+
+def _scatter_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
+                    *, E: int, R: int):
+    c = pl.program_id(0)
+    cur = row_tile_ref[c]
+    prev = row_tile_ref[jnp.maximum(c - 1, 0)]
+    first = (c == 0) | (cur != prev)
+
+    acc = jnp.zeros((R, 1), jnp.float32)
+    for b in range(E // _EB):
+        rloc = rloc_ref[:, b * _EB:(b + 1) * _EB]      # [1, EB], pad = R
+        contrib = contrib_ref[:, b * _EB:(b + 1) * _EB]
+        onehot = (jnp.broadcast_to(rloc, (R, _EB))
+                  == jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0))
+        acc = acc + jnp.sum(jnp.where(onehot, contrib, 0.0), axis=1,
+                            keepdims=True)             # [R, 1]
+
+    @pl.when(first)
+    def _():
+        y_ref[...] = acc
+
+    @pl.when(jnp.logical_not(first))
+    def _():
+        y_ref[...] = y_ref[...] + acc
+
+
+@functools.partial(jax.jit, static_argnames=("C", "R", "E", "n_col_tiles",
+                                             "n_row_tiles"))
+def _spmv_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
+                     chunk_row_tile, x_padded,
+                     C: int, R: int, E: int,
+                     n_col_tiles: int, n_row_tiles: int) -> jax.Array:
+    n_chunks = vals.shape[0]
+    m_chunks = row_local.shape[0]
+    xt = x_padded.reshape(n_col_tiles, C).T            # [C, n_col_tiles]
+
+    contrib = pl.pallas_call(
+        functools.partial(_gather_kernel, E=E, C=C),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_chunks,),
+            in_specs=[
+                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                             memory_space=pltpu.VMEM),   # vals
+                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                             memory_space=pltpu.VMEM),   # cols
+                pl.BlockSpec((C, 1), lambda c, m: (0, m[c]),
+                             memory_space=pltpu.VMEM),   # x tile (transposed)
+            ],
+            out_specs=pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                                   memory_space=pltpu.VMEM),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_chunks, E), jnp.float32),
+        interpret=interpret_mode(),
+    )(chunk_col_tile, vals, col_local, xt)
+
+    contrib_sorted = jnp.take(
+        contrib.reshape(-1), perm.reshape(-1)).reshape(m_chunks, E)
+
+    y2dt = pl.pallas_call(
+        functools.partial(_scatter_kernel, E=E, R=R),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(m_chunks,),
+            in_specs=[
+                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                             memory_space=pltpu.VMEM),   # contrib
+                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                             memory_space=pltpu.VMEM),   # row_local
+            ],
+            out_specs=pl.BlockSpec((R, 1), lambda c, m: (0, m[c]),
+                                   memory_space=pltpu.VMEM),
+        ),
+        out_shape=jax.ShapeDtypeStruct((R, n_row_tiles), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret_mode(),
+    )(chunk_row_tile, contrib_sorted, row_local)
+    return y2dt
+
+
+def spmv_tiled(tiled, x) -> jax.Array:
+    """y = A @ x for a :class:`raft_tpu.sparse.tiled.TiledELL` operand."""
+    n_rows, n_cols = tiled.shape
+    x = jnp.asarray(x, jnp.float32)
+    pad = tiled.n_col_tiles * tiled.C - n_cols
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,), jnp.float32)])
+    y2dt = _spmv_tiled_impl(
+        tiled.vals, tiled.col_local, tiled.chunk_col_tile, tiled.perm,
+        tiled.row_local, tiled.chunk_row_tile, x,
+        C=tiled.C, R=tiled.R, E=tiled.E,
+        n_col_tiles=tiled.n_col_tiles, n_row_tiles=tiled.n_row_tiles)
+    # zero row tiles the grid never visited (rows with no nonzeros)
+    y2d = jnp.where(tiled.visited_row_tiles[:, None], y2dt.T, 0.0)
+    return y2d.reshape(-1)[:n_rows]

@@ -7,8 +7,10 @@ from raft_tpu.sparse import linalg
 from raft_tpu.sparse import matrix
 from raft_tpu.sparse import op
 from raft_tpu.sparse import solver
+from raft_tpu.sparse.linalg import prepare_spmv
+from raft_tpu.sparse.tiled import TiledELL
 
 __all__ = [
-    "COOMatrix", "COOStructure", "CSRMatrix", "CSRStructure",
-    "convert", "linalg", "matrix", "op", "solver",
+    "COOMatrix", "COOStructure", "CSRMatrix", "CSRStructure", "TiledELL",
+    "convert", "linalg", "matrix", "op", "prepare_spmv", "solver",
 ]

@@ -35,9 +35,14 @@ def _as_coo_parts(A: Sparse):
     return A.rows, A.cols, A.values, A.shape
 
 
-def spmv(res, A: Sparse, x) -> jax.Array:
+def spmv(res, A, x) -> jax.Array:
     """y = A @ x. (ref: cusparseSpMV wrappers; the Lanczos hot loop's matvec
     — sparse/solver/detail/lanczos.cuh:263-271.)
+
+    ``A`` may be COO/CSR (gather + segment-sum path) or a pre-tiled
+    :class:`raft_tpu.sparse.tiled.TiledELL` (the Pallas lane-select
+    kernels in raft_tpu.ops.spmv_pallas — prepare once with
+    :func:`prepare_spmv` for repeated matvecs, e.g. Lanczos).
 
     Examples
     --------
@@ -47,9 +52,25 @@ def spmv(res, A: Sparse, x) -> jax.Array:
     >>> np.asarray(linalg.spmv(None, A, np.array([3.0, 4.0]))).tolist()
     [3.0, 8.0]
     """
+    from raft_tpu.sparse.tiled import TiledELL
+
+    if isinstance(A, TiledELL):
+        from raft_tpu.ops.spmv_pallas import spmv_tiled
+
+        return spmv_tiled(A, x)
     rows, cols, vals, shape = _as_coo_parts(A)
     x = jnp.asarray(x)
     return jax.ops.segment_sum(vals * x[cols], rows, num_segments=shape[0])
+
+
+def prepare_spmv(A: Sparse, C: int = 512, R: int = 256, E: int = 2048):
+    """One-time conversion of a sparse matrix to the tiled-ELL layout used
+    by the Pallas SpMV kernels; the returned operand is accepted by
+    :func:`spmv` and the Lanczos/spectral solvers. (ref: the role of
+    cusparse's conversion + SpMV-descriptor preparation.)"""
+    from raft_tpu.sparse.tiled import tile_csr
+
+    return tile_csr(A, C=C, R=R, E=E)
 
 
 def spmm(res, A: Sparse, B, alpha=1.0, beta=0.0, C=None) -> jax.Array:

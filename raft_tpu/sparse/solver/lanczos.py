@@ -34,11 +34,13 @@ from raft_tpu.core.resources import ensure_resources
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
 from raft_tpu.sparse.solver.lanczos_types import LANCZOS_WHICH, LanczosSolverConfig
 
-Operand = Union[COOMatrix, CSRMatrix, jax.Array]
+Operand = Union[COOMatrix, CSRMatrix, "TiledELL", jax.Array]
 
 
 def _matvec(A, x):
-    if isinstance(A, (COOMatrix, CSRMatrix)):
+    from raft_tpu.sparse.tiled import TiledELL
+
+    if isinstance(A, (COOMatrix, CSRMatrix, TiledELL)):
         from raft_tpu.sparse.linalg import spmv
 
         return spmv(None, A, x)
@@ -167,9 +169,14 @@ def lanczos_compute_eigenpairs(
     """
     res = ensure_resources(res)
     k = config.n_components
+    from raft_tpu.sparse.tiled import TiledELL
+
     if isinstance(A, (COOMatrix, CSRMatrix)):
         n = A.shape[0]
         dtype = A.values.dtype
+    elif isinstance(A, TiledELL):
+        n = A.shape[0]
+        dtype = A.vals.dtype
     else:
         A = jnp.asarray(A)
         n = A.shape[0]

@@ -1,0 +1,183 @@
+"""Tiled-ELL sparse format — the TPU-native SpMV preprocessing.
+
+(ref: the cusparse SpMV/SpMM surface
+cpp/include/raft/sparse/detail/cusparse_wrappers.h:1 and the Lanczos SpMV
+dispatch cpp/include/raft/sparse/solver/detail/lanczos.cuh:263-271. The
+reference leans on cusparse's CSR kernels; TPU has no hardware
+gather/scatter worth leaning on, so the format is re-thought: nonzeros are
+re-laid-out ONCE, host-side, into fixed-size chunks whose column (resp.
+row) footprint is a single tile — turning SpMV's irregular access into
+per-chunk lane-select folds that Mosaic lowers to plain VPU compare/
+select/reduce. See raft_tpu.ops.spmv_pallas for the kernels.)
+
+Layout produced by :func:`tile_csr`:
+
+- nonzeros sorted by (column tile, then row), padded per column tile to a
+  multiple of ``E`` (pad entries carry value 0 → contribute nothing);
+  stored as ``[n_chunks, E]`` arrays of values, LOCAL column ids
+  (col % C) and global row ids. ``chunk_col_tile [n_chunks]`` maps each
+  chunk to its x-tile (the Pallas scalar-prefetch block index).
+- the same nonzeros re-sorted by (row tile, then row), with
+  ``perm [n_chunks·E]`` being the gather permutation from col-sorted
+  contribution order to row-sorted order, ``row_local`` the in-tile row
+  ids, and ``chunk_row_tile`` the per-chunk output tile index.
+
+All conversion is one-time numpy (like the reference's conversion
+routines); the arrays then live on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class TiledELL:
+    """Device-resident tiled layout for one sparse matrix (see module doc).
+    Registered as a pytree (array fields are leaves, geometry is static)
+    so it can flow through jitted solver loops like the other sparse
+    types."""
+
+    shape: Tuple[int, int]
+    C: int                      # column tile width (x tile length)
+    R: int                      # row tile width (y tile length)
+    E: int                      # chunk length (nonzeros per grid step)
+    # --- gather phase (col-sorted) ---
+    vals: jax.Array             # [n_chunks, E] f32
+    col_local: jax.Array        # [n_chunks, E] int32, in [0, C)
+    chunk_col_tile: jax.Array   # [n_chunks] int32
+    # --- scatter phase (row-sorted) ---
+    perm: jax.Array             # [m_chunks, E] int32 into flat col-order
+    row_local: jax.Array        # [m_chunks, E] int32 in [0, R), pad = R
+    chunk_row_tile: jax.Array   # [m_chunks] int32
+    visited_row_tiles: jax.Array  # [n_row_tiles] bool — tiles with any nnz
+    n_col_tiles: int
+    n_row_tiles: int
+
+    @property
+    def n_chunks(self) -> int:
+        return self.vals.shape[0]
+
+    @property
+    def m_chunks(self) -> int:
+        return self.row_local.shape[0]
+
+    _LEAVES = ("vals", "col_local", "chunk_col_tile", "perm", "row_local",
+               "chunk_row_tile", "visited_row_tiles")
+
+    def tree_flatten(self):
+        leaves = tuple(getattr(self, f) for f in self._LEAVES)
+        aux = (self.shape, self.C, self.R, self.E,
+               self.n_col_tiles, self.n_row_tiles)
+        return leaves, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        shape, C, R, E, nct, nrt = aux
+        return cls(shape, C, R, E, *leaves, n_col_tiles=nct,
+                   n_row_tiles=nrt)
+
+
+def _pad_groups(order, keys, E):
+    """Given sort order and group key per nnz (keys[order] nondecreasing),
+    pad each group's entries to a multiple of E. Returns (padded index
+    array with -1 for pads, group id per chunk). Vectorized — conversion
+    must stay O(nnz) numpy time, not Python-loop time."""
+    n = len(order)
+    if n == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int32)
+    sorted_keys = np.asarray(keys)[order]
+    uniq, starts = np.unique(sorted_keys, return_index=True)
+    counts = np.diff(np.append(starts, n))
+    padded_counts = -(-counts // E) * E
+    out_starts = np.concatenate([[0], np.cumsum(padded_counts)[:-1]])
+    total = int(padded_counts.sum())
+    idx = np.full(total, -1, np.int64)
+    # destination of each real entry: its group's padded start + rank
+    ranks = np.arange(n) - np.repeat(starts, counts)
+    idx[np.repeat(out_starts, counts) + ranks] = order
+    chunk_tile = np.repeat(uniq, padded_counts // E).astype(np.int32)
+    return idx, chunk_tile
+
+
+def tile_csr(A, C: int = 512, R: int = 256, E: int = 2048) -> TiledELL:
+    """Convert a CSR/COO matrix to the tiled-ELL layout (one-time, host)."""
+    if E % 512 or C % 128 or R % 8:
+        raise ValueError("tile_csr: need E % 512 == 0, C % 128 == 0, "
+                         "R % 8 == 0 (kernel fold/tile alignment)")
+    if isinstance(A, CSRMatrix):
+        coo_rows = np.asarray(A.row_ids())
+        coo_cols = np.asarray(A.indices)
+        vals = np.asarray(A.values, np.float32)
+        shape = A.shape
+    elif isinstance(A, COOMatrix):
+        coo_rows = np.asarray(A.rows)
+        coo_cols = np.asarray(A.cols)
+        vals = np.asarray(A.values, np.float32)
+        shape = A.shape
+    else:
+        raise TypeError(f"tile_csr: expected sparse matrix, got {type(A)}")
+
+    # --- gather phase: sort by (col tile, row) and pad per col tile ---
+    col_tile = coo_cols // C
+    order = np.lexsort((coo_rows, col_tile))
+    pad_idx, chunk_col_tile = _pad_groups(order, col_tile, E)
+    pv = np.where(pad_idx >= 0, vals[np.maximum(pad_idx, 0)], 0.0
+                  ).astype(np.float32)
+    pc = np.where(pad_idx >= 0, coo_cols[np.maximum(pad_idx, 0)] % C, 0
+                  ).astype(np.int32)
+    prow = np.where(pad_idx >= 0, coo_rows[np.maximum(pad_idx, 0)], -1)
+
+    n_chunks = max(1, len(pad_idx) // E)
+    if len(pad_idx) == 0:                        # empty matrix
+        pv = np.zeros(E, np.float32)
+        pc = np.zeros(E, np.int32)
+        prow = np.full(E, -1, np.int64)
+        chunk_col_tile = np.zeros(1, np.int32)
+
+    # --- scatter phase: positions in flat col-order, sorted by (row tile,
+    # row) with pads (prow = -1) sent to the end of their row tile ---
+    flat_pos = np.arange(len(prow), dtype=np.int64)
+    row_tile = np.where(prow >= 0, prow // R, shape[0] // R + 1)
+    order2 = np.lexsort((prow, row_tile))
+    # drop trailing all-pad entries beyond the last real one, then re-pad
+    # per row tile
+    real_mask = prow[order2] >= 0
+    order2 = order2[real_mask]
+    rt_keys = prow[order2] // R
+    pad2, chunk_row_tile = _pad_groups(np.arange(len(order2)), rt_keys, E)
+    src = np.where(pad2 >= 0, flat_pos[order2[np.maximum(pad2, 0)]], 0
+                   ).astype(np.int32)
+    rloc = np.where(pad2 >= 0, prow[order2[np.maximum(pad2, 0)]] % R, R
+                    ).astype(np.int32)
+    if len(pad2) == 0:
+        src = np.zeros(E, np.int32)
+        rloc = np.full(E, R, np.int32)
+        chunk_row_tile = np.zeros(1, np.int32)
+    # pads must contribute nothing: point them at a real slot but mark
+    # row_local = R (outside every lane id, masked in-kernel)
+
+    m_chunks = len(src) // E
+    n_col_tiles = max(1, -(-shape[1] // C))
+    n_row_tiles = max(1, -(-shape[0] // R))
+    visited = np.zeros(n_row_tiles, bool)
+    visited[np.asarray(chunk_row_tile, np.int64)] = True
+    return TiledELL(
+        shape=shape, C=C, R=R, E=E,
+        vals=jnp.asarray(pv.reshape(n_chunks, E)),
+        col_local=jnp.asarray(pc.reshape(n_chunks, E)),
+        chunk_col_tile=jnp.asarray(chunk_col_tile),
+        perm=jnp.asarray(src.reshape(m_chunks, E)),
+        row_local=jnp.asarray(rloc.reshape(m_chunks, E)),
+        chunk_row_tile=jnp.asarray(chunk_row_tile),
+        visited_row_tiles=jnp.asarray(visited),
+        n_col_tiles=n_col_tiles, n_row_tiles=n_row_tiles,
+    )

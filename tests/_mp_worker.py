@@ -1,0 +1,70 @@
+"""Worker process for the multi-process comms test (see
+test_multiprocess.py). Launched once per rank with an OpenMPI-style
+environment; exercises the REAL multi-host bootstrap chain:
+mpi.detect_mpi_environment → jax.distributed.initialize →
+session.Comms over the global (2-process) device set → the full comms
+test battery across processes.
+
+(ref: the raft-dask LocalCUDACluster test pattern —
+python/raft-dask/raft_dask/tests/conftest.py:14-35, test_comms.py:62 —
+re-rendered as OS processes under jax.distributed.)
+"""
+
+import os
+import sys
+
+# 4 virtual CPU devices per process → an 8-device, 2-process clique
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    from raft_tpu.comms.mpi import initialize_mpi_comms
+
+    rank, size = initialize_mpi_comms(
+        coordinator_port=int(os.environ["RAFT_TPU_TEST_PORT"]))
+    assert jax.process_count() == size == 2, jax.process_count()
+    assert jax.process_index() == rank
+    assert len(jax.local_devices()) == 4
+    assert jax.device_count() == 8
+
+    from raft_tpu.comms import test_battery
+    from raft_tpu.comms.session import Comms
+
+    comms = Comms()            # all 8 global devices
+    comms.init()
+    hc = comms.comms
+    assert hc.size == 8
+
+    failures = []
+    for fn in test_battery.ALL_TESTS:
+        ok = fn(hc)
+        if not ok:
+            failures.append(fn.__name__)
+        print(f"[rank {rank}] {fn.__name__}: {'ok' if ok else 'FAIL'}",
+              flush=True)
+
+    # 2-D grid + comm_split across the process boundary
+    grid = Comms(axis_names=("rows", "cols"), mesh_shape=(2, 4))
+    grid.init()
+    ok = test_battery.perform_test_comm_split(grid.comms, "rows", "cols")
+    print(f"[rank {rank}] perform_test_comm_split: {'ok' if ok else 'FAIL'}",
+          flush=True)
+    if not ok:
+        failures.append("perform_test_comm_split")
+
+    hc.barrier()
+    if failures:
+        print(f"[rank {rank}] FAILURES: {failures}", flush=True)
+        return 1
+    print(f"[rank {rank}] battery complete", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

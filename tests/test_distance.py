@@ -46,6 +46,26 @@ def test_unexpanded_matches_expanded(res):
     np.testing.assert_allclose(e, u, atol=1e-3, rtol=1e-4)
 
 
+@pytest.mark.parametrize("metric,ref", [
+    ("l1", "cityblock"), ("chebyshev", "chebyshev"),
+    ("canberra", "canberra"), ("braycurtis", "braycurtis"),
+])
+def test_unexpanded_tiny_workspace_tiles_both_axes(metric, ref):
+    # a 4 KB budget forces row tiles of 1 AND feature chunking (d > chunk);
+    # the peak temp is [tile, m, dc], never [tile, m, d] — the reference's
+    # k-blocked contraction policy (contractions.cuh:313) rendered on the
+    # feature axis
+    import raft_tpu
+    from raft_tpu.core.resources import WorkspaceResource
+
+    small = raft_tpu.DeviceResources()
+    small.set_workspace_resource(WorkspaceResource(allocation_limit=4096))
+    x = rng.normal(size=(9, 70)).astype(np.float32)   # d=70 > chunk=32
+    y = rng.normal(size=(11, 70)).astype(np.float32)
+    out = np.asarray(distance.pairwise_distance(small, x, y, metric=metric))
+    np.testing.assert_allclose(out, cdist(x, y, ref), atol=1e-3, rtol=1e-4)
+
+
 def test_hamming(res):
     a = (rng.random((6, 9)) < 0.5).astype(np.float32)
     b = (rng.random((5, 9)) < 0.5).astype(np.float32)

@@ -1,0 +1,137 @@
+"""Certified fused KNN pipeline tests (interpret-mode kernel + XLA glue).
+
+Mirrors the reference's select_k/fused-distance test strategy
+(cpp/tests/matrix/select_k.cu, cpp/tests/distance/fused_l2_nn.cu): exact
+results vs an oracle across shapes, plus adversarial inputs that force the
+certificate/fixup paths (near-duplicate points sharing slots).
+
+Precision note: the pipeline's score function is the expanded squared L2
+in f32 (reference parity). The oracle is f64; assertions use the expanded-
+f32 cancellation floor ``ulp(‖x‖²+‖y‖²)`` as tolerance, which is tight
+(≈1e-5 for unit-scale data) for everything but near-duplicates.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.distance.knn_fused import knn_fused
+
+rng = np.random.default_rng(7)
+
+
+def _oracle(x, y, k):
+    xx = (x.astype(np.float64) ** 2).sum(1)
+    yy = (y.astype(np.float64) ** 2).sum(1)
+    d2 = xx[:, None] + yy[None, :] - 2.0 * (
+        x.astype(np.float64) @ y.astype(np.float64).T)
+    d2 = np.maximum(d2, 0)
+    ids = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    scale = float(np.max(xx[:, None] + yy[None, :]))
+    return np.take_along_axis(d2, ids, axis=1), ids, 8 * scale * 2.0 ** -24
+
+
+@pytest.mark.parametrize("Q,m,d,k", [
+    (64, 5000, 32, 8),
+    (100, 3000, 130, 16),     # d not a lane multiple
+    (8, 2048, 128, 64),
+    (300, 5000, 32, 8),       # Q not a block multiple
+    (16, 300, 20, 5),         # single tile
+])
+def test_exact_mode_random(Q, m, d, k):
+    x = rng.normal(size=(Q, d)).astype(np.float32)
+    y = rng.normal(size=(m, d)).astype(np.float32)
+    vals, ids = knn_fused(x, y, k=k, passes=3, T=512, Qb=64, g=8)
+    ref_vals, ref_ids, tol = _oracle(x, y, k)
+    np.testing.assert_allclose(np.asarray(vals), ref_vals, atol=tol)
+    # random data is well-separated: ids must match exactly
+    assert np.array_equal(np.sort(np.asarray(ids), 1), np.sort(ref_ids, 1))
+
+
+def test_exact_mode_clustered_forces_fixup():
+    # near-duplicate points share slots -> certificate fails -> fixup/
+    # fallback; the result must still be exact to the cancellation floor
+    Q, m, d, k = 256, 4096, 64, 32
+    base = rng.normal(size=(50, d)).astype(np.float32)
+    y = base[rng.integers(0, 50, m)] + 1e-3 * rng.normal(
+        size=(m, d)).astype(np.float32)
+    x = base[rng.integers(0, 50, Q)] + 1e-3 * rng.normal(
+        size=(Q, d)).astype(np.float32)
+    vals, ids = knn_fused(x, y, k=k, passes=3, T=512, Qb=64, g=8)
+    ref_vals, _, tol = _oracle(x, y, k)
+    np.testing.assert_allclose(np.asarray(vals), ref_vals, atol=tol)
+
+
+def test_fast_mode_recall():
+    Q, m, d, k = 64, 8192, 64, 16
+    x = rng.normal(size=(Q, d)).astype(np.float32)
+    y = rng.normal(size=(m, d)).astype(np.float32)
+    vals, ids = knn_fused(x, y, k=k, passes=1, T=512, Qb=64, g=8)
+    _, ref_ids, _ = _oracle(x, y, k)
+    recall = np.mean([len(set(np.asarray(ids)[i]) & set(ref_ids[i])) / k
+                      for i in range(Q)])
+    assert recall >= 0.99
+
+
+def test_query_chunking_matches_single_shot(monkeypatch):
+    import raft_tpu.distance.knn_fused as kf
+
+    monkeypatch.setattr(kf, "_Q_CHUNK", 64)
+    x = rng.normal(size=(150, 32)).astype(np.float32)   # 3 chunks
+    y = rng.normal(size=(3000, 32)).astype(np.float32)
+    vals, ids = kf.knn_fused(x, y, k=8, passes=3, T=512, Qb=64, g=8)
+    ref_vals, ref_ids, tol = _oracle(x, y, 8)
+    np.testing.assert_allclose(np.asarray(vals), ref_vals, atol=tol)
+    assert np.array_equal(np.sort(np.asarray(ids), 1), np.sort(ref_ids, 1))
+
+
+def test_bad_group_size_raises():
+    with pytest.raises(NotImplementedError, match="must divide"):
+        knn_fused(rng.normal(size=(16, 8)).astype(np.float32),
+                  rng.normal(size=(2048, 8)).astype(np.float32),
+                  k=4, T=512, Qb=16, g=48)
+
+
+def test_k_equals_m_small_index_raises():
+    with pytest.raises(NotImplementedError):
+        knn_fused(rng.normal(size=(16, 8)).astype(np.float32),
+                  rng.normal(size=(64, 8)).astype(np.float32),
+                  k=64, T=512, Qb=64, g=8)
+
+
+def test_k_larger_than_index_raises():
+    with pytest.raises(ValueError):
+        knn_fused(rng.normal(size=(4, 8)).astype(np.float32),
+                  rng.normal(size=(16, 8)).astype(np.float32), k=32)
+
+
+def test_knn_auto_routes_and_matches():
+    # public API: algo="fused" must agree with algo="streamed"
+    from raft_tpu import distance
+
+    x = rng.normal(size=(32, 48)).astype(np.float32)
+    y = rng.normal(size=(5000, 48)).astype(np.float32)
+    vf, if_ = distance.knn(None, y, x, k=8, algo="fused")
+    vs, is_ = distance.knn(None, y, x, k=8, algo="streamed")
+    np.testing.assert_allclose(np.asarray(vf), np.asarray(vs), atol=1e-4)
+    assert np.array_equal(np.asarray(if_), np.asarray(is_))
+
+
+def test_knn_fused_euclidean_sqrt():
+    from raft_tpu import distance
+
+    x = rng.normal(size=(16, 32)).astype(np.float32)
+    y = rng.normal(size=(4096, 32)).astype(np.float32)
+    v, _ = distance.knn(None, y, x, k=4, metric="euclidean", algo="fused")
+    v2, _ = distance.knn(None, y, x, k=4, metric="sqeuclidean", algo="fused")
+    np.testing.assert_allclose(np.asarray(v) ** 2, np.asarray(v2),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_inner_product_rejected():
+    from raft_tpu import distance
+    from raft_tpu.core.error import LogicError
+
+    x = rng.normal(size=(16, 32)).astype(np.float32)
+    y = rng.normal(size=(4096, 32)).astype(np.float32)
+    with pytest.raises(LogicError):
+        distance.knn(None, y, x, k=4, metric="inner_product", algo="fused")
