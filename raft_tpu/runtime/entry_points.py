@@ -16,6 +16,23 @@ from raft_tpu.core.resources import ensure_resources
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
 
 
+def _aot_call(res, name: str, statics: tuple, fn, *args):
+    """AOT lower+compile ``fn`` once per (entry, statics, arg shapes) and
+    reuse the executable from the handle's CompileCache — the TPU-native
+    analog of the reference's precompiled libraft.so instantiations
+    (ref: cpp/CMakeLists.txt:275-309). ``res.compile_cache.hits`` counts
+    reuse (tested in tests/test_runtime_aot.py)."""
+    args = tuple(jnp.asarray(a) for a in args)
+    # sharding/placement is part of the compiled executable's signature —
+    # a cache hit with differently-committed args would raise at dispatch
+    key = (name, statics,
+           tuple((a.shape, str(a.dtype),
+                  str(getattr(a, "sharding", None))) for a in args))
+    compiled = res.compile_cache.get_or_compile(
+        key, lambda: jax.jit(fn).lower(*args).compile())
+    return compiled(*args)
+
+
 def lanczos_solver(res, rows, cols, vals, n: int, n_components: int,
                    max_iterations: int = 1000, ncv: Optional[int] = None,
                    tolerance: float = 1e-6, which: str = "SA", seed: int = 42,
@@ -44,11 +61,18 @@ def randomized_svds(res, indptr, indices, vals, shape: Tuple[int, int],
     from raft_tpu.sparse.solver.randomized_svds import randomized_svds as _svds
 
     res = ensure_resources(res)
-    A = CSRMatrix(jnp.asarray(indptr, jnp.int32), jnp.asarray(indices, jnp.int32),
-                  jnp.asarray(vals), shape)
-    return _svds(res, A, SvdsConfig(n_components=n_components,
-                                    n_oversamples=n_oversamples,
-                                    n_power_iters=n_power_iters, seed=seed))
+    shape = tuple(int(s) for s in shape)
+    cfg = SvdsConfig(n_components=n_components, n_oversamples=n_oversamples,
+                     n_power_iters=n_power_iters, seed=seed)
+
+    def run(ip, ix, v):
+        return _svds(res, CSRMatrix(ip, ix, v, shape), cfg)
+
+    return _aot_call(
+        res, "randomized_svds",
+        (shape, n_components, n_oversamples, n_power_iters, seed), run,
+        jnp.asarray(indptr, jnp.int32), jnp.asarray(indices, jnp.int32),
+        jnp.asarray(vals))
 
 
 def rmat_rectangular_generator(res, theta, r_scale: int, c_scale: int,
@@ -59,5 +83,19 @@ def rmat_rectangular_generator(res, theta, r_scale: int, c_scale: int,
     from raft_tpu.random.rng_state import RngState
 
     res = ensure_resources(res)
-    return rmat_rectangular_gen(res, RngState(seed), n_edges, r_scale,
-                                c_scale, theta=theta)
+    if theta is None:
+        def run_default():
+            return rmat_rectangular_gen(res, RngState(seed), n_edges,
+                                        r_scale, c_scale)
+
+        return _aot_call(res, "rmat_rectangular_generator",
+                         (r_scale, c_scale, n_edges, seed, "default"),
+                         run_default)
+
+    def run(th):
+        return rmat_rectangular_gen(res, RngState(seed), n_edges, r_scale,
+                                    c_scale, theta=th)
+
+    return _aot_call(res, "rmat_rectangular_generator",
+                     (r_scale, c_scale, n_edges, seed), run,
+                     jnp.asarray(theta, jnp.float32))

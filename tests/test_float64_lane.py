@@ -1,0 +1,114 @@
+"""float64 CPU lane — the dtype-policy tests.
+
+The reference instantiates its solvers for <float, double>
+(cpp/src/raft_runtime/solver/, linalg/detail/eig.cuh:39-143). The TPU
+policy (documented in README "Dtype policy"): f32 (+bf16 contractions) on
+TPU — f64 is emulated and slow there — with full f64 support on the CPU
+backend via jax's x64 mode. This lane proves the f64 path end to end:
+factorizations and Lanczos run in float64 and hit tolerances far beyond
+f32's reach, so a drop-in user of the reference's double overloads has a
+working (CPU) home for them.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu import linalg
+
+rng = np.random.default_rng(29)
+
+
+@pytest.fixture()
+def x64():
+    jax.config.update("jax_enable_x64", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_cholesky_r1_f64(res, x64):
+    # grow a factor column by column, the reference's incremental potrf
+    a = rng.normal(size=(20, 20))
+    spd = (a @ a.T + 20 * np.eye(20)).astype(np.float64)
+    L = None
+    for k in range(1, 21):
+        L = linalg.cholesky_r1_update(res, L, spd[:k, k - 1])
+    L = np.asarray(L)
+    assert L.dtype == np.float64
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-12, atol=1e-11)
+
+
+def test_qr_f64(res, x64):
+    a = rng.normal(size=(50, 30)).astype(np.float64)
+    Q, R = linalg.qr_get_qr(res, a)
+    assert np.asarray(Q).dtype == np.float64
+    np.testing.assert_allclose(np.asarray(Q) @ np.asarray(R), a,
+                               rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(Q).T @ np.asarray(Q),
+                               np.eye(30), atol=1e-12)
+
+
+def test_eig_jacobi_f64(res, x64):
+    a = rng.normal(size=(24, 24))
+    sym = ((a + a.T) / 2).astype(np.float64)
+    w, v = linalg.eig_jacobi(res, sym)
+    w, v = np.asarray(w), np.asarray(v)
+    assert w.dtype == np.float64
+    np.testing.assert_allclose(v @ np.diag(w) @ v.T, sym, atol=1e-10)
+
+
+def test_svd_f64(res, x64):
+    a = rng.normal(size=(40, 25)).astype(np.float64)
+    U, S, V = linalg.svd_qr(res, a)
+    np.testing.assert_allclose(np.asarray(S),
+                               np.linalg.svd(a, compute_uv=False),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_lstsq_f64(res, x64):
+    A = rng.normal(size=(60, 20)).astype(np.float64)
+    w_true = rng.normal(size=(20,)).astype(np.float64)
+    b = A @ w_true
+    w = np.asarray(linalg.lstsq_svd_qr(res, A, b))
+    np.testing.assert_allclose(w, w_true, rtol=1e-10, atol=1e-10)
+
+
+def test_lanczos_f64(res, x64):
+    import scipy.sparse as sp
+
+    from raft_tpu.core.sparse_types import CSRMatrix
+    from raft_tpu.sparse.solver.lanczos import lanczos_compute_eigenpairs
+    from raft_tpu.sparse.solver.lanczos_types import (
+        LANCZOS_WHICH, LanczosSolverConfig)
+
+    d = rng.normal(size=(60, 60))
+    d = ((d + d.T) / 2).astype(np.float64)
+    m = sp.csr_matrix(d * (np.abs(d) > 0.8))
+    A = CSRMatrix(np.asarray(m.indptr, np.int32),
+                  np.asarray(m.indices, np.int32),
+                  m.data.astype(np.float64), m.shape)
+    cfg = LanczosSolverConfig(n_components=4, max_iterations=1000, ncv=28,
+                              tolerance=1e-12, which=LANCZOS_WHICH.SA,
+                              seed=0)
+    vals, vecs = lanczos_compute_eigenpairs(res, A, cfg)
+    from scipy.sparse.linalg import eigsh as scipy_eigsh
+
+    ref = scipy_eigsh(m.toarray(), k=4, which="SA")[0]
+    assert np.asarray(vals).dtype == np.float64
+    np.testing.assert_allclose(np.sort(np.asarray(vals)), np.sort(ref),
+                               atol=1e-8)
+
+
+def test_pairwise_f64(res, x64):
+    from scipy.spatial.distance import cdist
+
+    from raft_tpu import distance
+
+    x = rng.normal(size=(12, 40))
+    y = rng.normal(size=(9, 40))
+    out = np.asarray(distance.pairwise_distance(res, x, y, metric="l1"))
+    assert out.dtype == np.float64
+    np.testing.assert_allclose(out, cdist(x, y, "cityblock"), rtol=1e-12)

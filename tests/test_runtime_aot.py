@@ -1,0 +1,55 @@
+"""Runtime AOT compile-cache tests.
+
+(ref: cpp/CMakeLists.txt:275-309 — the reference ships precompiled
+explicit instantiations in libraft.so so callers never pay template
+compile cost twice; here the handle's CompileCache plays that role for
+the runtime entry points: one lower+compile per (entry, statics, shapes),
+executable reuse afterwards.)
+"""
+
+import numpy as np
+
+import raft_tpu
+from raft_tpu.runtime import entry_points
+
+
+def test_rmat_entry_aot_cache_hit():
+    res = raft_tpu.DeviceResources(seed=0)
+    theta = np.tile(np.asarray([0.57, 0.19, 0.19, 0.05], np.float32), 8)
+    before = res.compile_cache.misses
+    src1, dst1 = entry_points.rmat_rectangular_generator(
+        res, theta, r_scale=8, c_scale=8, n_edges=1000, seed=3)
+    assert res.compile_cache.misses == before + 1
+    hits0 = res.compile_cache.hits
+    src2, dst2 = entry_points.rmat_rectangular_generator(
+        res, theta, r_scale=8, c_scale=8, n_edges=1000, seed=3)
+    # second call with identical statics+shapes must reuse the executable
+    assert res.compile_cache.hits == hits0 + 1
+    assert res.compile_cache.misses == before + 1
+    np.testing.assert_array_equal(np.asarray(src1), np.asarray(src2))
+    # different statics -> a fresh executable, not a stale hit
+    theta9 = np.tile(np.asarray([0.57, 0.19, 0.19, 0.05], np.float32), 9)
+    entry_points.rmat_rectangular_generator(
+        res, theta9, r_scale=9, c_scale=9, n_edges=1000, seed=3)
+    assert res.compile_cache.misses == before + 2
+
+
+def test_svds_entry_aot_cache_hit():
+    import scipy.sparse as sp
+
+    res = raft_tpu.DeviceResources(seed=0)
+    A = sp.random(60, 40, density=0.2, random_state=1, dtype=np.float32,
+                  format="csr")
+    args = (np.asarray(A.indptr, np.int32), np.asarray(A.indices, np.int32),
+            A.data.astype(np.float32), (60, 40))
+    before = res.compile_cache.misses
+    U1, S1, V1 = entry_points.randomized_svds(res, *args, n_components=3,
+                                              n_power_iters=4)
+    assert res.compile_cache.misses == before + 1
+    hits0 = res.compile_cache.hits
+    U2, S2, V2 = entry_points.randomized_svds(res, *args, n_components=3,
+                                              n_power_iters=4)
+    assert res.compile_cache.hits == hits0 + 1
+    np.testing.assert_allclose(np.asarray(S1), np.asarray(S2), rtol=1e-6)
+    s_ref = np.linalg.svd(A.toarray(), compute_uv=False)[:3]
+    np.testing.assert_allclose(np.asarray(S1), s_ref, rtol=0.05)

@@ -240,6 +240,41 @@ def test_lap_float_costs(res):
         assert float(obj) == pytest.approx(ref, abs=8 * 1e-5)
 
 
+def test_lap_float_costs_certified(res):
+    # float costs: the complementary-slackness certificate must BOUND the
+    # true gap (obj − optimum ≤ gap_bound + fp slop) and be small
+    # (≤ n·ε_floor ≈ n·max|cost|·2⁻²⁰); in practice the assignment itself
+    # matches scipy's exact Hungarian
+    from scipy.optimize import linear_sum_assignment
+
+    for seed, n in [(7, 16), (8, 32), (9, 64)]:
+        r = np.random.default_rng(seed)
+        cost = r.random((n, n)).astype(np.float32)
+        lap = solver.LinearAssignmentProblem(res, n)
+        assign, obj = lap.solve(cost)
+        gap = float(lap.get_optimality_gap_bound())
+        ri, ci = linear_sum_assignment(cost.astype(np.float64))
+        ref = float(cost.astype(np.float64)[ri, ci].sum())
+        assert 0.0 <= gap <= n * 2.0 ** -18, gap
+        assert float(obj) - ref <= gap + n * 1e-6, (obj, ref, gap)
+        # generic random costs: the assignment is the true optimum
+        assert float(obj) == pytest.approx(ref, abs=n * 1e-6)
+
+
+def test_lap_integer_costs_zero_gap(res):
+    # integer costs with final ε < 1/(n+1): certificate must prove
+    # exactness outright... or at worst report sub-1 slack; the objective
+    # must be exactly optimal
+    from scipy.optimize import linear_sum_assignment
+
+    r = np.random.default_rng(3)
+    cost = r.integers(0, 50, size=(20, 20)).astype(np.float32)
+    lap = solver.LinearAssignmentProblem(res, 20)
+    _, obj = lap.solve(cost)
+    ri, ci = linear_sum_assignment(cost)
+    assert float(obj) == float(cost[ri, ci].sum())
+
+
 def test_lap_batched(res):
     r = np.random.default_rng(9)
     costs = r.integers(0, 50, size=(4, 8, 8)).astype(np.float32)
