@@ -1,7 +1,7 @@
 """raft_tpu.comms — the NCCL/UCX comms vocabulary over jax.lax collectives.
 (ref: cpp/include/raft/comms + core/comms.hpp, SURVEY §2.11/§3.2.)"""
 
-from raft_tpu.comms.comms import DataType, Op, Status, MeshComms, get_type
+from raft_tpu.comms.comms import ColorComms, DataType, Op, Status, MeshComms, get_type
 from raft_tpu.comms.host_comms import HostComms
 from raft_tpu.comms.session import (
     Comms,
@@ -13,7 +13,7 @@ from raft_tpu.comms import test_battery
 from raft_tpu.comms.mpi import detect_mpi_environment, initialize_mpi_comms
 
 __all__ = [
-    "DataType", "Op", "Status", "MeshComms", "HostComms", "get_type",
+    "ColorComms", "DataType", "Op", "Status", "MeshComms", "HostComms", "get_type",
     "Comms", "initialize_distributed", "inject_comms_on_handle",
     "local_handle", "test_battery", "detect_mpi_environment",
     "initialize_mpi_comms",

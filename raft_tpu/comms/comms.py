@@ -108,6 +108,16 @@ class MeshComms:
         other axis of the 2-D mesh.)"""
         return MeshComms(other_axis, size=size)
 
+    def comm_split_color(self, color, key=None) -> "ColorComms":
+        """Arbitrary-color split — the reference's full
+        ``comm_split(color, key)`` semantics (ref: core/comms.hpp:123):
+        ranks with equal ``color`` form a clique, ordered by
+        ``(key, rank)``. ``color``/``key`` may be traced per-rank values.
+        Static axis splits (row/col grids) should prefer :meth:`comm_split`
+        — it lowers to pure ICI collectives; ColorComms collectives ride an
+        axis-wide all_gather + masked fold (see ColorComms docs)."""
+        return ColorComms(self, color, key)
+
     def barrier(self, token=None):
         """SPMD barrier: a zero-cost psum dependency.
         (ref: comms_iface::barrier)"""
@@ -204,3 +214,125 @@ class MeshComms:
 
     def group_end(self):
         """(ref: comms_iface::group_end)"""
+
+
+class ColorComms:
+    """Dynamic sub-communicator over an arbitrary color partition.
+
+    (ref: core/comms.hpp:123 ``comm_split(color, key)`` — NCCL regroups
+    ranks into new cliques at runtime. XLA collectives are compiled over
+    STATIC axes, so the TPU rendering keeps the parent axis and makes
+    membership a data plane concept: every collective is an axis-wide
+    ``all_gather`` followed by a masked fold over ranks whose color equals
+    the caller's. Correct for any traced color/key assignment; costs
+    O(parent_size·|x|) per call, so it is the general-case path — static
+    grid splits should use mesh axes (``comm_split``), which lower to
+    plain psum/ppermute.)
+
+    Valid inside a ``shard_map`` region over the parent communicator's
+    mesh axis. Gather-family outputs are sized by the PARENT axis (static
+    shapes): the first ``get_size()`` rows are the clique's values in
+    (key, rank) order, the rest are zero-padding.
+    """
+
+    def __init__(self, parent: MeshComms, color, key=None):
+        self.parent = parent
+        self.axis_name = parent.axis_name
+        self.color = jnp.asarray(color, jnp.int32)
+        rank = parent.get_rank()
+        self.key = rank if key is None else jnp.asarray(key, jnp.int32)
+        # gathered per-rank tables, [parent_size]
+        self._colors = jax.lax.all_gather(self.color, self.axis_name)
+        self._keys = jax.lax.all_gather(self.key, self.axis_name)
+        self._member = self._colors == self.color
+        n = self._colors.shape[0]
+        order = jnp.arange(n, dtype=jnp.int32)
+        # single source of truth for the (key, rank) ordering: the rank of
+        # parent-rank r within ITS clique; own rank/size derive from it
+        same = (self._colors[None, :] == self._colors[:, None])
+        lt = ((self._keys[None, :] < self._keys[:, None])
+              | ((self._keys[None, :] == self._keys[:, None])
+                 & (order[None, :] < order[:, None])))
+        self._subrank_of = jnp.sum((same & lt).astype(jnp.int32), axis=1)
+        self._rank = self._subrank_of[rank]
+        self._size = jnp.sum(self._member.astype(jnp.int32))
+
+    # -- topology -----------------------------------------------------------
+    def get_size(self):
+        """Clique size (traced). (ref: comms_iface::get_size)"""
+        return self._size
+
+    def get_rank(self):
+        """Rank within the clique, (key, rank)-ordered.
+        (ref: comms_iface::get_rank)"""
+        return self._rank
+
+    # -- machinery ----------------------------------------------------------
+    def _gather_members(self, x):
+        """[parent_size, ...] of every rank's x, with a member mask."""
+        x = jnp.asarray(x)
+        g = jax.lax.all_gather(x, self.axis_name)
+        mask = self._member.reshape((-1,) + (1,) * x.ndim)
+        return g, mask
+
+    # -- collectives (within the clique) ------------------------------------
+    def allreduce(self, x, op: Op = Op.SUM):
+        g, mask = self._gather_members(x)
+        if op == Op.SUM:
+            return jnp.sum(jnp.where(mask, g, 0), axis=0)
+        if op == Op.PROD:
+            return jnp.prod(jnp.where(mask, g, 1), axis=0)
+        # dtype-aware identities: an inf fill would silently promote
+        # integer inputs to f32 (lossy past 2^24)
+        if jnp.issubdtype(g.dtype, jnp.integer):
+            lo, hi = jnp.iinfo(g.dtype).min, jnp.iinfo(g.dtype).max
+        else:
+            lo, hi = -jnp.inf, jnp.inf
+        if op == Op.MIN:
+            return jnp.min(jnp.where(mask, g, hi), axis=0)
+        return jnp.max(jnp.where(mask, g, lo), axis=0)
+
+    def bcast(self, x, root: int = 0):
+        """Value of the clique member with subcomm rank ``root``."""
+        g, mask = self._gather_members(x)
+        sel = (self._subrank_of == root).reshape(mask.shape) & mask
+        return jnp.sum(jnp.where(sel, g, 0), axis=0)
+
+    def reduce(self, x, root: int = 0, op: Op = Op.SUM):
+        full = self.allreduce(x, op)
+        return jnp.where(self._rank == root, full, jnp.zeros_like(full))
+
+    def allgather(self, x):
+        """[parent_size, ...]: rows [0, get_size()) hold the clique's
+        values in subcomm-rank order; the tail is zeros."""
+        g, mask = self._gather_members(x)
+        n = g.shape[0]
+        slot = jnp.where(self._member, self._subrank_of, n)
+        onehot = (slot[None, :] == jnp.arange(n)[:, None])
+        onehot = onehot.reshape(onehot.shape + (1,) * x.ndim)
+        return jnp.sum(jnp.where(onehot, g[None], 0), axis=1)
+
+    def gather(self, x, root: int = 0):
+        out = self.allgather(x)
+        return jnp.where(self._rank == root, out, jnp.zeros_like(out))
+
+    def barrier(self, token=None):
+        return self.parent.barrier(token)
+
+    def device_sendrecv(self, x, dst, src=None):
+        """Same contract as :meth:`MeshComms.device_sendrecv`, in subcomm
+        ranks: int ``dst`` = uniform ring shift (receive from the member
+        ``dst`` subcomm-ranks behind); a list of ``(src, dst)`` pairs
+        selects explicitly."""
+        g, _ = self._gather_members(x)
+        x = jnp.asarray(x)
+        if isinstance(dst, int):
+            want = jnp.mod(self._rank - dst, jnp.maximum(self._size, 1))
+        else:
+            # receive from the pair whose dst is me (default: keep own)
+            want = self._rank
+            for s, d in dst:
+                want = jnp.where(self._rank == d, jnp.int32(s), want)
+        slot = jnp.where(self._member, self._subrank_of, -1)
+        sel = (slot == want).reshape((-1,) + (1,) * x.ndim)
+        return jnp.sum(jnp.where(sel, g, 0), axis=0)

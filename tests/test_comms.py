@@ -171,3 +171,97 @@ def test_distributed_pca_over_mesh(mesh8):
     w, v = fn(Xs)
     ref = np.sort(np.linalg.eigvalsh(np.cov(X.T)))[::-1]
     np.testing.assert_allclose(np.asarray(w), ref, rtol=2e-3, atol=1e-4)
+
+
+# ---- dynamic comm_split (arbitrary colors) ----
+def test_comm_split_color_allreduce_and_topology(mesh8):
+    # colors = rank % 3 → cliques {0,3,6}, {1,4,7}, {2,5}; the reference's
+    # comm_split(color, key) semantics (core/comms.hpp:123) with runtime
+    # colors — no static mesh axis matches this regrouping
+    from jax.sharding import PartitionSpec as P
+
+    def f(x):
+        c = MeshComms("x", size=8)
+        rank = c.get_rank()
+        sub = c.comm_split_color(rank % 3)
+        total = sub.allreduce(x[0])
+        return jnp.stack([total, sub.get_size(), sub.get_rank()])[None]
+
+    x = jnp.arange(8, dtype=jnp.int32)
+    out = np.asarray(jax.shard_map(
+        f, mesh=mesh8, in_specs=(P("x"),), out_specs=P("x"))(x))
+    # clique sums: 0+3+6=9, 1+4+7=12, 2+5=7
+    want_sum = [9, 12, 7, 9, 12, 7, 9, 12]
+    want_size = [3, 3, 2, 3, 3, 2, 3, 3]
+    want_rank = [0, 0, 0, 1, 1, 1, 2, 2]
+    np.testing.assert_array_equal(out[:, 0], want_sum)
+    np.testing.assert_array_equal(out[:, 1], want_size)
+    np.testing.assert_array_equal(out[:, 2], want_rank)
+
+
+def test_comm_split_color_bcast_gather_ring(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    def f(x):
+        c = MeshComms("x", size=8)
+        rank = c.get_rank()
+        sub = c.comm_split_color(rank // 4)       # {0..3}, {4..7}
+        b = sub.bcast(x[0], root=1)               # member with subrank 1
+        g = sub.allgather(x[0])                   # [8] padded
+        ring = sub.device_sendrecv(x[0], dst=1)
+        return jnp.concatenate(
+            [jnp.stack([b, ring]), g])[None]
+
+    x = (10 + jnp.arange(8, dtype=jnp.int32))
+    out = np.asarray(jax.shard_map(
+        f, mesh=mesh8, in_specs=(P("x"),), out_specs=P("x"))(x))
+    # bcast root=1: clique {0..3} gets value of rank 1 (11); {4..7} -> 15
+    np.testing.assert_array_equal(out[:, 0], [11] * 4 + [15] * 4)
+    # ring shift=1: receive from previous member
+    np.testing.assert_array_equal(out[:, 1],
+                                  [13, 10, 11, 12, 17, 14, 15, 16])
+    # allgather ordered rows then zero padding
+    np.testing.assert_array_equal(out[0, 2:], [10, 11, 12, 13, 0, 0, 0, 0])
+    np.testing.assert_array_equal(out[5, 2:], [14, 15, 16, 17, 0, 0, 0, 0])
+
+
+def test_comm_split_color_key_reorders(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    def f(x):
+        c = MeshComms("x", size=8)
+        rank = c.get_rank()
+        # one clique, key reverses the order
+        sub = c.comm_split_color(jnp.int32(0), key=7 - rank)
+        return jnp.stack([sub.get_rank(), sub.bcast(x[0], root=0)])[None]
+
+    x = jnp.arange(8, dtype=jnp.int32)
+    out = np.asarray(jax.shard_map(
+        f, mesh=mesh8, in_specs=(P("x"),), out_specs=P("x"))(x))
+    np.testing.assert_array_equal(out[:, 0], [7, 6, 5, 4, 3, 2, 1, 0])
+    # root=0 of the reversed order is global rank 7
+    np.testing.assert_array_equal(out[:, 1], [7] * 8)
+
+
+def test_comm_split_color_int_minmax_and_pairs(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    def f(x):
+        c = MeshComms("x", size=8)
+        rank = c.get_rank()
+        sub = c.comm_split_color(rank % 2)     # evens / odds
+        big = x[0] + jnp.int32(16777216)       # > 2^24: f32 would corrupt
+        mn = sub.allreduce(big, Op.MIN)
+        sc = sub.allreduce(1.0)                # python-scalar input
+        pr = sub.device_sendrecv(x[0], dst=[(0, 1), (1, 0)])
+        return jnp.stack([mn, sc.astype(jnp.int32), pr])[None]
+
+    x = jnp.arange(8, dtype=jnp.int32)
+    out = np.asarray(jax.shard_map(
+        f, mesh=mesh8, in_specs=(P("x"),), out_specs=P("x"))(x))
+    # evens clique min = 16777216+0, odds = 16777216+1 — exact in int32
+    np.testing.assert_array_equal(out[:, 0] - 16777216,
+                                  [0, 1, 0, 1, 0, 1, 0, 1])
+    np.testing.assert_array_equal(out[:, 1], [4] * 8)
+    # pairs: subranks 0<->1 swap; subranks 2,3 keep their own values
+    np.testing.assert_array_equal(out[:, 2], [2, 3, 0, 1, 4, 5, 6, 7])
