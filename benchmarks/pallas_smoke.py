@@ -17,8 +17,10 @@ wedged transport can never hang the caller; without a TPU it reports
 
 import json
 import os
-import subprocess
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import subprocess
 import time
 import traceback
 

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""select_k algorithm measurement matrix → the data behind the AUTO
+heuristic.
+
+(ref: matrix/detail/select_k-inl.cuh:38 ``choose_select_k_algorithm`` —
+the reference fits a decision tree over (rows, cols, k) from benchmark
+sweeps; this produces the analogous measured table for the TPU
+algorithms: XLA top_k, the Pallas radix kernel, and the fused-pipeline
+slotted fold.)
+
+Writes ``SELECT_K_MATRIX.json``: per (batch, len, k) the RTT-corrected
+milliseconds per algorithm. Run on a healthy TPU (probe-guarded); on CPU
+it refuses (CPU timings would mis-train a TPU heuristic).
+"""
+
+import itertools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import subprocess
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(__file__), os.pardir,
+                   "SELECT_K_MATRIX.json")
+
+
+def main():
+    # RAFT_TPU_BENCH_FORCE=cpu: tiny-scale CPU dry-run that validates the
+    # harness end to end WITHOUT recording a table (CPU timings must never
+    # train the TPU heuristic)
+    dry = os.environ.get("RAFT_TPU_BENCH_FORCE") == "cpu"
+    if not dry:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; assert jax.devices()[0].platform == 'tpu'"],
+                timeout=150, capture_output=True)
+            if r.returncode != 0:
+                print(json.dumps({"skipped": "no healthy TPU"}))
+                return 0
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"skipped": "TPU probe timeout"}))
+            return 0
+
+    import jax
+
+    if dry:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import raft_tpu
+    from raft_tpu.benchmark import Fixture
+    from raft_tpu.matrix import SelectAlgo, select_k
+
+    res = raft_tpu.device_resources()
+    assert dry or res.platform == "tpu"
+    fx = Fixture(res=res, reps=1 if dry else 3)
+    rng = np.random.default_rng(0)
+
+    grid = (itertools.product((4,), (4096,), (16,)) if dry
+            else itertools.product((16, 64, 256), (16384, 131072, 1048576),
+                                   (16, 64, 256)))
+    results = []
+    for batch, length, k in grid:
+        v = jnp.asarray(rng.normal(size=(batch, length)).astype(np.float32))
+        jax.block_until_ready(v)
+        row = {"batch": batch, "len": length, "k": k}
+        for algo in (SelectAlgo.XLA_TOPK, SelectAlgo.RADIX):
+            try:
+                dt = fx.run(lambda x, a=algo: select_k(
+                    res, x, k=k, algo=a)[0], v)["seconds"]
+                row[algo.name] = round(dt * 1e3, 3)
+            except Exception as e:  # noqa: BLE001 — record, keep sweeping
+                row[algo.name] = f"error: {type(e).__name__}"
+        results.append(row)
+        print(row, flush=True)
+
+    if dry:
+        print(json.dumps({"dry_run": True, "rows": len(results)}))
+        return 0
+    with open(OUT, "w") as f:
+        json.dump({"platform": "tpu", "unit": "ms", "rows": results}, f,
+                  indent=1)
+    print(json.dumps({"wrote": OUT, "rows": len(results)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,23 @@
+#!/bin/bash
+# One-shot TPU measurement battery — run the moment the tunnel is healthy.
+# Each stage is independently probe-guarded and writes its own artifact,
+# so a mid-battery wedge loses only the remaining stages.
+#
+#   bash benchmarks/tpu_measure.sh
+#
+# Artifacts: PALLAS_SMOKE.json, SELECT_K_MATRIX.json, SPMV_BENCH.json,
+# BENCH_LOCAL.json (bench.py's line, also echoed).
+set -u
+cd "$(dirname "$0")/.."
+
+echo "=== pallas smoke (lowering) ==="
+timeout 1200 python benchmarks/pallas_smoke.py || echo "smoke rc=$?"
+
+echo "=== select_k matrix ==="
+timeout 1800 python benchmarks/select_k_matrix.py || echo "matrix rc=$?"
+
+echo "=== spmv bench ==="
+timeout 1800 python benchmarks/bench_spmv.py || echo "spmv rc=$?"
+
+echo "=== bench.py (driver metric) ==="
+timeout 1800 python bench.py | tee BENCH_LOCAL.json || echo "bench rc=$?"

@@ -304,35 +304,94 @@ class ColorComms:
 
     def allgather(self, x):
         """[parent_size, ...]: rows [0, get_size()) hold the clique's
-        values in subcomm-rank order; the tail is zeros."""
-        g, mask = self._gather_members(x)
+        values in subcomm-rank order; the tail is zeros. Linear cost: one
+        axis gather + a row scatter."""
+        g, _ = self._gather_members(x)
         n = g.shape[0]
-        slot = jnp.where(self._member, self._subrank_of, n)
-        onehot = (slot[None, :] == jnp.arange(n)[:, None])
-        onehot = onehot.reshape(onehot.shape + (1,) * x.ndim)
-        return jnp.sum(jnp.where(onehot, g[None], 0), axis=1)
+        slot = jnp.where(self._member, self._subrank_of, n)  # n → dropped
+        return jnp.zeros_like(g).at[slot].set(g, mode="drop")
+
+    def allgatherv(self, x, counts: Sequence[int]):
+        """Static per-subrank ``counts`` (like the reference's host-given
+        recvcounts); shards padded to max(counts) by the caller.
+        (ref: comms_iface::allgatherv)"""
+        out = self.allgather(x)
+        return jnp.concatenate(
+            [out[i, : counts[i]] for i in range(len(counts))], axis=0)
 
     def gather(self, x, root: int = 0):
         out = self.allgather(x)
         return jnp.where(self._rank == root, out, jnp.zeros_like(out))
 
+    def gatherv(self, x, counts: Sequence[int], root: int = 0):
+        out = self.allgatherv(x, counts)
+        return jnp.where(self._rank == root, out, jnp.zeros_like(out))
+
+    def reducescatter(self, x, op: Op = Op.SUM, clique_size: Optional[int]
+                      = None):
+        """Each member gets its tile of the within-clique reduction.
+        The clique size is data-dependent, but XLA slices need static
+        shapes — pass the statically-known ``clique_size`` (the
+        reference's recvcount plays the same role).
+        (ref: comms_iface::reducescatter)"""
+        expects(clique_size is not None,
+                "ColorComms.reducescatter needs static clique_size "
+                "(dynamic membership cannot size the output tile)")
+        full = self.allreduce(x, op)
+        chunk = full.shape[0] // clique_size
+        return jax.lax.dynamic_slice_in_dim(
+            full, self._rank * chunk, chunk, axis=0)
+
     def barrier(self, token=None):
         return self.parent.barrier(token)
+
+    def sync_stream(self, *arrays) -> Status:
+        """(ref: comms_iface::sync_stream — no-op inside one program)"""
+        return Status.SUCCESS
+
+    def comm_split_color(self, color, key=None) -> "ColorComms":
+        """Split the clique again: combined colors keep cliques disjoint
+        across parents. Colors must fit 15 bits (documented bound).
+        (ref: recursive comm_split)"""
+        combined = self.color * jnp.int32(32768) + (
+            jnp.asarray(color, jnp.int32) & jnp.int32(32767))
+        return ColorComms(self.parent, combined, key)
+
+    # -- device p2p (subcomm ranks; zero-fill parity with ppermute) ---------
+    def device_send(self, x, dst):
+        """(ref: comms_iface::device_send — see device_sendrecv)"""
+        return self.device_sendrecv(x, dst)
+
+    def device_recv(self, x_from_permute):
+        return x_from_permute
 
     def device_sendrecv(self, x, dst, src=None):
         """Same contract as :meth:`MeshComms.device_sendrecv`, in subcomm
         ranks: int ``dst`` = uniform ring shift (receive from the member
         ``dst`` subcomm-ranks behind); a list of ``(src, dst)`` pairs
-        selects explicitly."""
+        selects explicitly — members that are not a destination of any
+        pair receive ZEROS, matching ppermute's fill."""
         g, _ = self._gather_members(x)
         x = jnp.asarray(x)
         if isinstance(dst, int):
             want = jnp.mod(self._rank - dst, jnp.maximum(self._size, 1))
         else:
-            # receive from the pair whose dst is me (default: keep own)
-            want = self._rank
+            want = jnp.int32(-2)          # no pair targets me → zeros
             for s, d in dst:
                 want = jnp.where(self._rank == d, jnp.int32(s), want)
         slot = jnp.where(self._member, self._subrank_of, -1)
         sel = (slot == want).reshape((-1,) + (1,) * x.ndim)
         return jnp.sum(jnp.where(sel, g, 0), axis=0)
+
+    def device_multicast_sendrecv(self, x, dsts: Optional[Sequence[int]]
+                                  = None):
+        """(ref: comms_iface::device_multicast_sendrecv — padded
+        allgather, like the MeshComms rendering)"""
+        return self.allgather(x)
+
+    # -- grouping (no-ops inside one traced program) ------------------------
+    def group_start(self):
+        """(ref: comms_iface::group_start)"""
+
+    def group_end(self):
+        """(ref: comms_iface::group_end)"""

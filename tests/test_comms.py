@@ -263,5 +263,39 @@ def test_comm_split_color_int_minmax_and_pairs(mesh8):
     np.testing.assert_array_equal(out[:, 0] - 16777216,
                                   [0, 1, 0, 1, 0, 1, 0, 1])
     np.testing.assert_array_equal(out[:, 1], [4] * 8)
-    # pairs: subranks 0<->1 swap; subranks 2,3 keep their own values
-    np.testing.assert_array_equal(out[:, 2], [2, 3, 0, 1, 4, 5, 6, 7])
+    # pairs: subranks 0<->1 swap; unlisted destinations get ZEROS
+    # (ppermute fill parity)
+    np.testing.assert_array_equal(out[:, 2], [2, 3, 0, 1, 0, 0, 0, 0])
+
+
+def test_comm_split_color_vocabulary_surface(mesh8):
+    # the full comms_iface vocabulary must be callable on a ColorComms
+    # (substitutability with MeshComms-consuming code)
+    from jax.sharding import PartitionSpec as P
+
+    def f(x):
+        c = MeshComms("x", size=8)
+        sub = c.comm_split_color(c.get_rank() // 4)     # two cliques of 4
+        y = x[0]                                        # [4] per rank
+        sub.group_start()
+        rs = sub.reducescatter(y, clique_size=4)
+        gv = sub.allgatherv(y[:1], counts=[1, 1, 1, 1])
+        mc = sub.device_multicast_sendrecv(y[0])
+        sent = sub.device_send(y[0], dst=1)
+        assert sub.sync_stream() is not None
+        sub.group_end()
+        nested = sub.comm_split_color(sub.get_rank() % 2)
+        ns = nested.get_size()
+        return jnp.concatenate(
+            [rs, gv, mc[:1], jnp.stack([sent, ns.astype(jnp.float32)])])[None]
+
+    x = jnp.tile(jnp.arange(8, dtype=jnp.float32)[:, None], (1, 4))
+    out = np.asarray(jax.shard_map(
+        f, mesh=mesh8, in_specs=(P("x"),), out_specs=P("x"))(x))
+    # reducescatter: clique {0..3} sum = 0+1+2+3 = 6 per lane; each member
+    # gets 1 of the 4 lanes -> value 6; clique {4..7} sum = 22
+    np.testing.assert_array_equal(out[:, 0], [6, 6, 6, 6, 22, 22, 22, 22])
+    # allgatherv with counts [1,1,1,1]: first element = clique member 0's x
+    np.testing.assert_array_equal(out[:, 1], [0, 0, 0, 0, 4, 4, 4, 4])
+    # nested split: cliques of 4 split by parity -> size 2
+    np.testing.assert_array_equal(out[:, 7], [2] * 8)
