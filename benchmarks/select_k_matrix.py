@@ -68,7 +68,8 @@ def main():
         v = jnp.asarray(rng.normal(size=(batch, length)).astype(np.float32))
         jax.block_until_ready(v)
         row = {"batch": batch, "len": length, "k": k}
-        for algo in (SelectAlgo.XLA_TOPK, SelectAlgo.RADIX):
+        for algo in (SelectAlgo.XLA_TOPK, SelectAlgo.SLOTTED,
+                     SelectAlgo.RADIX):
             try:
                 dt = fx.run(lambda x, a=algo: select_k(
                     res, x, k=k, algo=a)[0], v)["seconds"]

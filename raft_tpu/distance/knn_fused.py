@@ -79,30 +79,7 @@ def _err_bound_coeff(d: int) -> float:
     return 2.0 ** -15 + d * 2.0 ** -21
 
 
-def _fold_group_top2(m1, i1, g: int):
-    """[Q, S] → per-group-of-g (top-2 values with slot-min point ids,
-    3rd-min value). Pure compare/select fold — no sort."""
-    Q, S = m1.shape
-    g = min(g, S)
-    G = S // g
-    v = m1.reshape(Q, G, g)
-    pid = i1.reshape(Q, G, g)
-    inf = jnp.full((Q, G), jnp.inf, m1.dtype)
-    a1, a2, a3 = inf, inf, inf
-    id1 = jnp.full((Q, G), -1, jnp.int32)
-    id2 = jnp.full((Q, G), -1, jnp.int32)
-    for r in range(g):
-        c = v[:, :, r]
-        cid = pid[:, :, r]
-        lt1 = c < a1
-        lt2 = c < a2
-        lt3 = c < a3
-        a3 = jnp.where(lt2, a2, jnp.where(lt3, c, a3))
-        id2 = jnp.where(lt1, id1, jnp.where(lt2, cid, id2))
-        a2 = jnp.where(lt1, a1, jnp.where(lt2, c, a2))
-        id1 = jnp.where(lt1, cid, id1)
-        a1 = jnp.minimum(a1, c)
-    return a1, id1, a2, id2, a3
+from raft_tpu.ops.folds import fold_group_top2 as _fold_group_top2
 
 
 def _pad_rows_to(y, mult: int):

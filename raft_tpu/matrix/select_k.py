@@ -84,6 +84,19 @@ def select_k(
     if algo == SelectAlgo.AUTO:
         algo = choose_select_k_algorithm(batch, length, k)
 
+    if algo == SelectAlgo.SLOTTED:
+        from raft_tpu.matrix.select_k_slotted import select_k_slotted
+
+        try:
+            return select_k_slotted(in_val, in_idx, k, select_min)
+        except NotImplementedError as e:
+            import warnings
+
+            warnings.warn(
+                f"select_k: explicit algo=SLOTTED outside its envelope "
+                f"({e}); falling back to XLA top-k",
+                RuntimeWarning, stacklevel=2)
+
     if algo in (SelectAlgo.BITONIC, SelectAlgo.RADIX):
         # BITONIC is an alias of the one Pallas kernel (radix): the
         # warpsort-family names map here for API parity, but no separate

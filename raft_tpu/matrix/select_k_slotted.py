@@ -1,0 +1,133 @@
+"""Certified slotted select_k — the bandwidth-bound selection algorithm.
+
+(ref: the role of matrix/detail/select_radix.cuh:639 — the reference's
+radix select exists because sorting is too expensive; its filtering
+passes stream the row at memory bandwidth. The TPU-native equivalent is
+slot folding: partition each row into S slots, keep per-slot (min,
+argmin, 2nd-min) — pure vector min/select ops that XLA fuses into ~3
+linear passes — then select among slot-mins and CERTIFY exactness with
+the 2nd-min bound. No sort, no histogram, no Pallas required: the memory
+system is the only cost.)
+
+Exactness: candidates are the top-C pool entries of per-group top-2 slot
+mins; every non-candidate value is ≥ B = min(slot 2nd-min, group 3rd-min,
+C-th pool value), so ``B ≥ θ`` (θ = k-th candidate) proves the candidate
+top-k is the true top-k (same certificate as distance.knn_fused). Rows
+that fail (two of the true top-k sharing a slot, ~k²/2S per row) are
+re-solved exactly by XLA top_k and scattered back — the result is ALWAYS
+exact; slotting only decides how fast.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops.folds import fold_group_top2
+
+_POOL_PAD = 32
+
+
+@partial(jax.jit, static_argnames=("k", "slot", "g", "fallback_rows"))
+def _slotted_select_min(vals, k: int, slot: int, g: int,
+                        fallback_rows: int) -> Tuple[jax.Array, jax.Array]:
+    """Exact k smallest per row of ``vals`` [B, L] (L % slot == 0),
+    ascending. Returns (values, positions)."""
+    B, L = vals.shape
+    S = L // slot
+    v3 = vals.reshape(B, S, slot)
+
+    # per-slot min / argmin / 2nd-min: three fused linear passes
+    m1 = jnp.min(v3, axis=2)
+    a1 = jnp.argmin(v3, axis=2).astype(jnp.int32)
+    i1 = a1 + slot * jnp.arange(S, dtype=jnp.int32)[None, :]
+    lane = jnp.arange(slot, dtype=jnp.int32)
+    masked = jnp.where(lane[None, None, :] == a1[:, :, None], jnp.inf, v3)
+    m2 = jnp.min(masked, axis=2)
+
+    p1, pid1, p2, pid2, p3 = fold_group_top2(m1, i1, g)
+    pool_v = jnp.concatenate([p1, p2], axis=1)
+    pool_i = jnp.concatenate([pid1, pid2], axis=1)
+    C = min(k + _POOL_PAD, pool_v.shape[1])
+    neg, pos = jax.lax.top_k(-pool_v, C)
+    cand_v = -neg
+    cand_i = jnp.take_along_axis(pool_i, pos, axis=1)
+
+    theta = cand_v[:, k - 1]
+    bound = jnp.minimum(jnp.min(m2, axis=1), jnp.min(p3, axis=1))
+    bound = jnp.minimum(bound, cand_v[:, C - 1])
+    failed = bound < theta                                      # [B]
+    n_fail = jnp.sum(failed.astype(jnp.int32))
+
+    out_v = cand_v[:, :k]
+    out_i = cand_i[:, :k]
+
+    def exact_rows(rows_v):
+        nv, np_ = jax.lax.top_k(-rows_v, k)
+        return -nv, np_.astype(jnp.int32)
+
+    def no_fix(o):
+        return o
+
+    def small_fix(o):
+        ov, oi = o
+        _, fidx = jax.lax.top_k(failed.astype(jnp.int32), fallback_rows)
+        fv, fi = exact_rows(vals[fidx])
+        return ov.at[fidx].set(fv), oi.at[fidx].set(fi)
+
+    def full_fix(o):
+        return exact_rows(vals)
+
+    if B <= fallback_rows:
+        return jax.lax.cond(n_fail > 0, full_fix, no_fix, (out_v, out_i))
+    return jax.lax.cond(
+        n_fail == 0, no_fix,
+        lambda o: jax.lax.cond(n_fail <= fallback_rows, small_fix,
+                               full_fix, o),
+        (out_v, out_i))
+
+
+def select_k_slotted(in_val, in_idx, k: int, select_min: bool
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """select_k via certified slot folding.
+
+    Envelope (raises NotImplementedError outside, so callers fall back):
+    - k ≤ pool capacity = 2·S/g — ≈ len/64 for the default slot=16, g=8
+      (len ≥ 4096), ≈ len/16 for short rows (slot=4);
+    - dtype: ≤ 32-bit floating keys (f32/bf16/f16 — selection keys are
+      compared in f32, which is exact for those; f64/int keys would be
+      silently rounded, so they take the XLA path instead).
+    Returned values are GATHERED from the input, preserving its dtype."""
+    in_val = jnp.asarray(in_val)
+    if not (jnp.issubdtype(in_val.dtype, jnp.floating)
+            and jnp.finfo(in_val.dtype).bits <= 32):
+        raise NotImplementedError(
+            f"slotted select_k: f32/bf16/f16 keys only, got {in_val.dtype}")
+    keys = in_val.astype(jnp.float32)
+    B, L = in_val.shape
+    slot = 16 if L >= 4096 else 4
+    g = 8
+    # pad rows so the slot count is a group multiple (the fold reshapes
+    # [B, S] into [B, S/g, g])
+    Lp = -(-L // (slot * g)) * (slot * g)
+    S = Lp // slot
+    pool = 2 * (S // min(g, S))
+    if k > pool:
+        raise NotImplementedError(
+            f"slotted select_k: k={k} exceeds pool {pool} for len={L}")
+    work = keys if select_min else -keys
+    if Lp != L:
+        work = jnp.pad(work, ((0, 0), (0, Lp - L)),
+                       constant_values=jnp.inf)
+    _, out_pos = _slotted_select_min(work, k, slot, min(g, S), 128)
+    safe_pos = jnp.clip(out_pos, 0, L - 1)
+    # gather from the ORIGINAL input: values keep the caller's dtype
+    out_v = jnp.take_along_axis(in_val, safe_pos, axis=1)
+    if in_idx is not None:
+        out_idx = jnp.take_along_axis(jnp.asarray(in_idx), safe_pos, axis=1)
+    else:
+        out_idx = out_pos
+    return out_v, out_idx

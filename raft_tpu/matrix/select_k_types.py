@@ -9,12 +9,17 @@ memory histograms. The variants that exist here:
 
 - ``AUTO``          — heuristic choice (see matrix/select_k.py)
 - ``XLA_TOPK``      — ``jax.lax.top_k`` (XLA's sort-based top-k)
+- ``SLOTTED``       — certified slot folding (select_k_slotted.py):
+                      ~3 bandwidth-bound vector passes + exactness
+                      certificate + per-row exact fallback — the
+                      bandwidth-bound role of the reference's radix
+                      filtering, without sort or histogram
 - ``RADIX``         — the Pallas kernel: multi-pass digit-histogram
                       filtering in VMEM (ops/select_k_pallas)
 - ``BITONIC``       — ALIAS of RADIX. The warpsort-family names map here
-                      for API parity; on TPU the one custom kernel is the
-                      radix design (no warp shuffles exist to build a
-                      bitonic queue from)
+                      for API parity; on TPU the filtered-queue role is
+                      played by SLOTTED (no warp shuffles exist to build
+                      a bitonic queue from)
 
 The CUDA names are kept as aliases so reference-written code dispatches
 meaningfully.
@@ -28,6 +33,7 @@ import enum
 class SelectAlgo(enum.Enum):
     AUTO = "auto"
     XLA_TOPK = "xla_topk"
+    SLOTTED = "slotted"
     BITONIC = "bitonic"
     RADIX = "radix"
 

@@ -1,0 +1,40 @@
+"""Shared compare/select fold primitives for certified selection.
+
+The group-fold is the common core of the certified-selection machinery
+(distance.knn_fused pool building and matrix.select_k_slotted): compress
+[B, S] slot-min arrays into per-group (top-2 values + ids, 3rd-min) with
+pure compare/selects — no sort. The 3rd-min feeds the exactness
+certificate (hidden entries of a group are ≥ its 3rd-min once the top-2
+are pooled).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def fold_group_top2(vals, ids, g: int):
+    """[B, S] → per-group-of-g ``(a1, id1, a2, id2, a3)`` each [B, S/g];
+    groups are contiguous runs of ``g`` slots. ``g`` is clamped to S and
+    must then divide S."""
+    B, S = vals.shape
+    g = min(g, S)
+    G = S // g
+    v = vals.reshape(B, G, g)
+    pid = ids.reshape(B, G, g)
+    inf = jnp.full((B, G), jnp.inf, vals.dtype)
+    a1, a2, a3 = inf, inf, inf
+    id1 = jnp.full((B, G), -1, jnp.int32)
+    id2 = jnp.full((B, G), -1, jnp.int32)
+    for r in range(g):
+        c = v[:, :, r]
+        cid = pid[:, :, r]
+        lt1 = c < a1
+        lt2 = c < a2
+        lt3 = c < a3
+        a3 = jnp.where(lt2, a2, jnp.where(lt3, c, a3))
+        id2 = jnp.where(lt1, id1, jnp.where(lt2, cid, id2))
+        a2 = jnp.where(lt1, a1, jnp.where(lt2, c, a2))
+        id1 = jnp.where(lt1, cid, id1)
+        a1 = jnp.minimum(a1, c)
+    return a1, id1, a2, id2, a3

@@ -78,3 +78,45 @@ def test_duplicate_custom_indices(res):
     ov, oi = matrix.select_k(res, v, in_idx=idx, k=2)
     np.testing.assert_array_equal(np.asarray(ov)[0], [1.0, 2.0])
     np.testing.assert_array_equal(np.asarray(oi)[0], [7, 9])
+
+
+# ---- certified slotted select_k ----
+@pytest.mark.parametrize("B,L,k,select_min", [
+    (4, 8192, 16, True),
+    (4, 8192, 16, False),
+    (3, 5000, 8, True),      # non-multiple length (padding)
+    (8, 1024, 64, True),     # small rows
+    (2, 65536, 256, True),   # big k
+])
+def test_slotted_matches_xla(B, L, k, select_min):
+    v = rng.normal(size=(B, L)).astype(np.float32)
+    ov, oi = matrix.select_k(res=None, in_val=v, k=k, select_min=select_min,
+                             algo=SelectAlgo.SLOTTED)
+    ref_v, _ = matrix.select_k(res=None, in_val=v, k=k,
+                               select_min=select_min,
+                               algo=SelectAlgo.XLA_TOPK)
+    np.testing.assert_allclose(np.asarray(ov), np.asarray(ref_v), rtol=1e-6)
+    # returned positions must index the returned values
+    got = np.take_along_axis(v, np.asarray(oi), axis=1)
+    np.testing.assert_allclose(got, np.asarray(ov), rtol=1e-6)
+
+
+def test_slotted_duplicates_force_fallback():
+    # heavy duplicates put many of the top-k in the same slot — the
+    # certificate must fail and the exact fallback must keep the result
+    # correct (the whole point of certified selection)
+    v = np.tile(rng.normal(size=(2, 64)).astype(np.float32), (1, 128))
+    ov, _ = matrix.select_k(res=None, in_val=v, k=32,
+                            algo=SelectAlgo.SLOTTED)
+    ref = np.sort(v, axis=1)[:, :32]
+    np.testing.assert_allclose(np.asarray(ov), ref, rtol=1e-6)
+
+
+def test_slotted_custom_indices():
+    v = rng.normal(size=(2, 4096)).astype(np.float32)
+    idx = rng.integers(0, 10_000, size=v.shape).astype(np.int32)
+    ov, oi = matrix.select_k(res=None, in_val=v, in_idx=idx, k=8,
+                             algo=SelectAlgo.SLOTTED)
+    pos = np.argsort(v, axis=1)[:, :8]
+    np.testing.assert_array_equal(np.sort(np.asarray(oi), 1),
+                                  np.sort(np.take_along_axis(idx, pos, 1), 1))
