@@ -60,6 +60,10 @@ def _slotted_select_min(vals, k: int, slot: int, g: int,
     bound = jnp.minimum(jnp.min(m2, axis=1), jnp.min(p3, axis=1))
     bound = jnp.minimum(bound, cand_v[:, C - 1])
     failed = bound < theta                                      # [B]
+    # rows with < k finite values leave unfilled (-1) candidates; route
+    # them through the exact fallback so positions stay distinct, exactly
+    # like the XLA path's degenerate-row behavior
+    failed = failed | jnp.any(cand_i[:, :k] < 0, axis=1)
     n_fail = jnp.sum(failed.astype(jnp.int32))
 
     out_v = cand_v[:, :k]

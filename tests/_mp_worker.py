@@ -58,6 +58,36 @@ def main() -> int:
     if not ok:
         failures.append("perform_test_comm_split")
 
+    # --- a real distributed algorithm across the process boundary: the
+    # dp-sharded PCA fit (mean/cov via psum over all 8 devices spanning
+    # both processes), checked against local numpy on the full matrix ---
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng_np = np.random.default_rng(0)      # same data on both processes
+    X = rng_np.normal(size=(64, 12)).astype(np.float32)
+
+    def pca_step(x):
+        # cross-process twin of tests/test_comms.py's in-process dist_pca
+        n_total = jax.lax.psum(x.shape[0], "x")
+        mu = jax.lax.psum(jnp.sum(x, axis=0), "x") / n_total
+        xc = x - mu[None, :]
+        cov = jax.lax.psum(xc.T @ xc, "x") / (n_total - 1)
+        return jnp.linalg.eigvalsh(cov)[::-1][:3]
+
+    mesh = comms.handle.mesh
+    step = jax.jit(jax.shard_map(pca_step, mesh=mesh, in_specs=(P("x"),),
+                                 out_specs=P()))
+    Xs = jax.device_put(X, NamedSharding(mesh, P("x")))
+    top3 = np.asarray(step(Xs))     # replicated output: fully addressable
+    ref = np.linalg.eigvalsh(np.cov(X.T))[::-1][:3]
+    if not np.allclose(top3.reshape(-1)[:3], ref, rtol=2e-3, atol=1e-4):
+        failures.append("distributed_pca")
+    print(f"[rank {rank}] distributed PCA eigvals "
+          f"{'ok' if 'distributed_pca' not in failures else 'FAIL'}",
+          flush=True)
+
     hc.barrier()
     if failures:
         print(f"[rank {rank}] FAILURES: {failures}", flush=True)

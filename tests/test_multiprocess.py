@@ -50,4 +50,5 @@ def test_two_process_battery():
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert "battery complete" in out
+        assert "distributed PCA eigvals ok" in out
         assert "FAIL" not in out

@@ -120,3 +120,18 @@ def test_slotted_custom_indices():
     pos = np.argsort(v, axis=1)[:, :8]
     np.testing.assert_array_equal(np.sort(np.asarray(oi), 1),
                                   np.sort(np.take_along_axis(idx, pos, 1), 1))
+
+
+def test_slotted_sparse_finite_rows_distinct_positions():
+    # rows with fewer than k finite values: the exact fallback must keep
+    # positions DISTINCT like the XLA path (masked-inf rows are common in
+    # knn-graph construction)
+    v = np.full((2, 4096), np.inf, np.float32)
+    v[0, [100, 2000, 5]] = [1.0, 2.0, 3.0]
+    v[1, [7]] = [4.0]
+    ov, oi = matrix.select_k(res=None, in_val=v, k=8,
+                             algo=SelectAlgo.SLOTTED)
+    oi = np.asarray(oi)
+    for r in range(2):
+        assert len(set(oi[r].tolist())) == 8, oi[r]
+    np.testing.assert_array_equal(np.asarray(ov)[0, :3], [1.0, 2.0, 3.0])
