@@ -63,7 +63,9 @@ def main() -> int:
     # both processes), checked against local numpy on the full matrix ---
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.parallel import shard_array
 
     rng_np = np.random.default_rng(0)      # same data on both processes
     X = rng_np.normal(size=(64, 12)).astype(np.float32)
@@ -79,7 +81,7 @@ def main() -> int:
     mesh = comms.handle.mesh
     step = jax.jit(jax.shard_map(pca_step, mesh=mesh, in_specs=(P("x"),),
                                  out_specs=P()))
-    Xs = jax.device_put(X, NamedSharding(mesh, P("x")))
+    Xs = shard_array(X, mesh)
     top3 = np.asarray(step(Xs))     # replicated output: fully addressable
     ref = np.linalg.eigvalsh(np.cov(X.T))[::-1][:3]
     if not np.allclose(top3.reshape(-1)[:3], ref, rtol=2e-3, atol=1e-4):
