@@ -11,7 +11,10 @@ random {make_blobs, permute, rng, subsample}, sparse {convert}, core
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -22,6 +25,12 @@ def main():
                     help="small sizes (CI / CPU smoke)")
     args = ap.parse_args()
 
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # honor the request via config too — the tunneled TPU transport
+        # ignores the env var (same guard as bench.py)
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     import raft_tpu
@@ -64,6 +73,30 @@ def main():
     rec("matrix.select_k(64)",
         fx.run(lambda a: matrix.select_k(res, a.reshape(-1, d * 64), k=64)[0],
                X[: (n // 64) * 64]), fbytes)
+    from raft_tpu.matrix import SelectAlgo
+
+    rec("matrix.select_k(64,slotted)",
+        fx.run(lambda a: matrix.select_k(res, a.reshape(-1, d * 64), k=64,
+                                         algo=SelectAlgo.SLOTTED)[0],
+               X[: (n // 64) * 64]), fbytes)
+    if res.platform == "tpu":
+        # fused variants are Pallas kernels: off-TPU they run interpreted
+        # (minutes-slow, meaningless numbers) — TPU lane only
+        nq = 1024
+        Q = X[:nq]
+        from raft_tpu import distance
+
+        rec("distance.knn(streamed,k=32)",
+            fx.run(lambda q: distance.knn(res, X, q, k=32,
+                                          algo="streamed")[0], Q),
+            nq * n * 4)
+        rec("distance.knn(fused,k=32)",
+            fx.run(lambda q: distance.knn(res, X, q, k=32, algo="fused")[0],
+                   Q), nq * n * 4)
+        rec("distance.knn(fused_fast,k=32)",
+            fx.run(lambda q: distance.knn(res, X, q, k=32,
+                                          algo="fused_fast")[0], Q),
+            nq * n * 4)
     rec("random.make_blobs",
         fx.run(lambda s: make_blobs(res, RngState(1), n, d)[0], X), fbytes)
     rec("random.rng.uniform",
@@ -86,6 +119,15 @@ def main():
         fx.run(lambda b: sparse.linalg.sddmm(res, jnp.asarray(dense), b,
                                              structure).values, B),
         structure.nnz * 4)
+    xv = jnp.asarray(np.random.default_rng(5).normal(size=64).astype(np.float32))
+    rec("sparse.spmv(segment_sum)",
+        fx.run(lambda v: sparse.linalg.spmv(res, csr, v), xv), csr.nnz * 8)
+    if res.platform == "tpu":
+        # Pallas kernels run interpreted off-TPU — TPU lane only
+        tiled = sparse.prepare_spmv(csr, C=128, R=64, E=512)
+        rec("sparse.spmv(tiled_ell)",
+            fx.run(lambda v: sparse.linalg.spmv(res, tiled, v), xv),
+            csr.nnz * 8)
 
     print(f"{'benchmark':<28}{'ms':>10}{'GB/s':>10}")
     for name, ms, gbs in rows:
