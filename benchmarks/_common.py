@@ -1,0 +1,42 @@
+"""Shared preamble for the TPU measurement scripts: the init-probe /
+dry-run gate, in ONE place.
+
+Contract: call ``gate()`` first thing in ``main()``. Returns
+``(dry, skip_reason)``:
+
+- ``RAFT_TPU_BENCH_FORCE=cpu`` ⇒ ``(True, None)`` with the CPU platform
+  forced via jax.config (the tunneled transport ignores the env var) —
+  the tiny-scale harness-validation mode; callers must not write TPU
+  artifacts in this mode.
+- otherwise a subprocess probe (with timeout — a wedged transport hangs
+  backend init forever) checks for a healthy TPU: unhealthy ⇒
+  ``(False, reason)`` and the caller should print the skip JSON and
+  exit 0; healthy ⇒ ``(False, None)``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional, Tuple
+
+
+def gate(probe_timeout_s: int = 150) -> Tuple[bool, Optional[str]]:
+    if os.environ.get("RAFT_TPU_BENCH_FORCE") == "cpu":
+        import jax
+
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        jax.config.update("jax_platforms", "cpu")
+        return True, None
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()[0].platform == 'tpu'"],
+            timeout=probe_timeout_s, capture_output=True)
+        if r.returncode != 0:
+            return False, "no healthy TPU"
+    except subprocess.TimeoutExpired:
+        return False, "TPU probe timeout"
+    return False, None

@@ -14,7 +14,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-import subprocess
+from benchmarks._common import gate
 import time
 
 import numpy as np
@@ -23,26 +23,13 @@ OUT = os.path.join(os.path.dirname(__file__), os.pardir, "SPMV_BENCH.json")
 
 
 def main():
-    # RAFT_TPU_BENCH_FORCE=cpu: tiny-scale CPU dry-run validating the
-    # harness without writing a TPU artifact
-    dry = os.environ.get("RAFT_TPU_BENCH_FORCE") == "cpu"
-    if not dry:
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; assert jax.devices()[0].platform == 'tpu'"],
-                timeout=150, capture_output=True)
-            if r.returncode != 0:
-                print(json.dumps({"skipped": "no healthy TPU"}))
-                return 0
-        except subprocess.TimeoutExpired:
-            print(json.dumps({"skipped": "TPU probe timeout"}))
-            return 0
+    dry, skip = gate()
+    if skip:
+        print(json.dumps({"skipped": skip}))
+        return 0
 
-    import jax
+    import jax  # noqa: F401
 
-    if dry:
-        jax.config.update("jax_platforms", "cpu")
     import raft_tpu
     from raft_tpu.benchmark import Fixture
     from raft_tpu.core.sparse_types import COOMatrix

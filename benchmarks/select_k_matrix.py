@@ -19,7 +19,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-import subprocess
+from benchmarks._common import gate
 
 import numpy as np
 
@@ -28,27 +28,14 @@ OUT = os.path.join(os.path.dirname(__file__), os.pardir,
 
 
 def main():
-    # RAFT_TPU_BENCH_FORCE=cpu: tiny-scale CPU dry-run that validates the
-    # harness end to end WITHOUT recording a table (CPU timings must never
-    # train the TPU heuristic)
-    dry = os.environ.get("RAFT_TPU_BENCH_FORCE") == "cpu"
-    if not dry:
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; assert jax.devices()[0].platform == 'tpu'"],
-                timeout=150, capture_output=True)
-            if r.returncode != 0:
-                print(json.dumps({"skipped": "no healthy TPU"}))
-                return 0
-        except subprocess.TimeoutExpired:
-            print(json.dumps({"skipped": "TPU probe timeout"}))
-            return 0
+    # dry mode validates the harness end to end WITHOUT recording a
+    # table (CPU timings must never train the TPU heuristic)
+    dry, skip = gate()
+    if skip:
+        print(json.dumps({"skipped": skip}))
+        return 0
 
-    import jax
-
-    if dry:
-        jax.config.update("jax_platforms", "cpu")
+    import jax  # noqa: F401
     import jax.numpy as jnp
 
     import raft_tpu

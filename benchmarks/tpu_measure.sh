@@ -19,5 +19,8 @@ timeout 1800 python benchmarks/select_k_matrix.py || echo "matrix rc=$?"
 echo "=== spmv bench ==="
 timeout 1800 python benchmarks/bench_spmv.py || echo "spmv rc=$?"
 
+echo "=== BASELINE config benchmarks ==="
+timeout 2400 python benchmarks/bench_configs.py || echo "configs rc=$?"
+
 echo "=== bench.py (driver metric) ==="
 timeout 1800 python bench.py | tee BENCH_LOCAL.json || echo "bench rc=$?"
