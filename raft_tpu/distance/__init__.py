@@ -8,9 +8,10 @@ from raft_tpu.distance.fused_l2nn import (
     fused_l2_nn,
     fused_l2_nn_argmin,
     knn,
+    knn_sharded,
 )
 
 __all__ = [
     "DistanceType", "METRIC_NAMES", "pairwise_distance",
-    "fused_l2_nn", "fused_l2_nn_argmin", "knn",
+    "fused_l2_nn", "fused_l2_nn_argmin", "knn", "knn_sharded",
 ]

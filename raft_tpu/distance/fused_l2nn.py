@@ -306,3 +306,53 @@ def _ip_sweep(x, y_padded, m_real, k: int, tile: int):
     best_v = jnp.full((n, k), -jnp.inf, jnp.float32)
     best_i = jnp.full((n, k), -1, jnp.int32)
     return jax.lax.fori_loop(0, n_tiles, body, (best_v, best_i))
+
+
+def knn_sharded(res, index, queries, k: int, mesh=None, axis: str = "x",
+                metric: str = "sqeuclidean", algo: str = "auto"
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Data-parallel brute-force KNN over a device mesh: queries are
+    row-sharded over ``axis``, the index is replicated, and every shard
+    runs the (fused or streamed) single-chip pipeline locally — no
+    cross-shard communication is needed because each query's top-k
+    depends only on the full index. (ref: the MNMG data-parallel model,
+    SURVEY §2.12 — raft-dask shards work across workers the same way.)
+
+    Returns globally-assembled (distances [nq, k], indices [nq, k]).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.parallel import replicated, shard_array
+
+    res = ensure_resources(res)
+    if mesh is None:
+        mesh = res.mesh
+    expects(mesh is not None, "knn_sharded: pass mesh= or set it on res")
+    expects(axis in mesh.axis_names,
+            "knn_sharded: axis %r not in mesh axes %s", axis,
+            tuple(mesh.axis_names))
+    ndev = mesh.shape[axis]
+    if ndev == 1:
+        import warnings
+
+        warnings.warn(
+            "knn_sharded over a 1-device mesh shards nothing — set a "
+            "multi-device mesh on the handle or pass mesh=",
+            RuntimeWarning, stacklevel=2)
+    index = jnp.asarray(index, jnp.float32)
+    queries = jnp.asarray(queries, jnp.float32)
+    nq = queries.shape[0]
+    queries, _ = _pad_rows(queries, ndev)
+
+    def shard_fn(q_shard, idx_repl):
+        return knn(res, idx_repl, q_shard, k=k, metric=metric, algo=algo)
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=(P(axis), P(axis)),
+        check_vma=False)
+    qs = shard_array(queries, mesh, axis)
+    ir = jax.device_put(index, replicated(mesh))
+    d, i = fn(qs, ir)
+    return d[:nq], i[:nq]

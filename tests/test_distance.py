@@ -176,3 +176,20 @@ def test_validation(res):
         distance.pairwise_distance(res, X, Y[:, :3])
     with pytest.raises(LogicError):
         distance.pairwise_distance(res, X, Y, metric="nope")
+
+
+def test_knn_sharded_matches_single(res):
+    import jax
+
+    from raft_tpu import parallel
+    from raft_tpu.distance.fused_l2nn import knn_sharded
+
+    mesh = parallel.make_mesh({"x": 8})
+    y = rng.normal(size=(4096, 32)).astype(np.float32)
+    q = rng.normal(size=(100, 32)).astype(np.float32)   # pads to 104
+    # same algo on both sides: auto resolves differently on TPU (fused)
+    # vs CPU (streamed), and near-ties order differently across algorithms
+    ds, is_ = knn_sharded(res, y, q, k=8, mesh=mesh, algo="streamed")
+    d1, i1 = distance.knn(res, y, q, k=8, algo="streamed")
+    np.testing.assert_allclose(np.asarray(ds), np.asarray(d1), atol=1e-4)
+    assert np.array_equal(np.asarray(is_), np.asarray(i1))
