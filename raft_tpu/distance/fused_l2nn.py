@@ -308,6 +308,9 @@ def _ip_sweep(x, y_padded, m_real, k: int, tile: int):
     return jax.lax.fori_loop(0, n_tiles, body, (best_v, best_i))
 
 
+_SHARDED_KNN_CACHE: dict = {}
+
+
 def knn_sharded(res, index, queries, k: int, mesh=None, axis: str = "x",
                 metric: str = "sqeuclidean", algo: str = "auto"
                 ) -> Tuple[jax.Array, jax.Array]:
@@ -344,14 +347,23 @@ def knn_sharded(res, index, queries, k: int, mesh=None, axis: str = "x",
     nq = queries.shape[0]
     queries, _ = _pad_rows(queries, ndev)
 
-    def shard_fn(q_shard, idx_repl):
-        return knn(res, idx_repl, q_shard, k=k, metric=metric, algo=algo)
+    # cache the shard_map-wrapped callable: a fresh closure per call would
+    # defeat the jit cache and recompile every invocation. The workspace
+    # budget is in the key because knn() sizes its tile from it at trace
+    # time.
+    key = (mesh, axis, k, metric, algo, res.workspace.allocation_limit)
+    fn = _SHARDED_KNN_CACHE.get(key)
+    if fn is None:
+        def shard_fn(q_shard, idx_repl):
+            return knn(res, idx_repl, q_shard, k=k, metric=metric,
+                       algo=algo)
 
-    fn = jax.shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=(P(axis), P(axis)),
-        check_vma=False)
+        fn = jax.jit(jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=(P(axis), P(axis)),
+            check_vma=False))
+        _SHARDED_KNN_CACHE[key] = fn
     qs = shard_array(queries, mesh, axis)
     ir = jax.device_put(index, replicated(mesh))
     d, i = fn(qs, ir)
