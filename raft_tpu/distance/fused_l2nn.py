@@ -354,8 +354,18 @@ def knn_sharded(res, index, queries, k: int, mesh=None, axis: str = "x",
     key = (mesh, axis, k, metric, algo, res.workspace.allocation_limit)
     fn = _SHARDED_KNN_CACHE.get(key)
     if fn is None:
+        # capture only the scalar budget, not the caller's handle — a
+        # cached closure holding res would pin it for process lifetime
+        # and silently reuse the FIRST caller's handle on key collisions
+        ws_limit = res.workspace.allocation_limit
+
         def shard_fn(q_shard, idx_repl):
-            return knn(res, idx_repl, q_shard, k=k, metric=metric,
+            from raft_tpu.core.resources import (
+                DeviceResources, WorkspaceResource)
+
+            local = DeviceResources()
+            local.set_workspace_resource(WorkspaceResource(ws_limit))
+            return knn(local, idx_repl, q_shard, k=k, metric=metric,
                        algo=algo)
 
         fn = jax.jit(jax.shard_map(

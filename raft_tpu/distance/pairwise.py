@@ -75,9 +75,17 @@ def _tile_rows(res, x, y, body, row_bytes: Optional[int] = None):
 
 
 def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclidean",
-                      p: float = 2.0) -> jax.Array:
+                      p: float = 2.0, precision=None) -> jax.Array:
     """Full [n, m] distance matrix. (ref: pre-cuVS
     raft::distance::pairwise_distance; pylibraft.distance.pairwise_distance)
+
+    Precision note (expanded metrics): with ``precision=None`` the MXU
+    contraction runs at JAX's default matmul precision — one-pass bf16 on
+    TPU, which is the same precision CLASS as the reference's default on
+    A100 (cuBLAS runs f32 GEMMs on TF32 tensor cores, 10-bit mantissa).
+    Pass ``precision=jax.lax.Precision.HIGHEST`` for f32-grade
+    contractions (3-pass bf16 split — BEYOND the reference's default), or
+    use ``jax.default_matmul_precision`` to set it globally.
 
     Examples
     --------
@@ -92,6 +100,15 @@ def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclid
     expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1],
             "pairwise_distance: inputs must be [n,d],[m,d]")
     t = _as_type(metric)
+    if precision is not None:
+        if isinstance(precision, jax.lax.Precision):
+            precision = precision.name.lower()
+        with jax.default_matmul_precision(precision):
+            return _pairwise_dispatch(res, x, y, t, p)
+    return _pairwise_dispatch(res, x, y, t, p)
+
+
+def _pairwise_dispatch(res, x, y, t: DistanceType, p: float) -> jax.Array:
 
     if t == DistanceType.L2Expanded:
         return _expanded_l2(x, y, sqrt=False)

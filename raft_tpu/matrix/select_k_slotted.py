@@ -94,6 +94,17 @@ def _slotted_select_min(vals, k: int, slot: int, g: int,
         (out_v, out_i))
 
 
+def slotted_envelope(L: int) -> Tuple[int, int, int]:
+    """(slot, g, pool_capacity) the slotted algorithm uses for row length
+    ``L`` — the single source of truth for the envelope (tests and the
+    AUTO heuristic derive bounds from here, never re-hardcode)."""
+    slot = 16 if L >= 4096 else 4
+    g = 8
+    Lp = -(-L // (slot * g)) * (slot * g)
+    S = Lp // slot
+    return slot, g, 2 * (S // min(g, S))
+
+
 def select_k_slotted(in_val, in_idx, k: int, select_min: bool
                      ) -> Tuple[jax.Array, jax.Array]:
     """select_k via certified slot folding.
@@ -112,13 +123,11 @@ def select_k_slotted(in_val, in_idx, k: int, select_min: bool
             f"slotted select_k: f32/bf16/f16 keys only, got {in_val.dtype}")
     keys = in_val.astype(jnp.float32)
     B, L = in_val.shape
-    slot = 16 if L >= 4096 else 4
-    g = 8
+    slot, g, pool = slotted_envelope(L)
     # pad rows so the slot count is a group multiple (the fold reshapes
     # [B, S] into [B, S/g, g])
     Lp = -(-L // (slot * g)) * (slot * g)
     S = Lp // slot
-    pool = 2 * (S // min(g, S))
     if k > pool:
         raise NotImplementedError(
             f"slotted select_k: k={k} exceeds pool {pool} for len={L}")

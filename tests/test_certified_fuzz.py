@@ -45,10 +45,10 @@ def test_fuzz_slotted_select_k(seed):
     L = int(rng.integers(600, 9000))
     kind = ["normal", "duplicates", "constant", "few_finite",
             "negative_blocks"][seed % 5]
+    from raft_tpu.matrix.select_k_slotted import slotted_envelope
+
     v = _pattern(rng, B, L, kind)
-    slot = 16 if L >= 4096 else 4
-    g = 8
-    pool = 2 * ((-(-L // (slot * g)) * (slot * g)) // slot // g)
+    _, _, pool = slotted_envelope(L)
     k = int(rng.integers(1, min(64, pool, L) + 1))
     select_min = bool(rng.integers(0, 2))
     ov, oi = select_k(None, v, k=k, select_min=select_min,
