@@ -81,10 +81,26 @@ def _smoke_spmv_tiled():
     np.testing.assert_allclose(y, ref, rtol=2e-4, atol=2e-4)
 
 
+def _smoke_spmm_tiled():
+    import scipy.sparse as sp
+
+    from raft_tpu.sparse import CSRMatrix, linalg, prepare_spmv
+
+    m = sp.random(2048, 2048, density=0.01, random_state=4,
+                  dtype=np.float32, format="csr")
+    A = CSRMatrix(np.asarray(m.indptr, np.int32),
+                  np.asarray(m.indices, np.int32),
+                  m.data.astype(np.float32), m.shape)
+    B = np.random.default_rng(5).normal(size=(2048, 32)).astype(np.float32)
+    Y = np.asarray(linalg.spmm(None, prepare_spmv(A), B))
+    np.testing.assert_allclose(Y, m @ B, rtol=5e-4, atol=5e-4)
+
+
 KERNELS = {
     "select_k_radix": _smoke_select_k_radix,
     "fused_l2_topk": _smoke_fused_l2_topk,
     "spmv_tiled": _smoke_spmv_tiled,
+    "spmm_tiled": _smoke_spmm_tiled,
 }
 
 

@@ -154,3 +154,132 @@ def spmv_tiled(tiled, x) -> jax.Array:
     # zero row tiles the grid never visited (rows with no nonzeros)
     y2d = jnp.where(tiled.visited_row_tiles[:, None], y2dt.T, 0.0)
     return y2d.reshape(-1)[:n_rows]
+
+
+# ---------------------------------------------------------------------------
+# SpMM: multi-vector operand — the one-hot select becomes an MXU matmul
+# ---------------------------------------------------------------------------
+
+
+def _gather_mm_kernel(col_tile_ref, vals_ref, cols_ref, x_ref, out_ref,
+                      *, E: int, C: int, V: int):
+    """contrib[e, :] = val[e] · x_tile[col[e], :] via onehotᵀ @ x — for
+    V ≥ ~8 columns the MXU does the selection (the one-hot rows are
+    exactly representable in bf16, so with HIGHEST precision the gather
+    error is the bf16x3 split residual of x, ~2⁻¹⁶ relative)."""
+    x = x_ref[0]                                         # [C, V]
+    for b in range(E // _EB):
+        cols = cols_ref[:, b * _EB:(b + 1) * _EB]        # [1, EB]
+        onehot = (jnp.broadcast_to(cols, (C, _EB))
+                  == jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0)
+                  ).astype(jnp.float32)                  # [C, EB]
+        g = jax.lax.dot_general(
+            onehot, x, (((0,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)          # [EB, V]
+        vals = vals_ref[0, b * _EB:(b + 1) * _EB]        # [EB]
+        out_ref[0, b * _EB:(b + 1) * _EB, :] = vals[:, None] * g
+
+
+def _scatter_mm_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
+                       *, E: int, R: int, V: int):
+    c = pl.program_id(0)
+    cur = row_tile_ref[c]
+    prev = row_tile_ref[jnp.maximum(c - 1, 0)]
+    first = (c == 0) | (cur != prev)
+
+    acc = jnp.zeros((R, V), jnp.float32)
+    for b in range(E // _EB):
+        rloc = rloc_ref[:, b * _EB:(b + 1) * _EB]        # [1, EB], pad = R
+        onehot = (jnp.broadcast_to(rloc, (R, _EB))
+                  == jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0)
+                  ).astype(jnp.float32)                  # [R, EB]
+        contrib = contrib_ref[0, b * _EB:(b + 1) * _EB, :]  # [EB, V]
+        acc = acc + jax.lax.dot_general(
+            onehot, contrib, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)          # [R, V]
+
+    @pl.when(first)
+    def _():
+        y_ref[0] = acc
+
+    @pl.when(jnp.logical_not(first))
+    def _():
+        y_ref[0] = y_ref[0] + acc
+
+
+@functools.partial(jax.jit, static_argnames=("C", "R", "E", "V",
+                                             "n_col_tiles", "n_row_tiles"))
+def _spmm_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
+                     chunk_row_tile, B_padded,
+                     C: int, R: int, E: int, V: int,
+                     n_col_tiles: int, n_row_tiles: int) -> jax.Array:
+    n_chunks = vals.shape[0]
+    m_chunks = row_local.shape[0]
+    x3d = B_padded.reshape(n_col_tiles, C, V)
+
+    contrib = pl.pallas_call(
+        functools.partial(_gather_mm_kernel, E=E, C=C, V=V),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_chunks,),
+            in_specs=[
+                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                             memory_space=pltpu.VMEM),   # vals
+                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                             memory_space=pltpu.VMEM),   # cols
+                pl.BlockSpec((1, C, V), lambda c, m: (m[c], 0, 0),
+                             memory_space=pltpu.VMEM),   # x tile
+            ],
+            out_specs=pl.BlockSpec((1, E, V), lambda c, m: (c, 0, 0),
+                                   memory_space=pltpu.VMEM),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_chunks, E, V), jnp.float32),
+        interpret=interpret_mode(),
+    )(chunk_col_tile, vals, col_local, x3d)
+
+    contrib_sorted = jnp.take(contrib.reshape(-1, V), perm.reshape(-1),
+                              axis=0).reshape(m_chunks, E, V)
+
+    y3d = pl.pallas_call(
+        functools.partial(_scatter_mm_kernel, E=E, R=R, V=V),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(m_chunks,),
+            in_specs=[
+                pl.BlockSpec((1, E, V), lambda c, m: (c, 0, 0),
+                             memory_space=pltpu.VMEM),   # contrib
+                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                             memory_space=pltpu.VMEM),   # row_local
+            ],
+            out_specs=pl.BlockSpec((1, R, V), lambda c, m: (m[c], 0, 0),
+                                   memory_space=pltpu.VMEM),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_row_tiles, R, V), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret_mode(),
+    )(chunk_row_tile, contrib_sorted, row_local)
+    return y3d
+
+
+def spmm_tiled(tiled, B) -> jax.Array:
+    """Y = A @ B for a TiledELL operand and dense B [n_cols, V] — the
+    cusparse-SpMM role with the one-hot selects running on the MXU.
+    (ref: sparse/linalg/spmm.hpp:42 / cusparse_wrappers.h SpMM.)"""
+    n_rows, n_cols = tiled.shape
+    B = jnp.asarray(B, jnp.float32)
+    if B.ndim != 2 or B.shape[0] != n_cols:
+        raise ValueError(f"spmm_tiled: B must be [{n_cols}, V]")
+    V = B.shape[1]
+    pad = tiled.n_col_tiles * tiled.C - n_cols
+    if pad:
+        B = jnp.concatenate([B, jnp.zeros((pad, V), jnp.float32)])
+    y3d = _spmm_tiled_impl(
+        tiled.vals, tiled.col_local, tiled.chunk_col_tile, tiled.perm,
+        tiled.row_local, tiled.chunk_row_tile, B,
+        C=tiled.C, R=tiled.R, E=tiled.E, V=V,
+        n_col_tiles=tiled.n_col_tiles, n_row_tiles=tiled.n_row_tiles)
+    y2d = jnp.where(tiled.visited_row_tiles[:, None, None], y3d, 0.0)
+    return y2d.reshape(-1, V)[:n_rows]

@@ -73,12 +73,25 @@ def prepare_spmv(A: Sparse, C: int = 512, R: int = 256, E: int = 2048):
     return tile_csr(A, C=C, R=R, E=E)
 
 
-def spmm(res, A: Sparse, B, alpha=1.0, beta=0.0, C=None) -> jax.Array:
-    """C = alpha A @ B + beta C for dense B. (ref: sparse/linalg/spmm.hpp:42)"""
-    rows, cols, vals, shape = _as_coo_parts(A)
+def spmm(res, A, B, alpha=1.0, beta=0.0, C=None) -> jax.Array:
+    """C = alpha A @ B + beta C for dense B. (ref: sparse/linalg/spmm.hpp:42)
+
+    ``A`` may be COO/CSR (gather + segment-sum, dtype-preserving) or a
+    pre-tiled :class:`TiledELL` (MXU one-hot kernels — see
+    ops.spmv_pallas.spmm_tiled). The tiled perf path computes in f32 —
+    the kernel/layout dtype — so f64 operands should stay on the
+    COO/CSR path (see the README dtype policy)."""
+    from raft_tpu.sparse.tiled import TiledELL
+
     B = jnp.asarray(B)
-    out = alpha * jax.ops.segment_sum(vals[:, None] * B[cols, :], rows,
-                                      num_segments=shape[0])
+    if isinstance(A, TiledELL):
+        from raft_tpu.ops.spmv_pallas import spmm_tiled
+
+        out = alpha * spmm_tiled(A, B)
+    else:
+        rows, cols, vals, shape = _as_coo_parts(A)
+        out = alpha * jax.ops.segment_sum(vals[:, None] * B[cols, :], rows,
+                                          num_segments=shape[0])
     if C is not None and beta != 0.0:
         out = out + beta * jnp.asarray(C)
     return out
