@@ -142,11 +142,13 @@ class MeshComms:
         return jax.lax.psum(masked, self.axis_name)
 
     def reduce(self, x, root: int = 0, op: Op = Op.SUM):
-        """All ranks compute; non-root results are zeroed to mirror the
-        root-only-output contract. (ref: comms_iface::reduce)"""
+        """Root gets the reduction; non-root ranks get their INPUT back
+        unchanged — the reference's in-place reduce leaves non-root
+        buffers untouched and its test asserts only the root
+        (comms_iface::reduce, detail/test.hpp:97-124)."""
         full = _psum_like(x, op, self.axis_name)
         is_root = jax.lax.axis_index(self.axis_name) == root
-        return jnp.where(is_root, full, jnp.zeros_like(full))
+        return jnp.where(is_root, full, x)
 
     def allgather(self, x):
         """(ref: comms_iface::allgather)"""
@@ -299,8 +301,9 @@ class ColorComms:
         return jnp.sum(jnp.where(sel, g, 0), axis=0)
 
     def reduce(self, x, root: int = 0, op: Op = Op.SUM):
+        """Non-root gets its input back — see MeshComms.reduce."""
         full = self.allreduce(x, op)
-        return jnp.where(self._rank == root, full, jnp.zeros_like(full))
+        return jnp.where(self._rank == root, full, jnp.asarray(x))
 
     def allgather(self, x):
         """[parent_size, ...]: rows [0, get_size()) hold the clique's

@@ -54,13 +54,15 @@ def perform_test_comm_bcast(comms: HostComms, root: int = 0) -> bool:
 
 
 def perform_test_comm_reduce(comms: HostComms, root: int = 0) -> bool:
-    """(ref: detail/test.hpp:97)"""
+    """(ref: detail/test.hpp:97 — the reference asserts only the root;
+    non-root buffers stay untouched, here = the rank's own input.)"""
     x = jnp.asarray(_ranks(comms)[:, None], jnp.float32)
     out = _fetch(comms.reduce(x, root=root, op=Op.SUM))
     want = _ranks(comms).sum()
     ok_root = out[root, 0] == want
     others = np.delete(out[:, 0], root)
-    return bool(ok_root and (others == 0).all())
+    untouched = np.delete(_ranks(comms), root)
+    return bool(ok_root and (others == untouched).all())
 
 
 def perform_test_comm_allgather(comms: HostComms) -> bool:

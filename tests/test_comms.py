@@ -299,3 +299,19 @@ def test_comm_split_color_vocabulary_surface(mesh8):
     np.testing.assert_array_equal(out[:, 1], [0, 0, 0, 0, 4, 4, 4, 4])
     # nested split: cliques of 4 split by parity -> size 2
     np.testing.assert_array_equal(out[:, 7], [2] * 8)
+
+
+def test_comm_split_color_reduce_nonroot_passthrough(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    def f(x):
+        c = MeshComms("x", size=8)
+        sub = c.comm_split_color(c.get_rank() % 2)   # evens / odds
+        return sub.reduce(x[0], root=1)[None]
+
+    x = jnp.arange(8, dtype=jnp.float32) * 10.0
+    out = np.asarray(jax.shard_map(
+        f, mesh=mesh8, in_specs=(P("x"),), out_specs=P("x"))(x))
+    # subrank-1 of evens = rank 2 (sum 0+20+40+60=120); of odds = rank 3
+    # (10+30+50+70=160); everyone else keeps their own input
+    np.testing.assert_array_equal(out, [0, 10, 120, 160, 40, 50, 60, 70])
