@@ -55,11 +55,18 @@ def main():
         v = jnp.asarray(rng.normal(size=(batch, length)).astype(np.float32))
         jax.block_until_ready(v)
         row = {"batch": batch, "len": length, "k": k}
+        import warnings
+
         for algo in (SelectAlgo.XLA_TOPK, SelectAlgo.SLOTTED,
                      SelectAlgo.RADIX):
             try:
-                dt = fx.run(lambda x, a=algo: select_k(
-                    res, x, k=k, algo=a)[0], v)["seconds"]
+                # an off-envelope explicit request warns and measures the
+                # XLA path — recording THAT under this algo's name would
+                # mis-train the AUTO table, so escalate the warning
+                with warnings.catch_warnings():
+                    warnings.simplefilter("error", RuntimeWarning)
+                    dt = fx.run(lambda x, a=algo: select_k(
+                        res, x, k=k, algo=a)[0], v)["seconds"]
                 row[algo.name] = round(dt * 1e3, 3)
             except Exception as e:  # noqa: BLE001 — record, keep sweeping
                 row[algo.name] = f"error: {type(e).__name__}"

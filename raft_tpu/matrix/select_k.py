@@ -27,16 +27,65 @@ from raft_tpu.core.error import expects
 from raft_tpu.matrix.select_k_types import SelectAlgo
 
 
+def _load_select_k_table():
+    """Load the measured algorithm table (benchmarks/select_k_matrix.py →
+    SELECT_K_MATRIX.json), if one has been committed. Returns a list of
+    (log-coords, SelectAlgo) cells, or None."""
+    import json
+    import math
+    import os
+
+    path = os.environ.get("RAFT_TPU_SELECTK_TABLE") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "SELECT_K_MATRIX.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        cells = []
+        for row in data.get("rows", []):
+            timings = {name: row[name] for name in
+                       ("XLA_TOPK", "SLOTTED", "RADIX")
+                       if isinstance(row.get(name), (int, float))
+                       and not isinstance(row.get(name), bool)}
+            if not timings:
+                continue
+            best = min(timings, key=timings.get)
+            cells.append(((math.log2(row["batch"]), math.log2(row["len"]),
+                           math.log2(row["k"])), SelectAlgo[best]))
+        return cells or None
+    except Exception:
+        # a malformed hand-edited table must never crash AUTO select_k —
+        # degrade to the no-table default
+        return None
+
+
+_SELECT_K_TABLE = ...   # lazy-loaded sentinel
+
+
 def choose_select_k_algorithm(n_rows: int, length: int, k: int) -> SelectAlgo:
     """Heuristic algorithm choice. (ref: select_k-inl.cuh:38 — a learned
-    decision tree over (rows, cols, k).)
+    decision tree over (rows, cols, k), generated from benchmark sweeps.)
 
-    Measured on TPU v5e (RTT-amortized, 16..64 × 1M rows, k=64): XLA's
-    native variable-k top-k runs ~4.7ms/16MB-row-batch vs ~43ms for the
-    Pallas radix kernel, whose 256-bucket one-hot histogram is VPU-bound
-    (~1.3k vector ops/element). AUTO therefore always picks XLA_TOPK today;
-    RADIX remains selectable explicitly (exact, VMEM-resident, useful when
-    fused into kernels that already hold tiles in VMEM)."""
+    The TPU analog is table-driven the same way: when a measured
+    ``SELECT_K_MATRIX.json`` exists (produced on real TPU by
+    benchmarks/select_k_matrix.py — never from CPU timings), AUTO picks
+    the measured-fastest algorithm of the nearest grid cell in
+    (log batch, log len, log k). Without a table the only
+    measurement-justified choice is XLA's top-k (round-1 anchor: XLA
+    ≈4.7ms vs Pallas radix ≈43ms on [16,1M] f32, k=64 — the radix
+    histogram is VPU-bound; SLOTTED had no TPU numbers yet)."""
+    global _SELECT_K_TABLE
+    if _SELECT_K_TABLE is ...:
+        _SELECT_K_TABLE = _load_select_k_table()
+    if _SELECT_K_TABLE:
+        import math
+
+        q = (math.log2(max(n_rows, 1)), math.log2(max(length, 1)),
+             math.log2(max(k, 1)))
+        _, algo = min(
+            _SELECT_K_TABLE,
+            key=lambda cell: sum((a - b) ** 2 for a, b in zip(cell[0], q)))
+        return algo
     return SelectAlgo.XLA_TOPK
 
 
@@ -81,7 +130,8 @@ def select_k(
         in_idx = jnp.asarray(in_idx)
         expects(in_idx.shape == in_val.shape, "select_k: in_idx shape mismatch")
 
-    if algo == SelectAlgo.AUTO:
+    explicit = algo != SelectAlgo.AUTO
+    if not explicit:
         algo = choose_select_k_algorithm(batch, length, k)
 
     if algo == SelectAlgo.SLOTTED:
@@ -90,12 +140,17 @@ def select_k(
         try:
             return select_k_slotted(in_val, in_idx, k, select_min)
         except NotImplementedError as e:
-            import warnings
+            # AUTO (nearest-cell lookup) may land outside the envelope —
+            # that fallback is silent by design; only an EXPLICIT request
+            # warns, because silently measuring the XLA path instead
+            # would invalidate benchmarks/tests of the named algorithm
+            if explicit:
+                import warnings
 
-            warnings.warn(
-                f"select_k: explicit algo=SLOTTED outside its envelope "
-                f"({e}); falling back to XLA top-k",
-                RuntimeWarning, stacklevel=2)
+                warnings.warn(
+                    f"select_k: explicit algo=SLOTTED outside its "
+                    f"envelope ({e}); falling back to XLA top-k",
+                    RuntimeWarning, stacklevel=2)
 
     if algo in (SelectAlgo.BITONIC, SelectAlgo.RADIX):
         # BITONIC is an alias of the one Pallas kernel (radix): the
@@ -107,15 +162,12 @@ def select_k(
             return select_k_pallas.select_k(in_val, in_idx, k, select_min,
                                             algo=algo)
         except NotImplementedError as e:
-            # config outside the kernel's envelope (k>256 or short rows):
-            # warn loudly — the caller asked for this algorithm by name, and
-            # silently measuring the XLA path instead would invalidate
-            # benchmarks/tests of the Pallas kernel
-            import warnings
+            if explicit:
+                import warnings
 
-            warnings.warn(
-                f"select_k: explicit algo={algo.name} outside the Pallas "
-                f"kernel envelope ({e}); falling back to XLA top-k",
-                RuntimeWarning, stacklevel=2)
+                warnings.warn(
+                    f"select_k: explicit algo={algo.name} outside the "
+                    f"Pallas kernel envelope ({e}); falling back to XLA "
+                    f"top-k", RuntimeWarning, stacklevel=2)
 
     return _xla_select_k(in_val, in_idx, k, select_min)

@@ -135,3 +135,39 @@ def test_slotted_sparse_finite_rows_distinct_positions():
     for r in range(2):
         assert len(set(oi[r].tolist())) == 8, oi[r]
     np.testing.assert_array_equal(np.asarray(ov)[0, :3], [1.0, 2.0, 3.0])
+
+
+def test_auto_heuristic_is_table_driven(tmp_path, monkeypatch):
+    # with a measured table committed, AUTO picks the measured-fastest
+    # algorithm of the nearest (batch, len, k) cell; without one it stays
+    # on the only measurement-justified default
+    import importlib
+    import json
+
+    sk = importlib.import_module("raft_tpu.matrix.select_k")
+
+    table = {"platform": "tpu", "unit": "ms", "rows": [
+        {"batch": 16, "len": 1048576, "k": 64,
+         "XLA_TOPK": 4.7, "SLOTTED": 0.4, "RADIX": 43.0},
+        {"batch": 16, "len": 16384, "k": 64,
+         "XLA_TOPK": 0.2, "SLOTTED": 0.5, "RADIX": 3.0},
+    ]}
+    p = tmp_path / "SELECT_K_MATRIX.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv("RAFT_TPU_SELECTK_TABLE", str(p))
+    monkeypatch.setattr(sk, "_SELECT_K_TABLE", ...)
+    assert sk.choose_select_k_algorithm(16, 1_000_000, 64) == \
+        SelectAlgo.SLOTTED
+    assert sk.choose_select_k_algorithm(16, 16000, 64) == \
+        SelectAlgo.XLA_TOPK
+    # no table -> default
+    monkeypatch.setenv("RAFT_TPU_SELECTK_TABLE", str(tmp_path / "none.json"))
+    monkeypatch.setattr(sk, "_SELECT_K_TABLE", ...)
+    assert sk.choose_select_k_algorithm(16, 1_000_000, 64) == \
+        SelectAlgo.XLA_TOPK
+    # a malformed table must degrade to the default, not crash
+    (tmp_path / "bad.json").write_text('{"rows": [{"batch": 16}]}')
+    monkeypatch.setenv("RAFT_TPU_SELECTK_TABLE", str(tmp_path / "bad.json"))
+    monkeypatch.setattr(sk, "_SELECT_K_TABLE", ...)
+    assert sk.choose_select_k_algorithm(16, 1_000_000, 64) == \
+        SelectAlgo.XLA_TOPK
