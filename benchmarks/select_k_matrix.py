@@ -17,6 +17,7 @@ import itertools
 import json
 import os
 import sys
+import warnings
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from benchmarks._common import gate
@@ -55,16 +56,17 @@ def main():
         v = jnp.asarray(rng.normal(size=(batch, length)).astype(np.float32))
         jax.block_until_ready(v)
         row = {"batch": batch, "len": length, "k": k}
-        import warnings
-
         for algo in (SelectAlgo.XLA_TOPK, SelectAlgo.SLOTTED,
                      SelectAlgo.RADIX):
             try:
                 # an off-envelope explicit request warns and measures the
                 # XLA path — recording THAT under this algo's name would
-                # mis-train the AUTO table, so escalate the warning
+                # mis-train the AUTO table, so escalate exactly that
+                # warning (not unrelated RuntimeWarnings) to an error
                 with warnings.catch_warnings():
-                    warnings.simplefilter("error", RuntimeWarning)
+                    warnings.filterwarnings(
+                        "error", message=r"select_k: explicit",
+                        category=RuntimeWarning)
                     dt = fx.run(lambda x, a=algo: select_k(
                         res, x, k=k, algo=a)[0], v)["seconds"]
                 row[algo.name] = round(dt * 1e3, 3)

@@ -136,4 +136,130 @@ int64_t host_coo_coalesce(const int32_t* rows, const int32_t* cols,
   return out_n + 1;
 }
 
+
+// ---------------- tiled-ELL layout (sparse SpMV/SpMM preprocessing) ----
+// (the native rendering of raft_tpu.sparse.tiled.tile_csr's hot path —
+// the role the reference's cusparse conversion routines play. Two-phase:
+// sizes from per-tile histograms (no sort), then one fill pass doing the
+// stable sorts. Must produce BIT-IDENTICAL layout to the numpy fallback:
+// both phases use stable ordering by (tile, row, original position).)
+
+// Phase A: padded lengths. out_sizes[0] = gather-phase padded nnz,
+// out_sizes[1] = scatter-phase padded nnz.
+void tiled_layout_sizes(const int32_t* rows, const int32_t* cols,
+                        int64_t nnz, int64_t n_rows, int64_t n_cols,
+                        int64_t C, int64_t R, int64_t E,
+                        int64_t* out_sizes) {
+  int64_t n_col_tiles = (n_cols + C - 1) / C;
+  if (n_col_tiles < 1) n_col_tiles = 1;
+  int64_t n_row_tiles = (n_rows + R - 1) / R;
+  if (n_row_tiles < 1) n_row_tiles = 1;
+  std::vector<int64_t> ccount(n_col_tiles, 0), rcount(n_row_tiles, 0);
+  for (int64_t i = 0; i < nnz; ++i) {
+    ++ccount[cols[i] / C];
+    ++rcount[rows[i] / R];
+  }
+  int64_t gp = 0, sp = 0;
+  for (int64_t t = 0; t < n_col_tiles; ++t)
+    gp += (ccount[t] + E - 1) / E * E;
+  for (int64_t t = 0; t < n_row_tiles; ++t)
+    sp += (rcount[t] + E - 1) / E * E;
+  out_sizes[0] = gp;
+  out_sizes[1] = sp;
+}
+
+// Phase B: fill the layout arrays (all pre-allocated to the phase-A
+// sizes; chunk arrays to size/E; visited to n_row_tiles).
+void tiled_layout_fill(const int32_t* rows, const int32_t* cols,
+                       const float* vals, int64_t nnz,
+                       int64_t n_rows, int64_t n_cols,
+                       int64_t C, int64_t R, int64_t E,
+                       float* pv, int32_t* pc, int32_t* chunk_col_tile,
+                       int32_t* src_perm, int32_t* rloc,
+                       int32_t* chunk_row_tile, uint8_t* visited) {
+  int64_t n_row_tiles = (n_rows + R - 1) / R;
+  if (n_row_tiles < 1) n_row_tiles = 1;
+  // gather phase ordering = (col tile, row, original position). Bucket
+  // by tile first (O(n) scatter off a histogram), then sort each small
+  // bucket with a div-free comparator — ~2x over one big lexicographic
+  // sort and matches np.lexsort((rows, col_tile)) exactly.
+  int64_t n_col_tiles_g = (n_cols + C - 1) / C;
+  if (n_col_tiles_g < 1) n_col_tiles_g = 1;
+  std::vector<int64_t> coff(n_col_tiles_g + 1, 0);
+  for (int64_t i = 0; i < nnz; ++i) ++coff[cols[i] / C + 1];
+  for (int64_t t2 = 0; t2 < n_col_tiles_g; ++t2) coff[t2 + 1] += coff[t2];
+  std::vector<int64_t> order(nnz);
+  {
+    std::vector<int64_t> cur(coff.begin(), coff.end() - 1);
+    for (int64_t i = 0; i < nnz; ++i) order[cur[cols[i] / C]++] = i;
+  }
+  for (int64_t t2 = 0; t2 < n_col_tiles_g; ++t2)
+    std::sort(order.begin() + coff[t2], order.begin() + coff[t2 + 1],
+              [&](int64_t a, int64_t b) {
+                if (rows[a] != rows[b]) return rows[a] < rows[b];
+                return a < b;   // original-position tie = stable
+              });
+  // lay out with per-tile padding; record each entry's flat gather slot
+  std::vector<int64_t> gather_slot(nnz);
+  int64_t pos = 0, t = 0;
+  while (t < nnz) {
+    int64_t tile = cols[order[t]] / C;
+    int64_t start = pos;
+    while (t < nnz && cols[order[t]] / C == tile) {
+      int64_t i = order[t];
+      pv[pos] = vals[i];
+      pc[pos] = (int32_t)(cols[i] % C);
+      gather_slot[i] = pos;
+      ++pos; ++t;
+    }
+    while ((pos - start) % E) {  // pad the tile to a chunk multiple
+      pv[pos] = 0.0f;
+      pc[pos] = 0;
+      ++pos;
+    }
+    for (int64_t ch = start; ch < pos; ch += E)
+      chunk_col_tile[ch / E] = (int32_t)tile;
+  }
+  // scatter phase: stable sort by (row tile, row), original order ties —
+  // matching np.lexsort((prow, row_tile)) over gather positions with
+  // pads dropped (note: numpy sorts the PADDED gather stream whose
+  // real entries keep (col_tile, row) order = this order)
+  {
+    std::vector<int64_t> roff(n_row_tiles + 1, 0);
+    for (int64_t i = 0; i < nnz; ++i) ++roff[rows[i] / R + 1];
+    for (int64_t t2 = 0; t2 < n_row_tiles; ++t2) roff[t2 + 1] += roff[t2];
+    std::vector<int64_t> tmp(nnz);
+    std::vector<int64_t> cur(roff.begin(), roff.end() - 1);
+    for (int64_t i = 0; i < nnz; ++i) tmp[cur[rows[i] / R]++] = i;
+    order.swap(tmp);
+    for (int64_t t2 = 0; t2 < n_row_tiles; ++t2)
+      std::sort(order.begin() + roff[t2], order.begin() + roff[t2 + 1],
+                [&](int64_t a, int64_t b) {
+                  if (rows[a] != rows[b]) return rows[a] < rows[b];
+                  return gather_slot[a] < gather_slot[b];
+                });
+  }
+  for (int64_t i = 0; i < n_row_tiles; ++i) visited[i] = 0;
+  pos = 0; t = 0;
+  while (t < nnz) {
+    int64_t tile = rows[order[t]] / R;
+    visited[tile] = 1;
+    int64_t start = pos;
+    while (t < nnz && rows[order[t]] / R == tile) {
+      int64_t i = order[t];
+      src_perm[pos] = (int32_t)gather_slot[i];
+      rloc[pos] = (int32_t)(rows[i] % R);
+      ++pos; ++t;
+    }
+    while ((pos - start) % E) {
+      src_perm[pos] = 0;
+      rloc[pos] = (int32_t)R;   // outside every lane id -> contributes 0
+      ++pos;
+    }
+    for (int64_t ch = start; ch < pos; ch += E)
+      chunk_row_tile[ch / E] = (int32_t)tile;
+  }
+}
+
 }  // extern "C"
+

@@ -35,9 +35,10 @@ def _load_select_k_table():
     import math
     import os
 
+    from raft_tpu.native import _REPO_ROOT
+
     path = os.environ.get("RAFT_TPU_SELECTK_TABLE") or os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), "SELECT_K_MATRIX.json")
+        _REPO_ROOT, "SELECT_K_MATRIX.json")
     try:
         with open(path) as f:
             data = json.load(f)

@@ -74,6 +74,25 @@ def load() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")]
         lib.host_coo_coalesce.restype = ctypes.c_int64
+        lib.tiled_layout_sizes.argtypes = [
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+        lib.tiled_layout_fill.argtypes = [
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")]
         _lib = lib
         return _lib
 
@@ -184,3 +203,40 @@ def host_coo_coalesce(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
     np.add.at(out_v, inverse, vals)
     return ((uniq // n_cols).astype(np.int32), (uniq % n_cols).astype(np.int32),
             out_v)
+
+
+def tiled_layout(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                 n_rows: int, n_cols: int, C: int, R: int, E: int):
+    """Native tiled-ELL layout (see cpp/hostops.cpp tiled_layout_*).
+    Returns the same tuple the numpy path in sparse/tiled.py builds, or
+    None when the native library is unavailable."""
+    lib = load()
+    if lib is None or len(rows) == 0:
+        return None
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    # the C++ pass indexes histograms by id/tile with no bounds checks —
+    # validate HERE so bad input raises instead of corrupting the heap
+    if (rows.min() < 0 or cols.min() < 0
+            or rows.max() >= n_rows or cols.max() >= n_cols):
+        raise ValueError(
+            "tiled_layout: row/col ids out of range for shape "
+            f"({n_rows}, {n_cols})")
+    rows = np.ascontiguousarray(rows, np.int32)
+    cols = np.ascontiguousarray(cols, np.int32)
+    vals = np.ascontiguousarray(vals, np.float32)
+    nnz = len(rows)
+    sizes = np.zeros(2, np.int64)
+    lib.tiled_layout_sizes(rows, cols, nnz, n_rows, n_cols, C, R, E, sizes)
+    gp, sp = int(sizes[0]), int(sizes[1])
+    n_row_tiles = max(1, -(-n_rows // R))
+    pv = np.empty(gp, np.float32)
+    pc = np.empty(gp, np.int32)
+    cct = np.empty(gp // E, np.int32)
+    perm = np.empty(sp, np.int32)
+    rloc = np.empty(sp, np.int32)
+    crt = np.empty(sp // E, np.int32)
+    visited = np.zeros(n_row_tiles, np.uint8)
+    lib.tiled_layout_fill(rows, cols, vals, nnz, n_rows, n_cols, C, R, E,
+                          pv, pc, cct, perm, rloc, crt, visited)
+    return pv, pc, cct, perm, rloc, crt, visited.astype(bool)

@@ -141,3 +141,44 @@ def test_spmm_tiled_powerlaw_and_empty_rows():
     Y = np.asarray(linalg.spmm(None, prepare_spmv(A, C=128, R=64, E=512), B))
     ref = m.toarray().astype(np.float64) @ B.astype(np.float64)
     np.testing.assert_allclose(Y, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_native_layout_bit_identical_to_numpy():
+    # the C++ layout pass must produce the EXACT arrays the numpy path
+    # builds (stable orderings on both sides) — otherwise committed
+    # layouts would depend on which toolchain built the wheel
+    from raft_tpu import native
+    from raft_tpu.sparse.tiled import tile_csr
+
+    if not native.available():
+        pytest.skip("native hostops unavailable")
+    for pattern in ("uniform", "powerlaw"):
+        m = _random_csr(700, 600, 0.02, pattern)
+        A = CSRMatrix(np.asarray(m.indptr, np.int32),
+                      np.asarray(m.indices, np.int32),
+                      m.data.astype(np.float32), m.shape)
+        t_native = tile_csr(A, C=128, R=64, E=512, impl="auto")
+        t_numpy = tile_csr(A, C=128, R=64, E=512, impl="numpy")
+        for f in ("vals", "col_local", "chunk_col_tile", "perm",
+                  "row_local", "chunk_row_tile", "visited_row_tiles"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(t_native, f)),
+                np.asarray(getattr(t_numpy, f)), err_msg=f"{pattern}:{f}")
+
+
+def test_tile_csr_validates_input():
+    from raft_tpu.core.sparse_types import COOMatrix
+    from raft_tpu.sparse.tiled import tile_csr
+
+    import jax.numpy as jnp
+
+    bad = COOMatrix(jnp.asarray([0, 1], jnp.int32),
+                    jnp.asarray([0, 50], jnp.int32),
+                    jnp.asarray([1.0, 2.0], jnp.float32), (4, 50))
+    for impl in ("auto", "numpy"):
+        with pytest.raises(ValueError, match="out of range"):
+            tile_csr(bad, C=128, R=64, E=512, impl=impl)
+    ok = COOMatrix(jnp.asarray([0], jnp.int32), jnp.asarray([0], jnp.int32),
+                   jnp.asarray([1.0], jnp.float32), (4, 50))
+    with pytest.raises(ValueError, match="impl"):
+        tile_csr(ok, C=128, R=64, E=512, impl="native")
