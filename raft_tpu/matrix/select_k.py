@@ -10,10 +10,12 @@ indices (defaults to 0..len-1 per row), ``select_min`` choosing smallest or
 largest, sorted output, stable on the XLA path.
 
 TPU-first algorithm space (no warp shuffles / SM histograms here):
-``XLA_TOPK`` lowers to XLA's fused sort/top-k; ``BITONIC`` / ``RADIX`` are
-Pallas kernels that stream the row in VMEM-sized blocks keeping a k-sized
-result queue (see raft_tpu/ops/select_k_pallas.py). The AUTO heuristic picks
-by (len, k) the way the reference's learned tree does by (rows, cols, k).
+``XLA_TOPK`` lowers to XLA's fused sort/top-k; ``SLOTTED`` is the
+certified slot-fold (sort-free, bandwidth-bound, always exact —
+select_k_slotted.py); ``BITONIC``/``RADIX`` are the Pallas radix kernel
+(VMEM-resident digit filtering, ops/select_k_pallas.py). The AUTO
+heuristic is table-driven off measured TPU timings the way the
+reference's learned tree is generated from benchmark sweeps.
 """
 
 from __future__ import annotations

@@ -182,3 +182,17 @@ def test_tile_csr_validates_input():
                    jnp.asarray([1.0], jnp.float32), (4, 50))
     with pytest.raises(ValueError, match="impl"):
         tile_csr(ok, C=128, R=64, E=512, impl="native")
+
+
+def test_spmm_tiled_validates_B():
+    from raft_tpu.ops.spmv_pallas import spmm_tiled
+
+    m = _random_csr(200, 100, 0.05)
+    A = CSRMatrix(np.asarray(m.indptr, np.int32),
+                  np.asarray(m.indices, np.int32),
+                  m.data.astype(np.float32), m.shape)
+    tiled = prepare_spmv(A, C=128, R=64, E=512)
+    with pytest.raises(ValueError, match="B must be"):
+        spmm_tiled(tiled, np.zeros((99, 4), np.float32))   # wrong n_cols
+    with pytest.raises(ValueError, match="B must be"):
+        spmm_tiled(tiled, np.zeros((100,), np.float32))    # 1-D
