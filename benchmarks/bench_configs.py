@@ -45,79 +45,104 @@ def main():
     fx = Fixture(res=res, reps=1 if dry else 3)
     out = {"platform": res.platform, "dry_run": dry, "configs": {}}
 
-    # ---- config 1: pairwise L2 on 5k×50 blobs ----
-    X1, _ = make_blobs(res, RngState(0), 5000 if not dry else 500, 50,
-                       n_clusters=8)
-    r = fx.run(lambda a: distance.pairwise_distance(res, a, a[:1000]), X1)
-    n1 = X1.shape[0]
-    out["configs"]["1_pairwise_l2_5kx50"] = {
-        "ms": round(r["seconds"] * 1e3, 3),
-        "gbps_distmatrix": round(n1 * 1000 * 4 / r["seconds"] / 1e9, 2)}
+    def record(name, payload):
+        # one config failing (or a wedge killing the process) must not
+        # lose the others: record + flush the artifact incrementally
+        out["configs"][name] = payload
+        print(json.dumps({name: payload}), flush=True)
+        if not dry:
+            with open(OUT, "w") as f:
+                json.dump(out, f, indent=1)
 
-    # ---- config 2: fused L2-NN + select_k top-64 on 1M×128 ----
-    n2, d2, q2 = (1_000_000, 128, 2048) if not dry else (20_000, 64, 256)
-    X2, _ = make_blobs(res, RngState(1), n2, d2, n_clusters=64)
-    Q2 = X2[:q2]
-    r = fx.run(lambda q: distance.knn(res, X2, q, k=64), Q2)
-    out["configs"]["2_fused_l2nn_selectk_1Mx128"] = {
-        "ms": round(r["seconds"] * 1e3, 3),
-        "gbps_effective": round(q2 * n2 * 4 / r["seconds"] / 1e9, 2)}
+    def config(name):
+        def deco(fn):
+            try:
+                record(name, fn())
+            except Exception as e:  # noqa: BLE001 — record, keep going
+                record(name, {"error": f"{type(e).__name__}: {e}"[:300]})
+        return deco
 
-    # ---- config 3: SVD / rSVD + Lanczos on 100k×1k dense ----
+    @config("1_pairwise_l2_5kx50")
+    def _():
+        X1, _ = make_blobs(res, RngState(0), 5000 if not dry else 500, 50,
+                           n_clusters=8)
+        r = fx.run(lambda a: distance.pairwise_distance(res, a, a[:1000]), X1)
+        n1 = X1.shape[0]
+        return {"ms": round(r["seconds"] * 1e3, 3),
+                "gbps_distmatrix": round(n1 * 1000 * 4 / r["seconds"] / 1e9,
+                                         2)}
+
+    @config("2_fused_l2nn_selectk_1Mx128")
+    def _():
+        n2, d2, q2 = (1_000_000, 128, 2048) if not dry else (20_000, 64, 256)
+        X2, _ = make_blobs(res, RngState(1), n2, d2, n_clusters=64)
+        Q2 = X2[:q2]
+        r = fx.run(lambda q: distance.knn(res, X2, q, k=64), Q2)
+        return {"ms": round(r["seconds"] * 1e3, 3),
+                "gbps_effective": round(q2 * n2 * 4 / r["seconds"] / 1e9, 2)}
+
     n3, d3 = (100_000, 1000) if not dry else (2000, 100)
     X3, _ = make_blobs(res, RngState(2), n3, d3, n_clusters=16)
-    r = fx.run(lambda a: linalg.randomized_svd(res, a, k=16)[1], X3)
-    out["configs"]["3_rsvd_100kx1k"] = {"ms": round(r["seconds"] * 1e3, 3)}
-    # Lanczos on the gram operator (symmetric), jitted-loop variant
-    from raft_tpu.sparse.solver.lanczos import lanczos_compute_eigenpairs
-    from raft_tpu.sparse.solver.lanczos_types import LanczosSolverConfig
 
-    G = (X3[:, : min(d3, 256)].T @ X3[:, : min(d3, 256)]) / n3
-    cfg = LanczosSolverConfig(n_components=8, max_iterations=300,
-                              ncv=32, tolerance=1e-6, seed=0, jit_loop=True)
-    r = fx.run(lambda g: lanczos_compute_eigenpairs(res, g, cfg)[0], G)
-    out["configs"]["3_lanczos_dense_gram"] = {
-        "ms": round(r["seconds"] * 1e3, 3)}
+    @config("3_rsvd_100kx1k")
+    def _():
+        r = fx.run(lambda a: linalg.randomized_svd(res, a, k=16)[1], X3)
+        return {"ms": round(r["seconds"] * 1e3, 3)}
 
-    # ---- config 4: spectral embedding on a 1M-edge RMAT graph ----
-    from raft_tpu.core.sparse_types import COOMatrix
-    from raft_tpu.models import SpectralEmbedding
-    from raft_tpu.random.rmat import rmat_rectangular_gen
+    @config("3_lanczos_dense_gram")
+    def _():
+        # Lanczos on the gram operator (symmetric), jitted-loop variant
+        from raft_tpu.sparse.solver.lanczos import lanczos_compute_eigenpairs
+        from raft_tpu.sparse.solver.lanczos_types import LanczosSolverConfig
 
-    scale, n_edges = (17, 1_000_000) if not dry else (10, 10_000)
-    src, dst = rmat_rectangular_gen(res, RngState(3), n_edges, scale, scale)
-    rows = jnp.concatenate([src, dst]).astype(jnp.int32)
-    cols = jnp.concatenate([dst, src]).astype(jnp.int32)
-    adj = COOMatrix(rows, cols, jnp.ones_like(rows, jnp.float32),
-                    (1 << scale, 1 << scale))
-    r = fx.run(lambda a: SpectralEmbedding(
-        n_components=4, max_iterations=400, res=res,
-        jit_loop=True).fit_transform(a), adj)
-    out["configs"]["4_spectral_embedding_1Medge"] = {
-        "ms": round(r["seconds"] * 1e3, 3)}
+        G = (X3[:, : min(d3, 256)].T @ X3[:, : min(d3, 256)]) / n3
+        cfg = LanczosSolverConfig(n_components=8, max_iterations=300,
+                                  ncv=32, tolerance=1e-6, seed=0,
+                                  jit_loop=True)
+        r = fx.run(lambda g: lanczos_compute_eigenpairs(res, g, cfg)[0], G)
+        return {"ms": round(r["seconds"] * 1e3, 3)}
 
-    # ---- config 5: MNMG allreduce/allgather over the mesh ----
-    from raft_tpu import parallel
-    from raft_tpu.comms import HostComms
+    @config("4_spectral_embedding_1Medge")
+    def _():
+        from raft_tpu.core.sparse_types import COOMatrix
+        from raft_tpu.models import SpectralEmbedding
+        from raft_tpu.random.rmat import rmat_rectangular_gen
 
-    ndev = len(jax.devices())
-    mesh = parallel.make_mesh({"x": ndev})
-    hc = HostComms(mesh, "x")
-    nbytes = (1 << 20) if dry else (64 << 20)
-    per_rank = nbytes // ndev
-    xs = jnp.zeros((ndev, per_rank // 4), jnp.float32)
-    r = fx.run(lambda a: hc.allreduce(a), xs)
-    # nccl-tests convention: busbw = 2(n-1)/n * PER-RANK bytes / time
-    busbw = 2 * (ndev - 1) / ndev * per_rank / r["seconds"] / 1e9
-    r2 = fx.run(lambda a: hc.allgather(a), xs)
-    out["configs"]["5_mnmg_allreduce_allgather"] = {
-        "n_devices": ndev,
-        # real ICI bus bandwidth needs >1 physical TPU chips; anything
-        # else is a code-path timing, never a bandwidth claim
-        "representative": jax.devices()[0].platform == "tpu" and ndev > 1,
-        "allreduce_ms": round(r["seconds"] * 1e3, 3),
-        "allreduce_busbw_gbps": round(busbw, 2) if ndev > 1 else None,
-        "allgather_ms": round(r2["seconds"] * 1e3, 3)}
+        scale, n_edges = (17, 1_000_000) if not dry else (10, 10_000)
+        src, dst = rmat_rectangular_gen(res, RngState(3), n_edges, scale,
+                                        scale)
+        rows = jnp.concatenate([src, dst]).astype(jnp.int32)
+        cols = jnp.concatenate([dst, src]).astype(jnp.int32)
+        adj = COOMatrix(rows, cols, jnp.ones_like(rows, jnp.float32),
+                        (1 << scale, 1 << scale))
+        r = fx.run(lambda a: SpectralEmbedding(
+            n_components=4, max_iterations=400, res=res,
+            jit_loop=True).fit_transform(a), adj)
+        return {"ms": round(r["seconds"] * 1e3, 3)}
+
+    @config("5_mnmg_allreduce_allgather")
+    def _():
+        from raft_tpu import parallel
+        from raft_tpu.comms import HostComms
+
+        ndev = len(jax.devices())
+        mesh = parallel.make_mesh({"x": ndev})
+        hc = HostComms(mesh, "x")
+        nbytes = (1 << 20) if dry else (64 << 20)
+        per_rank = nbytes // ndev
+        xs = jnp.zeros((ndev, per_rank // 4), jnp.float32)
+        r = fx.run(lambda a: hc.allreduce(a), xs)
+        # nccl-tests convention: busbw = 2(n-1)/n * PER-RANK bytes / time
+        busbw = 2 * (ndev - 1) / ndev * per_rank / r["seconds"] / 1e9
+        r2 = fx.run(lambda a: hc.allgather(a), xs)
+        return {
+            "n_devices": ndev,
+            # real ICI bus bandwidth needs >1 physical TPU chips; anything
+            # else is a code-path timing, never a bandwidth claim
+            "representative": jax.devices()[0].platform == "tpu" and ndev > 1,
+            "allreduce_ms": round(r["seconds"] * 1e3, 3),
+            "allreduce_busbw_gbps": round(busbw, 2) if ndev > 1 else None,
+            "allgather_ms": round(r2["seconds"] * 1e3, 3)}
 
     if dry:
         print(json.dumps({"dry_run": True, **out}))

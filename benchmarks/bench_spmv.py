@@ -59,12 +59,19 @@ def main():
     out = {"platform": res.platform, "nnz": int(2 * n_edges),
            "n": int(1 << scale), "unit": "ms"}
 
+    def flush():
+        if not dry:  # incremental: a wedge loses only the current point
+            with open(OUT, "w") as f:
+                json.dump(out, f, indent=1)
+
     dt = fx.run(lambda v: linalg.spmv(res, Acsr, v), x)["seconds"]
     out["segment_sum_ms"] = round(dt * 1e3, 3)
+    flush()
 
     t0 = time.time()
     tiled = prepare_spmv(Acsr)
     out["prepare_s"] = round(time.time() - t0, 2)
+    flush()
     dt = fx.run(lambda v: linalg.spmv(res, tiled, v), x)["seconds"]
     out["tiled_ell_ms"] = round(dt * 1e3, 3)
     out["tiled_speedup"] = round(out["segment_sum_ms"] / out["tiled_ell_ms"],

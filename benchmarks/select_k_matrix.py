@@ -17,6 +17,7 @@ import itertools
 import json
 import os
 import sys
+import time
 import warnings
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -26,6 +27,18 @@ import numpy as np
 
 OUT = os.path.join(os.path.dirname(__file__), os.pardir,
                    "SELECT_K_MATRIX.json")
+
+# Internal wall-clock budget: checked BETWEEN measurement points; on
+# expiry the partial table is kept and the script exits cleanly. An
+# external `timeout` kill mid-TPU-execution wedges the tunnel (measured:
+# round-2 battery) — the deadline must live inside the script.
+BUDGET_S = float(os.environ.get("SELECT_K_BUDGET_S", "3000"))
+
+# RADIX is measured 10-50x slower than XLA/SLOTTED at long rows (round-1
+# verdict; confirmed on v5e: 203ms at len=2^20) — skip it there rather
+# than spend the battery's budget re-proving it; the AUTO table treats a
+# missing entry as a non-candidate.
+RADIX_MAX_LEN = 2 ** 17
 
 
 def main():
@@ -52,12 +65,28 @@ def main():
             else itertools.product((16, 64, 256), (16384, 131072, 1048576),
                                    (16, 64, 256)))
     results = []
+    deadline = time.monotonic() + BUDGET_S
+
+    def flush(done: bool):
+        if dry:
+            return
+        with open(OUT, "w") as f:
+            json.dump({"platform": "tpu", "unit": "ms",
+                       "complete": done, "rows": results}, f, indent=1)
+
+    completed = True
     for batch, length, k in grid:
+        if time.monotonic() > deadline:
+            print(json.dumps({"budget_expired_after_rows": len(results)}))
+            completed = False
+            break
         v = jnp.asarray(rng.normal(size=(batch, length)).astype(np.float32))
         jax.block_until_ready(v)
         row = {"batch": batch, "len": length, "k": k}
         for algo in (SelectAlgo.XLA_TOPK, SelectAlgo.SLOTTED,
                      SelectAlgo.RADIX):
+            if algo is SelectAlgo.RADIX and length > RADIX_MAX_LEN:
+                continue
             try:
                 # an off-envelope explicit request warns and measures the
                 # XLA path — recording THAT under this algo's name would
@@ -74,14 +103,14 @@ def main():
                 row[algo.name] = f"error: {type(e).__name__}"
         results.append(row)
         print(row, flush=True)
+        flush(done=False)  # incremental: a kill/wedge loses only this row
 
     if dry:
         print(json.dumps({"dry_run": True, "rows": len(results)}))
         return 0
-    with open(OUT, "w") as f:
-        json.dump({"platform": "tpu", "unit": "ms", "rows": results}, f,
-                  indent=1)
-    print(json.dumps({"wrote": OUT, "rows": len(results)}))
+    flush(done=completed)
+    print(json.dumps({"wrote": OUT, "rows": len(results),
+                      "complete": completed}))
     return 0
 
 

@@ -5,22 +5,31 @@
 #
 #   bash benchmarks/tpu_measure.sh
 #
-# Artifacts: PALLAS_SMOKE.json, SELECT_K_MATRIX.json, SPMV_BENCH.json,
-# BENCH_LOCAL.json (bench.py's line, also echoed).
-set -u
+# Stage order: cheapest/most-load-bearing first, the long sweep LAST, so
+# a wedge mid-battery costs the least. Timeouts are last-resort only
+# (hours): killing a python mid-TPU-execution WEDGES the tunnel
+# (measured, twice) — every script enforces its own internal deadline
+# between measurement points instead.
+#
+# Artifacts: PALLAS_SMOKE.json, SPMV_BENCH.json, BENCH_CONFIGS.json,
+# BENCH_LOCAL.json, TUNE_FUSED.json, SELECT_K_MATRIX.json.
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 echo "=== pallas smoke (lowering) ==="
-timeout 1200 python benchmarks/pallas_smoke.py || echo "smoke rc=$?"
-
-echo "=== select_k matrix ==="
-timeout 1800 python benchmarks/select_k_matrix.py || echo "matrix rc=$?"
-
-echo "=== spmv bench ==="
-timeout 1800 python benchmarks/bench_spmv.py || echo "spmv rc=$?"
-
-echo "=== BASELINE config benchmarks ==="
-timeout 2400 python benchmarks/bench_configs.py || echo "configs rc=$?"
+timeout 3600 python benchmarks/pallas_smoke.py || echo "smoke rc=$?"
 
 echo "=== bench.py (driver metric) ==="
-timeout 1800 python bench.py | tee BENCH_LOCAL.json || echo "bench rc=$?"
+timeout 3600 python bench.py | tee BENCH_LOCAL.json || echo "bench rc=$?"
+
+echo "=== spmv bench ==="
+timeout 3600 python benchmarks/bench_spmv.py || echo "spmv rc=$?"
+
+echo "=== BASELINE config benchmarks ==="
+timeout 7200 python benchmarks/bench_configs.py || echo "configs rc=$?"
+
+echo "=== fused-pipeline tuning sweep ==="
+timeout 7200 python benchmarks/tune_fused.py || echo "tune rc=$?"
+
+echo "=== select_k matrix (long; internal budget) ==="
+timeout 7200 python benchmarks/select_k_matrix.py || echo "matrix rc=$?"

@@ -23,9 +23,13 @@ all plain VPU ops every Mosaic version lowers:
   Mosaic's sequential grid makes the revisited VMEM block the TPU
   replacement for CUDA's atomicAdd.
 
-Layout note: x tiles and y tiles are carried TRANSPOSED ([C, n_tiles] /
-[R, n_tiles]) so both kernels reduce along the natural axis (sublanes for
-gather, lanes for scatter) with no in-kernel relayout.
+Layout note: x tiles and y tiles are carried as [n_tiles, C, 1] /
+[n_tiles, R, 1] and the chunk arrays as [n_chunks, 1, E]: the leading axis
+is grid-blocked and every block's trailing two dims EQUAL the array's
+(Mosaic's block-shape rule — trailing dims must be (8, 128)-divisible or
+equal; a (1, E) block over an (n_chunks, E) array violates it). In-kernel
+the [C, 1] tile still reduces along sublanes and the [1, E] chunk along
+lanes, so there is no in-kernel relayout.
 
 Pad entries carry value 0 (gather side) / row_local = R (scatter side), so
 they contribute nothing. Row tiles with no nonzeros are never visited by
@@ -48,15 +52,16 @@ _EB = 512    # sub-block of the chunk folded at a time (bounds VMEM temps)
 
 def _gather_kernel(col_tile_ref, vals_ref, cols_ref, xt_ref, out_ref,
                    *, E: int, C: int):
-    xt = xt_ref[...]                                   # [C, 1]
+    xt = xt_ref[0]                                     # [C, 1]
+    cols_all = cols_ref[0]                             # [1, E]
     parts = []
     for b in range(E // _EB):
-        cols = cols_ref[:, b * _EB:(b + 1) * _EB]      # [1, EB]
+        cols = cols_all[:, b * _EB:(b + 1) * _EB]      # [1, EB]
         onehot = (jnp.broadcast_to(cols, (C, _EB))
                   == jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0))
         parts.append(jnp.sum(jnp.where(onehot, xt, 0.0), axis=0,
                              keepdims=True))           # [1, EB]
-    out_ref[...] = vals_ref[...] * jnp.concatenate(parts, axis=1)
+    out_ref[0] = vals_ref[0] * jnp.concatenate(parts, axis=1)
 
 
 def _scatter_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
@@ -67,9 +72,11 @@ def _scatter_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
     first = (c == 0) | (cur != prev)
 
     acc = jnp.zeros((R, 1), jnp.float32)
+    rloc_all = rloc_ref[0]                             # [1, E]
+    contrib_all = contrib_ref[0]
     for b in range(E // _EB):
-        rloc = rloc_ref[:, b * _EB:(b + 1) * _EB]      # [1, EB], pad = R
-        contrib = contrib_ref[:, b * _EB:(b + 1) * _EB]
+        rloc = rloc_all[:, b * _EB:(b + 1) * _EB]      # [1, EB], pad = R
+        contrib = contrib_all[:, b * _EB:(b + 1) * _EB]
         onehot = (jnp.broadcast_to(rloc, (R, _EB))
                   == jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0))
         acc = acc + jnp.sum(jnp.where(onehot, contrib, 0.0), axis=1,
@@ -77,11 +84,11 @@ def _scatter_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
 
     @pl.when(first)
     def _():
-        y_ref[...] = acc
+        y_ref[0] = acc
 
     @pl.when(jnp.logical_not(first))
     def _():
-        y_ref[...] = y_ref[...] + acc
+        y_ref[0] = y_ref[0] + acc
 
 
 @functools.partial(jax.jit, static_argnames=("C", "R", "E", "n_col_tiles",
@@ -92,7 +99,10 @@ def _spmv_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
                      n_col_tiles: int, n_row_tiles: int) -> jax.Array:
     n_chunks = vals.shape[0]
     m_chunks = row_local.shape[0]
-    xt = x_padded.reshape(n_col_tiles, C).T            # [C, n_col_tiles]
+    # 3-D carriers so every block's trailing two dims EQUAL the array's
+    # trailing dims (Mosaic's block-shape rule; a (1, E) block over an
+    # (n_chunks, E) array fails it — caught by the TPU smoke lane)
+    xt = x_padded.reshape(n_col_tiles, C, 1)           # [n_tiles, C, 1]
 
     contrib = pl.pallas_call(
         functools.partial(_gather_kernel, E=E, C=C),
@@ -100,43 +110,43 @@ def _spmv_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
             num_scalar_prefetch=1,
             grid=(n_chunks,),
             in_specs=[
-                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
                              memory_space=pltpu.VMEM),   # vals
-                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
                              memory_space=pltpu.VMEM),   # cols
-                pl.BlockSpec((C, 1), lambda c, m: (0, m[c]),
-                             memory_space=pltpu.VMEM),   # x tile (transposed)
+                pl.BlockSpec((1, C, 1), lambda c, m: (m[c], 0, 0),
+                             memory_space=pltpu.VMEM),   # x tile
             ],
-            out_specs=pl.BlockSpec((1, E), lambda c, m: (c, 0),
+            out_specs=pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
                                    memory_space=pltpu.VMEM),
         ),
-        out_shape=jax.ShapeDtypeStruct((n_chunks, E), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n_chunks, 1, E), jnp.float32),
         interpret=interpret_mode(),
-    )(chunk_col_tile, vals, col_local, xt)
+    )(chunk_col_tile, vals[:, None, :], col_local[:, None, :], xt)
 
     contrib_sorted = jnp.take(
-        contrib.reshape(-1), perm.reshape(-1)).reshape(m_chunks, E)
+        contrib.reshape(-1), perm.reshape(-1)).reshape(m_chunks, 1, E)
 
-    y2dt = pl.pallas_call(
+    y3d = pl.pallas_call(
         functools.partial(_scatter_kernel, E=E, R=R),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(m_chunks,),
             in_specs=[
-                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
                              memory_space=pltpu.VMEM),   # contrib
-                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
                              memory_space=pltpu.VMEM),   # row_local
             ],
-            out_specs=pl.BlockSpec((R, 1), lambda c, m: (0, m[c]),
+            out_specs=pl.BlockSpec((1, R, 1), lambda c, m: (m[c], 0, 0),
                                    memory_space=pltpu.VMEM),
         ),
-        out_shape=jax.ShapeDtypeStruct((R, n_row_tiles), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n_row_tiles, R, 1), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret_mode(),
-    )(chunk_row_tile, contrib_sorted, row_local)
-    return y2dt
+    )(chunk_row_tile, contrib_sorted, row_local[:, None, :])
+    return y3d[:, :, 0]                                # [n_row_tiles, R]
 
 
 def spmv_tiled(tiled, x) -> jax.Array:
@@ -152,7 +162,7 @@ def spmv_tiled(tiled, x) -> jax.Array:
         C=tiled.C, R=tiled.R, E=tiled.E,
         n_col_tiles=tiled.n_col_tiles, n_row_tiles=tiled.n_row_tiles)
     # zero row tiles the grid never visited (rows with no nonzeros)
-    y2d = jnp.where(tiled.visited_row_tiles[:, None], y2dt.T, 0.0)
+    y2d = jnp.where(tiled.visited_row_tiles[:, None], y2dt, 0.0)
     return y2d.reshape(-1)[:n_rows]
 
 
@@ -168,8 +178,9 @@ def _gather_mm_kernel(col_tile_ref, vals_ref, cols_ref, x_ref, out_ref,
     exactly representable in bf16, so with HIGHEST precision the gather
     error is the bf16x3 split residual of x, ~2⁻¹⁶ relative)."""
     x = x_ref[0]                                         # [C, V]
+    cols_all = cols_ref[0]                               # [1, E]
     for b in range(E // _EB):
-        cols = cols_ref[:, b * _EB:(b + 1) * _EB]        # [1, EB]
+        cols = cols_all[:, b * _EB:(b + 1) * _EB]        # [1, EB]
         onehot = (jnp.broadcast_to(cols, (C, _EB))
                   == jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0)
                   ).astype(jnp.float32)                  # [C, EB]
@@ -177,7 +188,7 @@ def _gather_mm_kernel(col_tile_ref, vals_ref, cols_ref, x_ref, out_ref,
             onehot, x, (((0,), (0,)), ((), ())),
             precision=jax.lax.Precision.HIGHEST,
             preferred_element_type=jnp.float32)          # [EB, V]
-        vals = vals_ref[0, b * _EB:(b + 1) * _EB]        # [EB]
+        vals = vals_ref[0, 0, b * _EB:(b + 1) * _EB]     # [EB]
         out_ref[0, b * _EB:(b + 1) * _EB, :] = vals[:, None] * g
 
 
@@ -189,8 +200,9 @@ def _scatter_mm_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
     first = (c == 0) | (cur != prev)
 
     acc = jnp.zeros((R, V), jnp.float32)
+    rloc_all = rloc_ref[0]                               # [1, E]
     for b in range(E // _EB):
-        rloc = rloc_ref[:, b * _EB:(b + 1) * _EB]        # [1, EB], pad = R
+        rloc = rloc_all[:, b * _EB:(b + 1) * _EB]        # [1, EB], pad = R
         onehot = (jnp.broadcast_to(rloc, (R, _EB))
                   == jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0)
                   ).astype(jnp.float32)                  # [R, EB]
@@ -225,9 +237,9 @@ def _spmm_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
             num_scalar_prefetch=1,
             grid=(n_chunks,),
             in_specs=[
-                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
                              memory_space=pltpu.VMEM),   # vals
-                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
                              memory_space=pltpu.VMEM),   # cols
                 pl.BlockSpec((1, C, V), lambda c, m: (m[c], 0, 0),
                              memory_space=pltpu.VMEM),   # x tile
@@ -237,7 +249,7 @@ def _spmm_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
         ),
         out_shape=jax.ShapeDtypeStruct((n_chunks, E, V), jnp.float32),
         interpret=interpret_mode(),
-    )(chunk_col_tile, vals, col_local, x3d)
+    )(chunk_col_tile, vals[:, None, :], col_local[:, None, :], x3d)
 
     contrib_sorted = jnp.take(contrib.reshape(-1, V), perm.reshape(-1),
                               axis=0).reshape(m_chunks, E, V)
@@ -250,7 +262,7 @@ def _spmm_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
             in_specs=[
                 pl.BlockSpec((1, E, V), lambda c, m: (c, 0, 0),
                              memory_space=pltpu.VMEM),   # contrib
-                pl.BlockSpec((1, E), lambda c, m: (c, 0),
+                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
                              memory_space=pltpu.VMEM),   # row_local
             ],
             out_specs=pl.BlockSpec((1, R, V), lambda c, m: (m[c], 0, 0),
@@ -260,7 +272,7 @@ def _spmm_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret_mode(),
-    )(chunk_row_tile, contrib_sorted, row_local)
+    )(chunk_row_tile, contrib_sorted, row_local[:, None, :])
     return y3d
 
 
