@@ -235,10 +235,13 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
     forced_fused = algo in ("fused", "fused_fast")
     expects(not (forced_fused and metric == "inner_product"),
             "knn: the fused pipeline is L2-only")
-    # the fused pipeline's candidate pool with its default tiling
-    # (T=2048, g=32) holds 8·ceil(n/2048) entries per query — mirror
+    # the fused pipeline's candidate pool is 2·128/g · ceil(n/T) entries
+    # per query under its active (possibly tuned) tiling — mirror
     # knn_fused's own envelope so auto never round-trips an exception
-    fused_pool = 8 * -(-max(n, 2048) // 2048)
+    from raft_tpu.distance.knn_fused import fused_defaults
+
+    _T, _, _g = fused_defaults()
+    fused_pool = (2 * 128 // _g) * -(-max(n, _T) // _T)
     auto_fused = (algo == "auto" and metric != "inner_product"
                   and jax.default_backend() == "tpu"
                   and queries.shape[1] <= 512 and n >= 4096

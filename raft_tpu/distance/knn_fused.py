@@ -46,7 +46,7 @@ precision expanded scores (validated in tests against an f64 oracle).
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -198,15 +198,56 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int
     return vals, ids
 
 
+_TUNED = ...   # lazy sentinel
+
+
+def fused_defaults() -> Tuple[int, int, int]:
+    """(T, Qb, g) for the fused pipeline: the measured-best point from
+    ``TUNE_FUSED.json`` (produced on real TPU by benchmarks/tune_fused.py
+    — the analog of the reference's fitted select_k heuristic) when one
+    is committed, else the hand-chosen defaults. ``passes`` is never
+    taken from the table — it is an exactness contract, not a tuning
+    knob."""
+    global _TUNED
+    if _TUNED is ...:
+        import json
+        import os
+
+        from raft_tpu.native import _REPO_ROOT
+
+        path = os.environ.get("RAFT_TPU_TUNE_FUSED") or os.path.join(
+            _REPO_ROOT, "TUNE_FUSED.json")
+        _TUNED = None
+        try:
+            with open(path) as f:
+                best = json.load(f).get("best")
+            if best:
+                T, Qb, g = int(best["T"]), int(best["Qb"]), int(best["g"])
+                # semantic validation, not just parseability: bad values
+                # would crash every knn() call downstream
+                if (T > 0 and T % _LANES == 0 and Qb > 0 and Qb % 8 == 0
+                        and 0 < g <= _LANES):
+                    _TUNED = (T, Qb, g)
+        except Exception:
+            _TUNED = None  # malformed table must never break knn
+    return _TUNED or (2048, 256, 32)
+
+
 def knn_fused(x, y, k: int, passes: int = 3,
-              T: int = 2048, Qb: int = 256, g: int = 32
-              ) -> Tuple[jax.Array, jax.Array]:
+              T: Optional[int] = None, Qb: Optional[int] = None,
+              g: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
     """Certified fused brute-force KNN (squared-L2, ascending).
 
     Returns (d2 [Q, k] f32 exact, ids [Q, k] int32). ``passes=3`` is
     certified-exact w.r.t. f32 distances; ``passes=1`` trades that for
     ~3× contraction speed (exact w.r.t. bf16 scores). See module doc.
+    ``T``/``Qb``/``g`` default to :func:`fused_defaults` (measured-best
+    when a tuning table is committed).
     """
+    dT, dQb, dg = fused_defaults()
+    T = dT if T is None else T
+    Qb = dQb if Qb is None else Qb
+    g = dg if g is None else g
     x = jnp.asarray(x, jnp.float32)
     y = jnp.asarray(y, jnp.float32)
     Q, d = x.shape

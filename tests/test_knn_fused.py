@@ -135,3 +135,29 @@ def test_fused_inner_product_rejected():
     y = rng.normal(size=(4096, 32)).astype(np.float32)
     with pytest.raises(LogicError):
         distance.knn(None, y, x, k=4, metric="inner_product", algo="fused")
+
+
+def test_fused_defaults_table(tmp_path, monkeypatch):
+    """fused_defaults() reads the measured-best tuning point when a table
+    exists, never takes `passes` from it, and degrades on malformed
+    tables."""
+    import json
+
+    from raft_tpu.distance import knn_fused as kf
+
+    tbl = tmp_path / "TUNE_FUSED.json"
+    tbl.write_text(json.dumps(
+        {"best": {"T": 4096, "Qb": 512, "g": 16, "passes": 1}}))
+    monkeypatch.setenv("RAFT_TPU_TUNE_FUSED", str(tbl))
+    # monkeypatch restores the cache even if an assert below fails
+    monkeypatch.setattr(kf, "_TUNED", ...)
+    assert kf.fused_defaults() == (4096, 512, 16)
+
+    tbl.write_text("{not json")
+    kf._TUNED = ...
+    assert kf.fused_defaults() == (2048, 256, 32)
+
+    # semantically invalid values (T=0 would div-by-zero in knn) degrade
+    tbl.write_text(json.dumps({"best": {"T": 0, "Qb": 512, "g": 16}}))
+    kf._TUNED = ...
+    assert kf.fused_defaults() == (2048, 256, 32)
