@@ -105,6 +105,14 @@ def main():
         2 * fbytes)
     rec("stats.histogram",
         fx.run(lambda a: stats.value_histogram(res, a.ravel(), 64), X), fbytes)
+    from raft_tpu.stats import HistType
+
+    bins = jnp.asarray(
+        np.random.default_rng(5).integers(0, 64, size=(n, 8)), jnp.int32)
+    for ht in (HistType.SegmentSum, HistType.OneHot, HistType.Blocked):
+        rec(f"stats.histogram[{ht.name}]",
+            fx.run(lambda b, h=ht: stats.histogram(res, b, 64, hist_type=h),
+                   bins), bins.size * 4)
 
     dense = np.array(X[:2048, :64])
     dense[np.random.default_rng(2).random(dense.shape) > 0.1] = 0

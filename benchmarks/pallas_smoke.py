@@ -96,11 +96,23 @@ def _smoke_spmm_tiled():
     np.testing.assert_allclose(Y, m @ B, rtol=5e-4, atol=5e-4)
 
 
+def _smoke_histogram_blocked():
+    from raft_tpu.ops.histogram_pallas import histogram_blocked
+
+    bins = np.random.default_rng(6).integers(
+        0, 64, size=(8192, 128)).astype(np.int32)
+    got = np.asarray(histogram_blocked(bins, 64))
+    want = np.stack([np.bincount(bins[:, c], minlength=64)
+                     for c in range(bins.shape[1])], axis=1)
+    np.testing.assert_array_equal(got, want)
+
+
 KERNELS = {
     "select_k_radix": _smoke_select_k_radix,
     "fused_l2_topk": _smoke_fused_l2_topk,
     "spmv_tiled": _smoke_spmv_tiled,
     "spmm_tiled": _smoke_spmm_tiled,
+    "histogram_blocked": _smoke_histogram_blocked,
 }
 
 

@@ -224,9 +224,10 @@ def fused_defaults() -> Tuple[int, int, int]:
             if best:
                 T, Qb, g = int(best["T"]), int(best["Qb"]), int(best["g"])
                 # semantic validation, not just parseability: bad values
-                # would crash every knn() call downstream
+                # would crash every knn() call downstream; g must divide
+                # the lane count or the S % g envelope check rejects it
                 if (T > 0 and T % _LANES == 0 and Qb > 0 and Qb % 8 == 0
-                        and 0 < g <= _LANES):
+                        and 0 < g <= _LANES and _LANES % g == 0):
                     _TUNED = (T, Qb, g)
         except Exception:
             _TUNED = None  # malformed table must never break knn

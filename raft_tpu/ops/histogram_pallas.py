@@ -1,0 +1,67 @@
+"""Blocked histogram kernel (Pallas/Mosaic) — the smem-histogram role.
+
+(ref: cpp/include/raft/stats/detail/histogram.cuh — the shared-memory
+``HistType`` strategies keep per-block bin counters in smem and merge via
+atomics. TPU has neither smem atomics nor scatter; the Mosaic idiom is a
+VMEM-RESIDENT ACCUMULATOR: the [n_bins, batch] output block is revisited
+by every row-block grid step (sequential grid), each step folding its row
+chunk as one-hot compare + sum — pure VPU ops.)
+
+Rows are streamed in blocks; inside a block, small sub-chunks bound the
+[n_bins, SUB, batch] one-hot temporary. Pad rows carry bin id -1, which
+matches no bin. Counts are accumulated in int32.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.ops.utils import interpret_mode
+
+_SUB = 8     # rows folded per one-hot temp (bounds VMEM: n_bins·SUB·batch)
+
+
+def _hist_kernel(bins_ref, out_ref, *, Rb: int, n_bins: int):
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    b = bins_ref[0]                                     # [Rb, batch] int32
+    acc = out_ref[...]                                  # [n_bins, batch]
+    ids = jax.lax.broadcasted_iota(jnp.int32, (n_bins, _SUB, 1), 0)
+    for r0 in range(0, Rb, _SUB):
+        sub = b[r0:r0 + _SUB][None, :, :]               # [1, SUB, batch]
+        onehot = (sub == ids).astype(jnp.int32)         # [n_bins,SUB,batch]
+        acc = acc + jnp.sum(onehot, axis=1)
+    out_ref[...] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("n_bins", "Rb"))
+def histogram_blocked(bins, n_bins: int, Rb: int = 1024) -> jax.Array:
+    """counts [n_bins, batch] for bins [n, batch] int32 (entries outside
+    [0, n_bins) are ignored). Grid-streamed rows, VMEM accumulator."""
+    n, batch = bins.shape
+    pad = (-n) % Rb
+    if pad:
+        bins = jnp.concatenate(
+            [bins, jnp.full((pad, batch), -1, jnp.int32)])
+    blocks = bins.reshape(-1, Rb, batch)
+    return pl.pallas_call(
+        functools.partial(_hist_kernel, Rb=Rb, n_bins=n_bins),
+        grid=(blocks.shape[0],),
+        in_specs=[pl.BlockSpec((1, Rb, batch), lambda j: (j, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((n_bins, batch), lambda j: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_bins, batch), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret_mode(),
+    )(blocks)

@@ -172,3 +172,34 @@ def test_information_criterion(res):
     aicc = np.asarray(stats.information_criterion_batched(
         res, ll, stats.IC_Type.AICc, n_params=3, batch_size=2, n_samples=50))
     np.testing.assert_allclose(aicc, -2 * ll + 6 + 24 / 46, rtol=1e-6)
+
+
+def test_histogram_strategies_agree(res):
+    """All three strategies (segment-sum scatter, dense one-hot, Pallas
+    blocked VMEM accumulator) produce identical counts; legacy HistType
+    names alias their TPU role-equivalents."""
+    from raft_tpu.stats import HistType
+
+    data = rng.integers(0, 37, size=(3000, 5)).astype(np.int32)
+    want = np.stack([np.bincount(data[:, c], minlength=37)
+                     for c in range(5)], axis=1)
+    for ht in (HistType.SegmentSum, HistType.OneHot, HistType.Blocked,
+               HistType.Auto):
+        got = np.asarray(stats.histogram(res, data, 37, hist_type=ht))
+        np.testing.assert_array_equal(got, want, err_msg=str(ht))
+    assert HistType.GlobalAtomics is HistType.SegmentSum
+    assert HistType.SmemBits is HistType.Blocked
+
+
+def test_histogram_strategies_unpadded_tail(res):
+    """Row counts that do not divide the chunk/block sizes are padded with
+    a sentinel that must match no bin."""
+    from raft_tpu.stats import HistType
+
+    data = rng.integers(0, 8, size=(1037, 2)).astype(np.int32)
+    want = np.stack([np.bincount(data[:, c], minlength=8)
+                     for c in range(2)], axis=1)
+    for ht in (HistType.OneHot, HistType.Blocked):
+        got = np.asarray(stats.histogram(res, data, 8, hist_type=ht))
+        np.testing.assert_array_equal(got, want)
+
