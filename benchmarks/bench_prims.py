@@ -123,6 +123,11 @@ def main():
     mask = np.zeros((2048, 32), np.float32)
     mask[np.random.default_rng(4).random(mask.shape) < 0.1] = 1
     structure = CSRMatrix.from_dense(mask)
+    tiled_pairs = sparse.prepare_sddmm(structure)
+    rec("sparse.sddmm[tiled]",
+        fx.run(lambda b: sparse.linalg.sddmm(
+            res, jnp.asarray(dense), b, tiled_pairs).values, B),
+        structure.nnz * 4 * 32)
     rec("sparse.sddmm",
         fx.run(lambda b: sparse.linalg.sddmm(res, jnp.asarray(dense), b,
                                              structure).values, B),

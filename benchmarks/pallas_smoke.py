@@ -96,6 +96,25 @@ def _smoke_spmm_tiled():
     np.testing.assert_allclose(Y, m @ B, rtol=5e-4, atol=5e-4)
 
 
+def _smoke_sddmm_tiled():
+    import scipy.sparse as sp
+
+    from raft_tpu.sparse import CSRMatrix, linalg, prepare_sddmm
+
+    m = sp.random(2048, 2048, density=0.01, random_state=7,
+                  dtype=np.float32, format="csr")
+    S = CSRMatrix(np.asarray(m.indptr, np.int32),
+                  np.asarray(m.indices, np.int32),
+                  m.data.astype(np.float32), m.shape)
+    rng = np.random.default_rng(8)
+    A = rng.normal(size=(2048, 128)).astype(np.float32)
+    B = rng.normal(size=(128, 2048)).astype(np.float32)
+    out = linalg.sddmm(None, A, B, prepare_sddmm(S))
+    want = (A @ B)[np.asarray(S.row_ids()), np.asarray(S.indices)]
+    np.testing.assert_allclose(np.asarray(out.values), want,
+                               rtol=1e-3, atol=1e-3)
+
+
 def _smoke_histogram_blocked():
     from raft_tpu.ops.histogram_pallas import histogram_blocked
 
@@ -112,6 +131,7 @@ KERNELS = {
     "fused_l2_topk": _smoke_fused_l2_topk,
     "spmv_tiled": _smoke_spmv_tiled,
     "spmm_tiled": _smoke_spmm_tiled,
+    "sddmm_tiled": _smoke_sddmm_tiled,
     "histogram_blocked": _smoke_histogram_blocked,
 }
 

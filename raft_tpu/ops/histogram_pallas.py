@@ -47,7 +47,12 @@ def _hist_kernel(bins_ref, out_ref, *, Rb: int, n_bins: int):
 def histogram_blocked(bins, n_bins: int, Rb: int = 1024) -> jax.Array:
     """counts [n_bins, batch] for bins [n, batch] int32 (entries outside
     [0, n_bins) are ignored). Grid-streamed rows, VMEM accumulator."""
+    if Rb % _SUB:
+        raise ValueError(f"histogram_blocked: Rb must be a multiple of "
+                         f"{_SUB}, got {Rb}")
     n, batch = bins.shape
+    if n == 0:  # grid=(0,) would leave the output uninitialized
+        return jnp.zeros((n_bins, batch), jnp.int32)
     pad = (-n) % Rb
     if pad:
         bins = jnp.concatenate(

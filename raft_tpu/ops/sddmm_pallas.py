@@ -1,0 +1,130 @@
+"""Blocked SDDMM kernel (Pallas/Mosaic) — the cusparse-SDDMM role on TPU.
+
+(ref: sparse/linalg/sddmm.hpp:43 and the masked_matmul consumer
+sparse/linalg/masked_matmul.cuh:47 — sampled dense-dense matmul at the
+nonzero positions of a sparsity structure. The reference calls
+cusparseSDDMM; GPUs gather A/B rows per nonzero. TPU-first re-design:
+the structure is bucketed ONCE by (row tile × col tile)
+(raft_tpu.sparse.tiled.tile_pairs), so each grid step owns E nonzeros
+inside one [R, C] output block. The step contracts that block's dense
+tile ``D = A_r @ B_cᵀ`` on the MXU — the FLOPs the op exists to do —
+then folds per-entry values straight out of VMEM:
+
+    Pt = Dᵀ-gather:  onehot_rows [R, EB] per sub-block — Pt[c, e] =
+         D[row_local[e], c] as ONE MXU matmul (D contracted with the
+         one-hot, exactly representable in bf16);
+    out[e] = Σ_c [col_local[e] = c] · Pt[c, e] — a VPU masked reduce.
+
+Pad entries carry row_local = R, whose one-hot column is all-zero, so
+they contribute exact zeros. d (the contraction depth) is VMEM-bounded:
+callers fall back to the XLA gather path past the envelope.)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.ops.utils import interpret_mode
+
+_EB = 512    # entries folded per MXU gather step
+MAX_D = 512  # A/B tile depth envelope (VMEM)
+
+
+def _sddmm_kernel(rt_ref, ct_ref, a_ref, b_ref, rloc_ref, cloc_ref, out_ref,
+                  *, R: int, C: int, E: int):
+    a = a_ref[0]                                         # [R, d]
+    b = b_ref[0]                                         # [C, d]
+    d_blk = jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)              # [R, C]
+
+    rloc_all = rloc_ref[0]                               # [1, E]
+    cloc_all = cloc_ref[0]
+    iota_r = jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0)
+    iota_c = jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0)
+    for bi in range(E // _EB):
+        rloc = rloc_all[:, bi * _EB:(bi + 1) * _EB]      # [1, EB], pad = R
+        cloc = cloc_all[:, bi * _EB:(bi + 1) * _EB]
+        onehot_r = (jnp.broadcast_to(rloc, (R, _EB))
+                    == iota_r).astype(jnp.float32)       # [R, EB]
+        # Pt[c, e] = Σ_r D[r, c]·onehot_r[r, e] = D[rloc[e], c]
+        pt = jax.lax.dot_general(
+            d_blk, onehot_r, (((0,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)          # [C, EB]
+        mask = jnp.broadcast_to(cloc, (C, _EB)) == iota_c
+        out_ref[0, :, bi * _EB:(bi + 1) * _EB] = jnp.sum(
+            jnp.where(mask, pt, 0.0), axis=0, keepdims=True)  # [1, EB]
+
+
+@functools.partial(jax.jit, static_argnames=("R", "C", "E"))
+def _sddmm_tiled_impl(a3, b3, row_local, col_local, chunk_row_tile,
+                      chunk_col_tile, R: int, C: int, E: int) -> jax.Array:
+    m_chunks = row_local.shape[0]
+    d = a3.shape[2]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(m_chunks,),
+        in_specs=[
+            pl.BlockSpec((1, R, d), lambda c, rt, ct: (rt[c], 0, 0),
+                         memory_space=pltpu.VMEM),       # A row tile
+            pl.BlockSpec((1, C, d), lambda c, rt, ct: (ct[c], 0, 0),
+                         memory_space=pltpu.VMEM),       # Bt col tile
+            pl.BlockSpec((1, 1, E), lambda c, rt, ct: (c, 0, 0),
+                         memory_space=pltpu.VMEM),       # row_local
+            pl.BlockSpec((1, 1, E), lambda c, rt, ct: (c, 0, 0),
+                         memory_space=pltpu.VMEM),       # col_local
+        ],
+        out_specs=pl.BlockSpec((1, 1, E), lambda c, rt, ct: (c, 0, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    return pl.pallas_call(
+        functools.partial(_sddmm_kernel, R=R, C=C, E=E),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m_chunks, 1, E), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m_chunks * (R * C * d + R * C * E),
+            bytes_accessed=m_chunks * ((R + C) * d * 4 + 3 * E * 4),
+            transcendentals=0,
+        ),
+        interpret=interpret_mode(),
+    )(chunk_row_tile, chunk_col_tile, a3, b3,
+      row_local[:, None, :], col_local[:, None, :])
+
+
+def sddmm_tiled(tiled, A, B) -> jax.Array:
+    """Values of (A @ B) at ``tiled``'s nonzero positions, in the
+    structure's ORIGINAL entry order. A [m, d], B [d, n];
+    ``tiled`` is a :class:`raft_tpu.sparse.tiled.TiledPairs` over [m, n].
+    """
+    m, n = tiled.shape
+    A = jnp.asarray(A, jnp.float32)
+    B = jnp.asarray(B, jnp.float32)
+    if A.ndim != 2 or B.ndim != 2 or A.shape[0] != m or B.shape[1] != n \
+            or A.shape[1] != B.shape[0]:
+        raise ValueError(
+            f"sddmm_tiled: need A [{m}, d] @ B [d, {n}], got "
+            f"{A.shape} @ {B.shape}")
+    d = A.shape[1]
+    if d > MAX_D:
+        raise NotImplementedError(
+            f"sddmm_tiled targets d <= {MAX_D} (VMEM tile); got {d}")
+    # pad to tile grids; dpad keeps the MXU contraction lane-aligned
+    dpad = (-d) % 128
+    rpad = tiled.n_row_tiles * tiled.R - m
+    cpad = tiled.n_col_tiles * tiled.C - n
+    a3 = jnp.pad(A, ((0, rpad), (0, dpad))).reshape(
+        tiled.n_row_tiles, tiled.R, d + dpad)
+    b3 = jnp.pad(B.T, ((0, cpad), (0, dpad))).reshape(
+        tiled.n_col_tiles, tiled.C, d + dpad)
+    contrib = _sddmm_tiled_impl(
+        a3, b3, tiled.row_local, tiled.col_local,
+        tiled.chunk_row_tile, tiled.chunk_col_tile,
+        R=tiled.R, C=tiled.C, E=tiled.E)
+    return jnp.take(contrib.reshape(-1), tiled.pos)

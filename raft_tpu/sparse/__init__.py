@@ -7,10 +7,11 @@ from raft_tpu.sparse import linalg
 from raft_tpu.sparse import matrix
 from raft_tpu.sparse import op
 from raft_tpu.sparse import solver
-from raft_tpu.sparse.linalg import prepare_spmv
-from raft_tpu.sparse.tiled import TiledELL
+from raft_tpu.sparse.linalg import prepare_sddmm, prepare_spmv
+from raft_tpu.sparse.tiled import TiledELL, TiledPairs
 
 __all__ = [
     "COOMatrix", "COOStructure", "CSRMatrix", "CSRStructure", "TiledELL",
-    "convert", "linalg", "matrix", "op", "prepare_spmv", "solver",
+    "TiledPairs", "convert", "linalg", "matrix", "op", "prepare_sddmm",
+    "prepare_spmv", "solver",
 ]

@@ -97,14 +97,43 @@ def spmm(res, A, B, alpha=1.0, beta=0.0, C=None) -> jax.Array:
     return out
 
 
-def sddmm(res, A, B, structure: Sparse, alpha=1.0, beta=0.0) -> Sparse:
+def prepare_sddmm(structure: Sparse, R: int = 256, C: int = 512,
+                  E: int = 2048):
+    """One-time conversion of a sparsity structure to the pair-tiled
+    layout used by the blocked SDDMM kernel; the returned operand is
+    accepted by :func:`sddmm` (as ``structure``) and
+    :func:`masked_matmul` (as ``prepared``) for repeated sampled
+    products over the same pattern. (ref: the cusparse SDDMM
+    descriptor-preparation role.)"""
+    from raft_tpu.sparse.tiled import tile_pairs
+
+    return tile_pairs(structure, R=R, C=C, E=E)
+
+
+def sddmm(res, A, B, structure, alpha=1.0, beta=0.0) -> Sparse:
     """Sampled dense-dense matmul: C_ij = alpha·(A @ B)_ij + beta·C_ij at the
     nonzero positions of ``structure`` only. A is [m×k], B is [k×n].
     (ref: sparse/linalg/sddmm.hpp:43) Returns a sparse matrix sharing
-    structure's sparsity pattern."""
-    rows, cols, vals, shape = _as_coo_parts(structure)
+    structure's sparsity pattern.
+
+    ``structure`` may be COO/CSR (gather path, dtype-preserving) or a
+    pre-tiled :class:`raft_tpu.sparse.tiled.TiledPairs` (the blocked MXU
+    kernel — ops.sddmm_pallas; f32, the per-block dense tile never
+    leaves VMEM). The tiled path has no values, so beta must be 0; the
+    result is a COO matrix in the structure's original entry order."""
+    from raft_tpu.sparse.tiled import TiledPairs
+
     A = jnp.asarray(A)
     B = jnp.asarray(B)
+    if isinstance(structure, TiledPairs):
+        from raft_tpu.ops.sddmm_pallas import sddmm_tiled
+
+        expects(beta == 0.0, "sddmm: TiledPairs carries no values "
+                "(beta must be 0)")
+        vals = alpha * sddmm_tiled(structure, A, B)
+        return COOMatrix(structure.rows, structure.cols, vals,
+                         structure.shape)
+    rows, cols, vals, shape = _as_coo_parts(structure)
     expects(A.shape[0] == shape[0] and B.shape[1] == shape[1],
             "sddmm: shape mismatch")
     prod = jnp.sum(A[rows, :] * B[:, cols].T, axis=1)
@@ -113,15 +142,22 @@ def sddmm(res, A, B, structure: Sparse, alpha=1.0, beta=0.0) -> Sparse:
 
 
 def masked_matmul(res, A, B, mask: "BitmapView | BitsetView", alpha=1.0,
-                  beta=0.0) -> CSRMatrix:
+                  beta=0.0, prepared=None) -> Sparse:
     """C = alpha·(A @ Bᵀ) ∘ mask, result sparse.
     (ref: sparse/linalg/masked_matmul.cuh:47,92 — bitmap/bitset-masked
     dense×dense → sparse via SDDMM; note the reference contracts A [m×k]
-    with B [n×k] transposed.)"""
+    with B [n×k] transposed.)
+
+    For repeated products over the SAME mask, pass ``prepared`` — the
+    :func:`prepare_sddmm` layout of the mask's structure — to route
+    through the blocked MXU kernel instead of re-deriving the CSR
+    structure per call (requires beta == 0)."""
     from raft_tpu.sparse.convert import bitmap_to_csr, bitset_to_csr
 
     A = jnp.asarray(A)
     B = jnp.asarray(B)
+    if prepared is not None:
+        return sddmm(res, A, B.T, prepared, alpha=alpha, beta=beta)
     if isinstance(mask, BitmapView):
         structure = bitmap_to_csr(mask)
     else:

@@ -89,6 +89,123 @@ class TiledELL:
                    n_row_tiles=nrt)
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class TiledPairs:
+    """Device-resident (row tile × col tile)-bucketed layout of a sparsity
+    STRUCTURE — the operand of the blocked SDDMM kernel
+    (raft_tpu.ops.sddmm_pallas). Each chunk's E entries share one
+    [R, C] block of the output, so the kernel can form that block's dense
+    A·Bᵀ tile ON the MXU and fold the entries out of VMEM. ``pos`` maps
+    each ORIGINAL structure entry to its chunk-flat slot, restoring the
+    caller's nnz order after the kernel. ``rows``/``cols`` keep the
+    original structure so the result can be returned as a sparse matrix."""
+
+    shape: Tuple[int, int]
+    R: int
+    C: int
+    E: int
+    row_local: jax.Array        # [m_chunks, E] int32 in [0, R), pad = R
+    col_local: jax.Array        # [m_chunks, E] int32 in [0, C), pad = 0
+    chunk_row_tile: jax.Array   # [m_chunks] int32
+    chunk_col_tile: jax.Array   # [m_chunks] int32
+    pos: jax.Array              # [nnz] int32 into chunk-flat order
+    rows: jax.Array             # [nnz] int32 — original structure
+    cols: jax.Array             # [nnz] int32
+    n_row_tiles: int
+    n_col_tiles: int
+
+    @property
+    def nnz(self) -> int:
+        return self.rows.shape[0]
+
+    @property
+    def m_chunks(self) -> int:
+        return self.row_local.shape[0]
+
+    _LEAVES = ("row_local", "col_local", "chunk_row_tile", "chunk_col_tile",
+               "pos", "rows", "cols")
+
+    def tree_flatten(self):
+        leaves = tuple(getattr(self, f) for f in self._LEAVES)
+        aux = (self.shape, self.R, self.C, self.E,
+               self.n_row_tiles, self.n_col_tiles)
+        return leaves, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        shape, R, C, E, nrt, nct = aux
+        return cls(shape, R, C, E, *leaves, n_row_tiles=nrt,
+                   n_col_tiles=nct)
+
+
+def _checked_coo_parts(A, C: int, R: int, E: int, name: str):
+    """Shared validation + extraction for the tiled conversions: kernel
+    alignment check, CSR/COO (rows, cols, vals, shape) extraction, and
+    id-range validation."""
+    if E % 512 or C % 128 or R % 8:
+        raise ValueError(f"{name}: need E % 512 == 0, C % 128 == 0, "
+                         f"R % 8 == 0 (kernel fold/tile alignment)")
+    if isinstance(A, CSRMatrix):
+        rows = np.asarray(A.row_ids())
+        cols = np.asarray(A.indices)
+        vals = np.asarray(A.values, np.float32)
+        shape = A.shape
+    elif isinstance(A, COOMatrix):
+        rows = np.asarray(A.rows)
+        cols = np.asarray(A.cols)
+        vals = np.asarray(A.values, np.float32)
+        shape = A.shape
+    else:
+        raise TypeError(f"{name}: expected sparse matrix, got {type(A)}")
+    if len(rows) and (
+            int(rows.min()) < 0 or int(cols.min()) < 0
+            or int(rows.max()) >= shape[0] or int(cols.max()) >= shape[1]):
+        raise ValueError(
+            f"{name}: row/col ids out of range for shape {shape}")
+    return rows, cols, vals, shape
+
+
+def tile_pairs(structure, R: int = 256, C: int = 512,
+               E: int = 2048) -> TiledPairs:
+    """Bucket a sparsity structure by (row tile, col tile) — one-time host
+    conversion for the blocked SDDMM kernel. (ref: the preprocessing role
+    of cusparse's SDDMM descriptors, cusparse_wrappers.h sddmm.)"""
+    rows, cols, _, shape = _checked_coo_parts(structure, C, R, E,
+                                              "tile_pairs")
+    n_row_tiles = max(1, -(-shape[0] // R))
+    n_col_tiles = max(1, -(-shape[1] // C))
+    key = (rows // R).astype(np.int64) * n_col_tiles + cols // C
+    order = np.lexsort((cols, rows, key))
+    pad_idx, chunk_key = _pad_groups(order, key, E)
+    gr, gc = rows, cols                          # gather targets
+    if len(pad_idx) == 0:                        # empty structure
+        pad_idx = np.full(E, -1, np.int64)
+        chunk_key = np.zeros(1, np.int32)
+        gr = np.zeros(1, np.int64)               # dummy targets for the
+        gc = np.zeros(1, np.int64)               # all-pad chunk
+    safe = np.maximum(pad_idx, 0)
+    rloc = np.where(pad_idx >= 0, gr[safe] % R, R).astype(np.int32)
+    cloc = np.where(pad_idx >= 0, gc[safe] % C, 0).astype(np.int32)
+    pos = np.empty(len(rows), np.int32)
+    real = pad_idx >= 0
+    pos[pad_idx[real]] = np.flatnonzero(real).astype(np.int32)
+    m_chunks = len(pad_idx) // E
+    return TiledPairs(
+        shape=shape, R=R, C=C, E=E,
+        row_local=jnp.asarray(rloc.reshape(m_chunks, E)),
+        col_local=jnp.asarray(cloc.reshape(m_chunks, E)),
+        chunk_row_tile=jnp.asarray(
+            (chunk_key // n_col_tiles).astype(np.int32)),
+        chunk_col_tile=jnp.asarray(
+            (chunk_key % n_col_tiles).astype(np.int32)),
+        pos=jnp.asarray(pos),
+        rows=jnp.asarray(rows, jnp.int32),
+        cols=jnp.asarray(cols, jnp.int32),
+        n_row_tiles=n_row_tiles, n_col_tiles=n_col_tiles,
+    )
+
+
 def _pad_groups(order, keys, E):
     """Given sort order and group key per nnz (keys[order] nondecreasing),
     pad each group's entries to a multiple of E. Returns (padded index
@@ -123,28 +240,8 @@ def tile_csr(A, C: int = 512, R: int = 256, E: int = 2048,
     if impl not in ("auto", "numpy"):
         raise ValueError(f"tile_csr: impl must be 'auto' or 'numpy', "
                          f"got {impl!r}")
-    if E % 512 or C % 128 or R % 8:
-        raise ValueError("tile_csr: need E % 512 == 0, C % 128 == 0, "
-                         "R % 8 == 0 (kernel fold/tile alignment)")
-    if isinstance(A, CSRMatrix):
-        coo_rows = np.asarray(A.row_ids())
-        coo_cols = np.asarray(A.indices)
-        vals = np.asarray(A.values, np.float32)
-        shape = A.shape
-    elif isinstance(A, COOMatrix):
-        coo_rows = np.asarray(A.rows)
-        coo_cols = np.asarray(A.cols)
-        vals = np.asarray(A.values, np.float32)
-        shape = A.shape
-    else:
-        raise TypeError(f"tile_csr: expected sparse matrix, got {type(A)}")
-
-    if len(coo_rows) and (
-            int(coo_rows.min()) < 0 or int(coo_cols.min()) < 0
-            or int(coo_rows.max()) >= shape[0]
-            or int(coo_cols.max()) >= shape[1]):
-        raise ValueError(
-            f"tile_csr: row/col ids out of range for shape {shape}")
+    coo_rows, coo_cols, vals, shape = _checked_coo_parts(A, C, R, E,
+                                                         "tile_csr")
 
     if impl == "auto" and len(coo_rows):
         from raft_tpu import native
