@@ -16,7 +16,7 @@ class SpectralEmbedding:
     def __init__(self, n_components: int = 2, normalized: bool = True,
                  drop_first: bool = True, ncv: Optional[int] = None,
                  tolerance: float = 1e-5, max_iterations: int = 2000,
-                 seed: int = 42, jit_loop: bool = False,
+                 seed: int = 42, jit_loop: bool = False, tiled="auto",
                  res: Optional[Resources] = None):
         self.res = ensure_resources(res)
         self.n_components = n_components
@@ -27,6 +27,7 @@ class SpectralEmbedding:
         self.max_iterations = max_iterations
         self.seed = seed
         self.jit_loop = jit_loop
+        self.tiled = tiled
         self.eigenvalues_ = None
         self.embedding_ = None
 
@@ -35,7 +36,8 @@ class SpectralEmbedding:
             self.res, adjacency, self.n_components, ncv=self.ncv,
             tolerance=self.tolerance, max_iterations=self.max_iterations,
             seed=self.seed, drop_first=self.drop_first,
-            normalized=self.normalized, jit_loop=self.jit_loop)
+            normalized=self.normalized, jit_loop=self.jit_loop,
+            tiled=self.tiled)
         self.eigenvalues_ = vals
         self.embedding_ = emb
         return self

@@ -57,13 +57,20 @@ def analyze_modularity(res, A: Sparse, n_clusters: int, clusters) -> float:
 def fit_embedding(res, A: Sparse, n_components: int, ncv=None,
                   tolerance: float = 1e-5, max_iterations: int = 2000,
                   seed: int = 42, drop_first: bool = True,
-                  normalized: bool = True, jit_loop: bool = False):
+                  normalized: bool = True, jit_loop: bool = False,
+                  tiled="auto"):
     """Spectral embedding: smallest eigenvectors of the graph Laplacian.
 
     The BASELINE config-4 pipeline (COO Laplacian + Lanczos). Returns
     (eigenvalues, embedding [n, n_components]).
+
+    ``tiled``: "auto" converts the Laplacian to the tiled-ELL layout
+    (one-time host pass) so the Lanczos hot loop runs the Pallas SpMV
+    kernel — on TPU, for graphs past ~200k nonzeros; True/False force
+    either path.
     """
-    from raft_tpu.sparse.linalg import compute_graph_laplacian, laplacian_normalized
+    from raft_tpu.sparse.linalg import (
+        compute_graph_laplacian, laplacian_normalized, prepare_spmv)
     from raft_tpu.sparse.solver.lanczos import lanczos_compute_eigenpairs
     from raft_tpu.sparse.solver.lanczos_types import LANCZOS_WHICH, LanczosSolverConfig
 
@@ -72,6 +79,13 @@ def fit_embedding(res, A: Sparse, n_components: int, ncv=None,
         L, _ = laplacian_normalized(res, A)
     else:
         L = compute_graph_laplacian(res, A)
+    if tiled == "auto":
+        # f64 inputs stay on the CSR path (the tiled kernel computes in
+        # f32 — see the dtype policy in linalg.spmm's docstring)
+        tiled = (jax.default_backend() == "tpu" and L.nnz >= 200_000
+                 and L.values.dtype == jnp.float32)
+    if tiled:
+        L = prepare_spmv(L)
     # jit_loop=True compiles the whole solve into one program (best for
     # remote/tunneled devices); the host loop (default) keeps cancellation
     # points and the stagnation early-exit for large zero clusters

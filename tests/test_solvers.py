@@ -376,3 +376,26 @@ def test_merge_labels(res):
     assert (full == 0).all()
     partial = np.asarray(label.merge_labels(res, chain_a, chain_b, max_iters=1))
     assert not (partial == 0).all()
+
+
+def test_spectral_embedding_tiled_path():
+    """fit_embedding(tiled=True) routes the Lanczos matvec through the
+    tiled-ELL Pallas SpMV and matches the CSR path."""
+    import numpy as np
+
+    from raft_tpu.core.sparse_types import COOMatrix
+    from raft_tpu.spectral.analysis import fit_embedding
+
+    rng2 = np.random.default_rng(21)
+    n = 300
+    ii = rng2.integers(0, n, 4000)
+    jj = rng2.integers(0, n, 4000)
+    m = ii != jj
+    r = np.concatenate([ii[m], jj[m]])
+    c = np.concatenate([jj[m], ii[m]])
+    A = COOMatrix(r.astype(np.int32), c.astype(np.int32),
+                  np.ones(r.size, np.float32), (n, n))
+    v1, e1 = fit_embedding(None, A, 3, seed=5, tiled=True)
+    v2, e2 = fit_embedding(None, A, 3, seed=5, tiled=False)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
+                               rtol=1e-3, atol=1e-4)
