@@ -16,6 +16,7 @@
 #include <cstdint>
 #include <cstring>
 #include <numeric>
+#include <unordered_map>
 #include <vector>
 
 extern "C" {
@@ -258,6 +259,70 @@ void tiled_layout_fill(const int32_t* rows, const int32_t* cols,
     }
     for (int64_t ch = start; ch < pos; ch += E)
       chunk_row_tile[ch / E] = (int32_t)tile;
+  }
+}
+
+// ---------------- pair-tiled layout (blocked SDDMM preprocessing) ------
+// (the native rendering of raft_tpu.sparse.tiled.tile_pairs — bucketing a
+// sparsity structure by (row tile x col tile) for the blocked SDDMM
+// kernel. Must produce BIT-IDENTICAL layout to the numpy fallback:
+// ordering = (pair key, row, col, original position), matching
+// np.lexsort((cols, rows, key)) with lexsort's stability.)
+
+// Phase A: out_size[0] = per-key-padded nnz.
+void pair_layout_sizes(const int32_t* rows, const int32_t* cols,
+                       int64_t nnz, int64_t n_cols,
+                       int64_t R, int64_t C, int64_t E, int64_t* out_size) {
+  int64_t nct = (n_cols + C - 1) / C;
+  if (nct < 1) nct = 1;
+  std::unordered_map<int64_t, int64_t> cnt;
+  cnt.reserve((size_t)(nnz / 8 + 8));
+  for (int64_t i = 0; i < nnz; ++i)
+    ++cnt[(int64_t)(rows[i] / R) * nct + cols[i] / C];
+  int64_t p = 0;
+  for (const auto& kv : cnt) p += (kv.second + E - 1) / E * E;
+  out_size[0] = p;
+}
+
+// Phase B: fill rloc/cloc (padded; pads rloc = R, cloc = 0), per-chunk
+// tile ids, and pos[nnz] (original entry -> chunk-flat slot).
+void pair_layout_fill(const int32_t* rows, const int32_t* cols, int64_t nnz,
+                      int64_t n_cols, int64_t R, int64_t C, int64_t E,
+                      int32_t* rloc, int32_t* cloc,
+                      int32_t* chunk_row_tile, int32_t* chunk_col_tile,
+                      int32_t* pos_out) {
+  int64_t nct = (n_cols + C - 1) / C;
+  if (nct < 1) nct = 1;
+  std::vector<int64_t> key(nnz), order(nnz);
+  for (int64_t i = 0; i < nnz; ++i)
+    key[i] = (int64_t)(rows[i] / R) * nct + cols[i] / C;
+  std::iota(order.begin(), order.end(), 0);
+  std::sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    if (key[a] != key[b]) return key[a] < key[b];
+    if (rows[a] != rows[b]) return rows[a] < rows[b];
+    if (cols[a] != cols[b]) return cols[a] < cols[b];
+    return a < b;  // original-position tie = lexsort stability
+  });
+  int64_t pos = 0, t = 0;
+  while (t < nnz) {
+    int64_t k = key[order[t]];
+    int64_t start = pos;
+    while (t < nnz && key[order[t]] == k) {
+      int64_t i = order[t];
+      rloc[pos] = (int32_t)(rows[i] % R);
+      cloc[pos] = (int32_t)(cols[i] % C);
+      pos_out[i] = (int32_t)pos;
+      ++pos; ++t;
+    }
+    while ((pos - start) % E) {  // pad the group to a chunk multiple
+      rloc[pos] = (int32_t)R;    // outside every lane id -> contributes 0
+      cloc[pos] = 0;
+      ++pos;
+    }
+    for (int64_t ch = start; ch < pos; ch += E) {
+      chunk_row_tile[ch / E] = (int32_t)(k / nct);
+      chunk_col_tile[ch / E] = (int32_t)(k % nct);
+    }
   }
 }
 

@@ -93,6 +93,22 @@ def load() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")]
+        lib.pair_layout_sizes.argtypes = [
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+        lib.pair_layout_fill.argtypes = [
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
         _lib = lib
         return _lib
 
@@ -240,3 +256,37 @@ def tiled_layout(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
     lib.tiled_layout_fill(rows, cols, vals, nnz, n_rows, n_cols, C, R, E,
                           pv, pc, cct, perm, rloc, crt, visited)
     return pv, pc, cct, perm, rloc, crt, visited.astype(bool)
+
+
+def pair_layout(rows: np.ndarray, cols: np.ndarray, n_rows: int,
+                n_cols: int, R: int, C: int, E: int):
+    """Native pair-tiled layout (see cpp/hostops.cpp pair_layout_*).
+    Returns (rloc, cloc, chunk_row_tile, chunk_col_tile, pos) — the same
+    arrays the numpy path in sparse/tiled.py builds — or None when the
+    native library is unavailable."""
+    lib = load()
+    if lib is None or len(rows) == 0:
+        return None
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    # the C++ pass indexes by id/tile with no bounds checks — validate
+    # HERE so bad input raises instead of corrupting the heap
+    if (rows.min() < 0 or cols.min() < 0
+            or rows.max() >= n_rows or cols.max() >= n_cols):
+        raise ValueError(
+            "pair_layout: row/col ids out of range for shape "
+            f"({n_rows}, {n_cols})")
+    rows = np.ascontiguousarray(rows, np.int32)
+    cols = np.ascontiguousarray(cols, np.int32)
+    nnz = len(rows)
+    size = np.zeros(1, np.int64)
+    lib.pair_layout_sizes(rows, cols, nnz, n_cols, R, C, E, size)
+    p = int(size[0])
+    rloc = np.empty(p, np.int32)
+    cloc = np.empty(p, np.int32)
+    crt = np.empty(p // E, np.int32)
+    cct = np.empty(p // E, np.int32)
+    pos = np.empty(nnz, np.int32)
+    lib.pair_layout_fill(rows, cols, nnz, n_cols, R, C, E,
+                         rloc, cloc, crt, cct, pos)
+    return rloc, cloc, crt, cct, pos

@@ -167,14 +167,40 @@ def _checked_coo_parts(A, C: int, R: int, E: int, name: str):
 
 
 def tile_pairs(structure, R: int = 256, C: int = 512,
-               E: int = 2048) -> TiledPairs:
+               E: int = 2048, impl: str = "auto") -> TiledPairs:
     """Bucket a sparsity structure by (row tile, col tile) — one-time host
     conversion for the blocked SDDMM kernel. (ref: the preprocessing role
-    of cusparse's SDDMM descriptors, cusparse_wrappers.h sddmm.)"""
+    of cusparse's SDDMM descriptors, cusparse_wrappers.h sddmm.)
+
+    ``impl``: "auto" uses the native C++ layout pass when available,
+    "numpy" forces the fallback; both produce BIT-IDENTICAL layouts
+    (tested)."""
+    if impl not in ("auto", "numpy"):
+        raise ValueError(f"tile_pairs: impl must be 'auto' or 'numpy', "
+                         f"got {impl!r}")
     rows, cols, _, shape = _checked_coo_parts(structure, C, R, E,
                                               "tile_pairs")
     n_row_tiles = max(1, -(-shape[0] // R))
     n_col_tiles = max(1, -(-shape[1] // C))
+
+    if impl == "auto" and len(rows):
+        from raft_tpu import native
+
+        out = native.pair_layout(rows, cols, shape[0], shape[1], R, C, E)
+        if out is not None:
+            rloc, cloc, crt, cct, pos = out
+            m_chunks = len(rloc) // E
+            return TiledPairs(
+                shape=shape, R=R, C=C, E=E,
+                row_local=jnp.asarray(rloc.reshape(m_chunks, E)),
+                col_local=jnp.asarray(cloc.reshape(m_chunks, E)),
+                chunk_row_tile=jnp.asarray(crt),
+                chunk_col_tile=jnp.asarray(cct),
+                pos=jnp.asarray(pos),
+                rows=jnp.asarray(rows, jnp.int32),
+                cols=jnp.asarray(cols, jnp.int32),
+                n_row_tiles=n_row_tiles, n_col_tiles=n_col_tiles)
+
     key = (rows // R).astype(np.int64) * n_col_tiles + cols // C
     order = np.lexsort((cols, rows, key))
     pad_idx, chunk_key = _pad_groups(order, key, E)

@@ -79,6 +79,10 @@ def fit_embedding(res, A: Sparse, n_components: int, ncv=None,
         L, _ = laplacian_normalized(res, A)
     else:
         L = compute_graph_laplacian(res, A)
+    if tiled not in ("auto", True, False):
+        raise ValueError(
+            f"fit_embedding: tiled must be 'auto', True or False, "
+            f"got {tiled!r}")
     if tiled == "auto":
         # f64 inputs stay on the CSR path (the tiled kernel computes in
         # f32 — see the dtype policy in linalg.spmm's docstring)

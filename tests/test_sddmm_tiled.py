@@ -144,3 +144,24 @@ def test_histogram_blocked_empty_and_rb():
     np.testing.assert_array_equal(out, np.zeros((8, 4), np.int32))
     with pytest.raises(ValueError):
         histogram_blocked(np.zeros((16, 4), np.int32), 8, Rb=1025)
+
+
+def test_tile_pairs_native_bit_identical():
+    """The C++ pair-layout pass and the numpy fallback produce the SAME
+    layout, bit for bit (matching np.lexsort stability)."""
+    from raft_tpu import native
+
+    if not native.available():
+        pytest.skip("native hostops not built")
+    # duplicates included: (row, col) collisions exercise the stability tie
+    r = rng.integers(0, 700, 30000).astype(np.int32)
+    c = rng.integers(0, 900, 30000).astype(np.int32)
+    from raft_tpu.core.sparse_types import COOMatrix
+
+    S = COOMatrix(r, c, np.ones(r.size, np.float32), (700, 900))
+    a = tile_pairs(S, impl="auto")
+    b = tile_pairs(S, impl="numpy")
+    for f in TiledPairs._LEAVES:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)), err_msg=f)
+    assert (a.n_row_tiles, a.n_col_tiles) == (b.n_row_tiles, b.n_col_tiles)
