@@ -142,6 +142,44 @@ def main():
             fx.run(lambda v: sparse.linalg.spmv(res, tiled, v), xv),
             csr.nnz * 8)
 
+    # --- remaining reference §4.3 rows: masked_matmul, subsample,
+    # bitmap/bitset→csr + select_k_csr, core bitset/popc, copy ---
+    from raft_tpu.core.bitset import Bitset, BitmapView
+
+    bm = BitmapView.from_dense(jnp.asarray(mask > 0))
+    A64 = jnp.asarray(dense)
+    Bt = jnp.asarray(np.random.default_rng(6).normal(size=(32, 64))
+                     .astype(np.float32))
+    # prepared= keeps the per-rep work on device (re-deriving the CSR from
+    # the bitmap is a host pass that would break Fixture's async-reps
+    # timing contract)
+    mm_prep = sparse.prepare_sddmm(structure)
+    rec("sparse.masked_matmul",
+        fx.run(lambda b: sparse.linalg.masked_matmul(
+            res, A64, b, bm, prepared=mm_prep).values, Bt),
+        structure.nnz * 4)
+    rec("sparse.convert.bitmap_to_csr",
+        fx.run(lambda _: sparse.convert.bitmap_to_csr(bm).values, Bt),
+        mask.size // 8)
+    bs = Bitset.from_dense(jnp.asarray(mask[0] > 0))
+    rec("sparse.convert.bitset_to_csr",
+        fx.run(lambda _: sparse.convert.bitset_to_csr(
+            bs, n_repeat=128).values, Bt), 128 * mask.shape[1] // 8)
+    csr_scores = CSRMatrix.from_dense(np.abs(dense))
+    rec("sparse.matrix.select_k_csr",
+        fx.run(lambda _: sparse.matrix.select_k(
+            res, csr_scores, k=8, select_min=False)[0], Bt),
+        csr_scores.nnz * 4)
+    from raft_tpu.random import sample_without_replacement
+
+    rec("random.subsample",
+        fx.run(lambda a: sample_without_replacement(
+            res, RngState(9), n, n // 10), X), n * 4)
+    bits = Bitset.from_dense(jnp.asarray(
+        np.random.default_rng(7).random(n) < 0.5))
+    rec("core.bitset.popc", fx.run(lambda _: bits.count(), X), n // 8)
+    rec("core.copy", fx.run(lambda a: jnp.copy(a), X), 2 * fbytes)
+
     print(f"{'benchmark':<28}{'ms':>10}{'GB/s':>10}")
     for name, ms, gbs in rows:
         print(f"{name:<28}{ms:>10.3f}{gbs:>10.1f}")
