@@ -12,10 +12,18 @@ largest, sorted output, stable on the XLA path.
 TPU-first algorithm space (no warp shuffles / SM histograms here):
 ``XLA_TOPK`` lowers to XLA's fused sort/top-k; ``SLOTTED`` is the
 certified slot-fold (sort-free, bandwidth-bound, always exact —
-select_k_slotted.py); ``BITONIC``/``RADIX`` are the Pallas radix kernel
-(VMEM-resident digit filtering, ops/select_k_pallas.py). The AUTO
-heuristic is table-driven off measured TPU timings the way the
-reference's learned tree is generated from benchmark sweeps.
+select_k_slotted.py) — it plays the reference warpsort family's ROLE
+(bandwidth-bound selection keeping per-bucket running minima in
+registers) with folds instead of queues; ``BITONIC``/``RADIX`` are the
+Pallas radix kernel (VMEM-resident digit filtering,
+ops/select_k_pallas.py). A literal bitonic lane-queue is an anti-fit
+here: every compare-exchange stage needs cross-lane shuffles the VPU
+only gets via relayouts, and the measured matrix (SELECT_K_MATRIX.json)
+shows even the radix histogram losing to compare/select folds — so the
+warpsort names map to the kernels that serve their roles rather than
+to a losing literal translation. The AUTO heuristic is table-driven
+off measured TPU timings the way the reference's learned tree is
+generated from benchmark sweeps.
 """
 
 from __future__ import annotations
