@@ -221,12 +221,30 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
 
     ``tile`` sizes the streamed sweep only; the fused pipeline has its own
     tiling and bounds its workspace by chunking queries internally.
+
+    ``metric="cosine"`` solves certified-exact squared-L2 on
+    row-normalized operands (monotone-equivalent ranking) and returns
+    ``1 − cos_sim = d2/2`` — so the fused Pallas pipeline serves cosine
+    too. Degenerate zero-norm rows normalize to the zero vector
+    (distance 0.5 to every unit vector) where the pairwise convention
+    reports 1.0.
     """
     res = ensure_resources(res)
     index = jnp.asarray(index, jnp.float32)
     queries = jnp.asarray(queries, jnp.float32)
-    expects(metric in ("sqeuclidean", "euclidean", "l2", "inner_product"),
+    expects(metric in ("sqeuclidean", "euclidean", "l2", "inner_product",
+                       "cosine"),
             "knn: unsupported metric %r", metric)
+    if metric == "cosine":
+        def _unit(a):
+            # same zero-norm guard as pairwise._cosine (1e-30), so both
+            # cosine surfaces share one degenerate-input convention
+            n = jnp.sqrt(jnp.sum(a * a, axis=1, keepdims=True))
+            return a / jnp.maximum(n, 1e-30)
+
+        d2, idx = knn(res, _unit(index), _unit(queries), k,
+                      metric="sqeuclidean", tile=tile, algo=algo)
+        return d2 * 0.5, idx
     expects(k <= index.shape[0], "knn: k larger than index size")
     expects(algo in ("auto", "fused", "fused_fast", "streamed"),
             "knn: unknown algo %r", algo)

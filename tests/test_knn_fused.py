@@ -161,3 +161,27 @@ def test_fused_defaults_table(tmp_path, monkeypatch):
     tbl.write_text(json.dumps({"best": {"T": 0, "Qb": 512, "g": 16}}))
     kf._TUNED = ...
     assert kf.fused_defaults() == (2048, 256, 32)
+
+
+def test_knn_cosine_matches_pairwise():
+    """metric='cosine' (normalized certified-L2 route) agrees with an
+    f64 numpy cosine oracle, on both the fused and streamed paths."""
+    from raft_tpu import distance
+
+    x = rng.normal(size=(24, 40)).astype(np.float32)
+    y = rng.normal(size=(5000, 40)).astype(np.float32)
+    # f64 oracle (backend-independent — the jax pairwise matrix would be
+    # bf16-grade on TPU)
+    x64, y64 = x.astype(np.float64), y.astype(np.float64)
+    sim = (x64 / np.linalg.norm(x64, axis=1, keepdims=True)) @ (
+        y64 / np.linalg.norm(y64, axis=1, keepdims=True)).T
+    full = 1.0 - sim
+    want_idx = np.argsort(full, axis=1, kind="stable")[:, :6]
+    want = np.take_along_axis(full, want_idx, axis=1)
+    for algo in ("fused", "streamed"):
+        v, i = distance.knn(None, y, x, k=6, metric="cosine", algo=algo)
+        # compare id SETS (f32-vs-f64 rounding can swap near-ties)
+        assert np.array_equal(np.sort(np.asarray(i), 1),
+                              np.sort(want_idx, 1)), algo
+        np.testing.assert_allclose(np.sort(np.asarray(v), 1),
+                                   np.sort(want, 1), rtol=1e-4, atol=1e-5)
