@@ -251,8 +251,6 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
     n = index.shape[0]
 
     forced_fused = algo in ("fused", "fused_fast")
-    expects(not (forced_fused and metric == "inner_product"),
-            "knn: the fused pipeline is L2-only")
     # the fused pipeline's candidate pool is 2·128/g · ceil(n/T) entries
     # per query under its active (possibly tuned) tiling — mirror
     # knn_fused's own envelope so auto never round-trips an exception
@@ -260,8 +258,7 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
 
     _T, _, _g = fused_defaults()
     fused_pool = (2 * 128 // _g) * -(-max(n, _T) // _T)
-    auto_fused = (algo == "auto" and metric != "inner_product"
-                  and jax.default_backend() == "tpu"
+    auto_fused = (algo == "auto" and jax.default_backend() == "tpu"
                   and queries.shape[1] <= 512 and n >= 4096
                   and k <= fused_pool)
     if forced_fused or auto_fused:
@@ -270,7 +267,8 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
         try:
             dists, idx = knn_fused(
                 queries, index, k,
-                passes=1 if algo == "fused_fast" else 3)
+                passes=1 if algo == "fused_fast" else 3,
+                metric="ip" if metric == "inner_product" else "l2")
             if metric in ("euclidean", "l2"):
                 dists = jnp.sqrt(jnp.maximum(dists, 0.0))
             return dists, idx

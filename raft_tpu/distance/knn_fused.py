@@ -89,26 +89,38 @@ def _pad_rows_to(y, mult: int):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("k", "T", "Qb", "g", "passes"))
-def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int
-               ) -> Tuple[jax.Array, jax.Array]:
+                   static_argnames=("k", "T", "Qb", "g", "passes", "metric"))
+def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
+               metric: str = "l2") -> Tuple[jax.Array, jax.Array]:
     """Certified fused KNN on pre-padded operands.
 
     x [Q, d] f32 (Q % Qb == 0, d % 128 == 0 — caller pads), y [m, d] f32
-    un-padded rows; returns exact (d2 [Q, k] ascending, ids [Q, k]).
+    un-padded rows; returns exact (score [Q, k] ascending, ids [Q, k]).
+    ``metric="l2"`` scores expanded squared L2; ``metric="ip"`` scores
+    ``−x·y`` (so ascending = best inner products first) by feeding the
+    SAME kernel zeros for xx/yy and the hi/lo split of y/2:
+    d2 = 0 + 0 − 2·x·(y/2) = −x·y. The certificate algebra is
+    metric-blind (it only needs "every non-candidate ≥ its slot's
+    2nd-min"); the bf16x3 error bound uses the TRUE operand norms.
     """
     Q, d = x.shape
     m = y.shape[0]
     yp = _pad_rows_to(y, T)
     M = yp.shape[0]
 
-    y_hi, y_lo = split_hi_lo(yp)
     xx = jnp.sum(x * x, axis=1, keepdims=True)                  # [Q,1] f32
     yy = jnp.sum(yp * yp, axis=1)[None, :]                      # [1,M] f32
+    if metric == "ip":
+        y_hi, y_lo = split_hi_lo(yp * 0.5)
+        xx_k = jnp.zeros((Q, 1), jnp.float32)
+        yy_k = jnp.zeros((1, M), jnp.float32)
+    else:
+        y_hi, y_lo = split_hi_lo(yp)
+        xx_k, yy_k = xx, yy
     m_real = jnp.full((1,), m, jnp.int32)
 
     m1, i1, m2min = fused_l2_slot_topk(
-        x, y_hi, y_lo, xx, yy, m_real, T=T, Qb=Qb, passes=passes)
+        x, y_hi, y_lo, xx_k, yy_k, m_real, T=T, Qb=Qb, passes=passes)
     S = m1.shape[1]
 
     a1, id1, a2, id2, a3 = _fold_group_top2(m1, i1, g)
@@ -123,11 +135,16 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int
     # exact f32 rescore of the C candidates (gather + HIGHEST contraction)
     safe_pid = jnp.maximum(cand_pid, 0)
     yc = jnp.take(y, safe_pid, axis=0)                          # [Q, C, d]
-    d2c = (xx + jnp.sum(yc * yc, axis=2)
-           - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
-                              precision=jax.lax.Precision.HIGHEST))
+    if metric == "ip":
+        d2c = -jnp.einsum("qd,qcd->qc", x, yc,
+                          precision=jax.lax.Precision.HIGHEST)
+    else:
+        d2c = (xx + jnp.sum(yc * yc, axis=2)
+               - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
+                                  precision=jax.lax.Precision.HIGHEST))
+        d2c = jnp.maximum(d2c, 0.0)
     d2c = jnp.where((cand_pid >= 0) & jnp.isfinite(cand_v_hat),
-                    jnp.maximum(d2c, 0.0), jnp.inf)
+                    d2c, jnp.inf)
     neg_k, ord_k = jax.lax.top_k(-d2c, k)
     vals = -neg_k                                               # exact, asc
     ids = jnp.take_along_axis(cand_pid, ord_k, axis=1)
@@ -154,13 +171,18 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int
         def body(j, carry):
             bv, bi = carry
             yt = jax.lax.dynamic_slice_in_dim(yp, j * T, T, axis=0)
-            d2 = (xs[:, None] + jnp.sum(yt * yt, axis=1)[None, :]
-                  - 2.0 * jax.lax.dot_general(
-                      xq, yt, (((1,), (1,)), ((), ())),
-                      precision=jax.lax.Precision.HIGHEST,
-                      preferred_element_type=jnp.float32))
+            s = jax.lax.dot_general(
+                xq, yt, (((1,), (1,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32)
+            if metric == "ip":
+                d2 = -s
+            else:
+                d2 = jnp.maximum(
+                    xs[:, None] + jnp.sum(yt * yt, axis=1)[None, :] - 2.0 * s,
+                    0.0)
             col = j * T + jnp.arange(T, dtype=jnp.int32)
-            d2 = jnp.where(col[None, :] < m, jnp.maximum(d2, 0.0), jnp.inf)
+            d2 = jnp.where(col[None, :] < m, d2, jnp.inf)
             av = jnp.concatenate([bv, d2], axis=1)
             ai = jnp.concatenate(
                 [bi, jnp.broadcast_to(col[None, :], d2.shape)], axis=1)
@@ -236,15 +258,21 @@ def fused_defaults() -> Tuple[int, int, int]:
 
 def knn_fused(x, y, k: int, passes: int = 3,
               T: Optional[int] = None, Qb: Optional[int] = None,
-              g: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
-    """Certified fused brute-force KNN (squared-L2, ascending).
+              g: Optional[int] = None, metric: str = "l2"
+              ) -> Tuple[jax.Array, jax.Array]:
+    """Certified fused brute-force KNN.
 
-    Returns (d2 [Q, k] f32 exact, ids [Q, k] int32). ``passes=3`` is
-    certified-exact w.r.t. f32 distances; ``passes=1`` trades that for
-    ~3× contraction speed (exact w.r.t. bf16 scores). See module doc.
-    ``T``/``Qb``/``g`` default to :func:`fused_defaults` (measured-best
-    when a tuning table is committed).
+    ``metric="l2"`` (default): (d2 [Q, k] f32 exact ascending, ids).
+    ``metric="ip"``: (scores = x·y [Q, k] f32 exact DESCENDING, ids) —
+    the same kernel fed zeros for the norm terms and y/2 operands (see
+    _knn_fused). ``passes=3`` is certified-exact w.r.t. f32 scores;
+    ``passes=1`` trades that for ~3× contraction speed (exact w.r.t.
+    bf16 scores). ``T``/``Qb``/``g`` default to :func:`fused_defaults`
+    (measured-best when a tuning table is committed).
     """
+    if metric not in ("l2", "ip"):
+        raise ValueError(f"knn_fused: metric must be 'l2' or 'ip', "
+                         f"got {metric!r}")
     dT, dQb, dg = fused_defaults()
     T = dT if T is None else T
     Qb = dQb if Qb is None else Qb
@@ -270,7 +298,7 @@ def knn_fused(x, y, k: int, passes: int = 3,
     if Q > _Q_CHUNK:
         # bound the [Q, S] slot arrays / rescore gather: chunk the queries
         outs = [knn_fused(x[s:s + _Q_CHUNK], y, k, passes=passes,
-                          T=T, Qb=Qb, g=g)
+                          T=T, Qb=Qb, g=g, metric=metric)
                 for s in range(0, Q, _Q_CHUNK)]
         return (jnp.concatenate([o[0] for o in outs]),
                 jnp.concatenate([o[1] for o in outs]))
@@ -284,5 +312,8 @@ def knn_fused(x, y, k: int, passes: int = 3,
     qpad = (-Q) % Qb
     if qpad:
         x = jnp.concatenate([x, jnp.zeros((qpad, x.shape[1]), x.dtype)])
-    vals, ids = _knn_fused(x, y, k=k, T=T, Qb=Qb, g=g, passes=passes)
+    vals, ids = _knn_fused(x, y, k=k, T=T, Qb=Qb, g=g, passes=passes,
+                           metric=metric)
+    if metric == "ip":
+        return -vals[:Q], ids[:Q]   # internal −x·y ascending → IP desc
     return vals[:Q], ids[:Q]

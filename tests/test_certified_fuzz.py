@@ -98,3 +98,34 @@ def test_fuzz_knn_fused(seed):
     np.testing.assert_allclose(true_d, ref, atol=tol)
     for q in range(Q):
         assert np.unique(ids[q]).size == k
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_knn_fused_ip(seed):
+    """Same fuzz contract for the inner-product mode: exact top-k of x·y
+    (descending), unique ids whose true IPs match the returned values."""
+    rng = np.random.default_rng(3000 + seed)
+    Q = int(rng.integers(4, 40))
+    m = int(rng.integers(600, 4000))
+    d = int(rng.integers(3, 70))
+    k = int(rng.integers(1, 17))
+    if seed % 2:
+        base = rng.normal(size=(max(4, m // 50), d)).astype(np.float32)
+        y = base[rng.integers(0, base.shape[0], m)] \
+            + 1e-3 * rng.normal(size=(m, d)).astype(np.float32)
+        x = base[rng.integers(0, base.shape[0], Q)].astype(np.float32)
+    else:
+        y = rng.normal(size=(m, d)).astype(np.float32)
+        x = rng.normal(size=(Q, d)).astype(np.float32)
+    vals, ids = knn_fused(x, y, k=k, passes=3, T=512, Qb=64, g=8,
+                          metric="ip")
+    ip = x.astype(np.float64) @ y.astype(np.float64).T
+    ref = np.sort(ip, axis=1)[:, ::-1][:, :k]
+    tol = 8 * float(np.abs(ip).max()) * 2.0 ** -24 + 1e-6
+    np.testing.assert_allclose(np.asarray(vals), ref, atol=tol,
+                               err_msg=f"Q={Q} m={m} d={d} k={k} s={seed}")
+    ids = np.asarray(ids)
+    true_ip = np.take_along_axis(ip, ids, axis=1)
+    np.testing.assert_allclose(true_ip, ref, atol=tol)
+    for q in range(Q):
+        assert np.unique(ids[q]).size == k

@@ -127,14 +127,46 @@ def test_knn_fused_euclidean_sqrt():
                                rtol=1e-5, atol=1e-5)
 
 
-def test_fused_inner_product_rejected():
+def test_fused_inner_product_matches_oracle():
+    """metric='inner_product' on the fused pipeline (−x·y scoring via
+    zeroed norm terms + y/2 operands) matches an f64 oracle and the
+    streamed IP sweep."""
     from raft_tpu import distance
-    from raft_tpu.core.error import LogicError
 
-    x = rng.normal(size=(16, 32)).astype(np.float32)
+    x = rng.normal(size=(48, 32)).astype(np.float32)
     y = rng.normal(size=(4096, 32)).astype(np.float32)
-    with pytest.raises(LogicError):
-        distance.knn(None, y, x, k=4, metric="inner_product", algo="fused")
+    ip = x.astype(np.float64) @ y.astype(np.float64).T
+    want_idx = np.argsort(-ip, axis=1, kind="stable")[:, :8]
+    want = np.take_along_axis(ip, want_idx, axis=1)
+    vf, if_ = distance.knn(None, y, x, k=8, metric="inner_product",
+                           algo="fused")
+    vs, is_ = distance.knn(None, y, x, k=8, metric="inner_product",
+                           algo="streamed")
+    assert np.array_equal(np.sort(np.asarray(if_), 1), np.sort(want_idx, 1))
+    assert np.array_equal(np.sort(np.asarray(is_), 1), np.sort(want_idx, 1))
+    np.testing.assert_allclose(np.asarray(vf), want, rtol=1e-4, atol=1e-4)
+    # fused values are exact-rescored and DESCENDING like the IP sweep
+    assert (np.diff(np.asarray(vf), axis=1) <= 1e-6).all()
+
+
+def test_fused_ip_clustered_forces_fixup():
+    """Near-duplicate index points share slots → the IP certificate
+    fails → fixup path; the result must still be oracle-exact.
+    Q=256 > _FIXUP_BATCH so the small_fixup scatter branch is reachable
+    (Q ≤ 128 can only take the full fallback)."""
+    Q, m, d, k = 256, 4096, 64, 16
+    base = rng.normal(size=(40, d)).astype(np.float32)
+    y = base[rng.integers(0, 40, m)] + 1e-3 * rng.normal(
+        size=(m, d)).astype(np.float32)
+    x = base[rng.integers(0, 40, Q)] + 1e-3 * rng.normal(
+        size=(Q, d)).astype(np.float32)
+    vals, ids = knn_fused(x, y, k=k, passes=3, T=512, Qb=64, g=8,
+                          metric="ip")
+    ip = x.astype(np.float64) @ y.astype(np.float64).T
+    want = np.sort(ip, axis=1)[:, ::-1][:, :k]
+    scale = float(np.abs(ip).max())
+    np.testing.assert_allclose(np.asarray(vals), want,
+                               atol=8 * scale * 2.0 ** -24)
 
 
 def test_fused_defaults_table(tmp_path, monkeypatch):
