@@ -80,6 +80,14 @@ def main():
                                          algo=SelectAlgo.SLOTTED)[0],
                X[: (n // 64) * 64]), fbytes)
     if res.platform == "tpu":
+        # inexact ceiling (recall 0.95); off-TPU approx_min_k silently
+        # lowers to exact top-k, which would duplicate the XLA row under
+        # a misleading label
+        rec("matrix.select_k(64,approx)",
+            fx.run(lambda a: matrix.select_k(
+                res, a.reshape(-1, d * 64), k=64,
+                algo=SelectAlgo.APPROX)[0], X[: (n // 64) * 64]), fbytes)
+    if res.platform == "tpu":
         # fused variants are Pallas kernels: off-TPU they run interpreted
         # (minutes-slow, meaningless numbers) — TPU lane only
         nq = 1024

@@ -116,11 +116,13 @@ def select_k(
     select_min: bool = True,
     sorted: bool = True,  # noqa: A002
     algo: SelectAlgo = SelectAlgo.AUTO,
+    recall_target: float = 0.95,
 ) -> Tuple[jax.Array, jax.Array]:
     """Select the k smallest (or largest) entries per row.
 
     Returns ``(out_val [batch, k], out_idx [batch, k])``.
-    (ref: matrix/select_k.cuh:75)
+    (ref: matrix/select_k.cuh:75) ``recall_target`` applies to
+    ``SelectAlgo.APPROX`` only (inexact by contract; see select_k_types).
 
     Examples
     --------
@@ -162,6 +164,13 @@ def select_k(
                     f"select_k: explicit algo=SLOTTED outside its "
                     f"envelope ({e}); falling back to XLA top-k",
                     RuntimeWarning, stacklevel=2)
+
+    if algo == SelectAlgo.APPROX:
+        # XLA's TPU-hardware aggregate top-k (recall-targeted, INEXACT —
+        # see select_k_types). Returns positions; gather the caller ids.
+        fn = jax.lax.approx_min_k if select_min else jax.lax.approx_max_k
+        vals_a, pos = fn(in_val, k, recall_target=float(recall_target))
+        return vals_a, jnp.take_along_axis(in_idx, pos, axis=1)
 
     if algo in (SelectAlgo.BITONIC, SelectAlgo.RADIX):
         # BITONIC is an alias of the one Pallas kernel (radix): the

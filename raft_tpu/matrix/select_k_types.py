@@ -20,6 +20,12 @@ memory histograms. The variants that exist here:
                       for API parity; on TPU the filtered-queue role is
                       played by SLOTTED (no warp shuffles exist to build
                       a bitonic queue from)
+- ``APPROX``        — ``jax.lax.approx_min_k/approx_max_k``: XLA's
+                      TPU-hardware aggregate top-k with a recall target
+                      (default 0.95). INEXACT by contract — a TPU-native
+                      extension with no reference counterpart (the
+                      reference's approximate selection lives in ANN,
+                      which moved to cuVS). AUTO never chooses it.
 
 The CUDA names are kept as aliases so reference-written code dispatches
 meaningfully.
@@ -36,6 +42,7 @@ class SelectAlgo(enum.Enum):
     SLOTTED = "slotted"
     BITONIC = "bitonic"
     RADIX = "radix"
+    APPROX = "approx"
 
     # reference-name aliases → nearest TPU variant
     @classmethod

@@ -189,3 +189,26 @@ def test_select_k_validation(res):
 def test_reference_algo_names():
     assert SelectAlgo.from_reference_name("kRadix11bits") == SelectAlgo.RADIX
     assert SelectAlgo.from_reference_name("kWarpImmediate") == SelectAlgo.BITONIC
+
+
+def test_select_k_approx(res):
+    """SelectAlgo.APPROX (lax.approx_min/max_k, recall-targeted) hits its
+    recall contract for both directions and AUTO never picks it."""
+    from raft_tpu.matrix.select_k import choose_select_k_algorithm
+
+    v = np.asarray(rng.normal(size=(8, 8192)), np.float32)
+    for select_min in (True, False):
+        av, ai = matrix.select_k(res, v, k=32, select_min=select_min,
+                                 algo=SelectAlgo.APPROX,
+                                 recall_target=0.95)
+        order = np.sort(v, axis=1)
+        ref = order[:, :32] if select_min else order[:, ::-1][:, :32]
+        recall = np.mean([
+            len(set(np.asarray(av)[b]) & set(ref[b])) / 32
+            for b in range(v.shape[0])])
+        assert recall >= 0.9, recall
+        # returned ids index the returned values
+        np.testing.assert_allclose(
+            np.take_along_axis(v, np.asarray(ai), axis=1), np.asarray(av))
+    for b, l, k in [(16, 16384, 16), (64, 1048576, 64), (1, 100, 5)]:
+        assert choose_select_k_algorithm(b, l, k) is not SelectAlgo.APPROX
