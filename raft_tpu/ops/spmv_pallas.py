@@ -285,6 +285,13 @@ def spmm_tiled(tiled, B) -> jax.Array:
     if B.ndim != 2 or B.shape[0] != n_cols:
         raise ValueError(f"spmm_tiled: B must be [{n_cols}, V]")
     V = B.shape[1]
+    if V > 512:
+        # the [1, C, V] x-tile and [1, E, V] contribution blocks are
+        # VMEM-resident; past this width Mosaic fails to fit them with an
+        # opaque error — fail early with an actionable one instead
+        raise NotImplementedError(
+            f"spmm_tiled targets V <= 512 dense columns (VMEM tile); got "
+            f"{V} — chunk B column-wise or use the COO/CSR path")
     pad = tiled.n_col_tiles * tiled.C - n_cols
     if pad:
         B = jnp.concatenate([B, jnp.zeros((pad, V), jnp.float32)])

@@ -196,3 +196,14 @@ def test_spmm_tiled_validates_B():
         spmm_tiled(tiled, np.zeros((99, 4), np.float32))   # wrong n_cols
     with pytest.raises(ValueError, match="B must be"):
         spmm_tiled(tiled, np.zeros((100,), np.float32))    # 1-D
+
+
+def test_spmm_tiled_v_envelope():
+    m = _random_csr(512, 512, 0.02)
+    A = CSRMatrix(np.asarray(m.indptr, np.int32),
+                  np.asarray(m.indices, np.int32),
+                  m.data.astype(np.float32), m.shape)
+    tiled = prepare_spmv(A)
+    B = rng.normal(size=(512, 600)).astype(np.float32)
+    with pytest.raises(NotImplementedError, match="V <= 512"):
+        linalg.spmm(None, tiled, B)
