@@ -96,6 +96,19 @@ def _smoke_spmm_tiled():
     np.testing.assert_allclose(Y, m @ B, rtol=5e-4, atol=5e-4)
 
 
+def _smoke_fused_l2_topk_dchunk():
+    """Wide-feature (d > 512) variant: the d-chunked kernel with the VMEM
+    scratch score accumulator."""
+    from raft_tpu.distance.knn_fused import knn_fused
+
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(128, 768)).astype(np.float32)
+    y = rng.normal(size=(8192, 768)).astype(np.float32)
+    vals, ids = knn_fused(x, y, k=8, passes=3)
+    d2 = ((x[:, None, :] - y[np.asarray(ids)]) ** 2).sum(-1)
+    np.testing.assert_allclose(np.asarray(vals), d2, rtol=1e-3, atol=1e-2)
+
+
 def _smoke_sddmm_tiled():
     import scipy.sparse as sp
 
@@ -129,6 +142,7 @@ def _smoke_histogram_blocked():
 KERNELS = {
     "select_k_radix": _smoke_select_k_radix,
     "fused_l2_topk": _smoke_fused_l2_topk,
+    "fused_l2_topk_dchunk": _smoke_fused_l2_topk_dchunk,
     "spmv_tiled": _smoke_spmv_tiled,
     "spmm_tiled": _smoke_spmm_tiled,
     "sddmm_tiled": _smoke_sddmm_tiled,

@@ -258,8 +258,10 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
 
     _T, _, _g = fused_defaults()
     fused_pool = (2 * 128 // _g) * -(-max(n, _T) // _T)
+    # d ≤ 512 takes the single-shot kernel; wider features take the
+    # d-chunked kernel (VMEM scratch accumulator) up to a pragmatic cap
     auto_fused = (algo == "auto" and jax.default_backend() == "tpu"
-                  and queries.shape[1] <= 512 and n >= 4096
+                  and queries.shape[1] <= 4096 and n >= 4096
                   and k <= fused_pool)
     if forced_fused or auto_fused:
         from raft_tpu.distance.knn_fused import knn_fused

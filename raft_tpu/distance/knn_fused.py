@@ -52,7 +52,12 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.ops.fused_l2_topk_pallas import (
-    _LANES, fused_l2_slot_topk, split_hi_lo)
+    _LANES, fused_l2_slot_topk, fused_l2_slot_topk_dchunk, split_hi_lo)
+
+# past this feature width the single-shot kernel's [Qb/T, d] VMEM tiles
+# stop fitting; the d-chunked kernel (VMEM scratch accumulator) takes over
+_D_SINGLE_SHOT = 512
+_DC = 256          # d-chunk width for the wide-feature kernel
 
 # static fixup batch: queries whose certificate failed re-run exactly
 _FIXUP_BATCH = 128
@@ -119,8 +124,13 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
         xx_k, yy_k = xx, yy
     m_real = jnp.full((1,), m, jnp.int32)
 
-    m1, i1, m2min = fused_l2_slot_topk(
-        x, y_hi, y_lo, xx_k, yy_k, m_real, T=T, Qb=Qb, passes=passes)
+    if d > _D_SINGLE_SHOT:
+        m1, i1, m2min = fused_l2_slot_topk_dchunk(
+            x, y_hi, y_lo, xx_k, yy_k, m_real, T=T, Qb=Qb, passes=passes,
+            dc=_DC)
+    else:
+        m1, i1, m2min = fused_l2_slot_topk(
+            x, y_hi, y_lo, xx_k, yy_k, m_real, T=T, Qb=Qb, passes=passes)
     S = m1.shape[1]
 
     a1, id1, a2, id2, a3 = _fold_group_top2(m1, i1, g)
@@ -290,8 +300,6 @@ def knn_fused(x, y, k: int, passes: int = 3,
         raise NotImplementedError(
             f"knn_fused: k={k} too large for pool size {pool} "
             f"(shrink g or T, or use the streamed path)")
-    if d > 512:
-        raise NotImplementedError("knn_fused targets d <= 512 (VMEM tile)")
     if S % min(g, S) != 0:
         raise NotImplementedError(
             f"knn_fused: group size g={g} must divide the slot count {S}")
@@ -302,8 +310,9 @@ def knn_fused(x, y, k: int, passes: int = 3,
                 for s in range(0, Q, _Q_CHUNK)]
         return (jnp.concatenate([o[0] for o in outs]),
                 jnp.concatenate([o[1] for o in outs]))
-    # pad feature dim to the lane width, queries to the block size
-    dpad = (-d) % _LANES
+    # pad feature dim to the lane width (d-chunk width for the wide
+    # kernel), queries to the block size
+    dpad = (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
     if dpad:
         zx = jnp.zeros((Q, dpad), jnp.float32)
         x = jnp.concatenate([x, zx], axis=1)

@@ -46,36 +46,34 @@ from raft_tpu.ops.utils import interpret_mode
 _LANES = 128
 
 
-def _fused_kernel(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
-                  m1_ref, i1_ref, m2min_ref,
-                  *, T: int, Qb: int, ylo_ref=None):
-    """One (query-block, index-tile) cell. ``ylo_ref`` present ⇒ bf16x3."""
-    j = pl.program_id(1)
-    n_chunks = T // _LANES
-
-    x = x_ref[...]                                   # [Qb, d] f32
-    yhi = yhi_ref[...]                               # [T, d] bf16
+def _contract(x, yhi, ylo):
+    """bf16 (ylo None) or bf16x3 MXU contraction of an f32 x block with a
+    bf16-split y tile → f32 [Qb, T] partial scores."""
     xhi = x.astype(jnp.bfloat16)
     s = jax.lax.dot_general(
         xhi, yhi, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)          # [Qb, T]
-    if ylo_ref is not None:
+        preferred_element_type=jnp.float32)
+    if ylo is not None:
         xlo = (x - xhi.astype(jnp.float32)).astype(jnp.bfloat16)
-        ylo = ylo_ref[...]
         s = s + jax.lax.dot_general(
             xhi, ylo, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         s = s + jax.lax.dot_general(
             xlo, yhi, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+    return s
 
-    d2 = xx_ref[...] + yy_ref[...] - 2.0 * s         # [Qb,1]+[1,T]-[Qb,T]
 
+def _fold_and_write(d2, j, m_real_ref, m1_ref, i1_ref, m2min_ref,
+                    T: int, Qb: int):
+    """Mask padded index rows, fold the [Qb, T] distance tile into LANES
+    slots (per-slot top-2 + argmin-1), and write/accumulate the outputs.
+    Shared by the single-shot and d-chunked kernels."""
+    n_chunks = T // _LANES
     # mask padded index rows (global col ≥ m_real) to +inf
     col = j * T + jax.lax.broadcasted_iota(jnp.int32, (Qb, T), 1)
     d2 = jnp.where(col < m_real_ref[0], d2, jnp.inf)
 
-    # fold the T columns into LANES slots, keeping per-slot top-2 + argmin-1.
     # slot class c collects columns {c, c+128, c+256, ...} of this tile
     # (chunk r contributes its lane c as global column j*T + r*128 + c).
     inf = jnp.full((Qb, _LANES), jnp.inf, jnp.float32)
@@ -101,6 +99,92 @@ def _fused_kernel(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
     @pl.when(j != 0)
     def _():
         m2min_ref[...] = jnp.minimum(m2min_ref[...], a2)
+
+
+def _fused_kernel(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
+                  m1_ref, i1_ref, m2min_ref,
+                  *, T: int, Qb: int, ylo_ref=None):
+    """One (query-block, index-tile) cell. ``ylo_ref`` present ⇒ bf16x3."""
+    j = pl.program_id(1)
+    s = _contract(x_ref[...], yhi_ref[...],
+                  None if ylo_ref is None else ylo_ref[...])
+    d2 = xx_ref[...] + yy_ref[...] - 2.0 * s         # [Qb,1]+[1,T]-[Qb,T]
+    _fold_and_write(d2, j, m_real_ref, m1_ref, i1_ref, m2min_ref,
+                    T=T, Qb=Qb)
+
+
+def _fused_kernel_dchunk(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
+                         m1_ref, i1_ref, m2min_ref, acc_ref,
+                         *, T: int, Qb: int, ylo_ref=None):
+    """d-chunked cell (grid (nq, n_tiles, n_dchunks), d innermost): the
+    partial contraction accumulates into a VMEM scratch [Qb, T]; the
+    mask+fold runs only on the LAST d-chunk. Lifts the d ≤ 512 envelope
+    — the d2 tile still never touches HBM."""
+    j = pl.program_id(1)
+    l = pl.program_id(2)
+    n_dc = pl.num_programs(2)
+    s = _contract(x_ref[...], yhi_ref[...],
+                  None if ylo_ref is None else ylo_ref[...])
+
+    @pl.when(l == 0)
+    def _():
+        acc_ref[...] = s
+
+    @pl.when(l != 0)
+    def _():
+        acc_ref[...] = acc_ref[...] + s
+
+    @pl.when(l == n_dc - 1)
+    def _():
+        d2 = xx_ref[...] + yy_ref[...] - 2.0 * acc_ref[...]
+        _fold_and_write(d2, j, m_real_ref, m1_ref, i1_ref, m2min_ref,
+                        T=T, Qb=Qb)
+
+
+# --- scaffolding shared by the single-shot and d-chunked calls (the
+# out-spec index maps take (i, j, *rest) so the same lambdas serve both
+# grid arities; *rest swallows the extra grid index + prefetch refs) ---
+
+def _slot_out_specs(Qb: int):
+    return [
+        pl.BlockSpec((Qb, _LANES), lambda i, j, *_: (i, j),
+                     memory_space=pltpu.VMEM),          # m1
+        pl.BlockSpec((Qb, _LANES), lambda i, j, *_: (i, j),
+                     memory_space=pltpu.VMEM),          # i1
+        pl.BlockSpec((Qb, _LANES), lambda i, j, *_: (i, 0),
+                     memory_space=pltpu.VMEM),          # m2min (revisited)
+    ]
+
+
+def _slot_out_shape(Q: int, S: int):
+    return [
+        jax.ShapeDtypeStruct((Q, S), jnp.float32),
+        jax.ShapeDtypeStruct((Q, S), jnp.int32),
+        jax.ShapeDtypeStruct((Q, _LANES), jnp.float32),
+    ]
+
+
+def _slot_cost(Q: int, M: int, d: int, S: int, passes: int):
+    return pl.CostEstimate(
+        flops=2 * Q * M * d * passes,
+        bytes_accessed=(Q * d * 4 + M * d * 2 * (2 if passes == 3 else 1)
+                        + Q * S * 8),
+        transcendentals=0,
+    )
+
+
+def _make_kernel(base, passes: int, T: int, Qb: int):
+    """Bind the base kernel for the passes mode; for passes == 3 reorder
+    the y_lo ref out of the positional stream (*rest carries the output
+    refs and, for the d-chunked kernel, the scratch ref)."""
+    if passes != 3:
+        return functools.partial(base, T=T, Qb=Qb, ylo_ref=None)
+
+    def kernel(m_real_ref, x_ref, yhi_ref, ylo_ref, xx_ref, yy_ref, *rest):
+        base(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref, *rest,
+             T=T, Qb=Qb, ylo_ref=ylo_ref)
+
+    return kernel
 
 
 @functools.partial(jax.jit, static_argnames=("T", "Qb", "passes"))
@@ -145,45 +229,78 @@ def fused_l2_slot_topk(x, y_hi, y_lo, xx, yy, m_real,
         in_specs.insert(2, pl.BlockSpec((T, d), lambda i, j, *_: (j, 0),
                                         memory_space=pltpu.VMEM))  # y_lo
         operands.insert(2, y_lo)
-
-        def kernel(m_real_ref, x_ref, yhi_ref, ylo_ref, xx_ref, yy_ref,
-                   m1_ref, i1_ref, m2min_ref):
-            _fused_kernel(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
-                          m1_ref, i1_ref, m2min_ref, T=T, Qb=Qb,
-                          ylo_ref=ylo_ref)
-    else:
-        kernel = functools.partial(_fused_kernel, T=T, Qb=Qb, ylo_ref=None)
+    kernel = _make_kernel(_fused_kernel, passes, T, Qb)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nq, n_tiles),
         in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((Qb, _LANES), lambda i, j, *_: (i, j),
-                         memory_space=pltpu.VMEM),          # m1
-            pl.BlockSpec((Qb, _LANES), lambda i, j, *_: (i, j),
-                         memory_space=pltpu.VMEM),          # i1
-            pl.BlockSpec((Qb, _LANES), lambda i, j, *_: (i, 0),
-                         memory_space=pltpu.VMEM),          # m2min (revisited)
-        ],
+        out_specs=_slot_out_specs(Qb),
     )
     m1, i1, m2min = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((Q, S), jnp.float32),
-            jax.ShapeDtypeStruct((Q, S), jnp.int32),
-            jax.ShapeDtypeStruct((Q, _LANES), jnp.float32),
-        ],
+        out_shape=_slot_out_shape(Q, S),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
-        cost_estimate=pl.CostEstimate(
-            flops=2 * Q * M * d * passes,
-            bytes_accessed=(Q * d * 4 + M * d * 2 * (2 if passes == 3 else 1)
-                            + Q * S * 8),
-            transcendentals=0,
+        cost_estimate=_slot_cost(Q, M, d, S, passes),
+        interpret=interpret_mode(),
+    )(m_real, *operands)
+    return m1, i1, m2min
+
+
+@functools.partial(jax.jit, static_argnames=("T", "Qb", "passes", "dc"))
+def fused_l2_slot_topk_dchunk(x, y_hi, y_lo, xx, yy, m_real,
+                              T: int, Qb: int, passes: int, dc: int = 256
+                              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """d-chunked variant of :func:`fused_l2_slot_topk` for wide features
+    (d > 512): grid (nq, n_tiles, d/dc) with the score tile accumulated
+    in VMEM scratch across d-chunks (see _fused_kernel_dchunk). Same
+    contract and outputs; caller pads d to a multiple of ``dc``."""
+    Q, d = x.shape
+    M = y_hi.shape[0]
+    if d % dc:
+        raise ValueError(
+            f"fused_l2_slot_topk_dchunk: d={d} must be a multiple of "
+            f"dc={dc} (the tail would be silently dropped)")
+    n_tiles = M // T
+    nq = Q // Qb
+    n_dc = d // dc
+    S = n_tiles * _LANES
+
+    in_specs = [
+        pl.BlockSpec((Qb, dc), lambda i, j, l, *_: (i, l),
+                     memory_space=pltpu.VMEM),          # x
+        pl.BlockSpec((T, dc), lambda i, j, l, *_: (j, l),
+                     memory_space=pltpu.VMEM),          # y_hi
+        pl.BlockSpec((Qb, 1), lambda i, j, *_: (i, 0),
+                     memory_space=pltpu.VMEM),          # xx
+        pl.BlockSpec((1, T), lambda i, j, *_: (0, j),
+                     memory_space=pltpu.VMEM),          # yy
+    ]
+    operands = [x, y_hi, xx, yy]
+    if passes == 3:
+        in_specs.insert(2, pl.BlockSpec((T, dc), lambda i, j, l, *_: (j, l),
+                                        memory_space=pltpu.VMEM))  # y_lo
+        operands.insert(2, y_lo)
+    kernel = _make_kernel(_fused_kernel_dchunk, passes, T, Qb)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nq, n_tiles, n_dc),
+        in_specs=in_specs,
+        out_specs=_slot_out_specs(Qb),
+        scratch_shapes=[pltpu.VMEM((Qb, T), jnp.float32)],  # score acc
+    )
+    m1, i1, m2min = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_slot_out_shape(Q, S),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
+        cost_estimate=_slot_cost(Q, M, d, S, passes),
         interpret=interpret_mode(),
     )(m_real, *operands)
     return m1, i1, m2min
