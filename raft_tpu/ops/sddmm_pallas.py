@@ -36,31 +36,36 @@ MAX_D = 512  # A/B tile depth envelope (VMEM)
 
 
 def _sddmm_kernel(rt_ref, ct_ref, a_ref, b_ref, rloc_ref, cloc_ref, out_ref,
-                  *, R: int, C: int, E: int):
-    a = a_ref[0]                                         # [R, d]
-    b = b_ref[0]                                         # [C, d]
-    d_blk = jax.lax.dot_general(
-        a, b, (((1,), (1,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32)              # [R, C]
+                  dblk_ref, *, R: int, C: int, E: int):
+    # The E axis is grid-blocked (see spmv_pallas layout note: in-kernel
+    # vector slicing leaves illegal lane offsets for vector.broadcast on
+    # Mosaic; full-block loads are offset-0). The dense [R, C] tile is
+    # computed once per chunk (b == 0) into VMEM scratch that persists
+    # across the chunk's sub-block steps.
+    b = pl.program_id(1)
 
-    rloc_all = rloc_ref[0]                               # [1, E]
-    cloc_all = cloc_ref[0]
-    iota_r = jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0)
-    iota_c = jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0)
-    for bi in range(E // _EB):
-        rloc = rloc_all[:, bi * _EB:(bi + 1) * _EB]      # [1, EB], pad = R
-        cloc = cloc_all[:, bi * _EB:(bi + 1) * _EB]
-        onehot_r = (jnp.broadcast_to(rloc, (R, _EB))
-                    == iota_r).astype(jnp.float32)       # [R, EB]
-        # Pt[c, e] = Σ_r D[r, c]·onehot_r[r, e] = D[rloc[e], c]
-        pt = jax.lax.dot_general(
-            d_blk, onehot_r, (((0,), (0,)), ((), ())),
+    @pl.when(b == 0)
+    def _():
+        dblk_ref[...] = jax.lax.dot_general(
+            a_ref[0], b_ref[0], (((1,), (1,)), ((), ())),
             precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32)          # [C, EB]
-        mask = jnp.broadcast_to(cloc, (C, _EB)) == iota_c
-        out_ref[0, :, bi * _EB:(bi + 1) * _EB] = jnp.sum(
-            jnp.where(mask, pt, 0.0), axis=0, keepdims=True)  # [1, EB]
+            preferred_element_type=jnp.float32)          # [R, C]
+
+    d_blk = dblk_ref[...]
+    rloc = rloc_ref[0]                                   # [1, EB], pad = R
+    cloc = cloc_ref[0]
+    onehot_r = (jnp.broadcast_to(rloc, (R, _EB))
+                == jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0)
+                ).astype(jnp.float32)                    # [R, EB]
+    # Pt[c, e] = Σ_r D[r, c]·onehot_r[r, e] = D[rloc[e], c]
+    pt = jax.lax.dot_general(
+        d_blk, onehot_r, (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)              # [C, EB]
+    mask = (jnp.broadcast_to(cloc, (C, _EB))
+            == jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0))
+    out_ref[0] = jnp.sum(jnp.where(mask, pt, 0.0), axis=0,
+                         keepdims=True)                  # [1, EB]
 
 
 @functools.partial(jax.jit, static_argnames=("R", "C", "E"))
@@ -68,21 +73,23 @@ def _sddmm_tiled_impl(a3, b3, row_local, col_local, chunk_row_tile,
                       chunk_col_tile, R: int, C: int, E: int) -> jax.Array:
     m_chunks = row_local.shape[0]
     d = a3.shape[2]
+    nb = E // _EB
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(m_chunks,),
+        grid=(m_chunks, nb),
         in_specs=[
-            pl.BlockSpec((1, R, d), lambda c, rt, ct: (rt[c], 0, 0),
+            pl.BlockSpec((1, R, d), lambda c, b, rt, ct: (rt[c], 0, 0),
                          memory_space=pltpu.VMEM),       # A row tile
-            pl.BlockSpec((1, C, d), lambda c, rt, ct: (ct[c], 0, 0),
+            pl.BlockSpec((1, C, d), lambda c, b, rt, ct: (ct[c], 0, 0),
                          memory_space=pltpu.VMEM),       # Bt col tile
-            pl.BlockSpec((1, 1, E), lambda c, rt, ct: (c, 0, 0),
+            pl.BlockSpec((1, 1, _EB), lambda c, b, rt, ct: (c, 0, b),
                          memory_space=pltpu.VMEM),       # row_local
-            pl.BlockSpec((1, 1, E), lambda c, rt, ct: (c, 0, 0),
+            pl.BlockSpec((1, 1, _EB), lambda c, b, rt, ct: (c, 0, b),
                          memory_space=pltpu.VMEM),       # col_local
         ],
-        out_specs=pl.BlockSpec((1, 1, E), lambda c, rt, ct: (c, 0, 0),
+        out_specs=pl.BlockSpec((1, 1, _EB), lambda c, b, rt, ct: (c, 0, b),
                                memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((R, C), jnp.float32)],  # dense tile
     )
     return pl.pallas_call(
         functools.partial(_sddmm_kernel, R=R, C=C, E=E),

@@ -25,11 +25,13 @@ all plain VPU ops every Mosaic version lowers:
 
 Layout note: x tiles and y tiles are carried as [n_tiles, C, 1] /
 [n_tiles, R, 1] and the chunk arrays as [n_chunks, 1, E]: the leading axis
-is grid-blocked and every block's trailing two dims EQUAL the array's
-(Mosaic's block-shape rule — trailing dims must be (8, 128)-divisible or
-equal; a (1, E) block over an (n_chunks, E) array violates it). In-kernel
-the [C, 1] tile still reduces along sublanes and the [1, E] chunk along
-lanes, so there is no in-kernel relayout.
+is grid-blocked and every block's trailing two dims EQUAL the array's or
+are (8, 128)-divisible (Mosaic's block-shape rule). The E axis is ALSO
+grid-blocked in ``_EB`` sub-blocks — slicing a loaded vector in-kernel
+leaves a lane offset in its layout (e.g. ``{*, 512}``) that Mosaic's
+apply-vector-layout pass rejects for ``vector.broadcast`` (caught on real
+v5e by the TPU smoke lane); full-block loads are always offset-0, so the
+sub-blocking lives in the grid, not the kernel body.
 
 Pad entries carry value 0 (gather side) / row_local = R (scatter side), so
 they contribute nothing. Row tiles with no nonzeros are never visited by
@@ -47,40 +49,34 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.ops.utils import interpret_mode
 
-_EB = 512    # sub-block of the chunk folded at a time (bounds VMEM temps)
+_EB = 512    # sub-block of the chunk folded per grid step (bounds VMEM)
 
 
 def _gather_kernel(col_tile_ref, vals_ref, cols_ref, xt_ref, out_ref,
-                   *, E: int, C: int):
+                   *, C: int):
     xt = xt_ref[0]                                     # [C, 1]
-    cols_all = cols_ref[0]                             # [1, E]
-    parts = []
-    for b in range(E // _EB):
-        cols = cols_all[:, b * _EB:(b + 1) * _EB]      # [1, EB]
-        onehot = (jnp.broadcast_to(cols, (C, _EB))
-                  == jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0))
-        parts.append(jnp.sum(jnp.where(onehot, xt, 0.0), axis=0,
-                             keepdims=True))           # [1, EB]
-    out_ref[0] = vals_ref[0] * jnp.concatenate(parts, axis=1)
+    cols = cols_ref[0]                                 # [1, EB]
+    onehot = (jnp.broadcast_to(cols, (C, _EB))
+              == jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0))
+    contrib = jnp.sum(jnp.where(onehot, xt, 0.0), axis=0,
+                      keepdims=True)                   # [1, EB]
+    out_ref[0] = vals_ref[0] * contrib
 
 
 def _scatter_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
-                    *, E: int, R: int):
+                    *, R: int):
     c = pl.program_id(0)
+    b = pl.program_id(1)
     cur = row_tile_ref[c]
     prev = row_tile_ref[jnp.maximum(c - 1, 0)]
-    first = (c == 0) | (cur != prev)
+    first = (((c == 0) | (cur != prev))) & (b == 0)
 
-    acc = jnp.zeros((R, 1), jnp.float32)
-    rloc_all = rloc_ref[0]                             # [1, E]
-    contrib_all = contrib_ref[0]
-    for b in range(E // _EB):
-        rloc = rloc_all[:, b * _EB:(b + 1) * _EB]      # [1, EB], pad = R
-        contrib = contrib_all[:, b * _EB:(b + 1) * _EB]
-        onehot = (jnp.broadcast_to(rloc, (R, _EB))
-                  == jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0))
-        acc = acc + jnp.sum(jnp.where(onehot, contrib, 0.0), axis=1,
-                            keepdims=True)             # [R, 1]
+    rloc = rloc_ref[0]                                 # [1, EB], pad = R
+    contrib = contrib_ref[0]                           # [1, EB]
+    onehot = (jnp.broadcast_to(rloc, (R, _EB))
+              == jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0))
+    acc = jnp.sum(jnp.where(onehot, contrib, 0.0), axis=1,
+                  keepdims=True)                       # [R, 1]
 
     @pl.when(first)
     def _():
@@ -99,28 +95,28 @@ def _spmv_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
                      n_col_tiles: int, n_row_tiles: int) -> jax.Array:
     n_chunks = vals.shape[0]
     m_chunks = row_local.shape[0]
-    # 3-D carriers so every block's trailing two dims EQUAL the array's
-    # trailing dims (Mosaic's block-shape rule; a (1, E) block over an
-    # (n_chunks, E) array fails it — caught by the TPU smoke lane)
+    nb = E // _EB
     xt = x_padded.reshape(n_col_tiles, C, 1)           # [n_tiles, C, 1]
 
     contrib = pl.pallas_call(
-        functools.partial(_gather_kernel, E=E, C=C),
+        functools.partial(_gather_kernel, C=C),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(n_chunks,),
+            grid=(n_chunks, nb),
             in_specs=[
-                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
+                pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
                              memory_space=pltpu.VMEM),   # vals
-                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
+                pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
                              memory_space=pltpu.VMEM),   # cols
-                pl.BlockSpec((1, C, 1), lambda c, m: (m[c], 0, 0),
+                pl.BlockSpec((1, C, 1), lambda c, b, m: (m[c], 0, 0),
                              memory_space=pltpu.VMEM),   # x tile
             ],
-            out_specs=pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
+            out_specs=pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
                                    memory_space=pltpu.VMEM),
         ),
         out_shape=jax.ShapeDtypeStruct((n_chunks, 1, E), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret_mode(),
     )(chunk_col_tile, vals[:, None, :], col_local[:, None, :], xt)
 
@@ -128,22 +124,22 @@ def _spmv_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
         contrib.reshape(-1), perm.reshape(-1)).reshape(m_chunks, 1, E)
 
     y3d = pl.pallas_call(
-        functools.partial(_scatter_kernel, E=E, R=R),
+        functools.partial(_scatter_kernel, R=R),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(m_chunks,),
+            grid=(m_chunks, nb),
             in_specs=[
-                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
+                pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
                              memory_space=pltpu.VMEM),   # contrib
-                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
+                pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
                              memory_space=pltpu.VMEM),   # row_local
             ],
-            out_specs=pl.BlockSpec((1, R, 1), lambda c, m: (m[c], 0, 0),
+            out_specs=pl.BlockSpec((1, R, 1), lambda c, b, m: (m[c], 0, 0),
                                    memory_space=pltpu.VMEM),
         ),
         out_shape=jax.ShapeDtypeStruct((n_row_tiles, R, 1), jnp.float32),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+            dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret_mode(),
     )(chunk_row_tile, contrib_sorted, row_local[:, None, :])
     return y3d[:, :, 0]                                # [n_row_tiles, R]
@@ -172,45 +168,40 @@ def spmv_tiled(tiled, x) -> jax.Array:
 
 
 def _gather_mm_kernel(col_tile_ref, vals_ref, cols_ref, x_ref, out_ref,
-                      *, E: int, C: int, V: int):
+                      *, C: int, V: int):
     """contrib[e, :] = val[e] · x_tile[col[e], :] via onehotᵀ @ x — for
     V ≥ ~8 columns the MXU does the selection (the one-hot rows are
     exactly representable in bf16, so with HIGHEST precision the gather
     error is the bf16x3 split residual of x, ~2⁻¹⁶ relative)."""
     x = x_ref[0]                                         # [C, V]
-    cols_all = cols_ref[0]                               # [1, E]
-    for b in range(E // _EB):
-        cols = cols_all[:, b * _EB:(b + 1) * _EB]        # [1, EB]
-        onehot = (jnp.broadcast_to(cols, (C, _EB))
-                  == jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0)
-                  ).astype(jnp.float32)                  # [C, EB]
-        g = jax.lax.dot_general(
-            onehot, x, (((0,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32)          # [EB, V]
-        vals = vals_ref[0, 0, b * _EB:(b + 1) * _EB]     # [EB]
-        out_ref[0, b * _EB:(b + 1) * _EB, :] = vals[:, None] * g
+    cols = cols_ref[0]                                   # [1, EB]
+    onehot = (jnp.broadcast_to(cols, (C, _EB))
+              == jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0)
+              ).astype(jnp.float32)                      # [C, EB]
+    g = jax.lax.dot_general(
+        onehot, x, (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)              # [EB, V]
+    out_ref[0] = vals_ref[0] * g                         # vals [EB, 1]
 
 
 def _scatter_mm_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
-                       *, E: int, R: int, V: int):
+                       *, R: int, V: int):
     c = pl.program_id(0)
+    b = pl.program_id(1)
     cur = row_tile_ref[c]
     prev = row_tile_ref[jnp.maximum(c - 1, 0)]
-    first = (c == 0) | (cur != prev)
+    first = ((c == 0) | (cur != prev)) & (b == 0)
 
-    acc = jnp.zeros((R, V), jnp.float32)
-    rloc_all = rloc_ref[0]                               # [1, E]
-    for b in range(E // _EB):
-        rloc = rloc_all[:, b * _EB:(b + 1) * _EB]        # [1, EB], pad = R
-        onehot = (jnp.broadcast_to(rloc, (R, _EB))
-                  == jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0)
-                  ).astype(jnp.float32)                  # [R, EB]
-        contrib = contrib_ref[0, b * _EB:(b + 1) * _EB, :]  # [EB, V]
-        acc = acc + jax.lax.dot_general(
-            onehot, contrib, (((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32)          # [R, V]
+    rloc = rloc_ref[0]                                   # [1, EB], pad = R
+    onehot = (jnp.broadcast_to(rloc, (R, _EB))
+              == jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0)
+              ).astype(jnp.float32)                      # [R, EB]
+    contrib = contrib_ref[0]                             # [EB, V]
+    acc = jax.lax.dot_general(
+        onehot, contrib, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)              # [R, V]
 
     @pl.when(first)
     def _():
@@ -229,48 +220,52 @@ def _spmm_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
                      n_col_tiles: int, n_row_tiles: int) -> jax.Array:
     n_chunks = vals.shape[0]
     m_chunks = row_local.shape[0]
+    nb = E // _EB
     x3d = B_padded.reshape(n_col_tiles, C, V)
+    vals3 = vals.reshape(n_chunks, E, 1)                 # [EB, 1] blocks
 
     contrib = pl.pallas_call(
-        functools.partial(_gather_mm_kernel, E=E, C=C, V=V),
+        functools.partial(_gather_mm_kernel, C=C, V=V),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(n_chunks,),
+            grid=(n_chunks, nb),
             in_specs=[
-                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
+                pl.BlockSpec((1, _EB, 1), lambda c, b, m: (c, b, 0),
                              memory_space=pltpu.VMEM),   # vals
-                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
+                pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
                              memory_space=pltpu.VMEM),   # cols
-                pl.BlockSpec((1, C, V), lambda c, m: (m[c], 0, 0),
+                pl.BlockSpec((1, C, V), lambda c, b, m: (m[c], 0, 0),
                              memory_space=pltpu.VMEM),   # x tile
             ],
-            out_specs=pl.BlockSpec((1, E, V), lambda c, m: (c, 0, 0),
+            out_specs=pl.BlockSpec((1, _EB, V), lambda c, b, m: (c, b, 0),
                                    memory_space=pltpu.VMEM),
         ),
         out_shape=jax.ShapeDtypeStruct((n_chunks, E, V), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret_mode(),
-    )(chunk_col_tile, vals[:, None, :], col_local[:, None, :], x3d)
+    )(chunk_col_tile, vals3, col_local[:, None, :], x3d)
 
     contrib_sorted = jnp.take(contrib.reshape(-1, V), perm.reshape(-1),
                               axis=0).reshape(m_chunks, E, V)
 
     y3d = pl.pallas_call(
-        functools.partial(_scatter_mm_kernel, E=E, R=R, V=V),
+        functools.partial(_scatter_mm_kernel, R=R, V=V),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(m_chunks,),
+            grid=(m_chunks, nb),
             in_specs=[
-                pl.BlockSpec((1, E, V), lambda c, m: (c, 0, 0),
+                pl.BlockSpec((1, _EB, V), lambda c, b, m: (c, b, 0),
                              memory_space=pltpu.VMEM),   # contrib
-                pl.BlockSpec((1, 1, E), lambda c, m: (c, 0, 0),
+                pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
                              memory_space=pltpu.VMEM),   # row_local
             ],
-            out_specs=pl.BlockSpec((1, R, V), lambda c, m: (m[c], 0, 0),
+            out_specs=pl.BlockSpec((1, R, V), lambda c, b, m: (m[c], 0, 0),
                                    memory_space=pltpu.VMEM),
         ),
         out_shape=jax.ShapeDtypeStruct((n_row_tiles, R, V), jnp.float32),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+            dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret_mode(),
     )(chunk_row_tile, contrib_sorted, row_local[:, None, :])
     return y3d
@@ -286,7 +281,7 @@ def spmm_tiled(tiled, B) -> jax.Array:
         raise ValueError(f"spmm_tiled: B must be [{n_cols}, V]")
     V = B.shape[1]
     if V > 512:
-        # the [1, C, V] x-tile and [1, E, V] contribution blocks are
+        # the [1, C, V] x-tile and [1, EB, V] contribution blocks are
         # VMEM-resident; past this width Mosaic fails to fit them with an
         # opaque error — fail early with an actionable one instead
         raise NotImplementedError(
