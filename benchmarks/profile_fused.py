@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Stage-by-stage profile of the fused KNN pipeline on real TPU.
+
+The tune sweep (benchmarks/tune_fused.py) measures the END-TO-END
+pipeline; this script decomposes it so kernel engineering targets the
+actual bottleneck instead of a guess. Stages, each timed separately:
+
+  matmul        the raw MXU contraction at the same shape (roofline)
+  kernel_p1/p3  fused_l2_slot_topk alone (Pallas), 1- and 3-pass
+  kernel_minonly  the same kernel with track=False — min-fold only, no
+                  i1/a2 bookkeeping (bounds that cost)
+  kernel_nomask   the same kernel with mask=False (bounds the in-kernel
+                  col<m mask cost)
+  post          fold_group_top2 + pool top_k + exact rescore (XLA)
+  full          knn_fused end-to-end
+
+The non-dry config is ``fused_defaults()`` — the config production
+``knn_fused`` actually ships. Writes PROFILE_FUSED.json (repo root)
+incrementally. Probe-guarded; RAFT_TPU_BENCH_FORCE=cpu runs a tiny-shape
+harness validation (no artifact).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._common import gate  # noqa: E402
+
+BUDGET_S = float(os.environ.get("PROFILE_FUSED_BUDGET_S", "1800"))
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "PROFILE_FUSED.json")
+
+
+def main():
+    dry, skip = gate()
+    if skip:
+        print(json.dumps({"skipped": True, "reason": skip}))
+        return
+
+    import jax
+    import jax.numpy as jnp
+
+    import raft_tpu
+    from raft_tpu.benchmark import Fixture
+    from raft_tpu.distance.knn_fused import fused_defaults, knn_fused
+    from raft_tpu.ops import fused_l2_topk_pallas as F
+    from raft_tpu.ops.folds import fold_group_top2
+    from raft_tpu.random import RngState, make_blobs
+
+    res = raft_tpu.device_resources()
+    T, Qb, g = fused_defaults()
+    if dry:
+        n_index, dim, n_q, k = 16_384, 128, 256, 64
+        T, Qb = 2048, 256
+    else:
+        n_index, dim, n_q, k = 1_000_000, 128, 2048, 64
+
+    X, _ = make_blobs(res, RngState(0), n_index, dim, n_clusters=64,
+                      cluster_std=2.0)
+    Q = X[:n_q]
+    jax.block_until_ready(X)
+    fx = Fixture(res=res, reps=3)
+
+    # padded operands exactly as _knn_fused prepares them
+    m = n_index
+    M = ((m + T - 1) // T) * T
+    yp = jnp.concatenate(
+        [X, jnp.zeros((M - m, dim), jnp.float32)]) if M > m else X
+    y_hi, y_lo = F.split_hi_lo(yp)
+    xx = jnp.sum(Q * Q, axis=1, keepdims=True)
+    yy = jnp.sum(yp * yp, axis=1)[None, :]
+    m_real = jnp.full((1,), m, jnp.int32)
+    jax.block_until_ready((y_hi, y_lo, xx, yy))
+
+    out = {"shape": [n_q, n_index, dim, k], "T": T, "Qb": Qb, "g": g,
+           "stages": {}}
+    deadline = time.monotonic() + BUDGET_S
+
+    def record(name, fn, *args):
+        if time.monotonic() > deadline:
+            return
+        try:
+            r = fx.run(fn, *args)
+            out["stages"][name] = {"ms": round(r["seconds"] * 1e3, 3)}
+        except Exception as e:
+            out["stages"][name] = {
+                "error": f"{type(e).__name__}: {e}"[:300]}
+        print(json.dumps({name: out["stages"][name]}), flush=True)
+        if not dry:
+            with open(OUT, "w") as f:
+                json.dump(out, f, indent=1)
+
+    # --- roofline: the raw bf16 contraction, XLA-tiled ---
+    @jax.jit
+    def raw_matmul(x, yh):
+        return jax.lax.dot_general(
+            x.astype(jnp.bfloat16), yh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    record("matmul", raw_matmul, Q, y_hi)
+
+    # --- the Pallas kernel alone, then its measurement variants ---
+    record("kernel_p1", lambda *a: F.fused_l2_slot_topk(
+        *a, T=T, Qb=Qb, passes=1), Q, y_hi, y_lo, xx, yy, m_real)
+    record("kernel_p3", lambda *a: F.fused_l2_slot_topk(
+        *a, T=T, Qb=Qb, passes=3), Q, y_hi, y_lo, xx, yy, m_real)
+    record("kernel_minonly", lambda *a: F.fused_l2_slot_topk(
+        *a, T=T, Qb=Qb, passes=1, track=False), Q, y_hi, y_lo, xx, yy,
+        m_real)
+    record("kernel_nomask", lambda *a: F.fused_l2_slot_topk(
+        *a, T=T, Qb=Qb, passes=1, mask=False), Q, y_hi, y_lo, xx, yy,
+        m_real)
+
+    # --- post-stages on materialized kernel outputs ---
+    m1, i1, m2min = jax.block_until_ready(F.fused_l2_slot_topk(
+        Q, y_hi, y_lo, xx, yy, m_real, T=T, Qb=Qb, passes=1))
+
+    @jax.jit
+    def post(m1, i1, x, y, xx):
+        a1, id1, a2, id2, a3 = fold_group_top2(m1, i1, g)
+        pool_v = jnp.concatenate([a1, a2], axis=1)
+        pool_id = jnp.concatenate([id1, id2], axis=1)
+        C = min(k + 32, pool_v.shape[1])
+        neg_top, pos = jax.lax.top_k(-pool_v, C)
+        cand_pid = jnp.take_along_axis(pool_id, pos, axis=1)
+        yc = jnp.take(y, jnp.maximum(cand_pid, 0), axis=0)
+        d2c = (xx + jnp.sum(yc * yc, axis=2)
+               - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
+                                  precision=jax.lax.Precision.HIGHEST))
+        neg_k, ord_k = jax.lax.top_k(-d2c, k)
+        return -neg_k, jnp.take_along_axis(cand_pid, ord_k, axis=1)
+
+    record("post", post, m1, i1, Q, X, xx)
+
+    @jax.jit
+    def group_fold_only(m1, i1):
+        return fold_group_top2(m1, i1, g)
+
+    record("post_groupfold", group_fold_only, m1, i1)
+
+    # --- end-to-end at the shipped defaults ---
+    record("full_p1", lambda q: knn_fused(q, X, k=k, passes=1)[0], Q)
+    record("full_p3", lambda q: knn_fused(q, X, k=k, passes=3)[0], Q)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

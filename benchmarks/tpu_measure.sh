@@ -25,6 +25,9 @@ timeout 3600 python bench.py | tee BENCH_LOCAL.json || echo "bench rc=$?"
 echo "=== spmv bench ==="
 timeout 3600 python benchmarks/bench_spmv.py || echo "spmv rc=$?"
 
+echo "=== fused-pipeline stage profile ==="
+timeout 3600 python benchmarks/profile_fused.py || echo "profile rc=$?"
+
 echo "=== BASELINE config benchmarks ==="
 timeout 7200 python benchmarks/bench_configs.py || echo "configs rc=$?"
 

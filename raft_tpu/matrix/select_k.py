@@ -57,7 +57,11 @@ def _load_select_k_table():
             timings = {name: row[name] for name in
                        ("XLA_TOPK", "SLOTTED", "RADIX")
                        if isinstance(row.get(name), (int, float))
-                       and not isinstance(row.get(name), bool)}
+                       and not isinstance(row.get(name), bool)
+                       # 0.0 is a measurement artifact (sub-RTT clamp in
+                       # Fixture.run), not a real timing — a cell must
+                       # never be labeled off an artifact
+                       and row[name] > 0.0}
             if not timings:
                 continue
             best = min(timings, key=timings.get)

@@ -65,28 +65,42 @@ def _contract(x, yhi, ylo):
 
 
 def _fold_and_write(d2, j, m_real_ref, m1_ref, i1_ref, m2min_ref,
-                    T: int, Qb: int):
+                    T: int, Qb: int, mask: bool = True, track: bool = True):
     """Mask padded index rows, fold the [Qb, T] distance tile into LANES
     slots (per-slot top-2 + argmin-1), and write/accumulate the outputs.
-    Shared by the single-shot and d-chunked kernels."""
+    Shared by the single-shot and d-chunked kernels.
+
+    ``mask=False`` / ``track=False`` are MEASUREMENT-ONLY knobs
+    (benchmarks/profile_fused.py bounds the cost of the mask and of the
+    index/2nd-min bookkeeping with them): mask=False requires pre-masked
+    operands; track=False returns i1 = 0 and m2min = the slot MIN — not
+    valid certificate inputs."""
     n_chunks = T // _LANES
-    # mask padded index rows (global col ≥ m_real) to +inf
-    col = j * T + jax.lax.broadcasted_iota(jnp.int32, (Qb, T), 1)
-    d2 = jnp.where(col < m_real_ref[0], d2, jnp.inf)
+    if mask:
+        # mask padded index rows (global col ≥ m_real) to +inf
+        col = j * T + jax.lax.broadcasted_iota(jnp.int32, (Qb, T), 1)
+        d2 = jnp.where(col < m_real_ref[0], d2, jnp.inf)
 
     # slot class c collects columns {c, c+128, c+256, ...} of this tile
     # (chunk r contributes its lane c as global column j*T + r*128 + c).
     inf = jnp.full((Qb, _LANES), jnp.inf, jnp.float32)
-    a1, a2 = inf, inf
-    i1 = jnp.full((Qb, _LANES), -1, jnp.int32)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (Qb, _LANES), 1)
-    for r in range(n_chunks):
-        c = d2[:, r * _LANES:(r + 1) * _LANES]
-        ci = j * T + r * _LANES + lane
-        lt1 = c < a1
-        a2 = jnp.where(lt1, a1, jnp.minimum(a2, c))
-        a1 = jnp.where(lt1, c, a1)
-        i1 = jnp.where(lt1, ci, i1)
+    if not track:
+        a1 = inf
+        for r in range(n_chunks):
+            a1 = jnp.minimum(a1, d2[:, r * _LANES:(r + 1) * _LANES])
+        a2 = a1
+        i1 = jnp.zeros((Qb, _LANES), jnp.int32)
+    else:
+        a1, a2 = inf, inf
+        i1 = jnp.full((Qb, _LANES), -1, jnp.int32)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (Qb, _LANES), 1)
+        for r in range(n_chunks):
+            c = d2[:, r * _LANES:(r + 1) * _LANES]
+            ci = j * T + r * _LANES + lane
+            lt1 = c < a1
+            a2 = jnp.where(lt1, a1, jnp.minimum(a2, c))
+            a1 = jnp.where(lt1, c, a1)
+            i1 = jnp.where(lt1, ci, i1)
 
     m1_ref[...] = a1
     i1_ref[...] = i1
@@ -103,14 +117,15 @@ def _fold_and_write(d2, j, m_real_ref, m1_ref, i1_ref, m2min_ref,
 
 def _fused_kernel(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
                   m1_ref, i1_ref, m2min_ref,
-                  *, T: int, Qb: int, ylo_ref=None):
+                  *, T: int, Qb: int, ylo_ref=None,
+                  mask: bool = True, track: bool = True):
     """One (query-block, index-tile) cell. ``ylo_ref`` present ⇒ bf16x3."""
     j = pl.program_id(1)
     s = _contract(x_ref[...], yhi_ref[...],
                   None if ylo_ref is None else ylo_ref[...])
     d2 = xx_ref[...] + yy_ref[...] - 2.0 * s         # [Qb,1]+[1,T]-[Qb,T]
     _fold_and_write(d2, j, m_real_ref, m1_ref, i1_ref, m2min_ref,
-                    T=T, Qb=Qb)
+                    T=T, Qb=Qb, mask=mask, track=track)
 
 
 def _fused_kernel_dchunk(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
@@ -173,25 +188,28 @@ def _slot_cost(Q: int, M: int, d: int, S: int, passes: int):
     )
 
 
-def _make_kernel(base, passes: int, T: int, Qb: int):
+def _make_kernel(base, passes: int, T: int, Qb: int, **fold_kw):
     """Bind the base kernel for the passes mode; for passes == 3 reorder
     the y_lo ref out of the positional stream (*rest carries the output
     refs and, for the d-chunked kernel, the scratch ref)."""
     if passes != 3:
-        return functools.partial(base, T=T, Qb=Qb, ylo_ref=None)
+        return functools.partial(base, T=T, Qb=Qb, ylo_ref=None, **fold_kw)
 
     def kernel(m_real_ref, x_ref, yhi_ref, ylo_ref, xx_ref, yy_ref, *rest):
         base(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref, *rest,
-             T=T, Qb=Qb, ylo_ref=ylo_ref)
+             T=T, Qb=Qb, ylo_ref=ylo_ref, **fold_kw)
 
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("T", "Qb", "passes"))
+@functools.partial(jax.jit,
+                   static_argnames=("T", "Qb", "passes", "mask", "track"))
 def fused_l2_slot_topk(x, y_hi, y_lo, xx, yy, m_real,
-                       T: int, Qb: int, passes: int
+                       T: int, Qb: int, passes: int,
+                       mask: bool = True, track: bool = True
                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Run the fused kernel.
+    """Run the fused kernel. ``mask``/``track`` are measurement-only
+    knobs (see _fold_and_write) — production callers use the defaults.
 
     Args:
       x: [Q, d] f32 queries (Q a multiple of Qb).
@@ -229,7 +247,8 @@ def fused_l2_slot_topk(x, y_hi, y_lo, xx, yy, m_real,
         in_specs.insert(2, pl.BlockSpec((T, d), lambda i, j, *_: (j, 0),
                                         memory_space=pltpu.VMEM))  # y_lo
         operands.insert(2, y_lo)
-    kernel = _make_kernel(_fused_kernel, passes, T, Qb)
+    kernel = _make_kernel(_fused_kernel, passes, T, Qb,
+                          mask=mask, track=track)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
