@@ -64,7 +64,15 @@ def main():
         if time.monotonic() > deadline:
             print(json.dumps({"budget_expired_after": len(rows)}))
             break
-        if Qb * T * 4 > 8 * 2 ** 20:   # d2 tile must fit VMEM comfortably
+        # skip configs the scoped-VMEM estimator rejects — they are
+        # guaranteed Mosaic compile failures (knn_fused would silently
+        # shrink them to a point already swept, double-counting it);
+        # footprint_for is the SAME predicate knn_fused's guard uses
+        from raft_tpu.distance.knn_fused import footprint_for
+        from raft_tpu.ops.fused_l2_topk_pallas import VMEM_BUDGET
+        if footprint_for(T, Qb, dim, p) > VMEM_BUDGET:
+            rows.append({"T": T, "Qb": Qb, "g": g, "passes": p,
+                         "skipped": "vmem_footprint"})
             continue
         try:
             dt = fx.run(lambda q: knn_fused(q, X, k=k, passes=p,

@@ -52,7 +52,8 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.ops.fused_l2_topk_pallas import (
-    _LANES, fused_l2_slot_topk, fused_l2_slot_topk_dchunk, split_hi_lo)
+    _LANES, VMEM_BUDGET, fused_l2_slot_topk, fused_l2_slot_topk_dchunk,
+    split_hi_lo, vmem_footprint)
 
 # past this feature width the single-shot kernel's [Qb/T, d] VMEM tiles
 # stop fitting; the d-chunked kernel (VMEM scratch accumulator) takes over
@@ -230,16 +231,39 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
     return vals, ids
 
 
-_TUNED = ...   # lazy sentinel
+_TUNED = ...   # lazy sentinel: {passes: (T, Qb, g)} once loaded
 
 
-def fused_defaults() -> Tuple[int, int, int]:
+def footprint_for(T: int, Qb: int, d: int, passes: int) -> int:
+    """Scoped-VMEM footprint of the fused kernel at a RAW (unpadded)
+    feature width — applies the same d-padding / d-chunk routing
+    ``knn_fused`` itself uses, so callers (the tune sweep's skip
+    predicate, the in-call shrink guard) can't diverge from it."""
+    d_eff = d + (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
+    return vmem_footprint(T, Qb, d_eff, passes,
+                          dchunk=d_eff > _D_SINGLE_SHOT)
+
+
+def _valid_cfg(T, Qb, g) -> bool:
+    # semantic validation, not just parseability: bad values would crash
+    # every knn() call downstream; g must divide the lane count or the
+    # S % g envelope check rejects it
+    return (T > 0 and T % _LANES == 0 and Qb > 0 and Qb % 8 == 0
+            and 0 < g <= _LANES and _LANES % g == 0)
+
+
+def fused_defaults(passes: int = 3) -> Tuple[int, int, int]:
     """(T, Qb, g) for the fused pipeline: the measured-best point from
     ``TUNE_FUSED.json`` (produced on real TPU by benchmarks/tune_fused.py
     — the analog of the reference's fitted select_k heuristic) when one
-    is committed, else the hand-chosen defaults. ``passes`` is never
-    taken from the table — it is an exactness contract, not a tuning
-    knob."""
+    is committed, else the hand-chosen defaults.
+
+    Best rows are keyed by ``passes``: the score-tile VMEM footprint
+    differs ~2× between the modes (see ops.fused_l2_topk_pallas.
+    vmem_footprint), so the passes=1 winner can be a passes=3 compile
+    failure — round 2's driver bench hit exactly that. ``passes`` itself
+    is never taken from the table — it is an exactness contract, not a
+    tuning knob."""
     global _TUNED
     if _TUNED is ...:
         import json
@@ -249,21 +273,30 @@ def fused_defaults() -> Tuple[int, int, int]:
 
         path = os.environ.get("RAFT_TPU_TUNE_FUSED") or os.path.join(
             _REPO_ROOT, "TUNE_FUSED.json")
-        _TUNED = None
+        _TUNED = {}
         try:
             with open(path) as f:
-                best = json.load(f).get("best")
+                tbl = json.load(f)
+            # per-passes winners from the measured rows; the legacy
+            # single "best" entry seeds any mode its passes matches (or
+            # both, for tables that never recorded passes)
+            for row in sorted((r for r in tbl.get("rows", [])
+                               if "seconds" in r),
+                              key=lambda r: r["seconds"], reverse=True):
+                cfg = (int(row["T"]), int(row["Qb"]), int(row["g"]))
+                if _valid_cfg(*cfg):
+                    _TUNED[int(row.get("passes", 0)) or None] = cfg
+            best = tbl.get("best")
             if best:
-                T, Qb, g = int(best["T"]), int(best["Qb"]), int(best["g"])
-                # semantic validation, not just parseability: bad values
-                # would crash every knn() call downstream; g must divide
-                # the lane count or the S % g envelope check rejects it
-                if (T > 0 and T % _LANES == 0 and Qb > 0 and Qb % 8 == 0
-                        and 0 < g <= _LANES and _LANES % g == 0):
-                    _TUNED = (T, Qb, g)
+                cfg = (int(best["T"]), int(best["Qb"]), int(best["g"]))
+                if _valid_cfg(*cfg):
+                    for p in (1, 3):
+                        if int(best.get("passes", p)) == p:
+                            _TUNED.setdefault(p, cfg)
         except Exception:
-            _TUNED = None  # malformed table must never break knn
-    return _TUNED or (2048, 256, 32)
+            _TUNED = {}  # malformed table must never break knn
+    return (_TUNED.get(passes) or _TUNED.get(None)
+            or (2048, 256, 32))
 
 
 def knn_fused(x, y, k: int, passes: int = 3,
@@ -283,7 +316,7 @@ def knn_fused(x, y, k: int, passes: int = 3,
     if metric not in ("l2", "ip"):
         raise ValueError(f"knn_fused: metric must be 'l2' or 'ip', "
                          f"got {metric!r}")
-    dT, dQb, dg = fused_defaults()
+    dT, dQb, dg = fused_defaults(passes)
     T = dT if T is None else T
     Qb = dQb if Qb is None else Qb
     g = dg if g is None else g
@@ -293,6 +326,15 @@ def knn_fused(x, y, k: int, passes: int = 3,
     m = y.shape[0]
     if k > m:
         raise ValueError(f"knn_fused: k={k} > index size {m}")
+    # scoped-VMEM guard: a config that exceeds Mosaic's stack limit is a
+    # guaranteed compile failure (observed: tuned-at-passes=1 winner OOMs
+    # at passes=3). Shrink Qb first (pure throughput knob), then T
+    # (weakens the certificate's slot count, so last).
+    while (footprint_for(T, Qb, d, passes) > VMEM_BUDGET and Qb > 8):
+        Qb = max(8, (Qb // 2) // 8 * 8)
+    while (footprint_for(T, Qb, d, passes) > VMEM_BUDGET
+           and T > 2 * _LANES):
+        T = max(2 * _LANES, (T // 2) // _LANES * _LANES)
     n_tiles = (max(m, T) + T - 1) // T
     S = n_tiles * _LANES
     pool = 2 * (S // min(g, S))

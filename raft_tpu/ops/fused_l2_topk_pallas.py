@@ -45,6 +45,35 @@ from raft_tpu.ops.utils import interpret_mode
 
 _LANES = 128
 
+# Mosaic's scoped-VMEM stack limit on current TPU generations (the
+# compiler rejects kernels whose live VMEM exceeds it); budget leaves
+# headroom for temporaries the estimator can't see.
+VMEM_LIMIT = 16 * 2 ** 20
+VMEM_BUDGET = 15 * 2 ** 20
+
+
+def vmem_footprint(T: int, Qb: int, d: int, passes: int,
+                   dchunk: bool = False) -> int:
+    """Estimated scoped-VMEM bytes of one fused-kernel grid cell.
+
+    Calibrated against measured Mosaic compiles on v5e (tune sweep +
+    driver bench): (T=2048, Qb=1024, d=128, passes=3) was rejected at
+    20.35 MB against the 16 MB limit while the same shape at passes=1
+    compiled and ran, and (T=4096, Qb=512, passes=3) was rejected. The
+    dominant term is the [Qb, T] f32 score tile; passes=3 holds an
+    accumulator plus a fresh dot result (~2 live copies + mask/fold
+    temporaries) where passes=1 keeps ~1."""
+    d2_bufs = 1.25 if passes == 1 else 2.25
+    dc = min(d, 256) if dchunk else d
+    bytes_ = int(Qb * T * 4 * d2_bufs)
+    bytes_ += T * dc * 2 * 2 * (2 if passes == 3 else 1)  # y hi(/lo), 2 bufs
+    bytes_ += Qb * dc * (4 + 2)                           # x f32 + bf16 cast
+    bytes_ += T * 4 * 2 + Qb * 4                          # yy (2 bufs), xx
+    bytes_ += Qb * _LANES * 12 * 2                        # slot outs + temps
+    if dchunk:
+        bytes_ += Qb * T * 4                              # score accumulator
+    return bytes_
+
 
 def _contract(x, yhi, ylo):
     """bf16 (ylo None) or bf16x3 MXU contraction of an f32 x block with a

@@ -170,20 +170,34 @@ def test_fused_ip_clustered_forces_fixup():
 
 
 def test_fused_defaults_table(tmp_path, monkeypatch):
-    """fused_defaults() reads the measured-best tuning point when a table
-    exists, never takes `passes` from it, and degrades on malformed
-    tables."""
+    """fused_defaults() reads the measured-best tuning point PER PASSES
+    MODE (the round-2 driver bench crashed because the passes=1 winner
+    was a passes=3 VMEM OOM), and degrades on malformed tables."""
     import json
 
     from raft_tpu.distance import knn_fused as kf
 
     tbl = tmp_path / "TUNE_FUSED.json"
-    tbl.write_text(json.dumps(
-        {"best": {"T": 4096, "Qb": 512, "g": 16, "passes": 1}}))
+    tbl.write_text(json.dumps({"rows": [
+        {"T": 2048, "Qb": 1024, "g": 32, "passes": 1, "seconds": 0.11},
+        {"T": 2048, "Qb": 512, "g": 32, "passes": 3, "seconds": 0.122},
+        {"T": 2048, "Qb": 256, "g": 32, "passes": 3, "seconds": 0.121},
+        {"T": 2048, "Qb": 1024, "g": 32, "passes": 3,
+         "error": "vmem oom"},
+    ], "best": {"T": 2048, "Qb": 1024, "g": 32, "passes": 1}}))
     monkeypatch.setenv("RAFT_TPU_TUNE_FUSED", str(tbl))
     # monkeypatch restores the cache even if an assert below fails
     monkeypatch.setattr(kf, "_TUNED", ...)
-    assert kf.fused_defaults() == (4096, 512, 16)
+    # passes=3 gets its own winner, NOT the (OOM-at-p3) p1 winner
+    assert kf.fused_defaults(3) == (2048, 256, 32)
+    assert kf.fused_defaults(1) == (2048, 1024, 32)
+
+    # legacy table with only a "best" entry: seeds only its own mode
+    tbl.write_text(json.dumps(
+        {"best": {"T": 4096, "Qb": 512, "g": 16, "passes": 1}}))
+    kf._TUNED = ...
+    assert kf.fused_defaults(1) == (4096, 512, 16)
+    assert kf.fused_defaults(3) == (2048, 256, 32)   # hand default
 
     tbl.write_text("{not json")
     kf._TUNED = ...
@@ -193,6 +207,35 @@ def test_fused_defaults_table(tmp_path, monkeypatch):
     tbl.write_text(json.dumps({"best": {"T": 0, "Qb": 512, "g": 16}}))
     kf._TUNED = ...
     assert kf.fused_defaults() == (2048, 256, 32)
+
+
+def test_vmem_footprint_guard():
+    """The footprint estimator rejects the configs Mosaic measurably
+    rejected on v5e (scoped-vmem stack OOM) and accepts the configs that
+    measurably compiled; knn_fused shrinks an over-budget config instead
+    of shipping a guaranteed compile failure."""
+    from raft_tpu.distance import knn_fused as kf
+    from raft_tpu.ops.fused_l2_topk_pallas import (
+        VMEM_BUDGET, vmem_footprint)
+
+    # measured rejections (tune sweep + driver bench, v5e)
+    assert vmem_footprint(2048, 1024, 128, passes=3) > VMEM_BUDGET
+    assert vmem_footprint(4096, 512, 128, passes=3) > VMEM_BUDGET
+    # measured compiles
+    assert vmem_footprint(2048, 1024, 128, passes=1) <= VMEM_BUDGET
+    assert vmem_footprint(2048, 512, 128, passes=3) <= VMEM_BUDGET
+    assert vmem_footprint(1024, 1024, 128, passes=3) <= VMEM_BUDGET
+
+    # the guard inside knn_fused: an explicit over-budget config still
+    # produces correct (shrunk-config) results rather than an OOM
+    x = rng.normal(size=(64, 32)).astype(np.float32)
+    y = rng.normal(size=(4096, 32)).astype(np.float32)
+    vals, ids = knn_fused(x, y, k=4, passes=3, T=2048, Qb=1024, g=32)
+    d2 = ((x[:, None, :].astype(np.float64)
+           - y[None, :, :].astype(np.float64)) ** 2).sum(-1)
+    want = np.sort(d2, axis=1)[:, :4]
+    np.testing.assert_allclose(np.asarray(vals), want, rtol=1e-5,
+                               atol=1e-4)
 
 
 def test_knn_cosine_matches_pairwise():
