@@ -5,14 +5,13 @@ The tune sweep (benchmarks/tune_fused.py) measures the END-TO-END
 pipeline; this script decomposes it so kernel engineering targets the
 actual bottleneck instead of a guess. Stages, each timed separately:
 
-  matmul        the raw MXU contraction at the same shape (roofline)
-  kernel_p1/p3  fused_l2_slot_topk alone (Pallas), 1- and 3-pass
-  kernel_minonly  the same kernel with track=False — min-fold only, no
-                  i1/a2 bookkeeping (bounds that cost)
-  kernel_nomask   the same kernel with mask=False (bounds the in-kernel
-                  col<m mask cost)
-  post          fold_group_top2 + pool top_k + exact rescore (XLA)
-  full          knn_fused end-to-end
+  matmul_*        the raw MXU contraction at the same shape (roofline)
+  kernel_grp_p1/p3  fused_l2_group_topk alone (the production kernel:
+                    in-kernel group fold), 1- and 3-pass
+  kernel_slot_p1    the retired per-(tile,lane) slot kernel (comparison)
+  kernel_slot_minonly  slot kernel, min-fold only (bounds fold cost)
+  post            pool top_k + exact rescore (XLA)
+  full            knn_fused end-to-end
 
 The non-dry config is ``fused_defaults()`` — the config production
 ``knn_fused`` actually ships. Writes PROFILE_FUSED.json (repo root)
@@ -46,11 +45,12 @@ def main():
     from raft_tpu.benchmark import Fixture
     from raft_tpu.distance.knn_fused import fused_defaults, knn_fused
     from raft_tpu.ops import fused_l2_topk_pallas as F
-    from raft_tpu.ops.folds import fold_group_top2
     from raft_tpu.random import RngState, make_blobs
 
     res = raft_tpu.device_resources()
+    from raft_tpu.distance.knn_fused import fit_config
     T, Qb, g = fused_defaults(3)   # production exactness mode's config
+    T, Qb = fit_config(T, Qb, 128, 3)   # what production actually runs
     if dry:
         n_index, dim, n_q, k = 16_384, 128, 256, 64
         T, Qb = 2048, 256
@@ -126,33 +126,40 @@ def main():
 
     record("matmul_sub131k", raw_matmul_sub, Q, y_hi)
 
-    # --- the Pallas kernel alone, then its measurement variants ---
-    record("kernel_p1", lambda *a: F.fused_l2_slot_topk(
+    # --- the Pallas kernels alone: the production group-fold kernel
+    # (top-2+3rd per (lane, tile-group) folded IN-KERNEL) and, for
+    # comparison, the retired per-(tile,lane) slot kernel whose XLA-side
+    # group fold motivated the redesign ---
+    # group kernels fold the half-score yy/2 − x·y; [8, M] carrier with
+    # +inf on padded columns (the kernel does no masking of its own —
+    # half-score 0 on padded columns would beat real candidates)
+    yyh = jnp.broadcast_to(
+        jnp.where((jnp.arange(M) < m)[None, :], 0.5 * yy, jnp.inf),
+        (8, M))
+    record("kernel_grp_p1", lambda *a: F.fused_l2_group_topk(
+        *a, T=T, Qb=Qb, passes=1, tpg=g), Q, y_hi, y_lo, yyh, m_real)
+    record("kernel_grp_p3", lambda *a: F.fused_l2_group_topk(
+        *a, T=T, Qb=Qb, passes=3, tpg=g), Q, y_hi, y_lo, yyh, m_real)
+    record("kernel_slot_p1", lambda *a: F.fused_l2_slot_topk(
         *a, T=T, Qb=Qb, passes=1), Q, y_hi, y_lo, xx, yy, m_real)
-    record("kernel_p3", lambda *a: F.fused_l2_slot_topk(
-        *a, T=T, Qb=Qb, passes=3), Q, y_hi, y_lo, xx, yy, m_real)
-    record("kernel_minonly", lambda *a: F.fused_l2_slot_topk(
+    record("kernel_slot_minonly", lambda *a: F.fused_l2_slot_topk(
         *a, T=T, Qb=Qb, passes=1, track=False), Q, y_hi, y_lo, xx, yy,
         m_real)
-    record("kernel_nomask", lambda *a: F.fused_l2_slot_topk(
-        *a, T=T, Qb=Qb, passes=1, mask=False), Q, y_hi, y_lo, xx, yy,
-        m_real)
 
-    # --- post-stages on materialized kernel outputs (skipped — not
+    # --- post-stage on materialized kernel outputs (skipped — not
     # fatal — if the raw kernel fails: full_p1/p3 below go through
     # knn_fused's shrink guard and can still succeed) ---
-    m1 = None
+    grp = None
     try:
-        m1, i1, m2min = jax.block_until_ready(F.fused_l2_slot_topk(
-            Q, y_hi, y_lo, xx, yy, m_real, T=T, Qb=Qb, passes=1))
+        grp = jax.block_until_ready(F.fused_l2_group_topk(
+            Q, y_hi, y_lo, yyh, m_real, T=T, Qb=Qb, passes=1, tpg=g))
     except Exception as e:
         out["stages"]["post"] = {
             "error": f"kernel for post-stage inputs failed: "
                      f"{type(e).__name__}: {e}"[:300]}
 
     @jax.jit
-    def post(m1, i1, x, y, xx):
-        a1, id1, a2, id2, a3 = fold_group_top2(m1, i1, g)
+    def post(a1, id1, a2, id2, x, y, xx):
         pool_v = jnp.concatenate([a1, a2], axis=1)
         pool_id = jnp.concatenate([id1, id2], axis=1)
         C = min(k + 32, pool_v.shape[1])
@@ -165,14 +172,9 @@ def main():
         neg_k, ord_k = jax.lax.top_k(-d2c, k)
         return -neg_k, jnp.take_along_axis(cand_pid, ord_k, axis=1)
 
-    if m1 is not None:
-        record("post", post, m1, i1, Q, X, xx)
-
-        @jax.jit
-        def group_fold_only(m1, i1):
-            return fold_group_top2(m1, i1, g)
-
-        record("post_groupfold", group_fold_only, m1, i1)
+    if grp is not None:
+        a1g, id1g, a2g, id2g, _ = grp
+        record("post", post, a1g, id1g, a2g, id2g, Q, X, xx)
 
     # --- end-to-end at the shipped defaults ---
     record("full_p1", lambda q: knn_fused(q, X, k=k, passes=1)[0], Q)

@@ -47,7 +47,7 @@ def main():
         n_index, dim, n_q, k = 1_000_000, 128, 2048, 64
         Ts = [1024, 2048, 4096]
         Qbs = [256, 512, 1024]
-        gs = [32]
+        gs = [8, 16, 32]     # tiles per certificate group (tpg)
         passes_l = [1, 3]
         reps = 3
 

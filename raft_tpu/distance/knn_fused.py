@@ -6,24 +6,28 @@ select_warpsort.cuh consuming distance tiles; BASELINE config 2.)
 
 Pipeline (all one jit program):
 
-1. ``ops.fused_l2_topk_pallas`` streams index tiles through VMEM: MXU
-   contraction + per-slot (min, argmin, 2nd-min) fold. Distance tiles
-   never touch HBM — only the [Q, S] slot summary does.
-2. A grouped top-2 fold (XLA, pure compare/selects) compresses the S
-   slot-mins to a 2·(S/g) candidate pool per query, tracking slot ids and
-   the per-group 3rd-min.
-3. ``top_k`` picks C = k + pad pool entries; their points are rescored
-   EXACTLY (f32, HIGHEST precision) and the final top-k is taken on exact
-   values.
-4. EXACTNESS CERTIFICATE, per query: every point outside the candidate
-   set has kernel-distance ≥ B = min(slot-2nd-min, group-3rd-min, C-th
-   pool value); with |kernel − exact| ≤ E, ``B − E ≥ θ*`` (θ* = exact
-   k-th candidate distance) proves no point can beat the returned top-k.
-   The bound needs NO second distance pass — it falls out of the fold.
-5. Queries that fail the certificate (two true neighbors sharing a slot:
-   ~k²/2S per query) are re-solved by an exact f32 streamed sweep — a
-   small static batch, ~1/16th of a full pass — and scattered back. If
-   more than the static budget fail, the whole batch falls back (cond).
+1. ``ops.fused_l2_topk_pallas.fused_l2_group_topk`` streams index tiles
+   through VMEM: MXU contraction + an IN-KERNEL top-2+3rd-min fold per
+   (lane-class, tile-group) — output blocks are revisited across ``g``
+   consecutive index tiles, so the fold accumulates in VMEM and the
+   distance tiles never touch HBM; only the [Q, 2·S'] group summary does
+   (S' = ceil(n_tiles/g)·128 slots). (Round-2 profile: the earlier
+   XLA-side group fold re-read ~1 GB of per-(tile,lane) slot arrays and
+   cost 3× the kernel itself.)
+2. ``top_k`` picks C = k + pad pool entries from the 2·S' candidates
+   (per-group top-2 with ids); their points are rescored EXACTLY (f32,
+   HIGHEST precision) and the final top-k is taken on exact values.
+3. EXACTNESS CERTIFICATE, per query: every point outside the candidate
+   set has kernel-distance ≥ B = min(group-3rd-min, C-th pool value);
+   with |kernel − exact| ≤ E, ``B − E ≥ θ*`` (θ* = exact k-th candidate
+   distance) proves no point can beat the returned top-k. The bound
+   needs NO second distance pass — it falls out of the fold.
+4. Queries that fail the certificate (THREE true neighbors sharing a
+   (lane, group): ~k³/6S'² per query — single digits per 2048 queries
+   at production scale) are re-solved exactly and scattered back:
+   tiered static batches (16, then 128) that materialize an [F, M]
+   distance tile and take one top_k; a full streamed fallback covers
+   pathological batches (cond).
 
 Modes:
 - ``passes=3`` (exact): bf16 hi/lo split contraction (hi·hi + hi·lo +
@@ -52,7 +56,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.ops.fused_l2_topk_pallas import (
-    _LANES, VMEM_BUDGET, fused_l2_slot_topk, fused_l2_slot_topk_dchunk,
+    _LANES, VMEM_BUDGET, fused_l2_group_topk, fused_l2_group_topk_dchunk,
     split_hi_lo, vmem_footprint)
 
 # past this feature width the single-shot kernel's [Qb/T, d] VMEM tiles
@@ -60,8 +64,13 @@ from raft_tpu.ops.fused_l2_topk_pallas import (
 _D_SINGLE_SHOT = 512
 _DC = 256          # d-chunk width for the wide-feature kernel
 
-# static fixup batch: queries whose certificate failed re-run exactly
-_FIXUP_BATCH = 128
+# static fixup batches: queries whose certificate failed re-run exactly
+# against the whole index. Tiered (16 first) because the cond pays the
+# whole static tier even for one failed query; with the group kernel's
+# top-2-per-group certificate the typical failure count is single-digit
+# per 2048 queries, so the small tier almost always suffices.
+_FIXUP_TIERS = (16, 128)
+_FIXUP_BATCH = _FIXUP_TIERS[-1]
 # pool oversampling beyond k before exact rescoring
 _POOL_PAD = 32
 # query-chunk bound: the [Q, S] slot arrays + [Q, C, d] rescore gather are
@@ -83,9 +92,6 @@ def _err_bound_coeff(d: int) -> float:
     margin's only cost is fixup rate, but the BOUND ITSELF must hold for
     the exactness certificate to be sound."""
     return 2.0 ** -15 + d * 2.0 ** -21
-
-
-from raft_tpu.ops.folds import fold_group_top2 as _fold_group_top2
 
 
 def _pad_rows_to(y, mult: int):
@@ -115,27 +121,41 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
     M = yp.shape[0]
 
     xx = jnp.sum(x * x, axis=1, keepdims=True)                  # [Q,1] f32
-    yy = jnp.sum(yp * yp, axis=1)[None, :]                      # [1,M] f32
+    yy_raw = jnp.sum(yp * yp, axis=1)[None, :]                  # [1,M] f32
+    # the kernel folds the HALF-SCORE r = yy/2 − x·y (a positive-scale +
+    # per-row-shift of d2, so per-row ordering is identical — one fewer
+    # live [Qb, T] buffer in-kernel); padded index columns carry +inf so
+    # they lose every strict < in the fold (no in-kernel masking). True
+    # distances are recovered as 2·r + xx on the tiny [Q, S'] outputs.
+    valid = (jnp.arange(M, dtype=jnp.int32) < m)[None, :]
     if metric == "ip":
+        # r = 0/2 − x·(y/2) = −x·y/2 → score −x·y = 2·r (+ xx_r = 0)
         y_hi, y_lo = split_hi_lo(yp * 0.5)
-        xx_k = jnp.zeros((Q, 1), jnp.float32)
-        yy_k = jnp.zeros((1, M), jnp.float32)
+        yyh_k = jnp.where(valid, 0.0, jnp.inf)
+        xx_r = jnp.zeros((Q, 1), jnp.float32)
     else:
         y_hi, y_lo = split_hi_lo(yp)
-        xx_k, yy_k = xx, yy
+        yyh_k = jnp.where(valid, 0.5 * yy_raw, jnp.inf)
+        xx_r = xx
+    # [8, M] sublane-replicated carrier (see fused_l2_group_topk)
+    yyh_k = jnp.broadcast_to(yyh_k, (8, M))
     m_real = jnp.full((1,), m, jnp.int32)
 
     if d > _D_SINGLE_SHOT:
-        m1, i1, m2min = fused_l2_slot_topk_dchunk(
-            x, y_hi, y_lo, xx_k, yy_k, m_real, T=T, Qb=Qb, passes=passes,
-            dc=_DC)
+        a1, id1, a2, id2, a3 = fused_l2_group_topk_dchunk(
+            x, y_hi, y_lo, yyh_k, m_real, T=T, Qb=Qb, passes=passes,
+            tpg=g, dc=_DC)
     else:
-        m1, i1, m2min = fused_l2_slot_topk(
-            x, y_hi, y_lo, xx_k, yy_k, m_real, T=T, Qb=Qb, passes=passes)
-    S = m1.shape[1]
+        a1, id1, a2, id2, a3 = fused_l2_group_topk(
+            x, y_hi, y_lo, yyh_k, m_real, T=T, Qb=Qb, passes=passes,
+            tpg=g)
+    # recover kernel-score space (d2 for l2, −x·y for ip); +inf stays
+    # +inf, ids untouched
+    a1 = 2.0 * a1 + xx_r
+    a2 = 2.0 * a2 + xx_r
+    a3 = 2.0 * a3 + xx_r
 
-    a1, id1, a2, id2, a3 = _fold_group_top2(m1, i1, g)
-    pool_v = jnp.concatenate([a1, a2], axis=1)                  # [Q, 2G]
+    pool_v = jnp.concatenate([a1, a2], axis=1)                  # [Q, 2S']
     pool_id = jnp.concatenate([id1, id2], axis=1)
 
     C = min(k + _POOL_PAD, pool_v.shape[1])
@@ -162,10 +182,11 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
 
     # ---- certificate ----
     theta = vals[:, k - 1]
-    bound = jnp.minimum(jnp.min(m2min, axis=1), jnp.min(a3, axis=1))
-    bound = jnp.minimum(bound, cand_v_hat[:, C - 1])
+    # every point outside its group's kept top-2 is ≥ that group's a3;
+    # every pool entry not among the C candidates is ≥ the C-th pool value
+    bound = jnp.minimum(jnp.min(a3, axis=1), cand_v_hat[:, C - 1])
     if passes == 3:
-        ymax = jnp.sqrt(jnp.max(yy))
+        ymax = jnp.sqrt(jnp.max(yy_raw))   # finite norms (padded rows: 0)
         err = _err_bound_coeff(d) * jnp.sqrt(xx[:, 0]) * ymax
     else:
         err = jnp.zeros((Q,), jnp.float32)
@@ -175,8 +196,35 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
 
     # ---- fixup: exact f32 sweep for failed queries ----
     def exact_rows(xq):
-        """Exact streamed top-k for a [F, d] query block (f32 HIGHEST)."""
+        """Exact top-k for a [F, d] query block (f32 HIGHEST).
+
+        Small blocks materialize the whole [F, M] distance tile and take
+        ONE top_k: MEASURED (v5e, 2048×1M×128) the old per-tile
+        merge loop (489 sequential top_k's on [F, k+T]) cost ~90 ms —
+        3× the entire rest of the pipeline — and ran on nearly every
+        batch because the certificate fires for a handful of queries at
+        production scale. [F≤128, 1M] is ≤512 MB: one matmul + one
+        XLA top_k ≈ single-digit ms."""
+        F = xq.shape[0]
         xs = jnp.sum(xq * xq, axis=1)
+        if F <= _FIXUP_TIERS[-1]:
+            s = jax.lax.dot_general(
+                xq, yp, (((1,), (1,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32)               # [F, M]
+            if metric == "ip":
+                d2 = -s
+            else:
+                d2 = jnp.maximum(
+                    xs[:, None] + jnp.sum(yp * yp, axis=1)[None, :]
+                    - 2.0 * s, 0.0)
+            col = jnp.arange(M, dtype=jnp.int32)
+            d2 = jnp.where(col[None, :] < m, d2, jnp.inf)
+            nt, ni = jax.lax.top_k(-d2, k)
+            return -nt, ni
+
+        # full-batch fallback: streamed per-tile merge (the [Q, M] tile
+        # would not fit HBM); rare — needs >_FIXUP_TIERS[-1] failures
         n_tiles = M // T
 
         def body(j, carry):
@@ -200,38 +248,54 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
             nt, np_ = jax.lax.top_k(-av, k)
             return -nt, jnp.take_along_axis(ai, np_, axis=1)
 
-        bv = jnp.full((xq.shape[0], k), jnp.inf, jnp.float32)
-        bi = jnp.full((xq.shape[0], k), -1, jnp.int32)
+        bv = jnp.full((F, k), jnp.inf, jnp.float32)
+        bi = jnp.full((F, k), -1, jnp.int32)
         return jax.lax.fori_loop(0, n_tiles, body, (bv, bi))
 
     def no_fixup(operand):
         vals, ids = operand
         return vals, ids
 
-    def small_fixup(operand):
-        vals, ids = operand
-        _, fidx = jax.lax.top_k(failed.astype(jnp.int32), _FIXUP_BATCH)
-        fv, fi = exact_rows(x[fidx])
-        # padded rows of fidx are healthy queries — recomputing them
-        # exactly and writing back is harmless (same answer)
-        return vals.at[fidx].set(fv), ids.at[fidx].set(fi)
+    def make_fixup(F):
+        def fixup(operand):
+            vals, ids = operand
+            _, fidx = jax.lax.top_k(failed.astype(jnp.int32), F)
+            fv, fi = exact_rows(x[fidx])
+            # padded rows of fidx are healthy queries — recomputing them
+            # exactly and writing back is harmless (same answer)
+            return vals.at[fidx].set(fv), ids.at[fidx].set(fi)
+        return fixup
 
     def full_fallback(operand):
         return exact_rows(x)
 
-    if Q <= _FIXUP_BATCH:
-        vals, ids = jax.lax.cond(
-            n_fail > 0, full_fallback, no_fixup, (vals, ids))
-    else:
-        vals, ids = jax.lax.cond(
-            n_fail == 0, no_fixup,
-            lambda op: jax.lax.cond(
-                n_fail <= _FIXUP_BATCH, small_fixup, full_fallback, op),
-            (vals, ids))
+    # tiered cascade: n_fail==0 → no-op; else the smallest tier that
+    # covers n_fail; else the full fallback
+    branch = full_fallback
+    for t in [t for t in reversed(_FIXUP_TIERS) if t < Q]:
+        branch = (lambda op, t=t, nxt=branch: jax.lax.cond(
+            n_fail <= t, make_fixup(t), nxt, op))
+    vals, ids = jax.lax.cond(n_fail == 0, no_fixup, branch, (vals, ids))
     return vals, ids
 
 
 _TUNED = ...   # lazy sentinel: {passes: (T, Qb, g)} once loaded
+
+
+def fit_config(T: int, Qb: int, d: int, passes: int):
+    """Scoped-VMEM guard: shrink (T, Qb) until the kernel footprint fits
+    Mosaic's stack budget — a config over it is a guaranteed compile
+    failure (observed: the tuned-at-passes=1 winner OOMs at passes=3).
+    Shrinks Qb first (pure throughput knob), then T (weakens the
+    certificate's slot count, so last). Shared by knn_fused and the
+    measurement scripts so they can never profile a config production
+    would silently shrink."""
+    while (footprint_for(T, Qb, d, passes) > VMEM_BUDGET and Qb > 8):
+        Qb = max(8, (Qb // 2) // 8 * 8)
+    while (footprint_for(T, Qb, d, passes) > VMEM_BUDGET
+           and T > 2 * _LANES):
+        T = max(2 * _LANES, (T // 2) // _LANES * _LANES)
+    return T, Qb
 
 
 def footprint_for(T: int, Qb: int, d: int, passes: int) -> int:
@@ -246,10 +310,9 @@ def footprint_for(T: int, Qb: int, d: int, passes: int) -> int:
 
 def _valid_cfg(T, Qb, g) -> bool:
     # semantic validation, not just parseability: bad values would crash
-    # every knn() call downstream; g must divide the lane count or the
-    # S % g envelope check rejects it
+    # every knn() call downstream; g = tiles-per-group ≥ 1
     return (T > 0 and T % _LANES == 0 and Qb > 0 and Qb % 8 == 0
-            and 0 < g <= _LANES and _LANES % g == 0)
+            and 0 < g <= 4096)
 
 
 def fused_defaults(passes: int = 3) -> Tuple[int, int, int]:
@@ -296,7 +359,7 @@ def fused_defaults(passes: int = 3) -> Tuple[int, int, int]:
         except Exception:
             _TUNED = {}  # malformed table must never break knn
     return (_TUNED.get(passes) or _TUNED.get(None)
-            or (2048, 256, 32))
+            or (2048, 256, 16))
 
 
 def knn_fused(x, y, k: int, passes: int = 3,
@@ -311,7 +374,10 @@ def knn_fused(x, y, k: int, passes: int = 3,
     _knn_fused). ``passes=3`` is certified-exact w.r.t. f32 scores;
     ``passes=1`` trades that for ~3× contraction speed (exact w.r.t.
     bf16 scores). ``T``/``Qb``/``g`` default to :func:`fused_defaults`
-    (measured-best when a tuning table is committed).
+    (measured-best when a tuning table is committed); ``g`` is the
+    number of consecutive index tiles folded into one certificate
+    group inside the kernel (tpg), so the candidate pool holds
+    ``2 · ceil(n_tiles/g) · 128`` entries.
     """
     if metric not in ("l2", "ip"):
         raise ValueError(f"knn_fused: metric must be 'l2' or 'ip', "
@@ -326,25 +392,22 @@ def knn_fused(x, y, k: int, passes: int = 3,
     m = y.shape[0]
     if k > m:
         raise ValueError(f"knn_fused: k={k} > index size {m}")
-    # scoped-VMEM guard: a config that exceeds Mosaic's stack limit is a
-    # guaranteed compile failure (observed: tuned-at-passes=1 winner OOMs
-    # at passes=3). Shrink Qb first (pure throughput knob), then T
-    # (weakens the certificate's slot count, so last).
-    while (footprint_for(T, Qb, d, passes) > VMEM_BUDGET and Qb > 8):
-        Qb = max(8, (Qb // 2) // 8 * 8)
-    while (footprint_for(T, Qb, d, passes) > VMEM_BUDGET
-           and T > 2 * _LANES):
-        T = max(2 * _LANES, (T // 2) // _LANES * _LANES)
+    T, Qb = fit_config(T, Qb, d, passes)
+    if g < 1:
+        raise ValueError(f"knn_fused: g={g} must be ≥ 1 (tiles per group)")
+    # the group fold iterates T // 128 lane-chunks and the carriers
+    # reshape Qb // 8 — a non-multiple T would silently skip the tail
+    # columns (no pool entry AND no certificate coverage)
+    if T % _LANES:
+        raise ValueError(f"knn_fused: T={T} must be a multiple of {_LANES}")
+    if Qb % 8:
+        raise ValueError(f"knn_fused: Qb={Qb} must be a multiple of 8")
     n_tiles = (max(m, T) + T - 1) // T
-    S = n_tiles * _LANES
-    pool = 2 * (S // min(g, S))
+    pool = 2 * (-(-n_tiles // g)) * _LANES
     if k > pool:
         raise NotImplementedError(
             f"knn_fused: k={k} too large for pool size {pool} "
             f"(shrink g or T, or use the streamed path)")
-    if S % min(g, S) != 0:
-        raise NotImplementedError(
-            f"knn_fused: group size g={g} must divide the slot count {S}")
     if Q > _Q_CHUNK:
         # bound the [Q, S] slot arrays / rescore gather: chunk the queries
         outs = [knn_fused(x[s:s + _Q_CHUNK], y, k, passes=passes,

@@ -53,43 +53,56 @@ VMEM_BUDGET = 15 * 2 ** 20
 
 
 def vmem_footprint(T: int, Qb: int, d: int, passes: int,
-                   dchunk: bool = False) -> int:
+                   dchunk: bool = False, kernel: str = "group") -> int:
     """Estimated scoped-VMEM bytes of one fused-kernel grid cell.
 
-    Calibrated against measured Mosaic compiles on v5e (tune sweep +
-    driver bench): (T=2048, Qb=1024, d=128, passes=3) was rejected at
-    20.35 MB against the 16 MB limit while the same shape at passes=1
-    compiled and ran, and (T=4096, Qb=512, passes=3) was rejected. The
-    dominant term is the [Qb, T] f32 score tile; passes=3 holds an
-    accumulator plus a fresh dot result (~2 live copies + mask/fold
-    temporaries) where passes=1 keeps ~1."""
-    d2_bufs = 1.25 if passes == 1 else 2.25
+    Calibrated against measured Mosaic compiles/rejections on v5e:
+    - slot kernel: (T=2048, Qb=1024, d=128, p3) rejected at 20.35 MB
+      vs the 16 MB limit; same shape at p1 compiled; (4096, 512, p3)
+      rejected. Model: [Qb, T] f32 score tile × ~1.25 (p1) / ~2.25 (p3)
+      live copies incl. the col-iota mask temporaries.
+    - group kernel (production): (2048, 512, d=128, p1) rejected at
+      16.36 MB WITH in-kernel masking; masking is since removed (yy
+      carries +inf — two fewer [Qb, T] buffers) but the in-kernel merge
+      holds more fold state, so its factors stay higher than the slot
+      kernel's: ~2.2 (p1) / ~3.2 (p3)."""
+    if kernel == "group":
+        d2_bufs = 2.2 if passes == 1 else 3.2
+        n_out = 5
+    else:
+        d2_bufs = 1.25 if passes == 1 else 2.25
+        n_out = 3
     dc = min(d, 256) if dchunk else d
     bytes_ = int(Qb * T * 4 * d2_bufs)
     bytes_ += T * dc * 2 * 2 * (2 if passes == 3 else 1)  # y hi(/lo), 2 bufs
     bytes_ += Qb * dc * (4 + 2)                           # x f32 + bf16 cast
     bytes_ += T * 4 * 2 + Qb * 4                          # yy (2 bufs), xx
-    bytes_ += Qb * _LANES * 12 * 2                        # slot outs + temps
+    bytes_ += Qb * _LANES * 4 * n_out * 2                 # out blocks + temps
     if dchunk:
         bytes_ += Qb * T * 4                              # score accumulator
     return bytes_
 
 
-def _contract(x, yhi, ylo):
+def _contract(x, yhi, ylo, yt: bool = False):
     """bf16 (ylo None) or bf16x3 MXU contraction of an f32 x block with a
-    bf16-split y tile → f32 [Qb, T] partial scores."""
+    bf16-split y tile → f32 [Qb, T] partial scores.
+
+    ``yt=True`` means the y tiles arrive TRANSPOSED ([d, T]) so the MXU
+    sees a native NN matmul. MEASURED (v5e, 2048×1M×128): yt loses
+    slightly (5.29 vs 4.72 ms p1) — Mosaic handles the ((1,),(1,)) NT
+    contraction natively and the XLA-side transpose costs more than it
+    saves, so yt=False is the default; the knob stays for A/B on future
+    chip generations (benchmarks/profile_fused.py kernel_p1_noyt)."""
+    dims = (((1,), (0,)), ((), ())) if yt else (((1,), (1,)), ((), ()))
     xhi = x.astype(jnp.bfloat16)
     s = jax.lax.dot_general(
-        xhi, yhi, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        xhi, yhi, dims, preferred_element_type=jnp.float32)
     if ylo is not None:
         xlo = (x - xhi.astype(jnp.float32)).astype(jnp.bfloat16)
         s = s + jax.lax.dot_general(
-            xhi, ylo, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            xhi, ylo, dims, preferred_element_type=jnp.float32)
         s = s + jax.lax.dot_general(
-            xlo, yhi, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            xlo, yhi, dims, preferred_element_type=jnp.float32)
     return s
 
 
@@ -147,11 +160,11 @@ def _fold_and_write(d2, j, m_real_ref, m1_ref, i1_ref, m2min_ref,
 def _fused_kernel(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
                   m1_ref, i1_ref, m2min_ref,
                   *, T: int, Qb: int, ylo_ref=None,
-                  mask: bool = True, track: bool = True):
+                  mask: bool = True, track: bool = True, yt: bool = False):
     """One (query-block, index-tile) cell. ``ylo_ref`` present ⇒ bf16x3."""
     j = pl.program_id(1)
     s = _contract(x_ref[...], yhi_ref[...],
-                  None if ylo_ref is None else ylo_ref[...])
+                  None if ylo_ref is None else ylo_ref[...], yt=yt)
     d2 = xx_ref[...] + yy_ref[...] - 2.0 * s         # [Qb,1]+[1,T]-[Qb,T]
     _fold_and_write(d2, j, m_real_ref, m1_ref, i1_ref, m2min_ref,
                     T=T, Qb=Qb, mask=mask, track=track)
@@ -159,7 +172,7 @@ def _fused_kernel(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
 
 def _fused_kernel_dchunk(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
                          m1_ref, i1_ref, m2min_ref, acc_ref,
-                         *, T: int, Qb: int, ylo_ref=None):
+                         *, T: int, Qb: int, ylo_ref=None, yt: bool = False):
     """d-chunked cell (grid (nq, n_tiles, n_dchunks), d innermost): the
     partial contraction accumulates into a VMEM scratch [Qb, T]; the
     mask+fold runs only on the LAST d-chunk. Lifts the d ≤ 512 envelope
@@ -232,10 +245,12 @@ def _make_kernel(base, passes: int, T: int, Qb: int, **fold_kw):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("T", "Qb", "passes", "mask", "track"))
+                   static_argnames=("T", "Qb", "passes", "mask", "track",
+                                    "yt"))
 def fused_l2_slot_topk(x, y_hi, y_lo, xx, yy, m_real,
                        T: int, Qb: int, passes: int,
-                       mask: bool = True, track: bool = True
+                       mask: bool = True, track: bool = True,
+                       yt: bool = False
                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Run the fused kernel. ``mask``/``track`` are measurement-only
     knobs (see _fold_and_write) — production callers use the defaults.
@@ -261,11 +276,20 @@ def fused_l2_slot_topk(x, y_hi, y_lo, xx, yy, m_real,
     nq = Q // Qb
     S = n_tiles * _LANES
 
+    if yt:
+        # transpose ONCE in XLA (one HBM round-trip) so every grid cell
+        # gets a native-layout [d, T] operand instead of re-transposing
+        # the same tile per query block inside the kernel
+        y_hi = y_hi.T
+        y_spec = pl.BlockSpec((d, T), lambda i, j, *_: (0, j),
+                              memory_space=pltpu.VMEM)
+    else:
+        y_spec = pl.BlockSpec((T, d), lambda i, j, *_: (j, 0),
+                              memory_space=pltpu.VMEM)
     in_specs = [
         pl.BlockSpec((Qb, d), lambda i, j, *_: (i, 0),
                      memory_space=pltpu.VMEM),          # x
-        pl.BlockSpec((T, d), lambda i, j, *_: (j, 0),
-                     memory_space=pltpu.VMEM),          # y_hi
+        y_spec,                                         # y_hi
         pl.BlockSpec((Qb, 1), lambda i, j, *_: (i, 0),
                      memory_space=pltpu.VMEM),          # xx
         pl.BlockSpec((1, T), lambda i, j, *_: (0, j),
@@ -273,11 +297,12 @@ def fused_l2_slot_topk(x, y_hi, y_lo, xx, yy, m_real,
     ]
     operands = [x, y_hi, xx, yy]
     if passes == 3:
-        in_specs.insert(2, pl.BlockSpec((T, d), lambda i, j, *_: (j, 0),
-                                        memory_space=pltpu.VMEM))  # y_lo
+        if yt:
+            y_lo = y_lo.T
+        in_specs.insert(2, y_spec)                      # y_lo
         operands.insert(2, y_lo)
     kernel = _make_kernel(_fused_kernel, passes, T, Qb,
-                          mask=mask, track=track)
+                          mask=mask, track=track, yt=yt)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -298,9 +323,11 @@ def fused_l2_slot_topk(x, y_hi, y_lo, xx, yy, m_real,
     return m1, i1, m2min
 
 
-@functools.partial(jax.jit, static_argnames=("T", "Qb", "passes", "dc"))
+@functools.partial(jax.jit,
+                   static_argnames=("T", "Qb", "passes", "dc", "yt"))
 def fused_l2_slot_topk_dchunk(x, y_hi, y_lo, xx, yy, m_real,
-                              T: int, Qb: int, passes: int, dc: int = 256
+                              T: int, Qb: int, passes: int, dc: int = 256,
+                              yt: bool = False
                               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """d-chunked variant of :func:`fused_l2_slot_topk` for wide features
     (d > 512): grid (nq, n_tiles, d/dc) with the score tile accumulated
@@ -317,11 +344,17 @@ def fused_l2_slot_topk_dchunk(x, y_hi, y_lo, xx, yy, m_real,
     n_dc = d // dc
     S = n_tiles * _LANES
 
+    if yt:
+        y_hi = y_hi.T
+        y_spec = pl.BlockSpec((dc, T), lambda i, j, l, *_: (l, j),
+                              memory_space=pltpu.VMEM)
+    else:
+        y_spec = pl.BlockSpec((T, dc), lambda i, j, l, *_: (j, l),
+                              memory_space=pltpu.VMEM)
     in_specs = [
         pl.BlockSpec((Qb, dc), lambda i, j, l, *_: (i, l),
                      memory_space=pltpu.VMEM),          # x
-        pl.BlockSpec((T, dc), lambda i, j, l, *_: (j, l),
-                     memory_space=pltpu.VMEM),          # y_hi
+        y_spec,                                         # y_hi
         pl.BlockSpec((Qb, 1), lambda i, j, *_: (i, 0),
                      memory_space=pltpu.VMEM),          # xx
         pl.BlockSpec((1, T), lambda i, j, *_: (0, j),
@@ -329,10 +362,11 @@ def fused_l2_slot_topk_dchunk(x, y_hi, y_lo, xx, yy, m_real,
     ]
     operands = [x, y_hi, xx, yy]
     if passes == 3:
-        in_specs.insert(2, pl.BlockSpec((T, dc), lambda i, j, l, *_: (j, l),
-                                        memory_space=pltpu.VMEM))  # y_lo
+        if yt:
+            y_lo = y_lo.T
+        in_specs.insert(2, y_spec)                      # y_lo
         operands.insert(2, y_lo)
-    kernel = _make_kernel(_fused_kernel_dchunk, passes, T, Qb)
+    kernel = _make_kernel(_fused_kernel_dchunk, passes, T, Qb, yt=yt)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -352,6 +386,277 @@ def fused_l2_slot_topk_dchunk(x, y_hi, y_lo, xx, yy, m_real,
         interpret=interpret_mode(),
     )(m_real, *operands)
     return m1, i1, m2min
+
+
+# --- in-kernel group fold: top-2 (+3rd-min) per (lane, tile-group) ---
+#
+# The slot kernel above writes one (min, argmin) per (tile, lane) slot —
+# [Q, n_tiles·128] outputs that an XLA group-fold then compresses.
+# MEASURED (v5e, 2048×1M×128): that fold alone costs 15.6 ms — 3× the
+# whole Pallas kernel — because XLA re-reads the ~1 GB slot arrays from
+# HBM. This variant keeps the fold INSIDE the kernel: output blocks are
+# revisited across `tpg` CONSECUTIVE index tiles (block index j // tpg —
+# consecutive, so Mosaic keeps the block VMEM-resident and writes it to
+# HBM once per group), accumulating per-(lane, group) top-2 values+ids
+# and the group 3rd-min. Outputs shrink ~tpg/2.5× and the XLA fold
+# disappears. Keeping top-2 per group also upgrades the exactness
+# certificate: a query now only fails when THREE true top-k share a
+# (lane, group) — O(k³/S²) instead of O(k²/S) — so the fixup path runs
+# orders of magnitude more rarely.
+
+
+def _merge_chunk_top2(c, ci, a1, id1, a2, id2, a3):
+    """Merge candidate chunk (values c, ids ci — [Qb, LANES]) into the
+    running per-(lane, group) (top-2 + 3rd-min) accumulators. Pure VPU
+    compare/selects; ~13 ops per element (vs 5 for the top-1 fold)."""
+    lt1 = c < a1
+    b1 = jnp.where(lt1, a1, c)          # loser of the round-1 compare
+    bid1 = jnp.where(lt1, id1, ci)
+    a1 = jnp.where(lt1, c, a1)
+    id1 = jnp.where(lt1, ci, id1)
+    lt2 = b1 < a2
+    b2 = jnp.where(lt2, a2, b1)         # loser of the round-2 compare
+    a2 = jnp.where(lt2, b1, a2)
+    id2 = jnp.where(lt2, bid1, id2)
+    a3 = jnp.minimum(a3, b2)
+    return a1, id1, a2, id2, a3
+
+
+def _group_fold_and_write(s, j, yyh_ref, a1_ref, id1_ref, a2_ref,
+                          id2_ref, a3_ref, *, T: int, Qb: int, tpg: int):
+    """Merge the [Qb, T] score tile ``s = x·y`` into the group
+    accumulators (initialized at the first tile of each group), folding
+    the half-score ``c = yy/2 − s`` chunk by chunk.
+
+    VMEM discipline (every full [Qb, T] f32 live buffer is ~25% of the
+    Mosaic 16 MB scoped stack at production tiles — measured 16.36 MB
+    rejections at T=2048, Qb=512 before these cuts):
+    - NO in-kernel padded-row masking: callers pass yy/2 = +inf for
+      padded columns; +inf loses every strict `<`, so padded-only slots
+      keep a=+inf, id=-1 (the old mask cost a col-iota + a masked copy).
+    - the half-score is computed per [Qb, LANES] chunk from the [1, T]
+      yy/2 block — never materialized at [Qb, T].
+    - candidate ids enter the merge as broadcast [1, LANES] rows, not
+      [Qb, LANES] tiles."""
+    @pl.when(j % tpg == 0)
+    def _():
+        inf = jnp.full((Qb, _LANES), jnp.inf, jnp.float32)
+        neg = jnp.full((Qb, _LANES), -1, jnp.int32)
+        a1_ref[...] = inf
+        a2_ref[...] = inf
+        a3_ref[...] = inf
+        id1_ref[...] = neg
+        id2_ref[...] = neg
+
+    # 3-D carriers [Qb/8, 8, LANES]: the [8, LANES] yy/2 slices and id
+    # rows broadcast legally against them (numpy rules) and Mosaic keeps
+    # native (8, 128) trailing tiles (a [1, N] source is an invalid-
+    # layout broadcast; a full [Qb, T] materialization is a live-buffer
+    # we can't afford)
+    q8 = Qb // 8
+    a1 = a1_ref[...].reshape(q8, 8, _LANES)
+    id1 = id1_ref[...].reshape(q8, 8, _LANES)
+    a2 = a2_ref[...].reshape(q8, 8, _LANES)
+    id2 = id2_ref[...].reshape(q8, 8, _LANES)
+    a3 = a3_ref[...].reshape(q8, 8, _LANES)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (8, _LANES), 1)
+    yyh = yyh_ref[...]                                   # [8, T]
+    for r in range(T // _LANES):
+        sl = slice(r * _LANES, (r + 1) * _LANES)
+        c = yyh[:, sl] - s[:, sl].reshape(q8, 8, _LANES)
+        ci = j * T + r * _LANES + lane                   # [8, LANES]
+        a1, id1, a2, id2, a3 = _merge_chunk_top2(
+            c, ci, a1, id1, a2, id2, a3)
+    a1_ref[...], id1_ref[...] = (a1.reshape(Qb, _LANES),
+                                 id1.reshape(Qb, _LANES))
+    a2_ref[...], id2_ref[...] = (a2.reshape(Qb, _LANES),
+                                 id2.reshape(Qb, _LANES))
+    a3_ref[...] = a3.reshape(Qb, _LANES)
+
+
+def _group_kernel(m_real_ref, x_ref, yhi_ref, yyh_ref,
+                  a1_ref, id1_ref, a2_ref, id2_ref, a3_ref,
+                  *, T: int, Qb: int, tpg: int, ylo_ref=None):
+    """Folds the HALF-SCORE r = yy/2 − s (NOT the full distance): per
+    query row, d2 = 2·r + xx is a positive-scale + per-row-shift of r,
+    so per-row top-2 ordering is identical and the caller recovers true
+    distances on the tiny [Q, S'] outputs. Dropping xx and the ·2 from
+    the kernel removes one live [Qb, T] f32 buffer from the broadcast
+    chain — the difference between 16.36 MB (scoped-VMEM reject at
+    T=2048, Qb=512) and fitting."""
+    j = pl.program_id(1)
+    s = _contract(x_ref[...], yhi_ref[...],
+                  None if ylo_ref is None else ylo_ref[...])
+    _group_fold_and_write(s, j, yyh_ref, a1_ref, id1_ref, a2_ref,
+                          id2_ref, a3_ref, T=T, Qb=Qb, tpg=tpg)
+
+
+def _group_kernel_dchunk(m_real_ref, x_ref, yhi_ref, yyh_ref,
+                         a1_ref, id1_ref, a2_ref, id2_ref, a3_ref, acc_ref,
+                         *, T: int, Qb: int, tpg: int, ylo_ref=None):
+    j = pl.program_id(1)
+    l = pl.program_id(2)
+    n_dc = pl.num_programs(2)
+    s = _contract(x_ref[...], yhi_ref[...],
+                  None if ylo_ref is None else ylo_ref[...])
+
+    @pl.when(l == 0)
+    def _():
+        acc_ref[...] = s
+
+    @pl.when(l != 0)
+    def _():
+        acc_ref[...] = acc_ref[...] + s
+
+    @pl.when(l == n_dc - 1)
+    def _():
+        _group_fold_and_write(acc_ref[...], j, yyh_ref, a1_ref, id1_ref,
+                              a2_ref, id2_ref, a3_ref, T=T, Qb=Qb, tpg=tpg)
+
+
+def _make_group_kernel(base, passes: int, T: int, Qb: int, **fold_kw):
+    """Bind the group-kernel base for the passes mode (group kernels
+    take no xx operand; for passes == 3 reorder the y_lo ref out of the
+    positional stream, as _make_kernel does for the slot kernels)."""
+    if passes != 3:
+        return functools.partial(base, T=T, Qb=Qb, ylo_ref=None, **fold_kw)
+
+    def kernel(m_real_ref, x_ref, yhi_ref, ylo_ref, yyh_ref, *rest):
+        base(m_real_ref, x_ref, yhi_ref, yyh_ref, *rest,
+             T=T, Qb=Qb, ylo_ref=ylo_ref, **fold_kw)
+
+    return kernel
+
+
+def _group_out_specs(Qb: int, tpg: int):
+    spec = pl.BlockSpec((Qb, _LANES), lambda i, j, *_: (i, j // tpg),
+                        memory_space=pltpu.VMEM)
+    return [spec] * 5
+
+
+def _group_out_shape(Q: int, Sg: int):
+    return [
+        jax.ShapeDtypeStruct((Q, Sg), jnp.float32),   # a1
+        jax.ShapeDtypeStruct((Q, Sg), jnp.int32),     # id1
+        jax.ShapeDtypeStruct((Q, Sg), jnp.float32),   # a2
+        jax.ShapeDtypeStruct((Q, Sg), jnp.int32),     # id2
+        jax.ShapeDtypeStruct((Q, Sg), jnp.float32),   # a3
+    ]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("T", "Qb", "passes", "tpg"))
+def fused_l2_group_topk(x, y_hi, y_lo, yy_half, m_real,
+                        T: int, Qb: int, passes: int, tpg: int = 16):
+    """Fused kernel with the IN-KERNEL group fold (see block comment).
+
+    Folds the HALF-SCORE ``r = yy/2 − x·y`` (see _group_kernel): callers
+    pass ``yy_half`` as an ``[8, M]`` sublane-replicated carrier (8 =
+    native vreg sublane count; Mosaic rejects [1, N]→[Qb, N] broadcasts
+    of sliced rows) holding ‖y‖²/2 with +inf on padded index columns (no
+    in-kernel mask; ``m_real`` stays as a prefetch operand for interface
+    stability but is not read) and recover true squared distances as
+    ``2·a + xx`` on the outputs. ``tpg`` = index tiles per group.
+    Returns ``(a1, id1, a2, id2, a3)``, each ``[Q, G·LANES]`` with
+    ``G = ceil(n_tiles / tpg)``: per (lane-class, tile-group) the two
+    smallest half-scores with their GLOBAL index-row ids, and the
+    3rd-smallest (certificate input: every point outside a group's
+    top-2 is ≥ that group's a3). Padded-only groups keep a=+inf,
+    id=-1."""
+    Q, d = x.shape
+    M = y_hi.shape[0]
+    n_tiles = M // T
+    nq = Q // Qb
+    G = -(-n_tiles // tpg)
+
+    y_spec = pl.BlockSpec((T, d), lambda i, j, *_: (j, 0),
+                          memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec((Qb, d), lambda i, j, *_: (i, 0),
+                     memory_space=pltpu.VMEM),          # x
+        y_spec,                                         # y_hi
+        pl.BlockSpec((8, T), lambda i, j, *_: (0, j),
+                     memory_space=pltpu.VMEM),          # yy_half
+    ]
+    operands = [x, y_hi, yy_half]
+    if passes == 3:
+        in_specs.insert(2, y_spec)                      # y_lo
+        operands.insert(2, y_lo)
+    kernel = _make_group_kernel(_group_kernel, passes, T, Qb, tpg=tpg)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nq, n_tiles),
+        in_specs=in_specs,
+        out_specs=_group_out_specs(Qb, tpg),
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_group_out_shape(Q, G * _LANES),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=_slot_cost(Q, M, d, G * _LANES, passes),
+        interpret=interpret_mode(),
+    )(m_real, *operands)
+    return outs
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("T", "Qb", "passes", "tpg", "dc"))
+def fused_l2_group_topk_dchunk(x, y_hi, y_lo, yy_half, m_real,
+                               T: int, Qb: int, passes: int, tpg: int = 16,
+                               dc: int = 256):
+    """d-chunked variant of :func:`fused_l2_group_topk` (wide features):
+    grid (nq, n_tiles, d/dc), score accumulated in VMEM scratch, the
+    group fold runs on the last d-chunk only. Same (half-score)
+    outputs."""
+    Q, d = x.shape
+    M = y_hi.shape[0]
+    if d % dc:
+        raise ValueError(
+            f"fused_l2_group_topk_dchunk: d={d} must be a multiple of "
+            f"dc={dc} (the tail would be silently dropped)")
+    n_tiles = M // T
+    nq = Q // Qb
+    n_dc = d // dc
+    G = -(-n_tiles // tpg)
+
+    y_spec = pl.BlockSpec((T, dc), lambda i, j, l, *_: (j, l),
+                          memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec((Qb, dc), lambda i, j, l, *_: (i, l),
+                     memory_space=pltpu.VMEM),          # x
+        y_spec,                                         # y_hi
+        pl.BlockSpec((8, T), lambda i, j, *_: (0, j),
+                     memory_space=pltpu.VMEM),          # yy_half
+    ]
+    operands = [x, y_hi, yy_half]
+    if passes == 3:
+        in_specs.insert(2, y_spec)                      # y_lo
+        operands.insert(2, y_lo)
+    kernel = _make_group_kernel(_group_kernel_dchunk, passes, T, Qb,
+                                tpg=tpg)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nq, n_tiles, n_dc),
+        in_specs=in_specs,
+        out_specs=_group_out_specs(Qb, tpg),
+        scratch_shapes=[pltpu.VMEM((Qb, T), jnp.float32)],  # score acc
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_group_out_shape(Q, G * _LANES),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        cost_estimate=_slot_cost(Q, M, d, G * _LANES, passes),
+        interpret=interpret_mode(),
+    )(m_real, *operands)
+    return outs
 
 
 def split_hi_lo(y: jax.Array) -> Tuple[jax.Array, jax.Array]:

@@ -85,17 +85,26 @@ def test_query_chunking_matches_single_shot(monkeypatch):
 
 
 def test_bad_group_size_raises():
-    with pytest.raises(NotImplementedError, match="must divide"):
-        knn_fused(rng.normal(size=(16, 8)).astype(np.float32),
-                  rng.normal(size=(2048, 8)).astype(np.float32),
-                  k=4, T=512, Qb=16, g=48)
+    # g is tiles-per-group now: any g ≥ 1 is legal (48 > n_tiles just
+    # means one group); g < 1 is rejected
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    y = rng.normal(size=(2048, 8)).astype(np.float32)
+    vals, ids = knn_fused(x, y, k=4, T=512, Qb=16, g=48)
+    ref_vals, ref_ids, tol = _oracle(x, y, 4)
+    np.testing.assert_allclose(np.asarray(vals), ref_vals, atol=tol)
+    with pytest.raises(ValueError, match="tiles per group"):
+        knn_fused(x, y, k=4, T=512, Qb=16, g=0)
 
 
-def test_k_equals_m_small_index_raises():
-    with pytest.raises(NotImplementedError):
-        knn_fused(rng.normal(size=(16, 8)).astype(np.float32),
-                  rng.normal(size=(64, 8)).astype(np.float32),
-                  k=64, T=512, Qb=64, g=8)
+def test_k_equals_m_small_index():
+    # k == m on a single padded tile: the pool (2·128) covers all 64
+    # points, so the result is simply all points sorted
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    y = rng.normal(size=(64, 8)).astype(np.float32)
+    vals, ids = knn_fused(x, y, k=64, T=512, Qb=64, g=8)
+    ref_vals, ref_ids, tol = _oracle(x, y, 64)
+    np.testing.assert_allclose(np.asarray(vals), ref_vals, atol=tol)
+    assert np.array_equal(np.sort(np.asarray(ids), 1), np.sort(ref_ids, 1))
 
 
 def test_k_larger_than_index_raises():
@@ -197,16 +206,16 @@ def test_fused_defaults_table(tmp_path, monkeypatch):
         {"best": {"T": 4096, "Qb": 512, "g": 16, "passes": 1}}))
     kf._TUNED = ...
     assert kf.fused_defaults(1) == (4096, 512, 16)
-    assert kf.fused_defaults(3) == (2048, 256, 32)   # hand default
+    assert kf.fused_defaults(3) == (2048, 256, 16)   # hand default
 
     tbl.write_text("{not json")
     kf._TUNED = ...
-    assert kf.fused_defaults() == (2048, 256, 32)
+    assert kf.fused_defaults() == (2048, 256, 16)
 
     # semantically invalid values (T=0 would div-by-zero in knn) degrade
     tbl.write_text(json.dumps({"best": {"T": 0, "Qb": 512, "g": 16}}))
     kf._TUNED = ...
-    assert kf.fused_defaults() == (2048, 256, 32)
+    assert kf.fused_defaults() == (2048, 256, 16)
 
 
 def test_vmem_footprint_guard():
@@ -218,13 +227,21 @@ def test_vmem_footprint_guard():
     from raft_tpu.ops.fused_l2_topk_pallas import (
         VMEM_BUDGET, vmem_footprint)
 
-    # measured rejections (tune sweep + driver bench, v5e)
-    assert vmem_footprint(2048, 1024, 128, passes=3) > VMEM_BUDGET
-    assert vmem_footprint(4096, 512, 128, passes=3) > VMEM_BUDGET
-    # measured compiles
-    assert vmem_footprint(2048, 1024, 128, passes=1) <= VMEM_BUDGET
-    assert vmem_footprint(2048, 512, 128, passes=3) <= VMEM_BUDGET
-    assert vmem_footprint(1024, 1024, 128, passes=3) <= VMEM_BUDGET
+    # slot kernel: measured rejections (tune sweep + driver bench, v5e)
+    assert vmem_footprint(2048, 1024, 128, passes=3,
+                          kernel="slot") > VMEM_BUDGET
+    assert vmem_footprint(4096, 512, 128, passes=3,
+                          kernel="slot") > VMEM_BUDGET
+    # slot kernel: measured compiles
+    assert vmem_footprint(2048, 1024, 128, passes=1,
+                          kernel="slot") <= VMEM_BUDGET
+    assert vmem_footprint(2048, 512, 128, passes=3,
+                          kernel="slot") <= VMEM_BUDGET
+    # group kernel (the production default): big-tile p3 prunes to a
+    # smaller Qb; the post-mask-removal p1 point fits
+    assert vmem_footprint(2048, 512, 128, passes=1) <= VMEM_BUDGET
+    assert vmem_footprint(2048, 512, 128, passes=3) > VMEM_BUDGET
+    assert vmem_footprint(2048, 256, 128, passes=3) <= VMEM_BUDGET
 
     # the guard inside knn_fused: an explicit over-budget config still
     # produces correct (shrunk-config) results rather than an OOM
@@ -301,3 +318,74 @@ def test_wide_features_fast_mode_recall():
     recall = np.mean([len(set(np.asarray(ids)[i]) & set(ref_ids[i])) / k
                       for i in range(Q)])
     assert recall >= 0.97
+
+
+def test_group_kernel_vs_numpy_oracle():
+    """fused_l2_group_topk's per-(lane, tile-group) top-2 + 3rd-min
+    against a direct numpy computation of the same partition."""
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.fused_l2_topk_pallas import (
+        _LANES, fused_l2_group_topk, split_hi_lo)
+
+    Q, m, d, T, Qb, tpg = 16, 5 * 512, 128, 512, 16, 2
+    x = rng.normal(size=(Q, d)).astype(np.float32)
+    y = rng.normal(size=(m, d)).astype(np.float32)
+    M = ((m + T - 1) // T) * T                  # pad rows like knn_fused
+    yp = np.concatenate([y, np.zeros((M - m, d), np.float32)])
+    n_tiles = M // T
+    G = -(-n_tiles // tpg)
+
+    y_hi, y_lo = split_hi_lo(jnp.asarray(yp))
+    xx = jnp.sum(jnp.asarray(x) ** 2, axis=1, keepdims=True)
+    # half-score operand: yy/2 with +inf on padded columns (the kernel
+    # does no masking of its own)
+    yyh = jnp.broadcast_to(
+        jnp.where((jnp.arange(M) < m)[None, :],
+                  0.5 * jnp.sum(jnp.asarray(yp) ** 2, axis=1)[None, :],
+                  jnp.inf), (8, M))
+    a1, id1, a2, id2, a3 = fused_l2_group_topk(
+        jnp.asarray(x), y_hi, y_lo, yyh,
+        jnp.full((1,), m, jnp.int32), T=T, Qb=Qb, passes=3, tpg=tpg)
+    # recover true squared distances: d2 = 2·r + ‖x‖²
+    a1, a2, a3 = (np.asarray(2.0 * v + xx) for v in (a1, a2, a3))
+    id1, id2 = map(np.asarray, (id1, id2))
+    assert a1.shape == (Q, G * _LANES)
+
+    # numpy oracle: same expanded-L2 score in f64 (tolerance = expanded
+    # f32 floor), same (lane, group) partition
+    d2 = ((x.astype(np.float64) ** 2).sum(1)[:, None]
+          + (yp.astype(np.float64) ** 2).sum(1)[None, :]
+          - 2.0 * x.astype(np.float64) @ yp.astype(np.float64).T)
+    d2[:, m:] = np.inf
+    # raw kernel scores are bf16x3-grade (rescoring happens downstream in
+    # knn_fused): tolerance is the kernel's own analytic error bound
+    from raft_tpu.distance.knn_fused import _err_bound_coeff
+    tol = _err_bound_coeff(d) * float(
+        np.linalg.norm(x, axis=1).max()
+        * np.linalg.norm(yp, axis=1).max())
+    for g in range(G):
+        cols = []
+        for j in range(g * tpg, min((g + 1) * tpg, n_tiles)):
+            cols.append(np.arange(j * T, (j + 1) * T))
+        cols = np.concatenate(cols)
+        for lane in range(0, _LANES, 37):       # sample lanes
+            lane_cols = cols[cols % _LANES == lane]
+            sub = d2[:, lane_cols]              # [Q, tiles*T/128]
+            order = np.argsort(sub, axis=1)
+            s = g * _LANES + lane
+            want1 = np.take_along_axis(sub, order[:, :1], 1)[:, 0]
+            want2 = np.take_along_axis(sub, order[:, 1:2], 1)[:, 0]
+            want3 = np.take_along_axis(sub, order[:, 2:3], 1)[:, 0]
+            np.testing.assert_allclose(a1[:, s], want1, atol=tol)
+            np.testing.assert_allclose(a2[:, s], want2, atol=tol)
+            np.testing.assert_allclose(a3[:, s], want3, atol=tol)
+            # ids: the claimed top-2 columns must reproduce the values
+            got_c1 = np.take_along_axis(
+                d2, id1[:, s][:, None].astype(np.int64), 1)[:, 0]
+            got_c2 = np.take_along_axis(
+                d2, id2[:, s][:, None].astype(np.int64), 1)[:, 0]
+            np.testing.assert_allclose(got_c1, want1, atol=tol)
+            np.testing.assert_allclose(got_c2, want2, atol=tol)
+            assert (id1[:, s] % _LANES == lane).all()
+            assert (id2[:, s] % _LANES == lane).all()
