@@ -83,17 +83,15 @@ def vmem_footprint(T: int, Qb: int, d: int, passes: int,
     return bytes_
 
 
-def _contract(x, yhi, ylo, yt: bool = False):
+def _contract(x, yhi, ylo):
     """bf16 (ylo None) or bf16x3 MXU contraction of an f32 x block with a
     bf16-split y tile → f32 [Qb, T] partial scores.
 
-    ``yt=True`` means the y tiles arrive TRANSPOSED ([d, T]) so the MXU
-    sees a native NN matmul. MEASURED (v5e, 2048×1M×128): yt loses
-    slightly (5.29 vs 4.72 ms p1) — Mosaic handles the ((1,),(1,)) NT
-    contraction natively and the XLA-side transpose costs more than it
-    saves, so yt=False is the default; the knob stays for A/B on future
-    chip generations (benchmarks/profile_fused.py kernel_p1_noyt)."""
-    dims = (((1,), (0,)), ((), ())) if yt else (((1,), (1,)), ((), ()))
+    The ((1,),(1,)) NT contraction is used directly: a pre-transposed
+    [d, T] y layout was A/B-measured on v5e (2048×1M×128) and LOST
+    (5.29 vs 4.72 ms p1) — Mosaic handles NT natively and the XLA-side
+    transpose costs more than it saves, so the knob was removed."""
+    dims = (((1,), (1,)), ((), ()))
     xhi = x.astype(jnp.bfloat16)
     s = jax.lax.dot_general(
         xhi, yhi, dims, preferred_element_type=jnp.float32)
@@ -160,11 +158,11 @@ def _fold_and_write(d2, j, m_real_ref, m1_ref, i1_ref, m2min_ref,
 def _fused_kernel(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
                   m1_ref, i1_ref, m2min_ref,
                   *, T: int, Qb: int, ylo_ref=None,
-                  mask: bool = True, track: bool = True, yt: bool = False):
+                  mask: bool = True, track: bool = True):
     """One (query-block, index-tile) cell. ``ylo_ref`` present ⇒ bf16x3."""
     j = pl.program_id(1)
     s = _contract(x_ref[...], yhi_ref[...],
-                  None if ylo_ref is None else ylo_ref[...], yt=yt)
+                  None if ylo_ref is None else ylo_ref[...])
     d2 = xx_ref[...] + yy_ref[...] - 2.0 * s         # [Qb,1]+[1,T]-[Qb,T]
     _fold_and_write(d2, j, m_real_ref, m1_ref, i1_ref, m2min_ref,
                     T=T, Qb=Qb, mask=mask, track=track)
@@ -172,7 +170,7 @@ def _fused_kernel(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
 
 def _fused_kernel_dchunk(m_real_ref, x_ref, yhi_ref, xx_ref, yy_ref,
                          m1_ref, i1_ref, m2min_ref, acc_ref,
-                         *, T: int, Qb: int, ylo_ref=None, yt: bool = False):
+                         *, T: int, Qb: int, ylo_ref=None):
     """d-chunked cell (grid (nq, n_tiles, n_dchunks), d innermost): the
     partial contraction accumulates into a VMEM scratch [Qb, T]; the
     mask+fold runs only on the LAST d-chunk. Lifts the d ≤ 512 envelope
@@ -230,6 +228,18 @@ def _slot_cost(Q: int, M: int, d: int, S: int, passes: int):
     )
 
 
+def _check_tiling(T: int, Qb: int):
+    """The folds iterate T // LANES lane-chunks and the 3-D carriers
+    reshape Qb // 8: a non-multiple T would SILENTLY skip the tail
+    columns of every tile (no pool entry, no certificate coverage), so
+    the invariant is enforced at the kernel entry points, not just in
+    knn_fused."""
+    if T % _LANES:
+        raise ValueError(f"T={T} must be a multiple of {_LANES}")
+    if Qb % 8:
+        raise ValueError(f"Qb={Qb} must be a multiple of 8")
+
+
 def _make_kernel(base, passes: int, T: int, Qb: int, **fold_kw):
     """Bind the base kernel for the passes mode; for passes == 3 reorder
     the y_lo ref out of the positional stream (*rest carries the output
@@ -245,12 +255,10 @@ def _make_kernel(base, passes: int, T: int, Qb: int, **fold_kw):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("T", "Qb", "passes", "mask", "track",
-                                    "yt"))
+                   static_argnames=("T", "Qb", "passes", "mask", "track"))
 def fused_l2_slot_topk(x, y_hi, y_lo, xx, yy, m_real,
                        T: int, Qb: int, passes: int,
-                       mask: bool = True, track: bool = True,
-                       yt: bool = False
+                       mask: bool = True, track: bool = True
                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Run the fused kernel. ``mask``/``track`` are measurement-only
     knobs (see _fold_and_write) — production callers use the defaults.
@@ -270,22 +278,15 @@ def fused_l2_slot_topk(x, y_hi, y_lo, xx, yy, m_real,
       s % LANES); i1 holds GLOBAL index-row ids; padded-only slots keep
       m1 = +inf, i1 = -1.
     """
+    _check_tiling(T, Qb)
     Q, d = x.shape
     M = y_hi.shape[0]
     n_tiles = M // T
     nq = Q // Qb
     S = n_tiles * _LANES
 
-    if yt:
-        # transpose ONCE in XLA (one HBM round-trip) so every grid cell
-        # gets a native-layout [d, T] operand instead of re-transposing
-        # the same tile per query block inside the kernel
-        y_hi = y_hi.T
-        y_spec = pl.BlockSpec((d, T), lambda i, j, *_: (0, j),
-                              memory_space=pltpu.VMEM)
-    else:
-        y_spec = pl.BlockSpec((T, d), lambda i, j, *_: (j, 0),
-                              memory_space=pltpu.VMEM)
+    y_spec = pl.BlockSpec((T, d), lambda i, j, *_: (j, 0),
+                          memory_space=pltpu.VMEM)
     in_specs = [
         pl.BlockSpec((Qb, d), lambda i, j, *_: (i, 0),
                      memory_space=pltpu.VMEM),          # x
@@ -297,12 +298,10 @@ def fused_l2_slot_topk(x, y_hi, y_lo, xx, yy, m_real,
     ]
     operands = [x, y_hi, xx, yy]
     if passes == 3:
-        if yt:
-            y_lo = y_lo.T
         in_specs.insert(2, y_spec)                      # y_lo
         operands.insert(2, y_lo)
     kernel = _make_kernel(_fused_kernel, passes, T, Qb,
-                          mask=mask, track=track, yt=yt)
+                          mask=mask, track=track)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -324,15 +323,15 @@ def fused_l2_slot_topk(x, y_hi, y_lo, xx, yy, m_real,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("T", "Qb", "passes", "dc", "yt"))
+                   static_argnames=("T", "Qb", "passes", "dc"))
 def fused_l2_slot_topk_dchunk(x, y_hi, y_lo, xx, yy, m_real,
-                              T: int, Qb: int, passes: int, dc: int = 256,
-                              yt: bool = False
+                              T: int, Qb: int, passes: int, dc: int = 256
                               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """d-chunked variant of :func:`fused_l2_slot_topk` for wide features
     (d > 512): grid (nq, n_tiles, d/dc) with the score tile accumulated
     in VMEM scratch across d-chunks (see _fused_kernel_dchunk). Same
     contract and outputs; caller pads d to a multiple of ``dc``."""
+    _check_tiling(T, Qb)
     Q, d = x.shape
     M = y_hi.shape[0]
     if d % dc:
@@ -344,13 +343,8 @@ def fused_l2_slot_topk_dchunk(x, y_hi, y_lo, xx, yy, m_real,
     n_dc = d // dc
     S = n_tiles * _LANES
 
-    if yt:
-        y_hi = y_hi.T
-        y_spec = pl.BlockSpec((dc, T), lambda i, j, l, *_: (l, j),
-                              memory_space=pltpu.VMEM)
-    else:
-        y_spec = pl.BlockSpec((T, dc), lambda i, j, l, *_: (j, l),
-                              memory_space=pltpu.VMEM)
+    y_spec = pl.BlockSpec((T, dc), lambda i, j, l, *_: (j, l),
+                          memory_space=pltpu.VMEM)
     in_specs = [
         pl.BlockSpec((Qb, dc), lambda i, j, l, *_: (i, l),
                      memory_space=pltpu.VMEM),          # x
@@ -362,11 +356,9 @@ def fused_l2_slot_topk_dchunk(x, y_hi, y_lo, xx, yy, m_real,
     ]
     operands = [x, y_hi, xx, yy]
     if passes == 3:
-        if yt:
-            y_lo = y_lo.T
         in_specs.insert(2, y_spec)                      # y_lo
         operands.insert(2, y_lo)
-    kernel = _make_kernel(_fused_kernel_dchunk, passes, T, Qb, yt=yt)
+    kernel = _make_kernel(_fused_kernel_dchunk, passes, T, Qb)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -563,6 +555,7 @@ def fused_l2_group_topk(x, y_hi, y_lo, yy_half, m_real,
     3rd-smallest (certificate input: every point outside a group's
     top-2 is ≥ that group's a3). Padded-only groups keep a=+inf,
     id=-1."""
+    _check_tiling(T, Qb)
     Q, d = x.shape
     M = y_hi.shape[0]
     n_tiles = M // T
@@ -612,6 +605,7 @@ def fused_l2_group_topk_dchunk(x, y_hi, y_lo, yy_half, m_real,
     grid (nq, n_tiles, d/dc), score accumulated in VMEM scratch, the
     group fold runs on the last d-chunk only. Same (half-score)
     outputs."""
+    _check_tiling(T, Qb)
     Q, d = x.shape
     M = y_hi.shape[0]
     if d % dc:

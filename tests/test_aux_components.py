@@ -32,9 +32,15 @@ def test_manager_round_robin():
     mgr.set_base_seed(5)
     mgr.set_workspace_allocation_limit(1 << 22)
     handles = {}
+    # all 4 threads must be ALIVE simultaneously: threading.get_ident()
+    # is reused after a thread exits, so without the barrier sequential
+    # scheduling collapses the workers onto one reused ident/slot
+    # (observed flake when run after slow test modules)
+    barrier = threading.Barrier(4)
 
     def worker(i):
         handles[i] = mgr.get_device_resources()
+        barrier.wait(timeout=30)
 
     threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
     for t in threads:
