@@ -96,23 +96,24 @@ def main():
                     warnings.filterwarnings(
                         "error", message=r"select_k: explicit",
                         category=RuntimeWarning)
-                    # a clamped (≤0 after RTT subtraction) span means
-                    # "below timing resolution": escalate reps until the
-                    # batched span clears the tunnel RTT (high-RTT
-                    # windows otherwise flood the table with identical
-                    # resolution-bound cells the AUTO fit can't rank);
-                    # if even 96 reps can't resolve it, record the
-                    # resolution upper bound rtt/reps — honest, and
+                    # an unresolved span (op time within RTT jitter —
+                    # Fixture's `resolved` contract) escalates reps
+                    # until the batched span clears the tunnel RTT
+                    # (high-RTT windows otherwise flood the table with
+                    # identical resolution-bound cells the AUTO fit
+                    # can't rank); if even 96 reps can't resolve it,
+                    # record the resolution upper bound — honest, and
                     # discarded by the table loader
                     for reps in (fx.reps, 24, 96):
                         fxr = fx if reps == fx.reps else Fixture(
                             res=res, reps=reps)
                         r = fxr.run(lambda x, a=algo: select_k(
                             res, x, k=k, algo=a)[0], v)
-                        ms = round(r["seconds"] * 1e3, 3)
-                        if ms > 0.0:
+                        if r["resolved"]:
+                            ms = round(r["seconds"] * 1e3, 3)
                             break
-                        ms = round(r["rtt"] / reps * 1e3, 3)
+                        ms = round(max(r["seconds"], r["resolution"])
+                                   * 1e3, 3)
                 row[algo.name] = ms
             except Exception as e:  # noqa: BLE001 — record, keep sweeping
                 row[algo.name] = f"error: {type(e).__name__}"

@@ -77,8 +77,17 @@ class Fixture:
             # device-side index first: fetch ONE scalar, not the whole leaf
             float(np.asarray(leaf.ravel()[0]))
             spans.append(time.perf_counter() - t0)
-        return {"seconds": max((min(spans) - rtt) / self.reps, 1e-9),
-                "rtt": rtt}
+        op_total = min(spans) - rtt
+        # resolution contract, consumed by the measurement scripts (ONE
+        # implementation — benchmarks must not reinvent the clamp):
+        # a span whose op part is within RTT-jitter territory (< 1/4 of
+        # an RTT) is UNRESOLVED; callers should escalate reps or report
+        # `resolution` (= rtt/reps, the per-rep upper bound) marked as a
+        # bound, never the noise-derived number.
+        return {"seconds": max(op_total / self.reps, 1e-9),
+                "rtt": rtt,
+                "resolved": op_total >= 0.25 * rtt,
+                "resolution": rtt / self.reps}
 
     def throughput(self, fn: Callable, nbytes: float, *args) -> Dict[str, float]:
         r = self.run(fn, *args)
