@@ -112,8 +112,12 @@ def main():
                         if r["resolved"]:
                             ms = round(r["seconds"] * 1e3, 3)
                             break
-                        ms = round(max(r["seconds"], r["resolution"])
-                                   * 1e3, 3)
+                        # unresolved even at max reps: record the bound
+                        # as a STRING so the AUTO table loader (which
+                        # keeps only numeric cells) cannot label a cell
+                        # off measurement noise
+                        ms = "<= %.3f" % (max(r["seconds"],
+                                              r["resolution"]) * 1e3)
                 row[algo.name] = ms
             except Exception as e:  # noqa: BLE001 — record, keep sweeping
                 row[algo.name] = f"error: {type(e).__name__}"

@@ -256,8 +256,10 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
     # knn_fused's own envelope so auto never round-trips an exception
     from raft_tpu.distance.knn_fused import fused_defaults
 
-    # per-passes tuned defaults: fused_fast runs passes=1
-    _T, _, _g = fused_defaults(1 if algo == "fused_fast" else 3)
+    # auto-routing only ever runs passes=3, and FORCED fused requests
+    # rely on knn_fused's own envelope errors (re-raised below), so the
+    # pool precheck mirrors the passes=3 defaults
+    _T, _, _g = fused_defaults(3)
     fused_pool = (2 * 128 // _g) * -(-max(n, _T) // _T)
     # d ≤ 512 takes the single-shot kernel; wider features take the
     # d-chunked kernel (VMEM scratch accumulator) up to a pragmatic cap

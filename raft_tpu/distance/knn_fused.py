@@ -56,7 +56,9 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.ops.fused_l2_topk_pallas import (
-    _LANES, VMEM_BUDGET, fused_l2_group_topk, fused_l2_group_topk_dchunk,
+    _LANES, _PACK_BITS, _PACK_MASK, _PACK_PAD, VMEM_BUDGET,
+    fused_l2_group_topk, fused_l2_group_topk_dchunk,
+    fused_l2_group_topk_packed, fused_l2_group_topk_packed_dchunk,
     split_hi_lo, vmem_footprint)
 
 # past this feature width the single-shot kernel's [Qb/T, d] VMEM tiles
@@ -124,47 +126,84 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
     yy_raw = jnp.sum(yp * yp, axis=1)[None, :]                  # [1,M] f32
     # the kernel folds the HALF-SCORE r = yy/2 − x·y (a positive-scale +
     # per-row-shift of d2, so per-row ordering is identical — one fewer
-    # live [Qb, T] buffer in-kernel); padded index columns carry +inf so
-    # they lose every strict < in the fold (no in-kernel masking). True
-    # distances are recovered as 2·r + xx on the tiny [Q, S'] outputs.
+    # live [Qb, T] buffer in-kernel); padded index columns carry a
+    # "never wins" sentinel so they lose every strict < in the fold (no
+    # in-kernel masking). True distances are recovered as 2·r + xx on
+    # the tiny [Q, S'] outputs.
+    #
+    # PACKED path (production whenever the per-group slot count fits the
+    # _PACK_BITS code space): candidate ids ride in the low mantissa
+    # bits of the half-scores — no id selects in the merge, no id output
+    # arrays, no pool-id gather; the candidate column reconstructs from
+    # (pool position, embedded code). Packing perturbs values by
+    # ≤ |v|·2⁻¹⁵, absorbed into the certificate margin e_pack below.
+    n_ch = T // _LANES
+    packed = g * n_ch <= (1 << _PACK_BITS)
+    pad_sentinel = _PACK_PAD if packed else jnp.inf
     valid = (jnp.arange(M, dtype=jnp.int32) < m)[None, :]
     if metric == "ip":
         # r = 0/2 − x·(y/2) = −x·y/2 → score −x·y = 2·r (+ xx_r = 0)
         y_hi, y_lo = split_hi_lo(yp * 0.5)
-        yyh_k = jnp.where(valid, 0.0, jnp.inf)
+        yyh_k = jnp.where(valid, 0.0, pad_sentinel)
         xx_r = jnp.zeros((Q, 1), jnp.float32)
     else:
         y_hi, y_lo = split_hi_lo(yp)
-        yyh_k = jnp.where(valid, 0.5 * yy_raw, jnp.inf)
+        yyh_k = jnp.where(valid, 0.5 * yy_raw, pad_sentinel)
         xx_r = xx
     # [8, M] sublane-replicated carrier (see fused_l2_group_topk)
     yyh_k = jnp.broadcast_to(yyh_k, (8, M))
     m_real = jnp.full((1,), m, jnp.int32)
 
-    if d > _D_SINGLE_SHOT:
-        a1, id1, a2, id2, a3 = fused_l2_group_topk_dchunk(
-            x, y_hi, y_lo, yyh_k, m_real, T=T, Qb=Qb, passes=passes,
-            tpg=g, dc=_DC)
+    if packed:
+        kern = (fused_l2_group_topk_packed_dchunk if d > _D_SINGLE_SHOT
+                else fused_l2_group_topk_packed)
+        kw = {"dc": _DC} if d > _D_SINGLE_SHOT else {}
+        a1p, a2p, a3p = kern(x, y_hi, y_lo, yyh_k, m_real, T=T, Qb=Qb,
+                             passes=passes, tpg=g, **kw)
+        S_ = a1p.shape[1]
+        pool_p = jnp.concatenate([a1p, a2p], axis=1)            # [Q, 2S']
+        C = min(k + _POOL_PAD, pool_p.shape[1])
+        # packed f32 order == value order (negation flips only the sign
+        # bit, so codes survive the top_k round-trip)
+        neg_top, pos = jax.lax.top_k(-pool_p, C)
+        cand_p = -neg_top
+        slot = pos % S_
+        local = jax.lax.bitcast_convert_type(
+            cand_p, jnp.int32) & _PACK_MASK
+        col = ((slot // _LANES) * g + local // n_ch) * T \
+            + (local % n_ch) * _LANES + (slot % _LANES)
+        cand_pid = jnp.where(cand_p < _PACK_PAD * 0.25, col, -1)
+        cand_v_hat = 2.0 * cand_p + xx_r
+        a3_min = 2.0 * jnp.min(a3p, axis=1) + xx_r[:, 0]
+        # packing error margin: |Δhalf| ≤ |half|·2⁻¹⁵ and
+        # |half| ≤ (xx + 2·yymax)/2, doubled through the ·2 recovery,
+        # plus safety factor 2
+        e_pack = (xx[:, 0] + 2.0 * jnp.max(yy_raw)) * 2.0 ** -14
     else:
-        a1, id1, a2, id2, a3 = fused_l2_group_topk(
-            x, y_hi, y_lo, yyh_k, m_real, T=T, Qb=Qb, passes=passes,
-            tpg=g)
-    # recover kernel-score space (d2 for l2, −x·y for ip); +inf stays
-    # +inf, ids untouched
-    a1 = 2.0 * a1 + xx_r
-    a2 = 2.0 * a2 + xx_r
-    a3 = 2.0 * a3 + xx_r
-
-    pool_v = jnp.concatenate([a1, a2], axis=1)                  # [Q, 2S']
-    pool_id = jnp.concatenate([id1, id2], axis=1)
-
-    C = min(k + _POOL_PAD, pool_v.shape[1])
-    neg_top, pos = jax.lax.top_k(-pool_v, C)                    # ascending
-    cand_v_hat = -neg_top                                       # kernel vals
-    cand_pid = jnp.take_along_axis(pool_id, pos, axis=1)        # point ids
+        if d > _D_SINGLE_SHOT:
+            a1, id1, a2, id2, a3 = fused_l2_group_topk_dchunk(
+                x, y_hi, y_lo, yyh_k, m_real, T=T, Qb=Qb, passes=passes,
+                tpg=g, dc=_DC)
+        else:
+            a1, id1, a2, id2, a3 = fused_l2_group_topk(
+                x, y_hi, y_lo, yyh_k, m_real, T=T, Qb=Qb, passes=passes,
+                tpg=g)
+        # recover kernel-score space (d2 for l2, −x·y for ip); +inf
+        # stays +inf, ids untouched
+        a1 = 2.0 * a1 + xx_r
+        a2 = 2.0 * a2 + xx_r
+        pool_v = jnp.concatenate([a1, a2], axis=1)              # [Q, 2S']
+        pool_id = jnp.concatenate([id1, id2], axis=1)
+        C = min(k + _POOL_PAD, pool_v.shape[1])
+        neg_top, pos = jax.lax.top_k(-pool_v, C)                # ascending
+        cand_v_hat = -neg_top                                   # kernel vals
+        cand_pid = jnp.take_along_axis(pool_id, pos, axis=1)    # point ids
+        cand_pid = jnp.where(jnp.isfinite(cand_v_hat), cand_pid, -1)
+        a3_min = 2.0 * jnp.min(a3, axis=1) + xx_r[:, 0]
+        e_pack = jnp.zeros((Q,), jnp.float32)
 
     # exact f32 rescore of the C candidates (gather + HIGHEST contraction)
-    safe_pid = jnp.maximum(cand_pid, 0)
+    safe_pid = jnp.minimum(jnp.maximum(cand_pid, 0), m - 1)
     yc = jnp.take(y, safe_pid, axis=0)                          # [Q, C, d]
     if metric == "ip":
         d2c = -jnp.einsum("qd,qcd->qc", x, yc,
@@ -174,8 +213,7 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
                - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
                                   precision=jax.lax.Precision.HIGHEST))
         d2c = jnp.maximum(d2c, 0.0)
-    d2c = jnp.where((cand_pid >= 0) & jnp.isfinite(cand_v_hat),
-                    d2c, jnp.inf)
+    d2c = jnp.where(cand_pid >= 0, d2c, jnp.inf)
     neg_k, ord_k = jax.lax.top_k(-d2c, k)
     vals = -neg_k                                               # exact, asc
     ids = jnp.take_along_axis(cand_pid, ord_k, axis=1)
@@ -184,12 +222,12 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
     theta = vals[:, k - 1]
     # every point outside its group's kept top-2 is ≥ that group's a3;
     # every pool entry not among the C candidates is ≥ the C-th pool value
-    bound = jnp.minimum(jnp.min(a3, axis=1), cand_v_hat[:, C - 1])
+    bound = jnp.minimum(a3_min, cand_v_hat[:, C - 1])
     if passes == 3:
         ymax = jnp.sqrt(jnp.max(yy_raw))   # finite norms (padded rows: 0)
-        err = _err_bound_coeff(d) * jnp.sqrt(xx[:, 0]) * ymax
+        err = _err_bound_coeff(d) * jnp.sqrt(xx[:, 0]) * ymax + e_pack
     else:
-        err = jnp.zeros((Q,), jnp.float32)
+        err = e_pack
     certified = bound >= theta + err                            # [Q] bool
     failed = ~certified
     n_fail = jnp.sum(failed.astype(jnp.int32))

@@ -240,6 +240,13 @@ def _check_tiling(T: int, Qb: int):
         raise ValueError(f"Qb={Qb} must be a multiple of 8")
 
 
+def _check_pack_envelope(T: int, tpg: int):
+    if tpg * (T // _LANES) > (1 << _PACK_BITS):
+        raise ValueError(
+            f"packed group kernel: tpg*T/128 = {tpg * T // _LANES} "
+            f"exceeds the {1 << _PACK_BITS}-code packing envelope")
+
+
 def _make_kernel(base, passes: int, T: int, Qb: int, **fold_kw):
     """Bind the base kernel for the passes mode; for passes == 3 reorder
     the y_lo ref out of the positional stream (*rest carries the output
@@ -466,6 +473,105 @@ def _group_fold_and_write(s, j, yyh_ref, a1_ref, id1_ref, a2_ref,
     a3_ref[...] = a3.reshape(Qb, _LANES)
 
 
+# --- PACKED group fold: candidate code embedded in the value mantissa ---
+#
+# The unpacked merge spends ~half its VPU ops and register pressure on
+# i32 id selects. Instead, the low _PACK_BITS mantissa bits of each
+# half-score are REPLACED by the candidate's within-group code
+# (tile-offset-in-group · chunks + chunk — the lane and group are
+# implicit in the output position), so the merge is 3 compares + 4
+# selects on f32 only, ids travel for free through every compare,
+# top_k, and negation downstream, and the id output arrays + the pool
+# id gather disappear. Cost: values carry a ≤ |v|·2⁻¹⁵ packing error —
+# absorbed into the certificate's analytic bound (rescoring is exact
+# f32 regardless). Envelope: tpg·(T/128) ≤ 2^_PACK_BITS slots per
+# group (the measured-optimal configs sit exactly at 256), and padded
+# columns use the finite _PACK_PAD sentinel (+inf would become NaN
+# when id bits are OR'd into its mantissa).
+
+_PACK_BITS = 8
+_PACK_MASK = (1 << _PACK_BITS) - 1
+_PACK_PAD = float(2.0 ** 125)    # finite "never wins" sentinel
+
+
+def _merge_chunk_top2_packed(cp, a1, a2, a3):
+    """7-op packed merge: top-2 + 3rd-min by packed-f32 order."""
+    lt1 = cp < a1
+    b1 = jnp.where(lt1, a1, cp)
+    a1 = jnp.where(lt1, cp, a1)
+    lt2 = b1 < a2
+    b2 = jnp.where(lt2, a2, b1)
+    a2 = jnp.where(lt2, b1, a2)
+    a3 = jnp.minimum(a3, b2)
+    return a1, a2, a3
+
+
+def _group_fold_and_write_packed(s, j, yyh_ref, a1_ref, a2_ref, a3_ref,
+                                 *, T: int, Qb: int, tpg: int):
+    """Packed variant of _group_fold_and_write: same VMEM discipline
+    (per-chunk half-scores, 3-D carriers, no masking — callers pass
+    yy/2 = _PACK_PAD on padded columns), but the merge runs on packed
+    values only (see the block comment above)."""
+    n_chunks = T // _LANES
+
+    @pl.when(j % tpg == 0)
+    def _():
+        big = jnp.full((Qb, _LANES), _PACK_PAD, jnp.float32)
+        a1_ref[...] = big
+        a2_ref[...] = big
+        a3_ref[...] = big
+
+    q8 = Qb // 8
+    a1 = a1_ref[...].reshape(q8, 8, _LANES)
+    a2 = a2_ref[...].reshape(q8, 8, _LANES)
+    a3 = a3_ref[...].reshape(q8, 8, _LANES)
+    yyh = yyh_ref[...]                                   # [8, T]
+    for r in range(n_chunks):
+        sl = slice(r * _LANES, (r + 1) * _LANES)
+        c = yyh[:, sl] - s[:, sl].reshape(q8, 8, _LANES)
+        local = (j % tpg) * n_chunks + r                 # scalar code
+        cp = jax.lax.bitcast_convert_type(
+            (jax.lax.bitcast_convert_type(c, jnp.int32) & ~_PACK_MASK)
+            | local, jnp.float32)
+        a1, a2, a3 = _merge_chunk_top2_packed(cp, a1, a2, a3)
+    a1_ref[...] = a1.reshape(Qb, _LANES)
+    a2_ref[...] = a2.reshape(Qb, _LANES)
+    a3_ref[...] = a3.reshape(Qb, _LANES)
+
+
+def _group_kernel_packed(m_real_ref, x_ref, yhi_ref, yyh_ref,
+                         a1_ref, a2_ref, a3_ref,
+                         *, T: int, Qb: int, tpg: int, ylo_ref=None):
+    j = pl.program_id(1)
+    s = _contract(x_ref[...], yhi_ref[...],
+                  None if ylo_ref is None else ylo_ref[...])
+    _group_fold_and_write_packed(s, j, yyh_ref, a1_ref, a2_ref, a3_ref,
+                                 T=T, Qb=Qb, tpg=tpg)
+
+
+def _group_kernel_packed_dchunk(m_real_ref, x_ref, yhi_ref, yyh_ref,
+                                a1_ref, a2_ref, a3_ref, acc_ref,
+                                *, T: int, Qb: int, tpg: int, ylo_ref=None):
+    j = pl.program_id(1)
+    l = pl.program_id(2)
+    n_dc = pl.num_programs(2)
+    s = _contract(x_ref[...], yhi_ref[...],
+                  None if ylo_ref is None else ylo_ref[...])
+
+    @pl.when(l == 0)
+    def _():
+        acc_ref[...] = s
+
+    @pl.when(l != 0)
+    def _():
+        acc_ref[...] = acc_ref[...] + s
+
+    @pl.when(l == n_dc - 1)
+    def _():
+        _group_fold_and_write_packed(acc_ref[...], j, yyh_ref, a1_ref,
+                                     a2_ref, a3_ref, T=T, Qb=Qb, tpg=tpg)
+
+
 def _group_kernel(m_real_ref, x_ref, yhi_ref, yyh_ref,
                   a1_ref, id1_ref, a2_ref, id2_ref, a3_ref,
                   *, T: int, Qb: int, tpg: int, ylo_ref=None):
@@ -536,6 +642,78 @@ def _group_out_shape(Q: int, Sg: int):
     ]
 
 
+def _packed_out_shape(Q: int, Sg: int):
+    return [jax.ShapeDtypeStruct((Q, Sg), jnp.float32)] * 3
+
+
+def _group_pallas_call(kernel_base, packed: bool,
+                       x, y_hi, y_lo, yy_half, m_real,
+                       *, T: int, Qb: int, passes: int, tpg: int,
+                       dc=None):
+    """Shared scaffolding for the four group-fold entry points
+    ((un)packed × (single-shot | d-chunked)) — specs, operands, grid and
+    pallas_call in ONE place so the variants cannot drift."""
+    _check_tiling(T, Qb)
+    Q, d = x.shape
+    M = y_hi.shape[0]
+    n_tiles = M // T
+    nq = Q // Qb
+    G = -(-n_tiles // tpg)
+    if dc is None:
+        y_spec = pl.BlockSpec((T, d), lambda i, j, *_: (j, 0),
+                              memory_space=pltpu.VMEM)
+        x_spec = pl.BlockSpec((Qb, d), lambda i, j, *_: (i, 0),
+                              memory_space=pltpu.VMEM)
+        grid = (nq, n_tiles)
+        semantics = ("parallel", "arbitrary")
+        scratch = []
+    else:
+        if d % dc:
+            raise ValueError(
+                f"fused_l2_group_topk*_dchunk: d={d} must be a multiple "
+                f"of dc={dc} (the tail would be silently dropped)")
+        y_spec = pl.BlockSpec((T, dc), lambda i, j, l, *_: (j, l),
+                              memory_space=pltpu.VMEM)
+        x_spec = pl.BlockSpec((Qb, dc), lambda i, j, l, *_: (i, l),
+                              memory_space=pltpu.VMEM)
+        grid = (nq, n_tiles, d // dc)
+        semantics = ("parallel", "arbitrary", "arbitrary")
+        scratch = [pltpu.VMEM((Qb, T), jnp.float32)]  # score accumulator
+
+    in_specs = [
+        x_spec,
+        y_spec,                                         # y_hi
+        pl.BlockSpec((8, T), lambda i, j, *_: (0, j),
+                     memory_space=pltpu.VMEM),          # yy_half
+    ]
+    operands = [x, y_hi, yy_half]
+    if passes == 3:
+        in_specs.insert(2, y_spec)                      # y_lo
+        operands.insert(2, y_lo)
+    kernel = _make_group_kernel(kernel_base, passes, T, Qb, tpg=tpg)
+
+    n_out = 3 if packed else 5
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=_group_out_specs(Qb, tpg)[:n_out],
+        scratch_shapes=scratch,
+    )
+    out_shape = (_packed_out_shape if packed else _group_out_shape)(
+        Q, G * _LANES)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=semantics,
+        ),
+        cost_estimate=_slot_cost(Q, M, d, G * _LANES, passes),
+        interpret=interpret_mode(),
+    )(m_real, *operands)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("T", "Qb", "passes", "tpg"))
 def fused_l2_group_topk(x, y_hi, y_lo, yy_half, m_real,
@@ -555,45 +733,9 @@ def fused_l2_group_topk(x, y_hi, y_lo, yy_half, m_real,
     3rd-smallest (certificate input: every point outside a group's
     top-2 is ≥ that group's a3). Padded-only groups keep a=+inf,
     id=-1."""
-    _check_tiling(T, Qb)
-    Q, d = x.shape
-    M = y_hi.shape[0]
-    n_tiles = M // T
-    nq = Q // Qb
-    G = -(-n_tiles // tpg)
-
-    y_spec = pl.BlockSpec((T, d), lambda i, j, *_: (j, 0),
-                          memory_space=pltpu.VMEM)
-    in_specs = [
-        pl.BlockSpec((Qb, d), lambda i, j, *_: (i, 0),
-                     memory_space=pltpu.VMEM),          # x
-        y_spec,                                         # y_hi
-        pl.BlockSpec((8, T), lambda i, j, *_: (0, j),
-                     memory_space=pltpu.VMEM),          # yy_half
-    ]
-    operands = [x, y_hi, yy_half]
-    if passes == 3:
-        in_specs.insert(2, y_spec)                      # y_lo
-        operands.insert(2, y_lo)
-    kernel = _make_group_kernel(_group_kernel, passes, T, Qb, tpg=tpg)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(nq, n_tiles),
-        in_specs=in_specs,
-        out_specs=_group_out_specs(Qb, tpg),
-    )
-    outs = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=_group_out_shape(Q, G * _LANES),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
-        ),
-        cost_estimate=_slot_cost(Q, M, d, G * _LANES, passes),
-        interpret=interpret_mode(),
-    )(m_real, *operands)
-    return outs
+    return _group_pallas_call(_group_kernel, False, x, y_hi, y_lo,
+                              yy_half, m_real, T=T, Qb=Qb, passes=passes,
+                              tpg=tpg)
 
 
 @functools.partial(jax.jit,
@@ -605,52 +747,40 @@ def fused_l2_group_topk_dchunk(x, y_hi, y_lo, yy_half, m_real,
     grid (nq, n_tiles, d/dc), score accumulated in VMEM scratch, the
     group fold runs on the last d-chunk only. Same (half-score)
     outputs."""
-    _check_tiling(T, Qb)
-    Q, d = x.shape
-    M = y_hi.shape[0]
-    if d % dc:
-        raise ValueError(
-            f"fused_l2_group_topk_dchunk: d={d} must be a multiple of "
-            f"dc={dc} (the tail would be silently dropped)")
-    n_tiles = M // T
-    nq = Q // Qb
-    n_dc = d // dc
-    G = -(-n_tiles // tpg)
+    return _group_pallas_call(_group_kernel_dchunk, False, x, y_hi, y_lo,
+                              yy_half, m_real, T=T, Qb=Qb, passes=passes,
+                              tpg=tpg, dc=dc)
 
-    y_spec = pl.BlockSpec((T, dc), lambda i, j, l, *_: (j, l),
-                          memory_space=pltpu.VMEM)
-    in_specs = [
-        pl.BlockSpec((Qb, dc), lambda i, j, l, *_: (i, l),
-                     memory_space=pltpu.VMEM),          # x
-        y_spec,                                         # y_hi
-        pl.BlockSpec((8, T), lambda i, j, *_: (0, j),
-                     memory_space=pltpu.VMEM),          # yy_half
-    ]
-    operands = [x, y_hi, yy_half]
-    if passes == 3:
-        in_specs.insert(2, y_spec)                      # y_lo
-        operands.insert(2, y_lo)
-    kernel = _make_group_kernel(_group_kernel_dchunk, passes, T, Qb,
-                                tpg=tpg)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(nq, n_tiles, n_dc),
-        in_specs=in_specs,
-        out_specs=_group_out_specs(Qb, tpg),
-        scratch_shapes=[pltpu.VMEM((Qb, T), jnp.float32)],  # score acc
-    )
-    outs = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=_group_out_shape(Q, G * _LANES),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
-        ),
-        cost_estimate=_slot_cost(Q, M, d, G * _LANES, passes),
-        interpret=interpret_mode(),
-    )(m_real, *operands)
-    return outs
+@functools.partial(jax.jit,
+                   static_argnames=("T", "Qb", "passes", "tpg"))
+def fused_l2_group_topk_packed(x, y_hi, y_lo, yy_half, m_real,
+                               T: int, Qb: int, passes: int,
+                               tpg: int = 16):
+    """Packed-id variant of :func:`fused_l2_group_topk` (see the PACKED
+    block comment): returns ``(a1p, a2p, a3p)``, each ``[Q, G·LANES]``
+    f32 whose low _PACK_BITS mantissa bits hold the candidate's
+    within-group code ``tile_offset·(T/LANES) + chunk`` (a3p's code is
+    meaningless — only its value is used). ``yy_half`` must carry the
+    finite ``_PACK_PAD`` sentinel (NOT +inf) on padded columns.
+    Requires tpg·(T/LANES) ≤ 2^_PACK_BITS."""
+    _check_pack_envelope(T, tpg)
+    return _group_pallas_call(_group_kernel_packed, True, x, y_hi, y_lo,
+                              yy_half, m_real, T=T, Qb=Qb, passes=passes,
+                              tpg=tpg)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("T", "Qb", "passes", "tpg", "dc"))
+def fused_l2_group_topk_packed_dchunk(x, y_hi, y_lo, yy_half, m_real,
+                                      T: int, Qb: int, passes: int,
+                                      tpg: int = 16, dc: int = 256):
+    """d-chunked packed variant (wide features): same contract as
+    :func:`fused_l2_group_topk_packed`."""
+    _check_pack_envelope(T, tpg)
+    return _group_pallas_call(_group_kernel_packed_dchunk, True, x, y_hi,
+                              y_lo, yy_half, m_real, T=T, Qb=Qb,
+                              passes=passes, tpg=tpg, dc=dc)
 
 
 def split_hi_lo(y: jax.Array) -> Tuple[jax.Array, jax.Array]:

@@ -389,3 +389,74 @@ def test_group_kernel_vs_numpy_oracle():
             np.testing.assert_allclose(got_c2, want2, atol=tol)
             assert (id1[:, s] % _LANES == lane).all()
             assert (id2[:, s] % _LANES == lane).all()
+
+
+def test_packed_kernel_decode_vs_unpacked():
+    """The packed group kernel's (value, embedded code) must decode to
+    the same candidates the unpacked kernel reports explicitly."""
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.fused_l2_topk_pallas import (
+        _LANES, _PACK_MASK, _PACK_PAD, fused_l2_group_topk,
+        fused_l2_group_topk_packed, split_hi_lo)
+    import jax
+
+    Q, m, d, T, Qb, tpg = 16, 5 * 512 - 37, 64, 512, 16, 2
+    x = rng.normal(size=(Q, d)).astype(np.float32)
+    y = rng.normal(size=(m, d)).astype(np.float32)
+    M = ((m + T - 1) // T) * T
+    yp = np.concatenate([y, np.zeros((M - m, d), np.float32)])
+    n_ch = T // _LANES
+
+    y_hi, y_lo = split_hi_lo(jnp.asarray(yp))
+    base = 0.5 * jnp.sum(jnp.asarray(yp) ** 2, axis=1)[None, :]
+    valid = (jnp.arange(M) < m)[None, :]
+    m_real = jnp.full((1,), m, jnp.int32)
+    xj = jnp.asarray(x)
+
+    yyh_inf = jnp.broadcast_to(jnp.where(valid, base, jnp.inf), (8, M))
+    a1, id1, a2, id2, a3 = fused_l2_group_topk(
+        xj, y_hi, y_lo, yyh_inf, m_real, T=T, Qb=Qb, passes=3, tpg=tpg)
+
+    yyh_pad = jnp.broadcast_to(
+        jnp.where(valid, base, _PACK_PAD), (8, M))
+    a1p, a2p, a3p = fused_l2_group_topk_packed(
+        xj, y_hi, y_lo, yyh_pad, m_real, T=T, Qb=Qb, passes=3, tpg=tpg)
+
+    S_ = a1.shape[1]
+    for (ap, au, idu) in ((a1p, a1, id1), (a2p, a2, id2)):
+        ap, au, idu = map(np.asarray, (ap, au, idu))
+        live = ap < _PACK_PAD * 0.25
+        # liveness must agree between the packed sentinel and the
+        # unpacked +inf convention
+        assert np.array_equal(live, np.isfinite(au))
+        # values agree to the packing tolerance |v|*2^-15
+        np.testing.assert_allclose(
+            ap[live], au[live],
+            atol=float(np.abs(au[live]).max()) * 2.0 ** -14)
+        # decoded columns == the unpacked kernel's explicit ids
+        codes = (np.asarray(ap).view(np.int32) & _PACK_MASK)
+        slot = np.broadcast_to(np.arange(S_)[None, :], ap.shape)
+        col = ((slot // _LANES) * tpg + codes // n_ch) * T \
+            + (codes % n_ch) * _LANES + (slot % _LANES)
+        assert np.array_equal(col[live], idu[live])
+    # a3 values agree (certificate input)
+    a3p_, a3_ = np.asarray(a3p), np.asarray(a3)
+    fin = np.isfinite(a3_) & (a3p_ < _PACK_PAD * 0.25)
+    np.testing.assert_allclose(
+        a3p_[fin], a3_[fin],
+        atol=float(np.abs(a3_[fin]).max()) * 2.0 ** -14)
+
+
+def test_packed_envelope_fallback(monkeypatch):
+    """g*(T/128) beyond the code space must route to the unpacked
+    kernel and still produce exact results."""
+    import raft_tpu.distance.knn_fused as kf
+
+    x = rng.normal(size=(16, 16)).astype(np.float32)
+    y = rng.normal(size=(9000, 16)).astype(np.float32)
+    # T=512 -> 4 chunks; g=128 -> 512 codes > 256 -> unpacked path
+    vals, ids = kf.knn_fused(x, y, k=8, passes=3, T=512, Qb=16, g=128)
+    ref_vals, ref_ids, tol = _oracle(x, y, 8)
+    np.testing.assert_allclose(np.asarray(vals), ref_vals, atol=tol)
+    assert np.array_equal(np.sort(np.asarray(ids), 1), np.sort(ref_ids, 1))
