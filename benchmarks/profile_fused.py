@@ -50,7 +50,7 @@ def main():
     res = raft_tpu.device_resources()
     from raft_tpu.distance.knn_fused import fit_config
     T, Qb, g = fused_defaults(3)   # production exactness mode's config
-    T, Qb = fit_config(T, Qb, 128, 3)   # what production actually runs
+    T, Qb = fit_config(T, Qb, 128, 3, g)   # what production actually runs
     if dry:
         n_index, dim, n_q, k = 16_384, 128, 256, 64
         T, Qb = 2048, 256
@@ -131,11 +131,20 @@ def main():
     # comparison, the retired per-(tile,lane) slot kernel whose XLA-side
     # group fold motivated the redesign ---
     # group kernels fold the half-score yy/2 − x·y; [8, M] carrier with
-    # +inf on padded columns (the kernel does no masking of its own —
-    # half-score 0 on padded columns would beat real candidates)
+    # a "never wins" sentinel on padded columns (the kernel does no
+    # masking of its own — half-score 0 there would beat real
+    # candidates): +inf for the unpacked kernels, the finite _PACK_PAD
+    # for the packed ones (id bits OR'd into +inf would make NaN)
+    valid_cols = (jnp.arange(M) < m)[None, :]
     yyh = jnp.broadcast_to(
-        jnp.where((jnp.arange(M) < m)[None, :], 0.5 * yy, jnp.inf),
-        (8, M))
+        jnp.where(valid_cols, 0.5 * yy, jnp.inf), (8, M))
+    yyh_pck = jnp.broadcast_to(
+        jnp.where(valid_cols, 0.5 * yy, F._PACK_PAD), (8, M))
+    # production path: packed-id fold
+    record("kernel_pck_p1", lambda *a: F.fused_l2_group_topk_packed(
+        *a, T=T, Qb=Qb, passes=1, tpg=g), Q, y_hi, y_lo, yyh_pck, m_real)
+    record("kernel_pck_p3", lambda *a: F.fused_l2_group_topk_packed(
+        *a, T=T, Qb=Qb, passes=3, tpg=g), Q, y_hi, y_lo, yyh_pck, m_real)
     record("kernel_grp_p1", lambda *a: F.fused_l2_group_topk(
         *a, T=T, Qb=Qb, passes=1, tpg=g), Q, y_hi, y_lo, yyh, m_real)
     record("kernel_grp_p3", lambda *a: F.fused_l2_group_topk(
@@ -175,6 +184,37 @@ def main():
     if grp is not None:
         a1g, id1g, a2g, id2g, _ = grp
         record("post", post, a1g, id1g, a2g, id2g, Q, X, xx)
+
+    # packed post: pool top_k on packed values + decode + exact rescore
+    # (the production post — no id arrays, no pool-id gather)
+    try:
+        pck = jax.block_until_ready(F.fused_l2_group_topk_packed(
+            Q, y_hi, y_lo, yyh_pck, m_real, T=T, Qb=Qb, passes=1, tpg=g))
+    except Exception:
+        pck = None
+
+    if pck is not None:
+        from raft_tpu.distance.knn_fused import (
+            _POOL_PAD, decode_packed_pool)
+
+        @jax.jit
+        def post_packed(a1p, a2p, x, y, xx):
+            S_ = a1p.shape[1]
+            pool_p = jnp.concatenate([a1p, a2p], axis=1)
+            C = min(k + _POOL_PAD, pool_p.shape[1])
+            neg, pos = jax.lax.top_k(-pool_p, C)
+            cand_p = -neg
+            pid = decode_packed_pool(cand_p, pos, S_, T, g)
+            yc = jnp.take(y, jnp.minimum(jnp.maximum(pid, 0),
+                                         y.shape[0] - 1), axis=0)
+            d2c = (xx + jnp.sum(yc * yc, axis=2)
+                   - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
+                                      precision=jax.lax.Precision.HIGHEST))
+            neg_k, ord_k = jax.lax.top_k(
+                -jnp.where(pid >= 0, d2c, jnp.inf), k)
+            return -neg_k, jnp.take_along_axis(pid, ord_k, axis=1)
+
+        record("post_packed", post_packed, pck[0], pck[1], Q, X, xx)
 
     # --- end-to-end at the shipped defaults ---
     record("full_p1", lambda q: knn_fused(q, X, k=k, passes=1)[0], Q)

@@ -70,7 +70,7 @@ def main():
         # footprint_for is the SAME predicate knn_fused's guard uses
         from raft_tpu.distance.knn_fused import footprint_for
         from raft_tpu.ops.fused_l2_topk_pallas import VMEM_BUDGET
-        if footprint_for(T, Qb, dim, p) > VMEM_BUDGET:
+        if footprint_for(T, Qb, dim, p, g) > VMEM_BUDGET:
             rows.append({"T": T, "Qb": Qb, "g": g, "passes": p,
                          "skipped": "vmem_footprint"})
             continue

@@ -96,6 +96,19 @@ def _err_bound_coeff(d: int) -> float:
     return 2.0 ** -15 + d * 2.0 ** -21
 
 
+def decode_packed_pool(cand_p, pos, S_: int, T: int, g: int):
+    """Candidate columns from (packed value, pool position) — THE
+    decode for the packed kernel's mantissa codes, shared by the
+    production pipeline and the profiler so they cannot drift. Returns
+    -1 for sentinel/empty entries."""
+    n_ch = T // _LANES
+    slot = pos % S_
+    local = jax.lax.bitcast_convert_type(cand_p, jnp.int32) & _PACK_MASK
+    col = ((slot // _LANES) * g + local // n_ch) * T \
+        + (local % n_ch) * _LANES + (slot % _LANES)
+    return jnp.where(cand_p < _PACK_PAD * 0.25, col, -1)
+
+
 def _pad_rows_to(y, mult: int):
     from raft_tpu.distance.fused_l2nn import _pad_rows
 
@@ -167,12 +180,7 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
         # bit, so codes survive the top_k round-trip)
         neg_top, pos = jax.lax.top_k(-pool_p, C)
         cand_p = -neg_top
-        slot = pos % S_
-        local = jax.lax.bitcast_convert_type(
-            cand_p, jnp.int32) & _PACK_MASK
-        col = ((slot // _LANES) * g + local // n_ch) * T \
-            + (local % n_ch) * _LANES + (slot % _LANES)
-        cand_pid = jnp.where(cand_p < _PACK_PAD * 0.25, col, -1)
+        cand_pid = decode_packed_pool(cand_p, pos, S_, T, g)
         cand_v_hat = 2.0 * cand_p + xx_r
         a3_min = 2.0 * jnp.min(a3p, axis=1) + xx_r[:, 0]
         # packing error margin: |Δhalf| ≤ |half|·2⁻¹⁵ and
@@ -320,7 +328,8 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
 _TUNED = ...   # lazy sentinel: {passes: (T, Qb, g)} once loaded
 
 
-def fit_config(T: int, Qb: int, d: int, passes: int):
+def fit_config(T: int, Qb: int, d: int, passes: int,
+               g: Optional[int] = None):
     """Scoped-VMEM guard: shrink (T, Qb) until the kernel footprint fits
     Mosaic's stack budget — a config over it is a guaranteed compile
     failure (observed: the tuned-at-passes=1 winner OOMs at passes=3).
@@ -328,22 +337,29 @@ def fit_config(T: int, Qb: int, d: int, passes: int):
     certificate's slot count, so last). Shared by knn_fused and the
     measurement scripts so they can never profile a config production
     would silently shrink."""
-    while (footprint_for(T, Qb, d, passes) > VMEM_BUDGET and Qb > 8):
+    while (footprint_for(T, Qb, d, passes, g) > VMEM_BUDGET and Qb > 8):
         Qb = max(8, (Qb // 2) // 8 * 8)
-    while (footprint_for(T, Qb, d, passes) > VMEM_BUDGET
+    while (footprint_for(T, Qb, d, passes, g) > VMEM_BUDGET
            and T > 2 * _LANES):
         T = max(2 * _LANES, (T // 2) // _LANES * _LANES)
     return T, Qb
 
 
-def footprint_for(T: int, Qb: int, d: int, passes: int) -> int:
+def footprint_for(T: int, Qb: int, d: int, passes: int,
+                  g: Optional[int] = None) -> int:
     """Scoped-VMEM footprint of the fused kernel at a RAW (unpadded)
-    feature width — applies the same d-padding / d-chunk routing
-    ``knn_fused`` itself uses, so callers (the tune sweep's skip
-    predicate, the in-call shrink guard) can't diverge from it."""
+    feature width — applies the same d-padding / d-chunk routing AND
+    packed-vs-unpacked kernel choice ``knn_fused`` itself uses, so
+    callers (the tune sweep's skip predicate, the in-call shrink guard)
+    can't diverge from it. ``g`` (tiles per group) decides the packed
+    envelope; None assumes UNPACKED — the larger footprint, so an
+    uninformed caller fails safe (over-shrinks) rather than shipping a
+    Mosaic scoped-VMEM reject."""
     d_eff = d + (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
+    packed = g is not None and g * (T // _LANES) <= (1 << _PACK_BITS)
     return vmem_footprint(T, Qb, d_eff, passes,
-                          dchunk=d_eff > _D_SINGLE_SHOT)
+                          dchunk=d_eff > _D_SINGLE_SHOT,
+                          kernel="packed" if packed else "group")
 
 
 def _valid_cfg(T, Qb, g) -> bool:
@@ -430,7 +446,7 @@ def knn_fused(x, y, k: int, passes: int = 3,
     m = y.shape[0]
     if k > m:
         raise ValueError(f"knn_fused: k={k} > index size {m}")
-    T, Qb = fit_config(T, Qb, d, passes)
+    T, Qb = fit_config(T, Qb, d, passes, g)
     if g < 1:
         raise ValueError(f"knn_fused: g={g} must be ≥ 1 (tiles per group)")
     # the group fold iterates T // 128 lane-chunks and the carriers

@@ -69,6 +69,11 @@ def vmem_footprint(T: int, Qb: int, d: int, passes: int,
     if kernel == "group":
         d2_bufs = 2.2 if passes == 1 else 3.2
         n_out = 5
+    elif kernel == "packed":
+        # no i32 id carriers in the merge and 3 f32 outputs — measured
+        # compiles at (1024, 256) both passes; factors kept conservative
+        d2_bufs = 1.8 if passes == 1 else 2.8
+        n_out = 3
     else:
         d2_bufs = 1.25 if passes == 1 else 2.25
         n_out = 3

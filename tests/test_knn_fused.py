@@ -448,7 +448,7 @@ def test_packed_kernel_decode_vs_unpacked():
         atol=float(np.abs(a3_[fin]).max()) * 2.0 ** -14)
 
 
-def test_packed_envelope_fallback(monkeypatch):
+def test_packed_envelope_fallback():
     """g*(T/128) beyond the code space must route to the unpacked
     kernel and still produce exact results."""
     import raft_tpu.distance.knn_fused as kf
