@@ -139,8 +139,21 @@ def _smoke_histogram_blocked():
     np.testing.assert_array_equal(got, want)
 
 
+def _smoke_select_k_slotted_pallas():
+    from raft_tpu.matrix import SelectAlgo, select_k
+
+    v = np.random.default_rng(5).normal(size=(64, 65536)).astype(np.float32)
+    ov, oi = select_k(None, v, k=32, algo=SelectAlgo.SLOTTED)
+    ref = np.sort(v, axis=1)[:, :32]
+    np.testing.assert_allclose(np.asarray(ov), ref, rtol=1e-6)
+    # returned positions must reproduce the values
+    got = np.take_along_axis(v, np.asarray(oi), axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
 KERNELS = {
     "select_k_radix": _smoke_select_k_radix,
+    "select_k_slotted_pallas": _smoke_select_k_slotted_pallas,
     "fused_l2_topk": _smoke_fused_l2_topk,
     "fused_l2_topk_dchunk": _smoke_fused_l2_topk_dchunk,
     "spmv_tiled": _smoke_spmv_tiled,

@@ -31,6 +31,40 @@ from raft_tpu.ops.folds import fold_group_top2
 _POOL_PAD = 32
 
 
+def _certified_fallback(vals, out_v, out_i, failed, k: int, tiers):
+    """Shared certificate-fallback scaffolding for both slotted paths:
+    rows flagged ``failed`` are re-solved exactly (XLA top_k on the
+    gathered rows) in the smallest static tier that covers them, else
+    the whole batch falls back. ALWAYS exact — slotting/packing only
+    decide how fast."""
+    B = vals.shape[0]
+    n_fail = jnp.sum(failed.astype(jnp.int32))
+
+    def exact_rows(rows_v):
+        nv, np_ = jax.lax.top_k(-rows_v, k)
+        return -nv, np_.astype(jnp.int32)
+
+    def no_fix(o):
+        return o
+
+    def make_fix(F):
+        def fix(o):
+            ov, oi = o
+            _, fidx = jax.lax.top_k(failed.astype(jnp.int32), F)
+            fv, fi = exact_rows(vals[fidx])
+            return ov.at[fidx].set(fv), oi.at[fidx].set(fi)
+        return fix
+
+    def full_fix(o):
+        return exact_rows(vals)
+
+    branch = full_fix
+    for t in [t for t in sorted(tiers, reverse=True) if t < B]:
+        branch = (lambda o, t=t, nxt=branch: jax.lax.cond(
+            n_fail <= t, make_fix(t), nxt, o))
+    return jax.lax.cond(n_fail == 0, no_fix, branch, (out_v, out_i))
+
+
 @partial(jax.jit, static_argnames=("k", "slot", "g", "fallback_rows"))
 def _slotted_select_min(vals, k: int, slot: int, g: int,
                         fallback_rows: int) -> Tuple[jax.Array, jax.Array]:
@@ -59,47 +93,106 @@ def _slotted_select_min(vals, k: int, slot: int, g: int,
     theta = cand_v[:, k - 1]
     bound = jnp.minimum(jnp.min(m2, axis=1), jnp.min(p3, axis=1))
     bound = jnp.minimum(bound, cand_v[:, C - 1])
-    failed = bound < theta                                      # [B]
-    # rows with < k finite values leave unfilled (-1) candidates; route
-    # them through the exact fallback so positions stay distinct, exactly
-    # like the XLA path's degenerate-row behavior
-    failed = failed | jnp.any(cand_i[:, :k] < 0, axis=1)
-    n_fail = jnp.sum(failed.astype(jnp.int32))
-
-    out_v = cand_v[:, :k]
-    out_i = cand_i[:, :k]
-
-    def exact_rows(rows_v):
-        nv, np_ = jax.lax.top_k(-rows_v, k)
-        return -nv, np_.astype(jnp.int32)
-
-    def no_fix(o):
-        return o
-
-    def small_fix(o):
-        ov, oi = o
-        _, fidx = jax.lax.top_k(failed.astype(jnp.int32), fallback_rows)
-        fv, fi = exact_rows(vals[fidx])
-        return ov.at[fidx].set(fv), oi.at[fidx].set(fi)
-
-    def full_fix(o):
-        return exact_rows(vals)
-
-    if B <= fallback_rows:
-        return jax.lax.cond(n_fail > 0, full_fix, no_fix, (out_v, out_i))
-    return jax.lax.cond(
-        n_fail == 0, no_fix,
-        lambda o: jax.lax.cond(n_fail <= fallback_rows, small_fix,
-                               full_fix, o),
-        (out_v, out_i))
+    # NaN-SAFE predicate (~(b ≥ θ), not b < θ): a NaN-poisoned bound —
+    # NaN inputs, or ±inf through the packed path — must read as FAILED
+    # so the row takes the exact fallback, never "certified". Rows with
+    # < k finite values leave unfilled (-1) candidates; route them
+    # through the fallback too so positions stay distinct.
+    failed = ~(bound >= theta) | jnp.any(cand_i[:, :k] < 0, axis=1)
+    return _certified_fallback(vals, cand_v[:, :k], cand_i[:, :k],
+                               failed, k, (fallback_rows,))
 
 
-def slotted_envelope(L: int) -> Tuple[int, int, int]:
+# Pallas streaming path (L ≥ _PALLAS_MIN_L): one linear pass, packed
+# candidate codes — see ops/select_slotted_pallas.py.
+#
+# Tile geometry is DMA-driven: a (Bb, T) block slices T·4 bytes from
+# each of Bb rows of the [B, L] input, so the per-row run length must
+# be large to amortize the row stride — (8, 8192) gives contiguous
+# 32 KB runs (MEASURED: (256, 1024) blocks ran at 0.28 GB/s — 4 KB
+# strided runs — 3.6 s for a [256, 1M] select). tpg=4 keeps
+# tpg·(T/128) = 256 = the full packed code space.
+_T_SEL = 8192
+_BB_SEL = 8
+_TPG_SEL = 4
+_PALLAS_MIN_L = 4096
+_FALLBACK_TIERS = (16, 128)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _slotted_select_min_pallas(work, k: int
+                               ) -> Tuple[jax.Array, jax.Array]:
+    """Exact k smallest per row of ``work`` [B, L] f32 via the packed
+    Pallas streaming fold + certified pool selection. Same contract as
+    :func:`_slotted_select_min`."""
+    from raft_tpu.distance.knn_fused import decode_packed_pool
+    from raft_tpu.ops.fused_l2_topk_pallas import _PACK_PAD
+    from raft_tpu.ops.select_slotted_pallas import select_slot_topk_packed
+
+    B, L = work.shape
+    Lp = -(-L // _T_SEL) * _T_SEL
+    Bb = _BB_SEL
+    Bp = -(-B // Bb) * Bb
+    # adaptive group size (from the envelope — the single source of
+    # truth): large k needs more slots or 3-in-group collisions explode
+    # (MEASURED: k=256 at [256, 1M] with tpg=4 fails ~16% of rows → the
+    # 128-row fallback tier dominates at ~119 ms; tpg=1 quadruples the
+    # slot count for ~1% failures)
+    _, tpg, _ = slotted_envelope(L, k)
+    w = jnp.pad(work, ((0, Bp - B), (0, Lp - L)),
+                constant_values=_PACK_PAD)
+    a1p, a2p, a3p = select_slot_topk_packed(w, T=_T_SEL, Bb=Bb,
+                                            tpg=tpg)
+    a1p, a2p, a3p = a1p[:B], a2p[:B], a3p[:B]
+    S_ = a1p.shape[1]
+
+    pool_p = jnp.concatenate([a1p, a2p], axis=1)        # [B, 2S'] packed
+    C = min(k + _POOL_PAD, pool_p.shape[1])
+    neg, pos = jax.lax.top_k(-pool_p, C)
+    cand_p = -neg
+    pid = decode_packed_pool(cand_p, pos, S_, _T_SEL, tpg)
+    # candidates' TRUE values (gather — the select analog of the fused
+    # pipeline's exact rescore; packing only perturbs the low mantissa
+    # bits used for ORDERING, the returned values are the inputs')
+    cand_true = jnp.take_along_axis(work, jnp.clip(pid, 0, L - 1), axis=1)
+    cand_true = jnp.where(pid >= 0, cand_true, jnp.inf)
+    neg_k, ord_k = jax.lax.top_k(-cand_true, k)
+    out_v = -neg_k
+    out_i = jnp.take_along_axis(pid, ord_k, axis=1)
+
+    # certificate: every non-candidate's packed value ≥ B_packed =
+    # min(group 3rd-mins, C-th pool entry); true ≥ packed − |packed|·2⁻¹⁵
+    # (the merge orders by packed values, whose low _PACK_BITS mantissa
+    # bits are the candidate code)
+    theta = out_v[:, k - 1]
+    b_packed = jnp.minimum(jnp.min(a3p, axis=1), cand_p[:, C - 1])
+    b_true = b_packed - jnp.abs(b_packed) * 2.0 ** -15
+    # NaN-SAFE predicate: ±inf inputs become NaN when code bits are
+    # OR'd into their mantissa, silently dropping them from candidates
+    # — but the same NaN poisons a3p and hence b_true, so ~(b ≥ θ)
+    # routes any row containing ±inf/NaN to the exact fallback (the
+    # pre-fix `b < θ` comparison read NaN as "certified": wrong top-k
+    # with no error)
+    failed = ~(b_true >= theta) | jnp.any(out_i < 0, axis=1)
+    return _certified_fallback(work, out_v, out_i, failed, k,
+                               _FALLBACK_TIERS)
+
+
+def slotted_envelope(L: int, k: int = None) -> Tuple[int, int, int]:
     """(slot, g, pool_capacity) the slotted algorithm uses for row length
-    ``L`` — the single source of truth for the envelope (tests and the
-    AUTO heuristic derive bounds from here, never re-hardcode)."""
-    slot = 16 if L >= 4096 else 4
-    g = 8
+    ``L`` (and, on the Pallas path, request size ``k`` — the adaptive
+    tpg switch means capacity GROWS for k > 64) — the single source of
+    truth for the envelope (tests and the AUTO heuristic derive bounds
+    from here, never re-hardcode). For L ≥ _PALLAS_MIN_L the streaming
+    Pallas path is used and the pool is 2·128·G (G = tile groups);
+    below it, the XLA slot fold. ``k=None`` reports the conservative
+    (small-k) capacity."""
+    if L >= _PALLAS_MIN_L:
+        tpg = _TPG_SEL if (k is None or k <= 64) else 1
+        n_tiles = -(-L // _T_SEL)
+        G = -(-n_tiles // tpg)
+        return _T_SEL // 128, tpg, 2 * 128 * G
+    slot, g = 4, 8
     Lp = -(-L // (slot * g)) * (slot * g)
     S = Lp // slot
     return slot, g, 2 * (S // min(g, S))
@@ -110,8 +203,10 @@ def select_k_slotted(in_val, in_idx, k: int, select_min: bool
     """select_k via certified slot folding.
 
     Envelope (raises NotImplementedError outside, so callers fall back):
-    - k ≤ pool capacity = 2·S/g — ≈ len/64 for the default slot=16, g=8
-      (len ≥ 4096), ≈ len/16 for short rows (slot=4);
+    - k ≤ pool capacity per :func:`slotted_envelope` — for len ≥ 4096
+      (the Pallas streaming path) 2·128·ceil(ceil(len/8192)/tpg) with
+      the adaptive tpg (4 for k ≤ 64, 1 above); ≈ len/16 for short rows
+      (XLA slot fold, slot=4);
     - dtype: ≤ 32-bit floating keys (f32/bf16/f16 — selection keys are
       compared in f32, which is exact for those; f64/int keys would be
       silently rounded, so they take the XLA path instead).
@@ -123,19 +218,23 @@ def select_k_slotted(in_val, in_idx, k: int, select_min: bool
             f"slotted select_k: f32/bf16/f16 keys only, got {in_val.dtype}")
     keys = in_val.astype(jnp.float32)
     B, L = in_val.shape
-    slot, g, pool = slotted_envelope(L)
-    # pad rows so the slot count is a group multiple (the fold reshapes
-    # [B, S] into [B, S/g, g])
-    Lp = -(-L // (slot * g)) * (slot * g)
-    S = Lp // slot
+    slot, g, pool = slotted_envelope(L, k)
     if k > pool:
         raise NotImplementedError(
             f"slotted select_k: k={k} exceeds pool {pool} for len={L}")
     work = keys if select_min else -keys
-    if Lp != L:
-        work = jnp.pad(work, ((0, 0), (0, Lp - L)),
-                       constant_values=jnp.inf)
-    _, out_pos = _slotted_select_min(work, k, slot, min(g, S), 128)
+    if L >= _PALLAS_MIN_L:
+        # streaming packed Pallas fold (pads internally)
+        _, out_pos = _slotted_select_min_pallas(work, k)
+    else:
+        # XLA slot fold for short rows; pad so the slot count is a
+        # group multiple (the fold reshapes [B, S] into [B, S/g, g])
+        Lp = -(-L // (slot * g)) * (slot * g)
+        S = Lp // slot
+        if Lp != L:
+            work = jnp.pad(work, ((0, 0), (0, Lp - L)),
+                           constant_values=jnp.inf)
+        _, out_pos = _slotted_select_min(work, k, slot, min(g, S), 128)
     safe_pos = jnp.clip(out_pos, 0, L - 1)
     # gather from the ORIGINAL input: values keep the caller's dtype
     out_v = jnp.take_along_axis(in_val, safe_pos, axis=1)

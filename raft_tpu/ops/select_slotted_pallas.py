@@ -1,0 +1,102 @@
+"""Packed slotted select kernel — streaming top-k candidates (Pallas).
+
+(ref: the role of matrix/detail/select_radix.cuh:639 /
+select_warpsort.cuh:752 — stream the row once at memory bandwidth,
+keeping per-bucket running minima in registers.)
+
+This is :mod:`raft_tpu.ops.fused_l2_topk_pallas`'s packed group fold
+with the MXU contraction removed: row tiles stream through VMEM and
+merge into per-(lane, tile-group) packed top-2 + 3rd-min accumulators
+(output blocks revisited across ``tpg`` consecutive tiles, candidate
+codes in the low mantissa bits — see the PACKED block comment there).
+One linear pass over the data; outputs are ~L/128 of the input. The
+certified selection built on top lives in
+raft_tpu.matrix.select_k_slotted.
+
+The slots-per-group product ``tpg · (T/128)`` is pinned to the full
+2^_PACK_BITS code space: the group kernel's measured-best configs sit
+exactly there, and for pure selection there is no reason to waste code
+space (fewer groups = smaller outputs = less pool work).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.ops.fused_l2_topk_pallas import (
+    _LANES, _PACK_BITS, _PACK_MASK, _PACK_PAD, _merge_chunk_top2_packed)
+from raft_tpu.ops.utils import interpret_mode
+
+
+def _select_kernel(v_ref, a1_ref, a2_ref, a3_ref,
+                   *, T: int, Bb: int, tpg: int):
+    j = pl.program_id(1)
+    n_chunks = T // _LANES
+
+    @pl.when(j % tpg == 0)
+    def _():
+        big = jnp.full((Bb, _LANES), _PACK_PAD, jnp.float32)
+        a1_ref[...] = big
+        a2_ref[...] = big
+        a3_ref[...] = big
+
+    b8 = Bb // 8
+    a1 = a1_ref[...].reshape(b8, 8, _LANES)
+    a2 = a2_ref[...].reshape(b8, 8, _LANES)
+    a3 = a3_ref[...].reshape(b8, 8, _LANES)
+    v = v_ref[...]                                       # [Bb, T]
+    for r in range(n_chunks):
+        sl = slice(r * _LANES, (r + 1) * _LANES)
+        c = v[:, sl].reshape(b8, 8, _LANES)
+        local = (j % tpg) * n_chunks + r                 # scalar code
+        cp = jax.lax.bitcast_convert_type(
+            (jax.lax.bitcast_convert_type(c, jnp.int32) & ~_PACK_MASK)
+            | local, jnp.float32)
+        a1, a2, a3 = _merge_chunk_top2_packed(cp, a1, a2, a3)
+    a1_ref[...] = a1.reshape(Bb, _LANES)
+    a2_ref[...] = a2.reshape(Bb, _LANES)
+    a3_ref[...] = a3.reshape(Bb, _LANES)
+
+
+@functools.partial(jax.jit, static_argnames=("T", "Bb", "tpg"))
+def select_slot_topk_packed(v, T: int = 1024, Bb: int = 256,
+                            tpg: int = 32):
+    """Per-(lane, tile-group) packed top-2 + 3rd-min of ``v`` [B, L].
+
+    Requirements (the caller — select_k_slotted — arranges all of
+    them): L % T == 0, B % Bb == 0, padded entries hold the finite
+    ``_PACK_PAD`` sentinel, |values| < _PACK_PAD/4 (rows violating this
+    fail the downstream certificate and take the exact fallback), and
+    tpg·(T/128) ≤ 2^_PACK_BITS. Returns (a1p, a2p, a3p), each
+    ``[B, G·LANES]`` packed f32 with G = ceil(L/T/tpg); positions
+    decode via distance.knn_fused.decode_packed_pool."""
+    B, L = v.shape
+    if L % T or B % Bb:
+        raise ValueError(f"select_slot_topk_packed: L={L} % T={T} or "
+                         f"B={B} % Bb={Bb} != 0")
+    if tpg * (T // _LANES) > (1 << _PACK_BITS):
+        raise ValueError("select_slot_topk_packed: packing envelope")
+    n_tiles = L // T
+    G = -(-n_tiles // tpg)
+    spec_out = pl.BlockSpec((Bb, _LANES), lambda i, j: (i, j // tpg),
+                            memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_select_kernel, T=T, Bb=Bb, tpg=tpg),
+        grid=(B // Bb, n_tiles),
+        in_specs=[pl.BlockSpec((Bb, T), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[spec_out] * 3,
+        out_shape=[jax.ShapeDtypeStruct((B, G * _LANES), jnp.float32)] * 3,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * B * L, bytes_accessed=B * L * 4 + B * G * 128 * 12,
+            transcendentals=0),
+        interpret=interpret_mode(),
+    )(v)

@@ -171,3 +171,24 @@ def test_auto_heuristic_is_table_driven(tmp_path, monkeypatch):
     monkeypatch.setattr(sk, "_SELECT_K_TABLE", ...)
     assert sk.choose_select_k_algorithm(16, 1_000_000, 64) == \
         SelectAlgo.XLA_TOPK
+
+
+@pytest.mark.parametrize("bad", [-np.inf, np.inf, np.nan])
+@pytest.mark.parametrize("L", [8192, 2048])   # Pallas path + XLA path
+def test_slotted_select_inf_nan_rows(bad, L):
+    """±inf/NaN inputs through the SLOTTED path: the packed kernel
+    turns ±inf into NaN (code bits OR'd into the mantissa), which MUST
+    route the row to the exact fallback — the pre-fix certificate read
+    the NaN-poisoned bound as 'certified' and silently dropped the true
+    minimum."""
+    from raft_tpu.matrix import SelectAlgo, select_k
+
+    rng = np.random.default_rng(3)
+    v = rng.normal(size=(8, L)).astype(np.float32)
+    v[3, 1234] = bad
+    ov, oi = select_k(None, v, k=8, algo=SelectAlgo.SLOTTED)
+    ov = np.asarray(ov)
+    # oracle: XLA top_k semantics (NaNs sort last for min-selection)
+    ref = np.sort(np.where(np.isnan(v), np.inf, v), axis=1)[:, :8]
+    got = np.where(np.isnan(ov), np.inf, ov)
+    np.testing.assert_array_equal(got, ref)
