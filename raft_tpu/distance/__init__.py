@@ -10,8 +10,10 @@ from raft_tpu.distance.fused_l2nn import (
     knn,
     knn_sharded,
 )
+from raft_tpu.distance.knn_fused import KnnIndex, prepare_knn_index
 
 __all__ = [
     "DistanceType", "METRIC_NAMES", "pairwise_distance",
     "fused_l2_nn", "fused_l2_nn_argmin", "knn", "knn_sharded",
+    "KnnIndex", "prepare_knn_index",
 ]

@@ -228,8 +228,30 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
     too. Degenerate zero-norm rows normalize to the zero vector
     (distance 0.5 to every unit vector) where the pairwise convention
     reports 1.0.
+
+    ``index`` may be a :class:`~raft_tpu.distance.knn_fused.KnnIndex`
+    (built once with ``prepare_knn_index`` — the build/query split for
+    repeated query batches); the metric must match what the index was
+    prepared for ("l2" serves sqeuclidean/euclidean/l2, "ip" serves
+    inner_product; prepare on pre-normalized data for cosine).
     """
     res = ensure_resources(res)
+    from raft_tpu.distance.knn_fused import KnnIndex, knn_fused
+
+    if isinstance(index, KnnIndex):
+        queries = jnp.asarray(queries, jnp.float32)
+        if metric in ("sqeuclidean", "euclidean", "l2"):
+            expects(index.metric == "l2",
+                    "knn: index prepared for %r, metric %r needs 'l2'",
+                    index.metric, metric)
+            dists, idx = knn_fused(queries, index, k)
+            if metric in ("euclidean", "l2"):
+                dists = jnp.sqrt(jnp.maximum(dists, 0.0))
+            return dists, idx
+        expects(metric == "inner_product" and index.metric == "ip",
+                "knn: prepared-index metric %r cannot serve %r",
+                index.metric, metric)
+        return knn_fused(queries, index, k)
     index = jnp.asarray(index, jnp.float32)
     queries = jnp.asarray(queries, jnp.float32)
     expects(metric in ("sqeuclidean", "euclidean", "l2", "inner_product",
@@ -262,9 +284,12 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
     _T, _, _g = fused_defaults(3)
     fused_pool = (2 * 128 // _g) * -(-max(n, _T) // _T)
     # d ≤ 512 takes the single-shot kernel; wider features take the
-    # d-chunked kernel (VMEM scratch accumulator) up to a pragmatic cap
-    auto_fused = (algo == "auto" and jax.default_backend() == "tpu"
-                  and queries.shape[1] <= 4096 and n >= 4096
+    # d-chunked kernel (VMEM scratch accumulator) up to a pragmatic cap;
+    # fused_eligible is THE shared backend/shape gate (also used by
+    # models.NearestNeighbors.fit and bench.py's prepare decision)
+    from raft_tpu.distance.knn_fused import fused_eligible
+
+    auto_fused = (algo == "auto" and fused_eligible(n, queries.shape[1])
                   and k <= fused_pool)
     if forced_fused or auto_fused:
         from raft_tpu.distance.knn_fused import knn_fused

@@ -115,11 +115,39 @@ def _pad_rows_to(y, mult: int):
     return _pad_rows(y, mult)[0]
 
 
+@functools.partial(jax.jit, static_argnames=("T", "g", "metric"))
+def _prepare_ops(y, T: int, g: int, metric: str):
+    """Index-side operand prep: row padding, bf16 hi/lo split, norms and
+    the [8, M] half-norm sentinel carrier. ~3 ms at 1M×128 on v5e —
+    hoisted out of the query path so a prepared index (KnnIndex) pays
+    it ONCE instead of per query batch."""
+    m = y.shape[0]
+    yp = _pad_rows_to(y, T)
+    M = yp.shape[0]
+    yy_raw = jnp.sum(yp * yp, axis=1)[None, :]                  # [1,M] f32
+    n_ch = T // _LANES
+    packed = g * n_ch <= (1 << _PACK_BITS)
+    pad_sentinel = _PACK_PAD if packed else jnp.inf
+    valid = (jnp.arange(M, dtype=jnp.int32) < m)[None, :]
+    if metric == "ip":
+        # r = 0/2 − x·(y/2) = −x·y/2 → score −x·y = 2·r (+ xx_r = 0)
+        y_hi, y_lo = split_hi_lo(yp * 0.5)
+        yyh_k = jnp.where(valid, 0.0, pad_sentinel)
+    else:
+        y_hi, y_lo = split_hi_lo(yp)
+        yyh_k = jnp.where(valid, 0.5 * yy_raw, pad_sentinel)
+    # [8, M] sublane-replicated carrier (see fused_l2_group_topk)
+    yyh_k = jnp.broadcast_to(yyh_k, (8, M))
+    return yp, y_hi, y_lo, yyh_k, yy_raw
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("k", "T", "Qb", "g", "passes", "metric"))
-def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
-               metric: str = "l2") -> Tuple[jax.Array, jax.Array]:
-    """Certified fused KNN on pre-padded operands.
+                   static_argnames=("k", "T", "Qb", "g", "passes", "metric",
+                                    "m"))
+def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
+                    k: int, T: int, Qb: int, g: int, passes: int,
+                    metric: str, m: int) -> Tuple[jax.Array, jax.Array]:
+    """Certified fused KNN on PREPARED operands (see _prepare_ops).
 
     x [Q, d] f32 (Q % Qb == 0, d % 128 == 0 — caller pads), y [m, d] f32
     un-padded rows; returns exact (score [Q, k] ascending, ids [Q, k]).
@@ -129,42 +157,31 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
     d2 = 0 + 0 − 2·x·(y/2) = −x·y. The certificate algebra is
     metric-blind (it only needs "every non-candidate ≥ its slot's
     2nd-min"); the bf16x3 error bound uses the TRUE operand norms.
+
+    The kernel folds the HALF-SCORE r = yy/2 − x·y (a positive-scale +
+    per-row-shift of d2, so per-row ordering is identical — one fewer
+    live [Qb, T] buffer in-kernel); padded index columns carry a
+    "never wins" sentinel so they lose every strict < in the fold (no
+    in-kernel masking). True distances are recovered as 2·r + xx on
+    the tiny [Q, S'] outputs.
+
+    PACKED path (production whenever the per-group slot count fits the
+    _PACK_BITS code space): candidate ids ride in the low mantissa
+    bits of the half-scores — no id selects in the merge, no id output
+    arrays, no pool-id gather; the candidate column reconstructs from
+    (pool position, embedded code). Packing perturbs values by
+    ≤ |v|·2⁻¹⁵, absorbed into the certificate margin e_pack.
     """
     Q, d = x.shape
-    m = y.shape[0]
-    yp = _pad_rows_to(y, T)
     M = yp.shape[0]
-
-    xx = jnp.sum(x * x, axis=1, keepdims=True)                  # [Q,1] f32
-    yy_raw = jnp.sum(yp * yp, axis=1)[None, :]                  # [1,M] f32
-    # the kernel folds the HALF-SCORE r = yy/2 − x·y (a positive-scale +
-    # per-row-shift of d2, so per-row ordering is identical — one fewer
-    # live [Qb, T] buffer in-kernel); padded index columns carry a
-    # "never wins" sentinel so they lose every strict < in the fold (no
-    # in-kernel masking). True distances are recovered as 2·r + xx on
-    # the tiny [Q, S'] outputs.
-    #
-    # PACKED path (production whenever the per-group slot count fits the
-    # _PACK_BITS code space): candidate ids ride in the low mantissa
-    # bits of the half-scores — no id selects in the merge, no id output
-    # arrays, no pool-id gather; the candidate column reconstructs from
-    # (pool position, embedded code). Packing perturbs values by
-    # ≤ |v|·2⁻¹⁵, absorbed into the certificate margin e_pack below.
     n_ch = T // _LANES
     packed = g * n_ch <= (1 << _PACK_BITS)
-    pad_sentinel = _PACK_PAD if packed else jnp.inf
-    valid = (jnp.arange(M, dtype=jnp.int32) < m)[None, :]
+
+    xx = jnp.sum(x * x, axis=1, keepdims=True)                  # [Q,1] f32
     if metric == "ip":
-        # r = 0/2 − x·(y/2) = −x·y/2 → score −x·y = 2·r (+ xx_r = 0)
-        y_hi, y_lo = split_hi_lo(yp * 0.5)
-        yyh_k = jnp.where(valid, 0.0, pad_sentinel)
         xx_r = jnp.zeros((Q, 1), jnp.float32)
     else:
-        y_hi, y_lo = split_hi_lo(yp)
-        yyh_k = jnp.where(valid, 0.5 * yy_raw, pad_sentinel)
         xx_r = xx
-    # [8, M] sublane-replicated carrier (see fused_l2_group_topk)
-    yyh_k = jnp.broadcast_to(yyh_k, (8, M))
     m_real = jnp.full((1,), m, jnp.int32)
 
     if packed:
@@ -210,9 +227,11 @@ def _knn_fused(x, y, k: int, T: int, Qb: int, g: int, passes: int,
         a3_min = 2.0 * jnp.min(a3, axis=1) + xx_r[:, 0]
         e_pack = jnp.zeros((Q,), jnp.float32)
 
-    # exact f32 rescore of the C candidates (gather + HIGHEST contraction)
+    # exact f32 rescore of the C candidates (gather + HIGHEST
+    # contraction; safe_pid is clamped to real rows, so gathering from
+    # the row-padded yp returns identical data to the original matrix)
     safe_pid = jnp.minimum(jnp.maximum(cand_pid, 0), m - 1)
-    yc = jnp.take(y, safe_pid, axis=0)                          # [Q, C, d]
+    yc = jnp.take(yp, safe_pid, axis=0)                         # [Q, C, d]
     if metric == "ip":
         d2c = -jnp.einsum("qd,qcd->qc", x, yc,
                           precision=jax.lax.Precision.HIGHEST)
@@ -416,16 +435,76 @@ def fused_defaults(passes: int = 3) -> Tuple[int, int, int]:
             or (2048, 256, 16))
 
 
+def fused_eligible(n_rows: int, d: int) -> bool:
+    """THE fused-pipeline eligibility gate (backend + shape envelope),
+    shared by knn()'s auto-routing, models.NearestNeighbors.fit's
+    prepare decision, and bench.py — one predicate, no drifting
+    copies."""
+    return (jax.default_backend() == "tpu"
+            and n_rows >= 4096 and d <= 4096)
+
+
+class KnnIndex:
+    """Prepared fused-KNN index: the index-side operands (row/feature
+    padding, bf16 hi/lo split, norms + sentinel carrier — ~3 ms at
+    1M×128 on v5e) computed ONCE at build time, the build/query split
+    of the reference ecosystem's index objects. Build with
+    :func:`prepare_knn_index`; query via ``knn_fused(x, index)`` or
+    ``distance.knn(res, index, queries, ...)``. The tiling config and
+    metric are frozen at build time."""
+
+    def __init__(self, yp, y_hi, y_lo, yyh_k, yy_raw, n_rows: int,
+                 T: int, Qb: int, g: int, passes: int, metric: str,
+                 d_orig: int):
+        # yp is the ROW-PADDED index; the original matrix is yp[:n_rows]
+        # (NOT stored separately — at 1M×128 that would pin a redundant
+        # ~512 MB f32 copy in HBM for the index lifetime)
+        self.yp = yp
+        self.y_hi, self.y_lo = y_hi, y_lo
+        self.yyh_k, self.yy_raw = yyh_k, yy_raw
+        self.n_rows = n_rows
+        self.T, self.Qb, self.g = T, Qb, g
+        self.passes, self.metric = passes, metric
+        self.d_orig = d_orig
+
+
+def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
+                      T: Optional[int] = None, Qb: Optional[int] = None,
+                      g: Optional[int] = None) -> KnnIndex:
+    """Build a :class:`KnnIndex` for repeated queries against ``y``."""
+    if metric not in ("l2", "ip"):
+        raise ValueError(f"prepare_knn_index: metric must be 'l2' or "
+                         f"'ip', got {metric!r}")
+    y = jnp.asarray(y, jnp.float32)
+    m, d = y.shape
+    dT, dQb, dg = fused_defaults(passes)
+    T = dT if T is None else T
+    Qb = dQb if Qb is None else Qb
+    g = dg if g is None else g
+    T, Qb = fit_config(T, Qb, d, passes, g)
+    dpad = (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
+    if dpad:
+        y = jnp.concatenate([y, jnp.zeros((m, dpad), jnp.float32)], axis=1)
+    yp, y_hi, y_lo, yyh_k, yy_raw = _prepare_ops(y, T, g, metric)
+    return KnnIndex(yp, y_hi, y_lo, yyh_k, yy_raw, m, T, Qb, g, passes,
+                    metric, d)
+
+
 def knn_fused(x, y, k: int, passes: int = 3,
               T: Optional[int] = None, Qb: Optional[int] = None,
               g: Optional[int] = None, metric: str = "l2"
               ) -> Tuple[jax.Array, jax.Array]:
     """Certified fused brute-force KNN.
 
+    ``y`` may be a raw [m, d] index matrix (operands prepared inline per
+    call) or a :class:`KnnIndex` (prepared once — preferred for repeated
+    query batches; its frozen T/Qb/g/passes/metric override the
+    corresponding arguments).
+
     ``metric="l2"`` (default): (d2 [Q, k] f32 exact ascending, ids).
     ``metric="ip"``: (scores = x·y [Q, k] f32 exact DESCENDING, ids) —
     the same kernel fed zeros for the norm terms and y/2 operands (see
-    _knn_fused). ``passes=3`` is certified-exact w.r.t. f32 scores;
+    _knn_fused_core). ``passes=3`` is certified-exact w.r.t. f32 scores;
     ``passes=1`` trades that for ~3× contraction speed (exact w.r.t.
     bf16 scores). ``T``/``Qb``/``g`` default to :func:`fused_defaults`
     (measured-best when a tuning table is committed); ``g`` is the
@@ -433,20 +512,31 @@ def knn_fused(x, y, k: int, passes: int = 3,
     group inside the kernel (tpg), so the candidate pool holds
     ``2 · ceil(n_tiles/g) · 128`` entries.
     """
+    idx: Optional[KnnIndex] = y if isinstance(y, KnnIndex) else None
+    if idx is not None:
+        T, Qb, g = idx.T, idx.Qb, idx.g
+        passes, metric = idx.passes, idx.metric
+        m, d = idx.n_rows, idx.d_orig
     if metric not in ("l2", "ip"):
         raise ValueError(f"knn_fused: metric must be 'l2' or 'ip', "
                          f"got {metric!r}")
-    dT, dQb, dg = fused_defaults(passes)
-    T = dT if T is None else T
-    Qb = dQb if Qb is None else Qb
-    g = dg if g is None else g
     x = jnp.asarray(x, jnp.float32)
-    y = jnp.asarray(y, jnp.float32)
-    Q, d = x.shape
-    m = y.shape[0]
+    Q, d_x = x.shape
+    if idx is None:
+        y = jnp.asarray(y, jnp.float32)
+        m, d = y.shape
+        dT, dQb, dg = fused_defaults(passes)
+        T = dT if T is None else T
+        Qb = dQb if Qb is None else Qb
+        g = dg if g is None else g
+        T, Qb = fit_config(T, Qb, d, passes, g)
+    if d_x != d:
+        raise ValueError(f"knn_fused: query width {d_x} != index {d}")
     if k > m:
         raise ValueError(f"knn_fused: k={k} > index size {m}")
-    T, Qb = fit_config(T, Qb, d, passes, g)
+    if Q == 0:
+        return (jnp.zeros((0, k), jnp.float32),
+                jnp.zeros((0, k), jnp.int32))
     if g < 1:
         raise ValueError(f"knn_fused: g={g} must be ≥ 1 (tiles per group)")
     # the group fold iterates T // 128 lane-chunks and the carriers
@@ -463,25 +553,31 @@ def knn_fused(x, y, k: int, passes: int = 3,
             f"knn_fused: k={k} too large for pool size {pool} "
             f"(shrink g or T, or use the streamed path)")
     if Q > _Q_CHUNK:
-        # bound the [Q, S] slot arrays / rescore gather: chunk the queries
-        outs = [knn_fused(x[s:s + _Q_CHUNK], y, k, passes=passes,
-                          T=T, Qb=Qb, g=g, metric=metric)
+        # bound the [Q, S] slot arrays / rescore gather: chunk the
+        # queries (prepare once so chunks share the index operands)
+        if idx is None:
+            idx = prepare_knn_index(y, passes=passes, metric=metric,
+                                    T=T, Qb=Qb, g=g)
+        outs = [knn_fused(x[s:s + _Q_CHUNK], idx, k)
                 for s in range(0, Q, _Q_CHUNK)]
         return (jnp.concatenate([o[0] for o in outs]),
                 jnp.concatenate([o[1] for o in outs]))
-    # pad feature dim to the lane width (d-chunk width for the wide
-    # kernel), queries to the block size
-    dpad = (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
+    # pad query feature dim to the index's padded width, queries to the
+    # block size
+    if idx is None:
+        idx = prepare_knn_index(y, passes=passes, metric=metric,
+                                T=T, Qb=Qb, g=g)
+    dpad = idx.yp.shape[1] - d
     if dpad:
-        zx = jnp.zeros((Q, dpad), jnp.float32)
-        x = jnp.concatenate([x, zx], axis=1)
-        y = jnp.concatenate([y, jnp.zeros((m, dpad), jnp.float32)], axis=1)
+        x = jnp.concatenate(
+            [x, jnp.zeros((Q, dpad), jnp.float32)], axis=1)
     Qb = min(Qb, ((Q + 7) // 8) * 8)
     qpad = (-Q) % Qb
     if qpad:
         x = jnp.concatenate([x, jnp.zeros((qpad, x.shape[1]), x.dtype)])
-    vals, ids = _knn_fused(x, y, k=k, T=T, Qb=Qb, g=g, passes=passes,
-                           metric=metric)
+    vals, ids = _knn_fused_core(
+        x, idx.yp, idx.y_hi, idx.y_lo, idx.yyh_k, idx.yy_raw,
+        k=k, T=T, Qb=Qb, g=g, passes=passes, metric=metric, m=m)
     if metric == "ip":
         return -vals[:Q], ids[:Q]   # internal −x·y ascending → IP desc
     return vals[:Q], ids[:Q]

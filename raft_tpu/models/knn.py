@@ -22,12 +22,36 @@ class NearestNeighbors:
 
     def fit(self, X) -> "NearestNeighbors":
         self._index = jnp.asarray(X, jnp.float32)
+        # build/query split: prepare the fused-pipeline index operands
+        # once, mirroring knn()'s own auto-routing condition (TPU +
+        # fused-eligible shape); anything else stays unprepared and
+        # takes knn()'s normal dispatch
+        self._prepared = None
+        kernel_metric = {"sqeuclidean": "l2", "euclidean": "l2",
+                         "l2": "l2", "inner_product": "ip"}.get(self.metric)
+        try:
+            from raft_tpu.distance.knn_fused import (
+                fused_eligible, prepare_knn_index)
+
+            if (kernel_metric is not None
+                    and fused_eligible(*self._index.shape)):
+                self._prepared = prepare_knn_index(
+                    self._index, metric=kernel_metric)
+        except Exception:
+            self._prepared = None   # preparation is an optimization only
         return self
 
     def kneighbors(self, queries, n_neighbors: Optional[int] = None
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         k = n_neighbors or self.n_neighbors
-        return _knn(self.res, self._index, queries, k, metric=self.metric)
+        index = self._index
+        if self._prepared is not None and k <= self._prepared.n_rows:
+            try:
+                return _knn(self.res, self._prepared, queries, k,
+                            metric=self.metric)
+            except NotImplementedError:
+                pass   # off-envelope k: fall through to normal dispatch
+        return _knn(self.res, index, queries, k, metric=self.metric)
 
     def kneighbors_graph(self, queries):
         """KNN as a CSR adjacency (for spectral embedding pipelines)."""

@@ -460,3 +460,54 @@ def test_packed_envelope_fallback():
     ref_vals, ref_ids, tol = _oracle(x, y, 8)
     np.testing.assert_allclose(np.asarray(vals), ref_vals, atol=tol)
     assert np.array_equal(np.sort(np.asarray(ids), 1), np.sort(ref_ids, 1))
+
+
+def test_prepared_index_matches_unprepared():
+    """KnnIndex (build/query split) must produce identical results to
+    the per-call path, for l2 and ip, through both knn_fused and the
+    public distance.knn surface."""
+    from raft_tpu import distance
+    from raft_tpu.distance.knn_fused import prepare_knn_index
+
+    x = rng.normal(size=(48, 40)).astype(np.float32)
+    y = rng.normal(size=(6000, 40)).astype(np.float32)
+
+    for metric in ("l2", "ip"):
+        idx = prepare_knn_index(y, metric=metric)
+        v1, i1 = knn_fused(x, idx, k=8)
+        v2, i2 = knn_fused(x, y, k=8, metric=metric)
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    idx = distance.prepare_knn_index(y)
+    v3, i3 = distance.knn(None, idx, x, k=8)
+    v4, i4 = distance.knn(None, y, x, k=8, algo="fused")
+    np.testing.assert_allclose(np.asarray(v3), np.asarray(v4))
+    assert np.array_equal(np.asarray(i3), np.asarray(i4))
+    # metric mismatch is rejected
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        distance.knn(None, idx, x, k=8, metric="inner_product")
+
+
+def test_prepared_index_query_chunking(monkeypatch):
+    """Q > _Q_CHUNK with a prepared index shares the operands across
+    chunks and still matches the oracle."""
+    import raft_tpu.distance.knn_fused as kf
+
+    monkeypatch.setattr(kf, "_Q_CHUNK", 64)
+    x = rng.normal(size=(150, 32)).astype(np.float32)
+    y = rng.normal(size=(4096, 32)).astype(np.float32)
+    idx = kf.prepare_knn_index(y)
+    vals, ids = kf.knn_fused(x, idx, k=8)
+    ref_vals, ref_ids, tol = _oracle(x, y, 8)
+    np.testing.assert_allclose(np.asarray(vals), ref_vals, atol=tol)
+    assert np.array_equal(np.sort(np.asarray(ids), 1), np.sort(ref_ids, 1))
+
+
+def test_empty_query_batch():
+    """Q == 0 returns empty [0, k] outputs instead of the historical
+    ZeroDivisionError in the Qb/qpad arithmetic."""
+    y = rng.normal(size=(2048, 16)).astype(np.float32)
+    vals, ids = knn_fused(np.zeros((0, 16), np.float32), y, k=4)
+    assert vals.shape == (0, 4) and ids.shape == (0, 4)
