@@ -69,13 +69,25 @@ def main():
     flush()
 
     t0 = time.time()
-    tiled = prepare_spmv(Acsr)
+    tiled = prepare_spmv(Acsr, layout="ell")
     out["prepare_s"] = round(time.time() - t0, 2)
     flush()
     dt = fx.run(lambda v: linalg.spmv(res, tiled, v), x)["seconds"]
     out["tiled_ell_ms"] = round(dt * 1e3, 3)
     out["tiled_speedup"] = round(out["segment_sum_ms"] / out["tiled_ell_ms"],
                                  2)
+    flush()
+
+    t0 = time.time()
+    pairs = prepare_spmv(Acsr, layout="pairs")   # single-kernel pair layout
+    out["prepare_pairs_s"] = round(time.time() - t0, 2)
+    flush()
+    dt = fx.run(lambda v: linalg.spmv(res, pairs, v), x)["seconds"]
+    out["pair_tiled_ms"] = round(dt * 1e3, 3)
+    out["pair_speedup_vs_segment"] = round(
+        out["segment_sum_ms"] / out["pair_tiled_ms"], 2)
+    out["pair_speedup_vs_ell"] = round(
+        out["tiled_ell_ms"] / out["pair_tiled_ms"], 2)
 
     if dry:
         print(json.dumps({"dry_run": True, **out}))

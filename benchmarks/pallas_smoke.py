@@ -76,8 +76,11 @@ def _smoke_spmv_tiled():
                   np.asarray(m.indices, np.int32),
                   m.data.astype(np.float32), m.shape)
     x = np.random.default_rng(3).normal(size=4096).astype(np.float32)
+    # default v2 ELL layout AND the single-kernel pair layout
     y = np.asarray(linalg.spmv(None, prepare_spmv(A), x))
+    y2 = np.asarray(linalg.spmv(None, prepare_spmv(A, layout="pairs"), x))
     ref = m @ x
+    np.testing.assert_allclose(y2, ref, rtol=5e-4, atol=5e-4)
     np.testing.assert_allclose(y, ref, rtol=2e-4, atol=2e-4)
 
 

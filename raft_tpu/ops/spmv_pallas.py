@@ -89,8 +89,8 @@ def _scatter_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
 
 @functools.partial(jax.jit, static_argnames=("C", "R", "E", "n_col_tiles",
                                              "n_row_tiles"))
-def _spmv_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
-                     chunk_row_tile, x_padded,
+def _spmv_tiled_impl(vals, col_local, chunk_col_tile, perm, perm_rows,
+                     row_local, chunk_row_tile, x_padded,
                      C: int, R: int, E: int,
                      n_col_tiles: int, n_row_tiles: int) -> jax.Array:
     n_chunks = vals.shape[0]
@@ -120,8 +120,17 @@ def _spmv_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
         interpret=interpret_mode(),
     )(chunk_col_tile, vals[:, None, :], col_local[:, None, :], xt)
 
-    contrib_sorted = jnp.take(
-        contrib.reshape(-1), perm.reshape(-1)).reshape(m_chunks, 1, E)
+    if perm_rows is not None:
+        # 8-aligned bucket layout: the bridge is a ROW gather (fast XLA
+        # path) with an appended zero row for pad slots; the scalar
+        # variant below measured 15.4 ms of the 17.1 ms SpMV at 2M nnz
+        contrib8 = jnp.concatenate(
+            [contrib.reshape(-1, 8), jnp.zeros((1, 8), jnp.float32)])
+        contrib_sorted = jnp.take(contrib8, perm_rows,
+                                  axis=0).reshape(m_chunks, 1, E)
+    else:
+        contrib_sorted = jnp.take(
+            contrib.reshape(-1), perm.reshape(-1)).reshape(m_chunks, 1, E)
 
     y3d = pl.pallas_call(
         functools.partial(_scatter_kernel, R=R),
@@ -154,11 +163,95 @@ def spmv_tiled(tiled, x) -> jax.Array:
         x = jnp.concatenate([x, jnp.zeros((pad,), jnp.float32)])
     y2dt = _spmv_tiled_impl(
         tiled.vals, tiled.col_local, tiled.chunk_col_tile, tiled.perm,
-        tiled.row_local, tiled.chunk_row_tile, x,
+        tiled.perm_rows, tiled.row_local, tiled.chunk_row_tile, x,
         C=tiled.C, R=tiled.R, E=tiled.E,
         n_col_tiles=tiled.n_col_tiles, n_row_tiles=tiled.n_row_tiles)
     # zero row tiles the grid never visited (rows with no nonzeros)
     y2d = jnp.where(tiled.visited_row_tiles[:, None], y2dt, 0.0)
+    return y2d.reshape(-1)[:n_rows]
+
+
+def _spmv_pair_kernel(row_tile_ref, col_tile_ref, vals_ref, cloc_ref,
+                      rloc_ref, xt_ref, y_ref, *, R: int, C: int):
+    """ONE fused gather·multiply·scatter step over a pair-tiled chunk
+    sub-block: no HBM contribution intermediate and — the measured
+    killer — no XLA scalar permutation between gather and scatter (15.4
+    of the two-kernel pipeline's 17.1 ms at 2M nnz ran in `jnp.take`,
+    XLA's scalar gather being ~0.5 GB/s on TPU)."""
+    c = pl.program_id(0)
+    b = pl.program_id(1)
+    cur = row_tile_ref[c]
+    prev = row_tile_ref[jnp.maximum(c - 1, 0)]
+    first = ((c == 0) | (cur != prev)) & (b == 0)
+
+    xt = xt_ref[0]                                     # [C, 1]
+    cols = cloc_ref[0]                                 # [1, EB]
+    oh_c = (jnp.broadcast_to(cols, (C, _EB))
+            == jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0))
+    xs = jnp.sum(jnp.where(oh_c, xt, 0.0), axis=0,
+                 keepdims=True)                        # [1, EB]
+    contrib = vals_ref[0] * xs
+    rloc = rloc_ref[0]                                 # [1, EB], pad = R
+    oh_r = (jnp.broadcast_to(rloc, (R, _EB))
+            == jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0))
+    acc = jnp.sum(jnp.where(oh_r, contrib, 0.0), axis=1,
+                  keepdims=True)                       # [R, 1]
+
+    @pl.when(first)
+    def _():
+        y_ref[0] = acc
+
+    @pl.when(jnp.logical_not(first))
+    def _():
+        y_ref[0] = y_ref[0] + acc
+
+
+@jax.jit
+def spmv_pair_tiled(t, x) -> jax.Array:
+    """y = A @ x for a :class:`raft_tpu.sparse.tiled.TiledPairsSpmv`
+    operand — the single-kernel pair-tiled SpMV (see _spmv_pair_kernel).
+    Chunks arrive sorted row-tile-major (tile_pairs' lexsort key), so
+    the output block is revisited across a row tile's consecutive
+    chunks and written to HBM once per tile."""
+    p = t.pairs
+    n_rows, n_cols = p.shape
+    x = jnp.asarray(x, jnp.float32)
+    pad = p.n_col_tiles * p.C - n_cols
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,), jnp.float32)])
+    xt = x.reshape(p.n_col_tiles, p.C, 1)
+    nb = p.E // _EB
+    m_chunks = p.m_chunks
+
+    y3d = pl.pallas_call(
+        functools.partial(_spmv_pair_kernel, R=p.R, C=p.C),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,                     # row tiles, col tiles
+            grid=(m_chunks, nb),
+            in_specs=[
+                pl.BlockSpec((1, 1, _EB), lambda c, b, mr, mc: (c, 0, b),
+                             memory_space=pltpu.VMEM),   # vals
+                pl.BlockSpec((1, 1, _EB), lambda c, b, mr, mc: (c, 0, b),
+                             memory_space=pltpu.VMEM),   # col_local
+                pl.BlockSpec((1, 1, _EB), lambda c, b, mr, mc: (c, 0, b),
+                             memory_space=pltpu.VMEM),   # row_local
+                pl.BlockSpec((1, p.C, 1),
+                             lambda c, b, mr, mc: (mc[c], 0, 0),
+                             memory_space=pltpu.VMEM),   # x tile
+            ],
+            out_specs=pl.BlockSpec((1, p.R, 1),
+                                   lambda c, b, mr, mc: (mr[c], 0, 0),
+                                   memory_space=pltpu.VMEM),
+        ),
+        out_shape=jax.ShapeDtypeStruct((p.n_row_tiles, p.R, 1),
+                                       jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret_mode(),
+    )(p.chunk_row_tile, p.chunk_col_tile, t.vals,
+      p.col_local[:, None, :], p.row_local[:, None, :], xt)
+    # zero row tiles the grid never visited (rows with no nonzeros)
+    y2d = jnp.where(t.visited[:, None], y3d[:, :, 0], 0.0)
     return y2d.reshape(-1)[:n_rows]
 
 
@@ -214,8 +307,8 @@ def _scatter_mm_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
 
 @functools.partial(jax.jit, static_argnames=("C", "R", "E", "V",
                                              "n_col_tiles", "n_row_tiles"))
-def _spmm_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
-                     chunk_row_tile, B_padded,
+def _spmm_tiled_impl(vals, col_local, chunk_col_tile, perm, perm_rows,
+                     row_local, chunk_row_tile, B_padded,
                      C: int, R: int, E: int, V: int,
                      n_col_tiles: int, n_row_tiles: int) -> jax.Array:
     n_chunks = vals.shape[0]
@@ -246,8 +339,16 @@ def _spmm_tiled_impl(vals, col_local, chunk_col_tile, perm, row_local,
         interpret=interpret_mode(),
     )(chunk_col_tile, vals3, col_local[:, None, :], x3d)
 
-    contrib_sorted = jnp.take(contrib.reshape(-1, V), perm.reshape(-1),
-                              axis=0).reshape(m_chunks, E, V)
+    if perm_rows is not None:
+        # 8-aligned bucket layout: gather 8-slot row groups ([8·V]-wide)
+        c8 = jnp.concatenate(
+            [contrib.reshape(-1, 8 * V),
+             jnp.zeros((1, 8 * V), jnp.float32)])
+        contrib_sorted = jnp.take(c8, perm_rows,
+                                  axis=0).reshape(m_chunks, E, V)
+    else:
+        contrib_sorted = jnp.take(contrib.reshape(-1, V), perm.reshape(-1),
+                                  axis=0).reshape(m_chunks, E, V)
 
     y3d = pl.pallas_call(
         functools.partial(_scatter_mm_kernel, R=R, V=V),
@@ -292,7 +393,7 @@ def spmm_tiled(tiled, B) -> jax.Array:
         B = jnp.concatenate([B, jnp.zeros((pad, V), jnp.float32)])
     y3d = _spmm_tiled_impl(
         tiled.vals, tiled.col_local, tiled.chunk_col_tile, tiled.perm,
-        tiled.row_local, tiled.chunk_row_tile, B,
+        tiled.perm_rows, tiled.row_local, tiled.chunk_row_tile, B,
         C=tiled.C, R=tiled.R, E=tiled.E, V=V,
         n_col_tiles=tiled.n_col_tiles, n_row_tiles=tiled.n_row_tiles)
     y2d = jnp.where(tiled.visited_row_tiles[:, None, None], y3d, 0.0)

@@ -8,10 +8,10 @@ from raft_tpu.sparse import matrix
 from raft_tpu.sparse import op
 from raft_tpu.sparse import solver
 from raft_tpu.sparse.linalg import prepare_sddmm, prepare_spmv
-from raft_tpu.sparse.tiled import TiledELL, TiledPairs
+from raft_tpu.sparse.tiled import TiledELL, TiledPairs, TiledPairsSpmv
 
 __all__ = [
-    "COOMatrix", "COOStructure", "CSRMatrix", "CSRStructure", "TiledELL",
+    "COOMatrix", "COOStructure", "CSRMatrix", "CSRStructure", "TiledELL", "TiledPairsSpmv",
     "TiledPairs", "convert", "linalg", "matrix", "op", "prepare_sddmm",
     "prepare_spmv", "solver",
 ]

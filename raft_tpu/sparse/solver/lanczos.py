@@ -34,13 +34,14 @@ from raft_tpu.core.resources import ensure_resources
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
 from raft_tpu.sparse.solver.lanczos_types import LANCZOS_WHICH, LanczosSolverConfig
 
-Operand = Union[COOMatrix, CSRMatrix, "TiledELL", jax.Array]
+Operand = Union[COOMatrix, CSRMatrix, "TiledELL", "TiledPairsSpmv",
+                jax.Array]
 
 
 def _matvec(A, x):
-    from raft_tpu.sparse.tiled import TiledELL
+    from raft_tpu.sparse.tiled import TiledELL, TiledPairsSpmv
 
-    if isinstance(A, (COOMatrix, CSRMatrix, TiledELL)):
+    if isinstance(A, (COOMatrix, CSRMatrix, TiledELL, TiledPairsSpmv)):
         from raft_tpu.sparse.linalg import spmv
 
         return spmv(None, A, x)
@@ -169,12 +170,12 @@ def lanczos_compute_eigenpairs(
     """
     res = ensure_resources(res)
     k = config.n_components
-    from raft_tpu.sparse.tiled import TiledELL
+    from raft_tpu.sparse.tiled import TiledELL, TiledPairsSpmv
 
     if isinstance(A, (COOMatrix, CSRMatrix)):
         n = A.shape[0]
         dtype = A.values.dtype
-    elif isinstance(A, TiledELL):
+    elif isinstance(A, (TiledELL, TiledPairsSpmv)):
         n = A.shape[0]
         dtype = A.vals.dtype
     else:
