@@ -262,6 +262,134 @@ void tiled_layout_fill(const int32_t* rows, const int32_t* cols,
   }
 }
 
+// ------------- v2 tiled-ELL layout (8-aligned bucket, row-perm) --------
+// (native rendering of sparse/tiled.py tile_csr's v2 numpy branch. Must
+// be BIT-IDENTICAL: (ct-major bucket, col, row, original) ordering, 8-
+// aligned (ct, rt) buckets, per-ct/rt-group padding to E, ROW-granular
+// perm with the zero-row sentinel. The row-perm bridge is the runtime
+// win — XLA's scalar permutation measured 15.4 of 17.1 ms at 2M nnz.)
+
+// Phase A: out_sizes[0] = gather slots, out_sizes[1] = scatter slots.
+void tiled_layout_v2_sizes(const int32_t* rows, const int32_t* cols,
+                           int64_t nnz, int64_t n_rows, int64_t n_cols,
+                           int64_t C, int64_t R, int64_t E,
+                           int64_t* out_sizes) {
+  int64_t n_ct = (n_cols + C - 1) / C; if (n_ct < 1) n_ct = 1;
+  int64_t n_rt = (n_rows + R - 1) / R; if (n_rt < 1) n_rt = 1;
+  // padded-8 bucket sizes, accumulated per ct group and per rt group —
+  // O(nnz) counting (no sort; Phase B does the one real sort)
+  std::unordered_map<int64_t, int64_t> bcount;
+  bcount.reserve((size_t)std::min<int64_t>(nnz, n_ct * n_rt) * 2);
+  for (int64_t i = 0; i < nnz; ++i)
+    ++bcount[(int64_t)(cols[i] / C) * n_rt + rows[i] / R];
+  std::vector<int64_t> ct_sum((size_t)n_ct, 0), rt_sum((size_t)n_rt, 0);
+  for (const auto& kv : bcount) {
+    int64_t p8 = (kv.second + 7) / 8 * 8;
+    ct_sum[kv.first / n_rt] += p8;
+    rt_sum[kv.first % n_rt] += p8;
+  }
+  int64_t gp = 0, sp = 0;
+  for (int64_t c = 0; c < n_ct; ++c) gp += (ct_sum[c] + E - 1) / E * E;
+  for (int64_t r = 0; r < n_rt; ++r) sp += (rt_sum[r] + E - 1) / E * E;
+  out_sizes[0] = gp > 0 ? gp : E;
+  out_sizes[1] = sp > 0 ? sp : E;
+}
+
+// Phase B: fill pv/pc/chunk_col_tile (gather), perm_rows/rloc/
+// chunk_row_tile/visited (scatter). Arrays pre-allocated to phase-A
+// sizes; perm_rows to scatter_slots/8; pads pre-set here.
+void tiled_layout_v2_fill(const int32_t* rows, const int32_t* cols,
+                          const float* vals, int64_t nnz,
+                          int64_t n_rows, int64_t n_cols,
+                          int64_t C, int64_t R, int64_t E,
+                          int64_t gather_slots, int64_t scatter_slots,
+                          float* pv, int32_t* pc, int32_t* chunk_col_tile,
+                          int32_t* perm_rows, int32_t* rloc,
+                          int32_t* chunk_row_tile, uint8_t* visited) {
+  int64_t n_ct = (n_cols + C - 1) / C; if (n_ct < 1) n_ct = 1;
+  int64_t n_rt = (n_rows + R - 1) / R; if (n_rt < 1) n_rt = 1;
+  auto bkey = [&](int64_t i) {
+    return (int64_t)(cols[i] / C) * n_rt + rows[i] / R;
+  };
+  // order: (bucket, col, row, original) — np.lexsort((rows, cols, bucket))
+  std::vector<int64_t> order(nnz);
+  for (int64_t i = 0; i < nnz; ++i) order[i] = i;
+  std::sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    int64_t ka = bkey(a), kb = bkey(b);
+    if (ka != kb) return ka < kb;
+    if (cols[a] != cols[b]) return cols[a] < cols[b];
+    if (rows[a] != rows[b]) return rows[a] < rows[b];
+    return a < b;
+  });
+  // bucket boundaries in sorted order
+  struct Bucket { int64_t key, start, count, p8, final_start; };
+  std::vector<Bucket> buckets;
+  {
+    int64_t t = 0;
+    while (t < nnz) {
+      int64_t k = bkey(order[t]), s = t;
+      while (t < nnz && bkey(order[t]) == k) ++t;
+      buckets.push_back({k, s, t - s, (t - s + 7) / 8 * 8, 0});
+    }
+  }
+  // gather stream: buckets ct-major (already sorted by key = ct-major),
+  // per-ct-group E padding
+  for (int64_t s = 0; s < gather_slots; ++s) { pv[s] = 0.0f; pc[s] = 0; }
+  int64_t pos = 0;
+  size_t bi = 0;
+  while (bi < buckets.size()) {
+    int64_t ct = buckets[bi].key / n_rt;
+    int64_t group_start = pos;
+    while (bi < buckets.size() && buckets[bi].key / n_rt == ct) {
+      Bucket& b = buckets[bi];
+      b.final_start = pos;
+      for (int64_t j = 0; j < b.count; ++j) {
+        int64_t i = order[b.start + j];
+        pv[pos + j] = vals[i];
+        pc[pos + j] = (int32_t)(cols[i] % C);
+      }
+      pos += b.p8;
+      ++bi;
+    }
+    pos = group_start + ((pos - group_start) + E - 1) / E * E;
+    for (int64_t ch = group_start; ch < pos; ch += E)
+      chunk_col_tile[ch / E] = (int32_t)ct;
+  }
+  // scatter stream: buckets (rt, ct)-major, per-rt-group E padding
+  std::vector<size_t> sidx(buckets.size());
+  for (size_t i = 0; i < sidx.size(); ++i) sidx[i] = i;
+  std::sort(sidx.begin(), sidx.end(), [&](size_t a, size_t b) {
+    int64_t ka = (buckets[a].key % n_rt) * n_ct + buckets[a].key / n_rt;
+    int64_t kb = (buckets[b].key % n_rt) * n_ct + buckets[b].key / n_rt;
+    return ka < kb;
+  });
+  const int32_t zero_row = (int32_t)(gather_slots / 8);
+  for (int64_t s = 0; s < scatter_slots; ++s) rloc[s] = (int32_t)R;
+  for (int64_t s = 0; s < scatter_slots / 8; ++s) perm_rows[s] = zero_row;
+  for (int64_t r = 0; r < n_rt; ++r) visited[r] = 0;
+  pos = 0;
+  size_t si = 0;
+  while (si < sidx.size()) {
+    int64_t rt = buckets[sidx[si]].key % n_rt;
+    visited[rt] = 1;
+    int64_t group_start = pos;
+    while (si < sidx.size() && buckets[sidx[si]].key % n_rt == rt) {
+      const Bucket& b = buckets[sidx[si]];
+      for (int64_t rr = 0; rr < b.p8 / 8; ++rr)
+        perm_rows[pos / 8 + rr] = (int32_t)(b.final_start / 8 + rr);
+      for (int64_t j = 0; j < b.count; ++j) {
+        int64_t i = order[b.start + j];
+        rloc[pos + j] = (int32_t)(rows[i] % R);
+      }
+      pos += b.p8;
+      ++si;
+    }
+    pos = group_start + ((pos - group_start) + E - 1) / E * E;
+    for (int64_t ch = group_start; ch < pos; ch += E)
+      chunk_row_tile[ch / E] = (int32_t)rt;
+  }
+}
+
 // ---------------- pair-tiled layout (blocked SDDMM preprocessing) ------
 // (the native rendering of raft_tpu.sparse.tiled.tile_pairs — bucketing a
 // sparsity structure by (row tile x col tile) for the blocked SDDMM

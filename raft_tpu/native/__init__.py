@@ -93,6 +93,29 @@ def load() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")]
+        try:
+            lib.tiled_layout_v2_sizes.argtypes = [
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+            lib.tiled_layout_v2_fill.argtypes = [
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")]
+        except AttributeError:
+            pass   # stale .so predating the v2 symbols — v2 falls back
         lib.pair_layout_sizes.argtypes = [
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
@@ -256,6 +279,48 @@ def tiled_layout(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
     lib.tiled_layout_fill(rows, cols, vals, nnz, n_rows, n_cols, C, R, E,
                           pv, pc, cct, perm, rloc, crt, visited)
     return pv, pc, cct, perm, rloc, crt, visited.astype(bool)
+
+
+def tiled_layout_v2(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                    n_rows: int, n_cols: int, C: int, R: int, E: int):
+    """Native v2 tiled-ELL layout (8-aligned buckets, ROW-granular perm
+    — see cpp/hostops.cpp tiled_layout_v2_*). Returns (pv, pc, cct,
+    perm_rows, rloc, crt, visited) bit-identical to the numpy v2 branch
+    in sparse/tiled.py, or None when the native library is unavailable
+    (or predates the symbol)."""
+    lib = load()
+    if lib is None or len(rows) == 0 or not hasattr(lib,
+                                                    "tiled_layout_v2_fill"):
+        return None
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    # the C++ pass indexes by id/tile with no bounds checks — validate
+    # HERE so bad input raises instead of corrupting the heap
+    if (rows.min() < 0 or cols.min() < 0
+            or rows.max() >= n_rows or cols.max() >= n_cols):
+        raise ValueError(
+            "tiled_layout_v2: row/col ids out of range for shape "
+            f"({n_rows}, {n_cols})")
+    rows = np.ascontiguousarray(rows, np.int32)
+    cols = np.ascontiguousarray(cols, np.int32)
+    vals = np.ascontiguousarray(vals, np.float32)
+    nnz = len(rows)
+    sizes = np.zeros(2, np.int64)
+    lib.tiled_layout_v2_sizes(rows, cols, nnz, n_rows, n_cols, C, R, E,
+                              sizes)
+    gp, sp = int(sizes[0]), int(sizes[1])
+    n_row_tiles = max(1, -(-n_rows // R))
+    pv = np.empty(gp, np.float32)
+    pc = np.empty(gp, np.int32)
+    cct = np.empty(gp // E, np.int32)
+    perm_rows = np.empty(sp // 8, np.int32)
+    rloc = np.empty(sp, np.int32)
+    crt = np.empty(sp // E, np.int32)
+    visited = np.zeros(n_row_tiles, np.uint8)
+    lib.tiled_layout_v2_fill(rows, cols, vals, nnz, n_rows, n_cols,
+                             C, R, E, gp, sp,
+                             pv, pc, cct, perm_rows, rloc, crt, visited)
+    return pv, pc, cct, perm_rows, rloc, crt, visited.astype(bool)
 
 
 def pair_layout(rows: np.ndarray, cols: np.ndarray, n_rows: int,

@@ -322,18 +322,38 @@ def tile_csr(A, C: int = 512, R: int = 256, E: int = 2048,
              impl: str = "auto") -> TiledELL:
     """Convert a CSR/COO matrix to the tiled-ELL layout (one-time, host).
 
-    ``impl``: "auto"/"numpy" build the v2 8-aligned-bucket layout whose
-    gather→scatter bridge is a ROW gather (runtime-optimal: the legacy
-    layout's scalar-permutation bridge measured 15.4 of the 17.1 ms
-    SpMV at 2M nnz on v5e); "native" forces the C++ layout pass
-    (legacy scalar-perm layout — ~an order of magnitude faster HOST
-    conversion at RMAT scale, for prepare-bound workloads). Both
-    layouts produce identical SpMV results (tested)."""
+    ``impl``: "auto" builds the v2 8-aligned-bucket layout (ROW-gather
+    bridge — runtime-optimal: the legacy scalar-permutation bridge
+    measured 15.4 of the 17.1 ms SpMV at 2M nnz on v5e) via the native
+    C++ pass when available, else numpy — BIT-IDENTICAL (tested);
+    "numpy" forces the fallback; "native" forces the LEGACY
+    scalar-perm C++ layout (kept for comparison/compat). All layouts
+    produce identical SpMV results (tested)."""
     if impl not in ("auto", "numpy", "native"):
         raise ValueError(f"tile_csr: impl must be 'auto', 'numpy' or "
                          f"'native', got {impl!r}")
     coo_rows, coo_cols, vals, shape = _checked_coo_parts(A, C, R, E,
                                                          "tile_csr")
+
+    if impl == "auto" and len(coo_rows):
+        from raft_tpu import native
+
+        out = native.tiled_layout_v2(coo_rows, coo_cols, vals, shape[0],
+                                     shape[1], C, R, E)
+        if out is not None:
+            pv, pc, cct, perm_rows, rloc, crt, visited = out
+            return TiledELL(
+                shape=shape, C=C, R=R, E=E,
+                vals=jnp.asarray(pv.reshape(-1, E)),
+                col_local=jnp.asarray(pc.reshape(-1, E)),
+                chunk_col_tile=jnp.asarray(cct),
+                perm=None,
+                perm_rows=jnp.asarray(perm_rows),
+                row_local=jnp.asarray(rloc.reshape(-1, E)),
+                chunk_row_tile=jnp.asarray(crt),
+                visited_row_tiles=jnp.asarray(visited),
+                n_col_tiles=max(1, -(-shape[1] // C)),
+                n_row_tiles=max(1, -(-shape[0] // R)))
 
     if impl == "native" and len(coo_rows):
         from raft_tpu import native

@@ -152,6 +152,31 @@ def test_spmm_tiled_powerlaw_and_empty_rows():
     np.testing.assert_allclose(Y, ref, rtol=2e-4, atol=2e-4)
 
 
+def test_native_v2_layout_bit_identical_to_numpy():
+    # the C++ v2 pass (impl="auto") must produce the EXACT arrays the
+    # numpy v2 branch builds — otherwise committed layouts would depend
+    # on which toolchain built the wheel
+    from raft_tpu import native
+    from raft_tpu.sparse.tiled import tile_csr
+
+    if not native.available() or not hasattr(native.load(),
+                                             "tiled_layout_v2_fill"):
+        pytest.skip("native v2 layout unavailable")
+    for pattern in ("uniform", "powerlaw"):
+        m = _random_csr(700, 600, 0.02, pattern)
+        A = CSRMatrix(np.asarray(m.indptr, np.int32),
+                      np.asarray(m.indices, np.int32),
+                      m.data.astype(np.float32), m.shape)
+        t_native = tile_csr(A, C=128, R=64, E=512, impl="auto")
+        t_numpy = tile_csr(A, C=128, R=64, E=512, impl="numpy")
+        assert t_native.perm_rows is not None
+        for f in ("vals", "col_local", "chunk_col_tile", "perm_rows",
+                  "row_local", "chunk_row_tile", "visited_row_tiles"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(t_native, f)),
+                np.asarray(getattr(t_numpy, f)), err_msg=f"{pattern}:{f}")
+
+
 def test_native_layout_output_equivalent_to_numpy():
     # the C++ pass builds the legacy scalar-perm layout, the numpy path
     # the v2 row-perm layout — different arrays BY DESIGN, but SpMV
