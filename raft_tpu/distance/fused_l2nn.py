@@ -273,16 +273,20 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
     n = index.shape[0]
 
     forced_fused = algo in ("fused", "fused_fast")
-    # the fused pipeline's candidate pool is 2·128/g · ceil(n/T) entries
-    # per query under its active (possibly tuned) tiling — mirror
-    # knn_fused's own envelope so auto never round-trips an exception
+    # the fused pipeline's candidate pool is 2·128·ceil(n_tiles/g)
+    # entries per query under its active (possibly tuned) tiling —
+    # mirror knn_fused's own envelope so auto never round-trips an
+    # exception
     from raft_tpu.distance.knn_fused import fused_defaults
 
     # auto-routing only ever runs passes=3, and FORCED fused requests
     # rely on knn_fused's own envelope errors (re-raised below), so the
     # pool precheck mirrors the passes=3 defaults
     _T, _, _g = fused_defaults(3)
-    fused_pool = (2 * 128 // _g) * -(-max(n, _T) // _T)
+    # pool = 2·128 per tile-GROUP (g = tiles per group), matching
+    # knn_fused's own pool construction — NOT 2·128/g per tile
+    _n_tiles = -(-max(n, _T) // _T)
+    fused_pool = 2 * (-(-_n_tiles // _g)) * 128
     # d ≤ 512 takes the single-shot kernel; wider features take the
     # d-chunked kernel (VMEM scratch accumulator) up to a pragmatic cap;
     # fused_eligible is THE shared backend/shape gate (also used by

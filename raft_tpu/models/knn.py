@@ -22,6 +22,7 @@ class NearestNeighbors:
 
     def fit(self, X) -> "NearestNeighbors":
         self._index = jnp.asarray(X, jnp.float32)
+        self._n_index = self._index.shape[0]
         # build/query split: prepare the fused-pipeline index operands
         # once, mirroring knn()'s own auto-routing condition (TPU +
         # fused-eligible shape); anything else stays unprepared and
@@ -37,21 +38,32 @@ class NearestNeighbors:
                     and fused_eligible(*self._index.shape)):
                 self._prepared = prepare_knn_index(
                     self._index, metric=kernel_metric)
+                # the KnnIndex's row-padded yp already holds the full
+                # f32 matrix; keeping self._index too would pin a
+                # redundant ~512 MB copy in HBM at 1M×128
+                self._index = None
         except Exception:
             self._prepared = None   # preparation is an optimization only
         return self
 
+    @property
+    def _index_matrix(self):
+        if self._index is not None:
+            return self._index
+        p = self._prepared
+        return p.yp[:p.n_rows, :p.d_orig]
+
     def kneighbors(self, queries, n_neighbors: Optional[int] = None
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         k = n_neighbors or self.n_neighbors
-        index = self._index
         if self._prepared is not None and k <= self._prepared.n_rows:
             try:
                 return _knn(self.res, self._prepared, queries, k,
                             metric=self.metric)
             except NotImplementedError:
                 pass   # off-envelope k: fall through to normal dispatch
-        return _knn(self.res, index, queries, k, metric=self.metric)
+        return _knn(self.res, self._index_matrix, queries, k,
+                    metric=self.metric)
 
     def kneighbors_graph(self, queries):
         """KNN as a CSR adjacency (for spectral embedding pipelines)."""
@@ -61,4 +73,4 @@ class NearestNeighbors:
         nq, k = i.shape
         indptr = jnp.arange(nq + 1, dtype=jnp.int32) * k
         return CSRMatrix(indptr, i.reshape(-1), d.reshape(-1),
-                         (nq, self._index.shape[0]))
+                         (nq, self._n_index))
