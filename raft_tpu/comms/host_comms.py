@@ -132,3 +132,45 @@ class HostComms:
     def device_multicast_sendrecv(self, x):
         return self._run(lambda c, s: c.device_multicast_sendrecv(s), x,
                          out_extra_rank=1)
+
+    # -- host point-to-point (ref: core/comms.hpp:130-140) -------------------
+    def _rank_devices(self):
+        """Rank → device along the communicator axis, fixing the other
+        mesh axes at index 0. Host p2p addresses one rank LINE: on a
+        multi-axis mesh whose lines cross process boundaries
+        differently per row, build the p2p comm on a 1-D (sub)mesh of
+        the actual line instead — process ownership is derived from
+        these devices."""
+        names = list(self.mesh.axis_names)
+        ax = names.index(self.axis_name)
+        dev = self.mesh.devices
+        sl = [0] * dev.ndim
+        sl[ax] = slice(None)
+        return list(dev[tuple(sl)].flat)
+
+    def _p2p_comm(self):
+        from raft_tpu.comms import p2p
+
+        devs = self._rank_devices()
+        return devs, p2p.comm_fingerprint(devs, self.axis_name)
+
+    def isend(self, x, src: int, dst: int, tag: int = 0):
+        """Host send rank src → dst; complete via :meth:`waitall`.
+        Deviation from the reference's implicit-source signature: the
+        single controller drives all local ranks, so src is explicit
+        (see comms/p2p.py)."""
+        from raft_tpu.comms import p2p
+
+        devs, comm = self._p2p_comm()
+        return p2p.isend(devs, x, src, dst, tag, comm=comm)
+
+    def irecv(self, shape, dtype, src: int, dst: int, tag: int = 0):
+        from raft_tpu.comms import p2p
+
+        devs, comm = self._p2p_comm()
+        return p2p.irecv(devs, shape, dtype, src, dst, tag, comm=comm)
+
+    def waitall(self, requests, timeout: float = 60.0) -> Status:
+        from raft_tpu.comms import p2p
+
+        return p2p.waitall(requests, timeout=timeout)

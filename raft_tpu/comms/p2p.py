@@ -1,0 +1,311 @@
+"""Host point-to-point messaging — ``isend`` / ``irecv`` / ``waitall``.
+
+(ref: cpp/include/raft/core/comms.hpp:130-140 — the ``comms_iface`` host
+p2p rows (UCX-backed in std_comms); exercised by
+comms/detail/test.hpp:301 ``test_pointToPoint_simple_send_recv``.)
+
+TPU-native mapping: under the single-controller SPMD model a "rank" is a
+mesh position, and its host-side owner is the process that holds the
+rank's device (``device.process_index``). Host p2p is therefore
+host-memory message passing between ``jax.distributed`` processes:
+
+- ranks on the SAME process exchange through an in-memory mailbox;
+- ranks on DIFFERENT processes exchange NumPy buffers over TCP sockets,
+  with listener addresses rendezvoused once per process group through
+  ``multihost_utils.process_allgather`` (the coordination-service
+  analog of the reference's UCX address exchange).
+
+Deliberate API deviation (documented in docs/using_comms.md): the
+reference's per-rank ``isend(buf, size, dest, tag)`` has an implicit
+source (the calling process IS the rank). Here one host drives all its
+local ranks, so both ``src`` and ``dst`` are explicit. Calls for ranks
+this process does not own are no-ops returning completed requests —
+every process runs the same SPMD host program, so each transfer is
+issued exactly once cluster-wide.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.comms.comms import Status
+
+_HDR = struct.Struct("<iiiiq")     # comm fingerprint, src, dst, tag, nbytes
+_DTYPE_HDR_LEN = 16                # fixed-width dtype string
+
+
+def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly n bytes; None on a clean close BEFORE any byte, a
+    raised error on mid-message truncation (a silently dropped message
+    would surface only as the receiver's generic timeout)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            if not buf:
+                return None
+            raise ConnectionError(
+                f"HostP2P: peer closed mid-message ({len(buf)}/{n} bytes)")
+        buf += chunk
+    return buf
+
+
+def _my_ip() -> str:
+    """The address peers can reach this process at: explicit override,
+    else the kernel's outbound-route source address (a UDP connect sends
+    no packets), else hostname resolution — which alone often yields
+    127.0.0.1 on hosts whose /etc/hosts maps the hostname to loopback."""
+    import os
+
+    override = os.environ.get("RAFT_TPU_P2P_HOST")
+    if override:
+        return override
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            ip = s.getsockname()[0]
+        if not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    return socket.gethostbyname(socket.gethostname())
+
+
+class P2PRequest:
+    """One pending transfer. ``result()`` is valid after ``waitall``
+    (receives resolve to the received ndarray; sends to None)."""
+
+    def __init__(self, kind: str, key: Tuple,
+                 thread: Optional[threading.Thread] = None,
+                 done: bool = False):
+        self.kind = kind
+        self.key = key
+        self.thread = thread
+        self.value: Optional[np.ndarray] = None
+        self.error: Optional[Exception] = None
+        self.done = done
+
+    def result(self) -> Optional[np.ndarray]:
+        expects(self.done, "P2PRequest: waitall() has not completed this "
+                           "request")
+        return self.value
+
+
+class HostP2P:
+    """Mailbox + socket transport shared by all communicators of one
+    process. One instance per (process, port-group); see
+    :func:`get_transport`."""
+
+    def __init__(self, n_processes: int, my_process: int):
+        self.n_processes = n_processes
+        self.my_process = my_process
+        self._mail: Dict[Tuple, queue.Queue] = {}
+        self._mail_lock = threading.Lock()
+        self._fabric_lock = threading.Lock()
+        self._listener: Optional[socket.socket] = None
+        self._peer_addrs: Optional[List[Tuple[str, int]]] = None
+        self._listen_thread: Optional[threading.Thread] = None
+
+    # -- mailbox -----------------------------------------------------------
+    def _box(self, key: Tuple) -> queue.Queue:
+        with self._mail_lock:
+            if key not in self._mail:
+                self._mail[key] = queue.Queue()
+            return self._mail[key]
+
+    def deliver_local(self, key, arr: np.ndarray) -> None:
+        self._box(key).put(arr)
+
+    # -- socket fabric (multi-process only) --------------------------------
+    def _ensure_fabric(self) -> None:
+        """Start the listener + rendezvous peer addresses. COLLECTIVE
+        over processes (every process must reach first p2p use).
+        Serialized by _fabric_lock — concurrent first uses would each
+        run the allgather (duplicate collectives deadlock the group) —
+        and _peer_addrs is published only once fully populated, so a
+        thread racing past the fast-path None check can never index a
+        half-built table."""
+        if self._peer_addrs is not None or self.n_processes == 1:
+            return
+        with self._fabric_lock:
+            if self._peer_addrs is not None:
+                return
+            from jax.experimental import multihost_utils
+
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.bind(("0.0.0.0", 0))
+            listener.listen(self.n_processes * 4)
+            port = listener.getsockname()[1]
+            host = _my_ip()
+            mine = np.frombuffer(
+                (host + ":" + str(port)).ljust(64).encode(), np.uint8)
+            allv = np.asarray(multihost_utils.process_allgather(mine))
+            addrs = []
+            for row in allv.reshape(self.n_processes, 64):
+                h, p = bytes(row).decode().strip().rsplit(":", 1)
+                addrs.append((h, int(p)))
+
+            def serve():
+                while True:
+                    try:
+                        conn, _ = listener.accept()
+                    except OSError:
+                        return
+                    threading.Thread(target=self._recv_conn, args=(conn,),
+                                     daemon=True).start()
+
+            self._listener = listener
+            self._listen_thread = threading.Thread(target=serve, daemon=True)
+            self._listen_thread.start()
+            self._peer_addrs = addrs
+
+    def _recv_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                hdr = _recv_exact(conn, _HDR.size)
+                if hdr is None:
+                    return
+                comm, src, dst, tag, nbytes = _HDR.unpack(hdr)
+                dt = _recv_exact(conn, _DTYPE_HDR_LEN)
+                shape_len = struct.unpack("<i", _recv_exact(conn, 4))[0]
+                shape = struct.unpack(f"<{shape_len}q",
+                                      _recv_exact(conn, 8 * shape_len))
+                payload = _recv_exact(conn, nbytes) if nbytes else b""
+                arr = np.frombuffer(
+                    payload,
+                    dtype=np.dtype(dt.decode().strip())).reshape(shape)
+                self.deliver_local((comm, src, dst, tag), arr)
+        except Exception:  # noqa: BLE001 — daemon thread: log, don't die
+            from raft_tpu.core.logger import default_logger
+
+            default_logger().error("HostP2P: dropped incoming message",
+                                   exc_info=True)
+
+    def send_remote(self, key, arr: np.ndarray, peer_process: int) -> None:
+        self._ensure_fabric()
+        comm, src, dst, tag = key
+        host, port = self._peer_addrs[peer_process]
+        with socket.create_connection((host, port), timeout=60) as s:
+            data = np.ascontiguousarray(arr)
+            s.sendall(_HDR.pack(comm, src, dst, tag, data.nbytes))
+            s.sendall(str(data.dtype).ljust(_DTYPE_HDR_LEN).encode())
+            s.sendall(struct.pack("<i", data.ndim))
+            s.sendall(struct.pack(f"<{data.ndim}q", *data.shape))
+            s.sendall(data.tobytes())
+
+    def pop(self, key, timeout: float) -> np.ndarray:
+        try:
+            return self._box(key).get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"HostP2P: no message for (src, dst, tag)={key} within "
+                f"{timeout}s — matching isend never issued?")
+
+
+_transport: Optional[HostP2P] = None
+_transport_lock = threading.Lock()
+
+
+def get_transport() -> HostP2P:
+    import jax
+
+    global _transport
+    with _transport_lock:
+        if _transport is None:
+            _transport = HostP2P(jax.process_count(), jax.process_index())
+        return _transport
+
+
+def comm_fingerprint(mesh_devices, axis_name: str) -> int:
+    """A 31-bit namespace id for one communicator's rank line, mixed
+    into every message key: without it, a parent communicator and a
+    comm_split sub-communicator exchanging the same (src, dst, tag)
+    through the shared process-global transport could cross-talk."""
+    import zlib
+
+    ids = ",".join(str(d.id) for d in mesh_devices)
+    return zlib.crc32(f"{axis_name}|{ids}".encode()) & 0x7FFFFFFF
+
+
+def isend(mesh_devices, x, src: int, dst: int, tag: int = 0,
+          comm: int = 0) -> P2PRequest:
+    """Post a host send of ``x`` from rank ``src`` to rank ``dst``.
+    (ref: core/comms.hpp:130 ``isend``.) Immediate; complete via
+    :func:`waitall`."""
+    import jax
+
+    expects(src != dst, "isend: src == dst == %d", src)
+    t = get_transport()
+    key = (comm, src, dst, tag)
+    src_proc = mesh_devices[src].process_index
+    dst_proc = mesh_devices[dst].process_index
+    if src_proc != jax.process_index():
+        return P2PRequest("send", key, done=True)   # not ours to issue
+    arr = np.asarray(x)
+    if dst_proc == src_proc:
+        t.deliver_local(key, arr)
+        return P2PRequest("send", key, done=True)
+    req = P2PRequest("send", key)
+
+    def run():
+        try:
+            t.send_remote(key, arr, dst_proc)
+        except Exception as e:  # noqa: BLE001 — re-raised by waitall
+            req.error = e
+
+    req.thread = threading.Thread(target=run, daemon=True)
+    req.thread.start()
+    return req
+
+
+def irecv(mesh_devices, shape, dtype, src: int, dst: int,
+          tag: int = 0, comm: int = 0) -> P2PRequest:
+    """Post a host receive at rank ``dst`` from rank ``src``.
+    (ref: core/comms.hpp:135 ``irecv``.) The (shape, dtype) are the
+    caller's declared buffer — validated on completion."""
+    import jax
+
+    t = get_transport()
+    if t.n_processes > 1:
+        t._ensure_fabric()          # collective: all processes join
+    key = (comm, src, dst, tag)
+    if mesh_devices[dst].process_index != jax.process_index():
+        return P2PRequest("recv", key, done=True)   # lands elsewhere
+    req = P2PRequest("recv", key)
+    req.shape, req.dtype = tuple(shape), np.dtype(dtype)
+    return req
+
+
+def waitall(requests: List[P2PRequest], timeout: float = 60.0) -> Status:
+    """Complete all posted requests. (ref: core/comms.hpp:140
+    ``waitall``.) Receives resolve their ``result()``; a failed send
+    re-raises its transport error here rather than reporting SUCCESS
+    for bytes that never left."""
+    for r in requests:
+        if r.done:
+            continue
+        if r.kind == "send":
+            r.thread.join(timeout=timeout)
+            expects(not r.thread.is_alive(),
+                    "waitall: send %s timed out", r.key)
+            if r.error is not None:
+                raise r.error
+            r.done = True
+    for r in requests:
+        if r.done:
+            continue
+        arr = get_transport().pop(r.key, timeout=timeout)
+        expects(arr.shape == r.shape and arr.dtype == r.dtype,
+                "waitall: received (%s, %s) for posted (%s, %s) on %s",
+                arr.shape, arr.dtype, r.shape, r.dtype, r.key)
+        r.value = arr
+        r.done = True
+    return Status.SUCCESS

@@ -119,6 +119,54 @@ def perform_test_comm_device_multicast_sendrecv(comms: HostComms) -> bool:
                     for r in range(comms.size)))
 
 
+def perform_test_comm_send_recv(comms: HostComms, num_trials: int = 2) -> bool:
+    """Host p2p all-to-all: every rank isends its id to every other rank
+    (tag 0), irecvs from all, waitall, verifies. (ref: detail/test.hpp:301
+    test_pointToPoint_simple_send_recv — the same pattern per trial.)"""
+    size = comms.size
+    for _ in range(num_trials):
+        reqs = []
+        for dst in range(size):
+            for src in range(size):
+                if src != dst:
+                    reqs.append(comms.irecv((1,), np.int32, src, dst))
+        for src in range(size):
+            for dst in range(size):
+                if src != dst:
+                    reqs.append(comms.isend(
+                        np.asarray([src], np.int32), src, dst))
+        if comms.waitall(reqs).value != 0:
+            return False
+        for r in reqs:
+            if r.kind == "recv" and r.value is not None:
+                if int(r.value[0]) != r.key[1]:
+                    return False
+        comms.barrier()
+    return True
+
+
+def perform_test_comm_device_send_or_recv(comms: HostComms,
+                                          num_trials: int = 2) -> bool:
+    """Disjoint send-OR-receive pairs: even rank r sends its id to r+1,
+    odd ranks only receive and verify rank−1 arrived.
+    (ref: detail/test.hpp:366 test_pointToPoint_device_send_or_recv.)"""
+    size = comms.size
+    for _ in range(num_trials):
+        reqs = []
+        for r in range(size):
+            if r % 2 == 0 and r + 1 < size:
+                reqs.append(comms.isend(np.asarray([r], np.int32), r, r + 1))
+            elif r % 2 == 1:
+                reqs.append(comms.irecv((1,), np.int32, r - 1, r))
+        if comms.waitall(reqs).value != 0:
+            return False
+        for q in reqs:
+            if q.kind == "recv" and q.value is not None:
+                if int(q.value[0]) != q.key[1]:
+                    return False
+    return True
+
+
 def perform_test_comm_split(comms: HostComms, row_axis: str, col_axis: str) -> bool:
     """2-D grid: row/col sub-communicator reductions.
     (ref: detail/test.hpp:513 test_commsplit; SURVEY §2.12
@@ -146,4 +194,6 @@ ALL_TESTS = [
     perform_test_comm_reducescatter,
     perform_test_comm_device_sendrecv,
     perform_test_comm_device_multicast_sendrecv,
+    perform_test_comm_send_recv,
+    perform_test_comm_device_send_or_recv,
 ]
