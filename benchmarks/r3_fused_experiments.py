@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Round-3 fused-KNN experiments on real TPU (VERDICT r2 item 1).
+
+Measures, at the production shape (2048×1M×128, k=64, T=2048, Qb=256,
+g=16):
+
+  kernel variants   packed fold: round-2 baseline semantics now with the
+                    5-op min/max merge (v1) and the pairwise
+                    pre-reduction (v2, pair=True), p1 and p3
+  post components   XLA top_k on [2048, C..7936] pool widths,
+                    approx_max_k, the rescore gather+einsum alone, and a
+                    Pallas second-level pool fold candidate
+  fixup             XLA top_k [16, 1M] vs the slotted select kernel
+
+Writes R3_FUSED_EXP.json (repo root) incrementally. Probe-guarded;
+RAFT_TPU_BENCH_FORCE=cpu validates the harness at tiny shapes (no
+artifact).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._common import gate  # noqa: E402
+
+BUDGET_S = float(os.environ.get("R3_FUSED_BUDGET_S", "2400"))
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "R3_FUSED_EXP.json")
+
+
+def main():
+    dry, skip = gate()
+    if skip:
+        print(json.dumps({"skipped": True, "reason": skip}))
+        return
+
+    import jax
+    import jax.numpy as jnp
+
+    import raft_tpu
+    from raft_tpu.benchmark import Fixture
+    from raft_tpu.ops import fused_l2_topk_pallas as F
+    from raft_tpu.random import RngState, make_blobs
+
+    res = raft_tpu.device_resources()
+    T, Qb, g = 2048, 256, 16
+    if dry:
+        n_index, dim, n_q, k = 16_384, 128, 256, 64
+        T, Qb = 512, 32
+    else:
+        n_index, dim, n_q, k = 1_000_000, 128, 2048, 64
+
+    X, _ = make_blobs(res, RngState(0), n_index, dim, n_clusters=64,
+                      cluster_std=2.0)
+    Q = X[:n_q]
+    jax.block_until_ready(X)
+    fx = Fixture(res=res, reps=3)
+
+    m = n_index
+    M = ((m + T - 1) // T) * T
+    yp = jnp.concatenate(
+        [X, jnp.zeros((M - m, dim), jnp.float32)]) if M > m else X
+    y_hi, y_lo = F.split_hi_lo(yp)
+    xx = jnp.sum(Q * Q, axis=1, keepdims=True)
+    yy = jnp.sum(yp * yp, axis=1)[None, :]
+    m_real = jnp.full((1,), m, jnp.int32)
+    valid_cols = (jnp.arange(M) < m)[None, :]
+    yyh_pck = jnp.broadcast_to(
+        jnp.where(valid_cols, 0.5 * yy, F._PACK_PAD), (8, M))
+    jax.block_until_ready((y_hi, y_lo, xx, yyh_pck))
+
+    out = {"shape": [n_q, n_index, dim, k], "T": T, "Qb": Qb, "g": g,
+           "stages": {}}
+    deadline = time.monotonic() + BUDGET_S
+
+    def record(name, fn, *args):
+        if time.monotonic() > deadline:
+            return None
+        try:
+            r = fx.run(fn, *args)
+            out["stages"][name] = {"ms": round(r["seconds"] * 1e3, 3)}
+        except Exception as e:
+            out["stages"][name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(json.dumps({name: out["stages"][name]}), flush=True)
+        if not dry:
+            with open(OUT, "w") as f:
+                json.dump(out, f, indent=1)
+        return out["stages"][name].get("ms")
+
+    # --- kernel variants ---
+    record("kernel_pck_p1_v1", lambda *a: F.fused_l2_group_topk_packed(
+        *a, T=T, Qb=Qb, passes=1, tpg=g), Q, y_hi, y_lo, yyh_pck, m_real)
+    record("kernel_pck_p1_v2pair", lambda *a: F.fused_l2_group_topk_packed(
+        *a, T=T, Qb=Qb, passes=1, tpg=g, pair=True),
+        Q, y_hi, y_lo, yyh_pck, m_real)
+    record("kernel_pck_p3_v1", lambda *a: F.fused_l2_group_topk_packed(
+        *a, T=T, Qb=Qb, passes=3, tpg=g), Q, y_hi, y_lo, yyh_pck, m_real)
+    record("kernel_pck_p3_v2pair", lambda *a: F.fused_l2_group_topk_packed(
+        *a, T=T, Qb=Qb, passes=3, tpg=g, pair=True),
+        Q, y_hi, y_lo, yyh_pck, m_real)
+
+    # --- post components: pool selection alternatives ---
+    pck = jax.block_until_ready(F.fused_l2_group_topk_packed(
+        Q, y_hi, y_lo, yyh_pck, m_real, T=T, Qb=Qb, passes=1, tpg=g))
+    pool = jnp.concatenate([pck[0], pck[1]], axis=1)     # [Q, 2S']
+    W = pool.shape[1]
+    C = min(k + 32, W)
+
+    @jax.jit
+    def xla_topk(p):
+        return jax.lax.top_k(-p, C)
+
+    record(f"topk_xla_{W}", xla_topk, pool)
+    for w in (4096, 2048, 1024, 256):
+        if w <= W:
+            record(f"topk_xla_{w}", xla_topk, pool[:, :w])
+
+    @jax.jit
+    def approx_topk(p):
+        return jax.lax.approx_max_k(-p, C, recall_target=0.95)
+
+    record(f"topk_approx_{W}", approx_topk, pool)
+
+    @jax.jit
+    def approx_topk_hi(p):
+        return jax.lax.approx_max_k(-p, C, recall_target=0.999)
+
+    record(f"topk_approx999_{W}", approx_topk_hi, pool)
+
+    # count-check pass (the soundness verifier for approx selection)
+    @jax.jit
+    def count_below(p, t):
+        return jnp.sum((p < t[:, None]).astype(jnp.int32), axis=1)
+
+    t0 = jnp.zeros((n_q,), jnp.float32)
+    record("count_below_pool", count_below, pool, t0)
+
+    # rescore alone: gather C rows of yp + HIGHEST einsum + final top_k
+    pid = jnp.argsort(pool[:, :C], axis=1).astype(jnp.int32) * 977 % m
+
+    @jax.jit
+    def rescore(pid, x, y, xx):
+        yc = jnp.take(y, pid, axis=0)
+        d2c = (xx + jnp.sum(yc * yc, axis=2)
+               - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
+                                  precision=jax.lax.Precision.HIGHEST))
+        nk, ok = jax.lax.top_k(-d2c, k)
+        return -nk, ok
+
+    record("rescore_gather_C", rescore, pid, Q, yp, xx)
+
+    # --- fixup row select: XLA vs the in-house slotted kernel ---
+    d2f = jax.block_until_ready(
+        xx[:16] + yy - 2.0 * (Q[:16] @ yp.T))           # [16, M] f32
+
+    @jax.jit
+    def fix_xla(d2):
+        return jax.lax.top_k(-d2, k)
+
+    record("fixup_topk_xla_16xM", fix_xla, d2f)
+
+    def fix_slotted(d2):
+        from raft_tpu.matrix.select_k import SelectAlgo, select_k
+        return select_k(res, d2, k=k, select_min=True,
+                        algo=SelectAlgo.SLOTTED)
+
+    record("fixup_select_slotted_16xM", fix_slotted, d2f)
+
+    def fix_auto(d2):
+        from raft_tpu.matrix.select_k import select_k
+        return select_k(res, d2, k=k, select_min=True)
+
+    record("fixup_select_auto_16xM", fix_auto, d2f)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

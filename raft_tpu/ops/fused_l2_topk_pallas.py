@@ -252,6 +252,15 @@ def _check_pack_envelope(T: int, tpg: int):
             f"exceeds the {1 << _PACK_BITS}-code packing envelope")
 
 
+def _check_pair_envelope(n_chunks: int):
+    # silently falling back to the non-pair loop would make a benchmark
+    # row labelled "pair" measure the baseline kernel
+    if n_chunks % 2:
+        raise ValueError(
+            f"pair=True requires an even chunk count, got T/128 = "
+            f"{n_chunks}")
+
+
 def _make_kernel(base, passes: int, T: int, Qb: int, **fold_kw):
     """Bind the base kernel for the passes mode; for passes == 3 reorder
     the y_lo ref out of the positional stream (*rest carries the output
@@ -500,23 +509,37 @@ _PACK_PAD = float(2.0 ** 125)    # finite "never wins" sentinel
 
 
 def _merge_chunk_top2_packed(cp, a1, a2, a3):
-    """7-op packed merge: top-2 + 3rd-min by packed-f32 order."""
-    lt1 = cp < a1
-    b1 = jnp.where(lt1, a1, cp)
-    a1 = jnp.where(lt1, cp, a1)
-    lt2 = b1 < a2
-    b2 = jnp.where(lt2, a2, b1)
-    a2 = jnp.where(lt2, b1, a2)
+    """5-op packed merge: top-2 + 3rd-min by packed-f32 order.
+
+    Pure min/max network (no compare+select pairs — min/max are single
+    VPU ops where lt+where is two): with the invariant a1 ≤ a2, the
+    round-1 loser max(a1, cp) either stays ≥ a2 (cp wins nothing) or
+    becomes the new 2nd; the round-2 loser max(a2, ·) is exactly the
+    3rd-smallest seen, which feeds the certificate bound."""
+    b1 = jnp.maximum(a1, cp)
+    a1 = jnp.minimum(a1, cp)
+    b2 = jnp.maximum(a2, b1)
+    a2 = jnp.minimum(a2, b1)
     a3 = jnp.minimum(a3, b2)
     return a1, a2, a3
 
 
 def _group_fold_and_write_packed(s, j, yyh_ref, a1_ref, a2_ref, a3_ref,
-                                 *, T: int, Qb: int, tpg: int):
+                                 *, T: int, Qb: int, tpg: int,
+                                 pair: bool = False):
     """Packed variant of _group_fold_and_write: same VMEM discipline
     (per-chunk half-scores, 3-D carriers, no masking — callers pass
     yy/2 = _PACK_PAD on padded columns), but the merge runs on packed
-    values only (see the block comment above)."""
+    values only (see the block comment above).
+
+    ``pair=True`` inserts a pairwise pre-reduction: adjacent chunks are
+    min-combined BEFORE packing/merging (the pack + top-2 merge then run
+    on half the stream — ~8 effective VPU ops/element vs ~10), and each
+    pair's loser feeds the 3rd-min tracker directly, so the certificate
+    stays sound: every value discarded anywhere still lower-bounds into
+    a3. Cost: a query now also needs fixup when TWO true top-k collide
+    in one (lane, chunk-pair) — ~2× the three-share-a-group rate, still
+    single-digit per 2048 queries at production scale (measured)."""
     n_chunks = T // _LANES
 
     @pl.when(j % tpg == 0)
@@ -531,14 +554,30 @@ def _group_fold_and_write_packed(s, j, yyh_ref, a1_ref, a2_ref, a3_ref,
     a2 = a2_ref[...].reshape(q8, 8, _LANES)
     a3 = a3_ref[...].reshape(q8, 8, _LANES)
     yyh = yyh_ref[...]                                   # [8, T]
-    for r in range(n_chunks):
+
+    def half_score(r):
         sl = slice(r * _LANES, (r + 1) * _LANES)
-        c = yyh[:, sl] - s[:, sl].reshape(q8, 8, _LANES)
-        local = (j % tpg) * n_chunks + r                 # scalar code
-        cp = jax.lax.bitcast_convert_type(
+        return yyh[:, sl] - s[:, sl].reshape(q8, 8, _LANES)
+
+    def pack(c, code):
+        return jax.lax.bitcast_convert_type(
             (jax.lax.bitcast_convert_type(c, jnp.int32) & ~_PACK_MASK)
-            | local, jnp.float32)
-        a1, a2, a3 = _merge_chunk_top2_packed(cp, a1, a2, a3)
+            | code, jnp.float32)
+
+    if pair:
+        _check_pair_envelope(n_chunks)
+        for r in range(0, n_chunks, 2):
+            c0, c1 = half_score(r), half_score(r + 1)
+            mn = jnp.minimum(c0, c1)
+            a3 = jnp.minimum(a3, jnp.maximum(c0, c1))
+            base = (j % tpg) * n_chunks + r              # even → bit0 free
+            cp = pack(mn, jnp.where(mn == c1, base + 1, base))
+            a1, a2, a3 = _merge_chunk_top2_packed(cp, a1, a2, a3)
+    else:
+        for r in range(n_chunks):
+            local = (j % tpg) * n_chunks + r             # scalar code
+            cp = pack(half_score(r), local)
+            a1, a2, a3 = _merge_chunk_top2_packed(cp, a1, a2, a3)
     a1_ref[...] = a1.reshape(Qb, _LANES)
     a2_ref[...] = a2.reshape(Qb, _LANES)
     a3_ref[...] = a3.reshape(Qb, _LANES)
@@ -546,17 +585,78 @@ def _group_fold_and_write_packed(s, j, yyh_ref, a1_ref, a2_ref, a3_ref,
 
 def _group_kernel_packed(m_real_ref, x_ref, yhi_ref, yyh_ref,
                          a1_ref, a2_ref, a3_ref,
-                         *, T: int, Qb: int, tpg: int, ylo_ref=None):
+                         *, T: int, Qb: int, tpg: int, pair: bool = False,
+                         ylo_ref=None):
     j = pl.program_id(1)
     s = _contract(x_ref[...], yhi_ref[...],
                   None if ylo_ref is None else ylo_ref[...])
     _group_fold_and_write_packed(s, j, yyh_ref, a1_ref, a2_ref, a3_ref,
-                                 T=T, Qb=Qb, tpg=tpg)
+                                 T=T, Qb=Qb, tpg=tpg, pair=pair)
+
+
+def _group_kernel_packed_stream(m_real_ref, x_ref, yhi_ref, yyh_ref,
+                                a1_ref, a2_ref, a3_ref,
+                                *, T: int, Qb: int, tpg: int,
+                                pair: bool = False, ylo_ref=None):
+    """Streamed variant: the [Qb, T] contraction is split into T/LANES
+    [Qb, LANES] chunk contractions interleaved with the fold of the
+    PREVIOUS chunk. The big-matmul kernel serializes MXU (contract) then
+    VPU (fold) per cell; emitting them as independent small ops lets
+    Mosaic's VLIW scheduler co-issue fold(r) with contract(r+1) — the
+    in-kernel analog of double-buffering, targeting
+    max(matmul, fold) instead of matmul + fold per cell. Also drops the
+    live [Qb, T] f32 score buffer (only [Qb, LANES] chunks live)."""
+    j = pl.program_id(1)
+    n_chunks = T // _LANES
+
+    @pl.when(j % tpg == 0)
+    def _():
+        big = jnp.full((Qb, _LANES), _PACK_PAD, jnp.float32)
+        a1_ref[...] = big
+        a2_ref[...] = big
+        a3_ref[...] = big
+
+    q8 = Qb // 8
+    a1 = a1_ref[...].reshape(q8, 8, _LANES)
+    a2 = a2_ref[...].reshape(q8, 8, _LANES)
+    a3 = a3_ref[...].reshape(q8, 8, _LANES)
+    x = x_ref[...]
+    yhi = yhi_ref[...]
+    ylo = None if ylo_ref is None else ylo_ref[...]
+    yyh = yyh_ref[...]                                   # [8, T]
+
+    def chunk_score(r):
+        sl = slice(r * _LANES, (r + 1) * _LANES)
+        s_r = _contract(x, yhi[sl, :], None if ylo is None else ylo[sl, :])
+        return yyh[:, sl] - s_r.reshape(q8, 8, _LANES)
+
+    def pack(c, code):
+        return jax.lax.bitcast_convert_type(
+            (jax.lax.bitcast_convert_type(c, jnp.int32) & ~_PACK_MASK)
+            | code, jnp.float32)
+
+    if pair:
+        _check_pair_envelope(n_chunks)
+        for r in range(0, n_chunks, 2):
+            c0, c1 = chunk_score(r), chunk_score(r + 1)
+            mn = jnp.minimum(c0, c1)
+            a3 = jnp.minimum(a3, jnp.maximum(c0, c1))
+            base = (j % tpg) * n_chunks + r              # even → bit0 free
+            cp = pack(mn, jnp.where(mn == c1, base + 1, base))
+            a1, a2, a3 = _merge_chunk_top2_packed(cp, a1, a2, a3)
+    else:
+        for r in range(n_chunks):
+            cp = pack(chunk_score(r), (j % tpg) * n_chunks + r)
+            a1, a2, a3 = _merge_chunk_top2_packed(cp, a1, a2, a3)
+    a1_ref[...] = a1.reshape(Qb, _LANES)
+    a2_ref[...] = a2.reshape(Qb, _LANES)
+    a3_ref[...] = a3.reshape(Qb, _LANES)
 
 
 def _group_kernel_packed_dchunk(m_real_ref, x_ref, yhi_ref, yyh_ref,
                                 a1_ref, a2_ref, a3_ref, acc_ref,
-                                *, T: int, Qb: int, tpg: int, ylo_ref=None):
+                                *, T: int, Qb: int, tpg: int,
+                                pair: bool = False, ylo_ref=None):
     j = pl.program_id(1)
     l = pl.program_id(2)
     n_dc = pl.num_programs(2)
@@ -574,7 +674,8 @@ def _group_kernel_packed_dchunk(m_real_ref, x_ref, yhi_ref, yyh_ref,
     @pl.when(l == n_dc - 1)
     def _():
         _group_fold_and_write_packed(acc_ref[...], j, yyh_ref, a1_ref,
-                                     a2_ref, a3_ref, T=T, Qb=Qb, tpg=tpg)
+                                     a2_ref, a3_ref, T=T, Qb=Qb, tpg=tpg,
+                                     pair=pair)
 
 
 def _group_kernel(m_real_ref, x_ref, yhi_ref, yyh_ref,
@@ -654,7 +755,7 @@ def _packed_out_shape(Q: int, Sg: int):
 def _group_pallas_call(kernel_base, packed: bool,
                        x, y_hi, y_lo, yy_half, m_real,
                        *, T: int, Qb: int, passes: int, tpg: int,
-                       dc=None):
+                       dc=None, **fold_kw):
     """Shared scaffolding for the four group-fold entry points
     ((un)packed × (single-shot | d-chunked)) — specs, operands, grid and
     pallas_call in ONE place so the variants cannot drift."""
@@ -695,7 +796,8 @@ def _group_pallas_call(kernel_base, packed: bool,
     if passes == 3:
         in_specs.insert(2, y_spec)                      # y_lo
         operands.insert(2, y_lo)
-    kernel = _make_group_kernel(kernel_base, passes, T, Qb, tpg=tpg)
+    kernel = _make_group_kernel(kernel_base, passes, T, Qb, tpg=tpg,
+                                **fold_kw)
 
     n_out = 3 if packed else 5
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -758,34 +860,42 @@ def fused_l2_group_topk_dchunk(x, y_hi, y_lo, yy_half, m_real,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("T", "Qb", "passes", "tpg"))
+                   static_argnames=("T", "Qb", "passes", "tpg", "pair",
+                                    "stream"))
 def fused_l2_group_topk_packed(x, y_hi, y_lo, yy_half, m_real,
                                T: int, Qb: int, passes: int,
-                               tpg: int = 16):
+                               tpg: int = 16, pair: bool = False,
+                               stream: bool = False):
     """Packed-id variant of :func:`fused_l2_group_topk` (see the PACKED
     block comment): returns ``(a1p, a2p, a3p)``, each ``[Q, G·LANES]``
     f32 whose low _PACK_BITS mantissa bits hold the candidate's
     within-group code ``tile_offset·(T/LANES) + chunk`` (a3p's code is
     meaningless — only its value is used). ``yy_half`` must carry the
     finite ``_PACK_PAD`` sentinel (NOT +inf) on padded columns.
-    Requires tpg·(T/LANES) ≤ 2^_PACK_BITS."""
+    Requires tpg·(T/LANES) ≤ 2^_PACK_BITS. ``pair`` enables the
+    pairwise pre-reduction (see _group_fold_and_write_packed);
+    ``stream`` the chunked MXU/VPU-overlap contraction (see
+    _group_kernel_packed_stream)."""
     _check_pack_envelope(T, tpg)
-    return _group_pallas_call(_group_kernel_packed, True, x, y_hi, y_lo,
+    base = _group_kernel_packed_stream if stream else _group_kernel_packed
+    return _group_pallas_call(base, True, x, y_hi, y_lo,
                               yy_half, m_real, T=T, Qb=Qb, passes=passes,
-                              tpg=tpg)
+                              tpg=tpg, pair=pair)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("T", "Qb", "passes", "tpg", "dc"))
+                   static_argnames=("T", "Qb", "passes", "tpg", "dc",
+                                    "pair"))
 def fused_l2_group_topk_packed_dchunk(x, y_hi, y_lo, yy_half, m_real,
                                       T: int, Qb: int, passes: int,
-                                      tpg: int = 16, dc: int = 256):
+                                      tpg: int = 16, dc: int = 256,
+                                      pair: bool = False):
     """d-chunked packed variant (wide features): same contract as
     :func:`fused_l2_group_topk_packed`."""
     _check_pack_envelope(T, tpg)
     return _group_pallas_call(_group_kernel_packed_dchunk, True, x, y_hi,
                               y_lo, yy_half, m_real, T=T, Qb=Qb,
-                              passes=passes, tpg=tpg, dc=dc)
+                              passes=passes, tpg=tpg, dc=dc, pair=pair)
 
 
 def split_hi_lo(y: jax.Array) -> Tuple[jax.Array, jax.Array]:
