@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Round-3 fused-KNN experiments, part 3: the glue/fixup breakdown.
+
+After integrating the streamed kernel: where do the remaining
+e2e-minus-kernel-minus-post milliseconds go? Times, on prepared
+operands at 2048×1M×128 k=64:
+
+  core_nofixup_pN   _knn_fused_core(_diag=True) — kernel + pool top_k +
+                    decode + rescore + certificate, NO fixup cascade
+  n_fail_pN         the measured failure count on the bench data
+  e2e_pN            full knn_fused via KnnIndex (with fixup)
+
+Writes R3_FUSED_EXP3.json incrementally.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._common import gate  # noqa: E402
+
+BUDGET_S = float(os.environ.get("R3_FUSED_BUDGET_S", "1800"))
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "R3_FUSED_EXP3.json")
+
+
+def main():
+    dry, skip = gate()
+    if skip:
+        print(json.dumps({"skipped": True, "reason": skip}))
+        return
+
+    import jax
+    import numpy as np
+
+    import raft_tpu
+    from raft_tpu.benchmark import Fixture
+    from raft_tpu.distance.knn_fused import (
+        _knn_fused_core, knn_fused, prepare_knn_index)
+    from raft_tpu.random import RngState, make_blobs
+
+    res = raft_tpu.device_resources()
+    if dry:
+        n_index, dim, n_q, k = 16_384, 128, 256, 64
+    else:
+        n_index, dim, n_q, k = 1_000_000, 128, 2048, 64
+
+    X, _ = make_blobs(res, RngState(0), n_index, dim, n_clusters=64,
+                      cluster_std=2.0)
+    Q = X[:n_q]
+    jax.block_until_ready(X)
+    fx = Fixture(res=res, reps=3)
+
+    out = {"shape": [n_q, n_index, dim, k], "stages": {}}
+    deadline = time.monotonic() + BUDGET_S
+
+    def record(name, fn, *args):
+        if time.monotonic() > deadline:
+            return None
+        try:
+            r = fx.run(fn, *args)
+            out["stages"][name] = {"ms": round(r["seconds"] * 1e3, 3)}
+        except Exception as e:
+            out["stages"][name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(json.dumps({name: out["stages"][name]}), flush=True)
+        if not dry:
+            with open(OUT, "w") as f:
+                json.dump(out, f, indent=1)
+        return out["stages"][name].get("ms")
+
+    for passes in (1, 3):
+        idx = prepare_knn_index(X, passes=passes)
+        jax.block_until_ready(idx.yp)
+        core_args = dict(k=k, T=idx.T, Qb=idx.Qb, g=idx.g, passes=passes,
+                        metric="l2", m=idx.n_rows)
+
+        def core_nofix(q, ix=idx, ca=core_args):
+            return _knn_fused_core(q, ix.yp, ix.y_hi, ix.y_lo, ix.yyh_k,
+                                   ix.yy_raw, _diag=True, **ca)[0]
+
+        record(f"core_nofixup_p{passes}", core_nofix, Q)
+        # the failure count on this data (drives which fixup tier runs)
+        try:
+            nf = _knn_fused_core(Q, idx.yp, idx.y_hi, idx.y_lo, idx.yyh_k,
+                                 idx.yy_raw, _diag=True, **core_args)[2]
+            out["stages"][f"n_fail_p{passes}"] = int(np.asarray(nf))
+            print(json.dumps(
+                {f"n_fail_p{passes}": out["stages"][f"n_fail_p{passes}"]}),
+                flush=True)
+        except Exception as e:
+            out["stages"][f"n_fail_p{passes}"] = f"{type(e).__name__}: {e}"
+        record(f"e2e_p{passes}",
+               lambda q, ix=idx: knn_fused(q, ix, k)[0], Q)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

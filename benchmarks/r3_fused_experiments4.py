@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Round-3 fused-KNN experiments, part 4: cumulative prefix timing.
+
+core_nofixup_p1 = 21.4 ms but kernel (4.4) + pool top_k (5.9) + rescore
+(1.9) only account for ~12.4 — this script times jitted PREFIXES of the
+core pipeline on prepared operands to locate the missing ~9 ms:
+
+  A  stream kernel alone
+  B  A + pool concat + top_k C
+  C  B + decode + clamp + yp gather + HIGHEST rescore + final top_k
+  D  C + certificate terms (a3 min, e_pack, bound compare, n_fail)
+
+Writes R3_FUSED_EXP4.json incrementally.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._common import gate  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "R3_FUSED_EXP4.json")
+
+
+def main():
+    dry, skip = gate()
+    if skip:
+        print(json.dumps({"skipped": True, "reason": skip}))
+        return
+
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    import raft_tpu
+    from raft_tpu.benchmark import Fixture
+    from raft_tpu.distance.knn_fused import (
+        _POOL_PAD, _err_bound_coeff, decode_packed_pool, prepare_knn_index)
+    from raft_tpu.ops.fused_l2_topk_pallas import (
+        fused_l2_group_topk_packed)
+    from raft_tpu.random import RngState, make_blobs
+
+    res = raft_tpu.device_resources()
+    if dry:
+        n_index, dim, n_q, k = 16_384, 128, 256, 64
+    else:
+        n_index, dim, n_q, k = 1_000_000, 128, 2048, 64
+
+    X, _ = make_blobs(res, RngState(0), n_index, dim, n_clusters=64,
+                      cluster_std=2.0)
+    Q = X[:n_q]
+    jax.block_until_ready(X)
+    fx = Fixture(res=res, reps=3)
+
+    idx = prepare_knn_index(X, passes=1)
+    T, Qb, g, m = idx.T, idx.Qb, idx.g, idx.n_rows
+    jax.block_until_ready(idx.yp)
+
+    out = {"shape": [n_q, n_index, dim, k], "stages": {}}
+
+    def record(name, fn, *args):
+        try:
+            r = fx.run(fn, *args)
+            out["stages"][name] = {"ms": round(r["seconds"] * 1e3, 3)}
+        except Exception as e:
+            out["stages"][name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(json.dumps({name: out["stages"][name]}), flush=True)
+        if not dry:
+            with open(OUT, "w") as f:
+                json.dump(out, f, indent=1)
+
+    m_real = jnp.full((1,), m, jnp.int32)
+
+    # operands must be jit ARGUMENTS: closing over the 512 MB index
+    # arrays bakes them into the program as constants, and the tunnel's
+    # remote-compile request then blows its body-size limit (HTTP 413)
+    def kern(x, y_hi, y_lo, yyh_k):
+        return fused_l2_group_topk_packed(
+            x, y_hi, y_lo, yyh_k, m_real, T=T, Qb=Qb,
+            passes=1, tpg=g, pair=True, stream=True)
+
+    @jax.jit
+    def stage_a(x, y_hi, y_lo, yyh_k, yp, yy_raw):
+        return kern(x, y_hi, y_lo, yyh_k)[0]
+
+    @jax.jit
+    def stage_b(x, y_hi, y_lo, yyh_k, yp, yy_raw):
+        a1p, a2p, a3p = kern(x, y_hi, y_lo, yyh_k)
+        pool_p = jnp.concatenate([a1p, a2p], axis=1)
+        C = min(k + 32, pool_p.shape[1])
+        neg, pos = jax.lax.top_k(-pool_p, C)
+        return neg
+
+    def post_c(x, y_hi, y_lo, yyh_k, yp, yy_raw, with_cert):
+        a1p, a2p, a3p = kern(x, y_hi, y_lo, yyh_k)
+        S_ = a1p.shape[1]
+        xx = jnp.sum(x * x, axis=1, keepdims=True)
+        pool_p = jnp.concatenate([a1p, a2p], axis=1)
+        C = min(k + 32, pool_p.shape[1])
+        neg_top, pos = jax.lax.top_k(-pool_p, C)
+        cand_p = -neg_top
+        cand_pid = decode_packed_pool(cand_p, pos, S_, T, g)
+        cand_v_hat = 2.0 * cand_p + xx
+        safe_pid = jnp.minimum(jnp.maximum(cand_pid, 0), m - 1)
+        yc = jnp.take(yp, safe_pid, axis=0)
+        d2c = (xx + jnp.sum(yc * yc, axis=2)
+               - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
+                                  precision=jax.lax.Precision.HIGHEST))
+        d2c = jnp.where(cand_pid >= 0, jnp.maximum(d2c, 0.0), jnp.inf)
+        neg_k, ord_k = jax.lax.top_k(-d2c, k)
+        vals = -neg_k
+        ids = jnp.take_along_axis(cand_pid, ord_k, axis=1)
+        if not with_cert:
+            return vals, ids
+        theta = vals[:, k - 1]
+        a3_min = 2.0 * jnp.min(a3p, axis=1) + xx[:, 0]
+        e_pack = (xx[:, 0] + 2.0 * jnp.max(yy_raw)) * 2.0 ** -14
+        bound = jnp.minimum(a3_min, cand_v_hat[:, C - 1])
+        certified = bound >= theta + e_pack
+        n_fail = jnp.sum((~certified).astype(jnp.int32))
+        return vals, ids, n_fail
+
+    ops = (Q, idx.y_hi, idx.y_lo, idx.yyh_k, idx.yp, idx.yy_raw)
+    record("A_kernel", stage_a, *ops)
+    record("B_pool_topk", stage_b, *ops)
+    record("C_rescore", jax.jit(functools.partial(post_c, with_cert=False)),
+           *ops)
+    record("D_cert", jax.jit(functools.partial(post_c, with_cert=True)),
+           *ops)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

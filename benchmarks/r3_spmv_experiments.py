@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Round-3 SpMV experiments: stage breakdown + (C, R, eb) sweep.
+
+VERDICT r2 item 3: the 5.68 ms tiled-ELL SpMV at 2M nnz needs a stage
+attribution (gather kernel vs bridge row-gather vs scatter kernel) and
+then halving, twice. Hypotheses measured here:
+
+  - per-grid-step overhead dominates: steps = padded_nnz / eb, so
+    raising ``eb`` (the new sub-block knob) cuts steps proportionally;
+  - the one-hot fold costs C (resp. R) VPU compare/select per nonzero:
+    C=128 does 4× less gather work than the round-2 default C=512.
+
+Sweep: (C, R, eb) on the same rmat graph (2M nnz, scale 17 — BASELINE
+config 4's shape), stages timed separately at the round-2 default and
+the winner. Writes R3_SPMV_EXP.json incrementally.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._common import gate  # noqa: E402
+
+BUDGET_S = float(os.environ.get("R3_SPMV_BUDGET_S", "2400"))
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "R3_SPMV_EXP.json")
+
+
+def main():
+    dry, skip = gate()
+    if skip:
+        print(json.dumps({"skipped": True, "reason": skip}))
+        return
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import raft_tpu
+    from raft_tpu.benchmark import Fixture
+    from raft_tpu.core.sparse_types import COOMatrix
+    from raft_tpu.ops import spmv_pallas as SP
+    from raft_tpu.random import RngState
+    from raft_tpu.random.rmat import rmat_rectangular_gen
+    from raft_tpu.sparse.tiled import tile_csr
+
+    res = raft_tpu.device_resources()
+    scale, n_edges = (17, 1_000_000) if not dry else (10, 10_000)
+    src, dst = rmat_rectangular_gen(res, RngState(3), n_edges, scale, scale)
+    rows = np.concatenate([np.asarray(src), np.asarray(dst)]).astype(np.int32)
+    cols = np.concatenate([np.asarray(dst), np.asarray(src)]).astype(np.int32)
+    n = 1 << scale
+    A = COOMatrix(jnp.asarray(rows), jnp.asarray(cols),
+                  jnp.ones((len(rows),), jnp.float32), (n, n))
+    x = jnp.asarray(np.random.RandomState(0).randn(n).astype(np.float32))
+    jax.block_until_ready(x)
+    fx = Fixture(res=res, reps=3 if not dry else 1)
+
+    # dense reference for correctness spot-check
+    import scipy.sparse as sp
+
+    ref = sp.coo_matrix(
+        (np.ones(len(rows), np.float32), (rows, cols)), shape=(n, n)) @ \
+        np.asarray(x)
+
+    out = {"nnz": int(len(rows)), "n": n, "rows_sweep": []}
+    deadline = time.monotonic() + BUDGET_S
+
+    def flush():
+        if not dry:
+            with open(OUT, "w") as f:
+                json.dump(out, f, indent=1)
+
+    cfgs = [
+        (512, 256, 2048, 512),     # round-2 default
+        (512, 256, 2048, 1024),
+        (512, 256, 2048, 2048),
+        (128, 256, 2048, 512),
+        (128, 256, 2048, 1024),
+        (128, 256, 2048, 2048),
+        (128, 64, 2048, 2048),
+        (256, 128, 2048, 2048),
+        (128, 128, 4096, 4096),
+    ]
+    if dry:
+        cfgs = cfgs[:3]
+
+    best = None
+    for C, R, E, eb in cfgs:
+        if time.monotonic() > deadline:
+            break
+        row = {"C": C, "R": R, "E": E, "eb": eb}
+        try:
+            t = tile_csr(A, C=C, R=R, E=E)
+            row["n_chunks"] = int(t.n_chunks)
+            row["m_chunks"] = int(t.m_chunks)
+            y = jax.block_until_ready(SP.spmv_tiled(t, x, eb=eb))
+            ok = np.allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-3)
+            row["correct"] = bool(ok)
+            r = fx.run(lambda xx, tt=t, e=eb: SP.spmv_tiled(tt, xx, eb=e), x)
+            row["ms"] = round(r["seconds"] * 1e3, 3)
+            if ok and (best is None or row["ms"] < best[0]):
+                best = (row["ms"], C, R, E, eb, t)
+        except Exception as e:  # noqa: BLE001 — record, keep sweeping
+            row["error"] = f"{type(e).__name__}: {e}"[:300]
+        out["rows_sweep"].append(row)
+        print(json.dumps(row), flush=True)
+        flush()
+
+    # --- stage breakdown at the default and the winner ---
+    def stages(tag, t, eb):
+        n_chunks, m_chunks = t.n_chunks, t.m_chunks
+        nb = t.E // eb
+        xt_pad = t.n_col_tiles * t.C - t.shape[1]
+        xp = jnp.concatenate([x, jnp.zeros((xt_pad,), jnp.float32)]) \
+            if xt_pad else x
+
+        import functools
+
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        @jax.jit
+        def gather_only(xv):
+            xt = xv.reshape(t.n_col_tiles, t.C, 1)
+            return pl.pallas_call(
+                functools.partial(SP._gather_kernel, C=t.C, eb=eb),
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=1,
+                    grid=(n_chunks, nb),
+                    in_specs=[
+                        pl.BlockSpec((1, 1, eb), lambda c, b, m: (c, 0, b),
+                                     memory_space=pltpu.VMEM),
+                        pl.BlockSpec((1, 1, eb), lambda c, b, m: (c, 0, b),
+                                     memory_space=pltpu.VMEM),
+                        pl.BlockSpec((1, t.C, 1),
+                                     lambda c, b, m: (m[c], 0, 0),
+                                     memory_space=pltpu.VMEM),
+                    ],
+                    out_specs=pl.BlockSpec((1, 1, eb),
+                                           lambda c, b, m: (c, 0, b),
+                                           memory_space=pltpu.VMEM),
+                ),
+                out_shape=jax.ShapeDtypeStruct((n_chunks, 1, t.E),
+                                               jnp.float32),
+                compiler_params=pltpu.CompilerParams(
+                    dimension_semantics=("parallel", "arbitrary")),
+                interpret=SP.interpret_mode(),
+            )(t.chunk_col_tile, t.vals[:, None, :],
+              t.col_local[:, None, :], xt)
+
+        contrib = jax.block_until_ready(gather_only(xp))
+
+        @jax.jit
+        def bridge_only(c):
+            c8 = jnp.concatenate(
+                [c.reshape(-1, 8), jnp.zeros((1, 8), jnp.float32)])
+            return jnp.take(c8, t.perm_rows, axis=0)
+
+        @jax.jit
+        def scatter_only(cs):
+            return pl.pallas_call(
+                functools.partial(SP._scatter_kernel, R=t.R, eb=eb),
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=1,
+                    grid=(m_chunks, nb),
+                    in_specs=[
+                        pl.BlockSpec((1, 1, eb), lambda c, b, m: (c, 0, b),
+                                     memory_space=pltpu.VMEM),
+                        pl.BlockSpec((1, 1, eb), lambda c, b, m: (c, 0, b),
+                                     memory_space=pltpu.VMEM),
+                    ],
+                    out_specs=pl.BlockSpec((1, t.R, 1),
+                                           lambda c, b, m: (m[c], 0, 0),
+                                           memory_space=pltpu.VMEM),
+                ),
+                out_shape=jax.ShapeDtypeStruct((t.n_row_tiles, t.R, 1),
+                                               jnp.float32),
+                compiler_params=pltpu.CompilerParams(
+                    dimension_semantics=("arbitrary", "arbitrary")),
+                interpret=SP.interpret_mode(),
+            )(t.chunk_row_tile, cs, t.row_local[:, None, :])
+
+        cs = jax.block_until_ready(
+            bridge_only(contrib).reshape(m_chunks, 1, t.E))
+        st = {}
+        for nm, fn, arg in (("gather", gather_only, xp),
+                            ("bridge", bridge_only, contrib),
+                            ("scatter", scatter_only, cs)):
+            try:
+                st[nm] = round(fx.run(fn, arg)["seconds"] * 1e3, 3)
+            except Exception as e:
+                st[nm] = f"{type(e).__name__}: {e}"[:200]
+            print(json.dumps({f"{tag}_{nm}": st[nm]}), flush=True)
+        out[f"stages_{tag}"] = st
+        flush()
+
+    t_def = tile_csr(A, C=512, R=256, E=2048)
+    stages("default", t_def, 512)
+    if best is not None and not dry:
+        _, C, R, E, eb, t_best = best
+        out["best"] = {"C": C, "R": R, "E": E, "eb": eb, "ms": best[0]}
+        stages("best", t_best, eb)
+
+    flush()
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

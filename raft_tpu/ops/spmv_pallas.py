@@ -49,32 +49,34 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.ops.utils import interpret_mode
 
-_EB = 512    # sub-block of the chunk folded per grid step (bounds VMEM)
+_EB = 512    # DEFAULT sub-block of the chunk folded per grid step; the
+             # kernels take the actual width as the ``eb`` parameter
+             # (grid steps = padded_nnz/eb, VMEM one-hot = [C|R, eb])
 
 
 def _gather_kernel(col_tile_ref, vals_ref, cols_ref, xt_ref, out_ref,
-                   *, C: int):
+                   *, C: int, eb: int):
     xt = xt_ref[0]                                     # [C, 1]
-    cols = cols_ref[0]                                 # [1, EB]
-    onehot = (jnp.broadcast_to(cols, (C, _EB))
-              == jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0))
+    cols = cols_ref[0]                                 # [1, eb]
+    onehot = (jnp.broadcast_to(cols, (C, eb))
+              == jax.lax.broadcasted_iota(jnp.int32, (C, eb), 0))
     contrib = jnp.sum(jnp.where(onehot, xt, 0.0), axis=0,
-                      keepdims=True)                   # [1, EB]
+                      keepdims=True)                   # [1, eb]
     out_ref[0] = vals_ref[0] * contrib
 
 
 def _scatter_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
-                    *, R: int):
+                    *, R: int, eb: int):
     c = pl.program_id(0)
     b = pl.program_id(1)
     cur = row_tile_ref[c]
     prev = row_tile_ref[jnp.maximum(c - 1, 0)]
     first = (((c == 0) | (cur != prev))) & (b == 0)
 
-    rloc = rloc_ref[0]                                 # [1, EB], pad = R
-    contrib = contrib_ref[0]                           # [1, EB]
-    onehot = (jnp.broadcast_to(rloc, (R, _EB))
-              == jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0))
+    rloc = rloc_ref[0]                                 # [1, eb], pad = R
+    contrib = contrib_ref[0]                           # [1, eb]
+    onehot = (jnp.broadcast_to(rloc, (R, eb))
+              == jax.lax.broadcasted_iota(jnp.int32, (R, eb), 0))
     acc = jnp.sum(jnp.where(onehot, contrib, 0.0), axis=1,
                   keepdims=True)                       # [R, 1]
 
@@ -88,30 +90,31 @@ def _scatter_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("C", "R", "E", "n_col_tiles",
-                                             "n_row_tiles"))
+                                             "n_row_tiles", "eb"))
 def _spmv_tiled_impl(vals, col_local, chunk_col_tile, perm, perm_rows,
                      row_local, chunk_row_tile, x_padded,
                      C: int, R: int, E: int,
-                     n_col_tiles: int, n_row_tiles: int) -> jax.Array:
+                     n_col_tiles: int, n_row_tiles: int,
+                     eb: int = _EB) -> jax.Array:
     n_chunks = vals.shape[0]
     m_chunks = row_local.shape[0]
-    nb = E // _EB
+    nb = E // eb
     xt = x_padded.reshape(n_col_tiles, C, 1)           # [n_tiles, C, 1]
 
     contrib = pl.pallas_call(
-        functools.partial(_gather_kernel, C=C),
+        functools.partial(_gather_kernel, C=C, eb=eb),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(n_chunks, nb),
             in_specs=[
-                pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
+                pl.BlockSpec((1, 1, eb), lambda c, b, m: (c, 0, b),
                              memory_space=pltpu.VMEM),   # vals
-                pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
+                pl.BlockSpec((1, 1, eb), lambda c, b, m: (c, 0, b),
                              memory_space=pltpu.VMEM),   # cols
                 pl.BlockSpec((1, C, 1), lambda c, b, m: (m[c], 0, 0),
                              memory_space=pltpu.VMEM),   # x tile
             ],
-            out_specs=pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
+            out_specs=pl.BlockSpec((1, 1, eb), lambda c, b, m: (c, 0, b),
                                    memory_space=pltpu.VMEM),
         ),
         out_shape=jax.ShapeDtypeStruct((n_chunks, 1, E), jnp.float32),
@@ -133,14 +136,14 @@ def _spmv_tiled_impl(vals, col_local, chunk_col_tile, perm, perm_rows,
             contrib.reshape(-1), perm.reshape(-1)).reshape(m_chunks, 1, E)
 
     y3d = pl.pallas_call(
-        functools.partial(_scatter_kernel, R=R),
+        functools.partial(_scatter_kernel, R=R, eb=eb),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(m_chunks, nb),
             in_specs=[
-                pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
+                pl.BlockSpec((1, 1, eb), lambda c, b, m: (c, 0, b),
                              memory_space=pltpu.VMEM),   # contrib
-                pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
+                pl.BlockSpec((1, 1, eb), lambda c, b, m: (c, 0, b),
                              memory_space=pltpu.VMEM),   # row_local
             ],
             out_specs=pl.BlockSpec((1, R, 1), lambda c, b, m: (m[c], 0, 0),
@@ -154,9 +157,14 @@ def _spmv_tiled_impl(vals, col_local, chunk_col_tile, perm, perm_rows,
     return y3d[:, :, 0]                                # [n_row_tiles, R]
 
 
-def spmv_tiled(tiled, x) -> jax.Array:
-    """y = A @ x for a :class:`raft_tpu.sparse.tiled.TiledELL` operand."""
+def spmv_tiled(tiled, x, eb: int = _EB) -> jax.Array:
+    """y = A @ x for a :class:`raft_tpu.sparse.tiled.TiledELL` operand.
+    ``eb`` is the per-grid-step sub-block of each chunk (must divide E);
+    larger eb = fewer grid steps (less per-step overhead) at more VMEM
+    per step — the one-hot intermediates are [C, eb] / [R, eb]."""
     n_rows, n_cols = tiled.shape
+    if tiled.E % eb:
+        raise ValueError(f"spmv_tiled: eb={eb} must divide E={tiled.E}")
     x = jnp.asarray(x, jnp.float32)
     pad = tiled.n_col_tiles * tiled.C - n_cols
     if pad:
@@ -165,7 +173,8 @@ def spmv_tiled(tiled, x) -> jax.Array:
         tiled.vals, tiled.col_local, tiled.chunk_col_tile, tiled.perm,
         tiled.perm_rows, tiled.row_local, tiled.chunk_row_tile, x,
         C=tiled.C, R=tiled.R, E=tiled.E,
-        n_col_tiles=tiled.n_col_tiles, n_row_tiles=tiled.n_row_tiles)
+        n_col_tiles=tiled.n_col_tiles, n_row_tiles=tiled.n_row_tiles,
+        eb=eb)
     # zero row tiles the grid never visited (rows with no nonzeros)
     y2d = jnp.where(tiled.visited_row_tiles[:, None], y2dt, 0.0)
     return y2d.reshape(-1)[:n_rows]
