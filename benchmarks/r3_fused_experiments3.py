@@ -74,7 +74,7 @@ def main():
         idx = prepare_knn_index(X, passes=passes)
         jax.block_until_ready(idx.yp)
         core_args = dict(k=k, T=idx.T, Qb=idx.Qb, g=idx.g, passes=passes,
-                        metric="l2", m=idx.n_rows)
+                        metric="l2", m=idx.n_rows, pbits=idx.pbits)
 
         def core_nofix(q, ix=idx, ca=core_args):
             return _knn_fused_core(q, ix.yp, ix.y_hi, ix.y_lo, ix.yyh_k,

@@ -61,9 +61,17 @@ def main():
     fx = Fixture(res=res, reps=1 if dry else 3)
     rng = np.random.default_rng(0)
 
-    grid = (itertools.product((4,), (4096,), (16,)) if dry
-            else itertools.product((16, 64, 256), (16384, 131072, 1048576),
-                                   (16, 64, 256)))
+    grid = (list(itertools.product((4,), (4096,), (16,))) if dry
+            else list(itertools.product((16, 64, 256),
+                                        (16384, 131072, 1048576),
+                                        (16, 64, 256)))
+            # large-k rows (ref: cpp/tests/matrix/select_large_k.cu —
+            # the regime the reference's radix select exists for)
+            + ([] if dry else [
+                (b, ln, kk)
+                for b in (16, 64, 256)
+                for ln in (131072, 1048576)
+                for kk in (1024, 2048) if kk * 8 <= ln]))
     results = []
     deadline = time.monotonic() + BUDGET_S
 
@@ -84,8 +92,9 @@ def main():
         jax.block_until_ready(v)
         row = {"batch": batch, "len": length, "k": k}
         for algo in (SelectAlgo.XLA_TOPK, SelectAlgo.SLOTTED,
-                     SelectAlgo.RADIX):
-            if algo is SelectAlgo.RADIX and length > RADIX_MAX_LEN:
+                     SelectAlgo.RADIX, SelectAlgo.CHUNKED):
+            if algo is SelectAlgo.RADIX and (length > RADIX_MAX_LEN
+                                             or k > 256):
                 continue
             try:
                 # an off-envelope explicit request warns and measures the

@@ -105,17 +105,34 @@ def _err_bound_coeff(d: int) -> float:
     return 2.0 ** -15 + d * 2.0 ** -21
 
 
-def decode_packed_pool(cand_p, pos, S_: int, T: int, g: int):
+def decode_packed_pool(cand_p, pos, S_: int, T: int, g: int,
+                       pbits: int = _PACK_BITS):
     """Candidate columns from (packed value, pool position) — THE
     decode for the packed kernel's mantissa codes, shared by the
     production pipeline and the profiler so they cannot drift. Returns
     -1 for sentinel/empty entries."""
     n_ch = T // _LANES
     slot = pos % S_
-    local = jax.lax.bitcast_convert_type(cand_p, jnp.int32) & _PACK_MASK
+    local = (jax.lax.bitcast_convert_type(cand_p, jnp.int32)
+             & ((1 << pbits) - 1))
     col = ((slot // _LANES) * g + local // n_ch) * T \
         + (local % n_ch) * _LANES + (slot % _LANES)
     return jnp.where(cand_p < _PACK_PAD * 0.25, col, -1)
+
+
+def auto_pack_bits(n_tiles: int, T: int) -> int:
+    """Pack-code width for an index of ``n_tiles`` tiles of length T:
+    the candidate pool (and the certificate's bucket count) is
+    M/2^pbits wide, so pick the widest codes that keep ≥ ~2.5k buckets
+    (fixup rate ∝ 1/buckets²), clamped to [8, 13] (value perturbation
+    2^(pbits−23) must stay well under the error margins). ONE
+    definition — prepare_knn_index and the north-star benchmark both
+    call it, so the measured configuration cannot drift from
+    production's."""
+    import math
+
+    return min(13, max(_PACK_BITS, int(math.floor(
+        math.log2(max(n_tiles * T / 2560.0, 256.0))))))
 
 
 def _pad_rows_to(y, mult: int):
@@ -124,8 +141,10 @@ def _pad_rows_to(y, mult: int):
     return _pad_rows(y, mult)[0]
 
 
-@functools.partial(jax.jit, static_argnames=("T", "g", "metric"))
-def _prepare_ops(y, T: int, g: int, metric: str):
+@functools.partial(jax.jit, static_argnames=("T", "g", "metric",
+                                             "pbits"))
+def _prepare_ops(y, T: int, g: int, metric: str,
+                 pbits: int = _PACK_BITS):
     """Index-side operand prep: row padding, bf16 hi/lo split, norms and
     the [8, M] half-norm sentinel carrier. ~3 ms at 1M×128 on v5e —
     hoisted out of the query path so a prepared index (KnnIndex) pays
@@ -135,7 +154,7 @@ def _prepare_ops(y, T: int, g: int, metric: str):
     M = yp.shape[0]
     yy_raw = jnp.sum(yp * yp, axis=1)[None, :]                  # [1,M] f32
     n_ch = T // _LANES
-    packed = g * n_ch <= (1 << _PACK_BITS)
+    packed = g * n_ch <= (1 << pbits)
     pad_sentinel = _PACK_PAD if packed else jnp.inf
     valid = (jnp.arange(M, dtype=jnp.int32) < m)[None, :]
     if metric == "ip":
@@ -152,11 +171,12 @@ def _prepare_ops(y, T: int, g: int, metric: str):
 
 @functools.partial(jax.jit,
                    static_argnames=("k", "T", "Qb", "g", "passes", "metric",
-                                    "m", "_diag"))
+                                    "m", "rescore", "pbits", "_diag"))
 def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                     k: int, T: int, Qb: int, g: int, passes: int,
-                    metric: str, m: int, _diag: bool = False
-                    ) -> Tuple[jax.Array, ...]:
+                    metric: str, m: int, rescore: bool = True,
+                    pbits: int = _PACK_BITS,
+                    _diag: bool = False) -> Tuple[jax.Array, ...]:
     """Certified fused KNN on PREPARED operands (see _prepare_ops).
 
     x [Q, d] f32 (Q % Qb == 0, d % 128 == 0 — caller pads), y [m, d] f32
@@ -183,9 +203,9 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
     ≤ |v|·2⁻¹⁵, absorbed into the certificate margin e_pack.
     """
     Q, d = x.shape
-    M = yp.shape[0]
+    M = y_hi.shape[0]
     n_ch = T // _LANES
-    packed = g * n_ch <= (1 << _PACK_BITS)
+    packed = g * n_ch <= (1 << pbits)
 
     xx = jnp.sum(x * x, axis=1, keepdims=True)                  # [Q,1] f32
     if metric == "ip":
@@ -196,17 +216,24 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
 
     if packed:
         if d > _D_SINGLE_SHOT:
-            kern, kw = fused_l2_group_topk_packed_dchunk, {"dc": _DC}
+            kern, kw = fused_l2_group_topk_packed_dchunk, {
+                "dc": _DC, "pbits": pbits}
         else:
             # streamed chunk contraction (MXU/VPU co-issue — measured
             # p1 10.9→4.4 ms, p3 15.6→9.8 ms at 2048×1M×128); the pair
             # pre-reduction pays only in p1 (p3 is matmul-floor-bound)
             # and T/128 must be even for it
             kern = fused_l2_group_topk_packed
-            kw = {"stream": True,
+            kw = {"stream": True, "pbits": pbits,
                   "pair": passes == 1 and (T // _LANES) % 2 == 0}
+        # the query half-norm rides INTO the kernel: packed values are
+        # then d2/2 (l2) — small, so pack perturbation is relative to
+        # the distances compared, not to the norm-dominated half-score
+        # (measured at clustered 10M×256: the norm-scaled error failed
+        # the certificate for ~80% of queries at pbits=11)
+        xxh = 0.5 * xx if metric != "ip" else jnp.zeros_like(xx)
         a1p, a2p, a3p = kern(x, y_hi, y_lo, yyh_k, m_real, T=T, Qb=Qb,
-                             passes=passes, tpg=g, **kw)
+                             passes=passes, tpg=g, xxh=xxh, **kw)
         S_ = a1p.shape[1]
         # TWIN-POOL selection (round-3 redesign): top_k over a1p ONLY —
         # the XLA TopK measured ~2.5× superlinear in pool width inside
@@ -222,6 +249,12 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         #   outside any bucket top-2  ≥ a3_min
         # Each term is ≥ the old whole-pool C-th value, so this bound
         # is ≥ the round-2 bound — fewer or equal fixups.
+        # Ca MUST oversample beyond k: bound_a1 is the Ca-th smallest
+        # bucket-min, and when the true top-k spread over k distinct
+        # buckets the k-th bucket-min IS θ — with Ca = k the margin
+        # check bound ≥ θ + err then fails for EVERY query (measured:
+        # n_fail 2048/2048 at 10M×256, a 14 s full-fallback). The +pad
+        # buys bound_a1 ≈ the (k+pad)-th neighbor value instead.
         Ca = min(k + _POOL_PAD, S_)
         # the envelope admits k up to 2·S_ (both twins of every bucket):
         # the pruned candidate count must cover k even when S_ < k+pad
@@ -236,15 +269,24 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         neg_top, sel = jax.lax.top_k(-cands, C)
         cand_p = -neg_top
         pos = jnp.take_along_axis(cpos, sel, axis=1)
-        cand_pid = decode_packed_pool(cand_p, pos, S_, T, g)
-        cand_v_hat = 2.0 * cand_p + xx_r
-        bound_a1 = 2.0 * a1_sel[:, Ca - 1] + xx_r[:, 0]
-        a3_min = jnp.minimum(2.0 * jnp.min(a3p, axis=1) + xx_r[:, 0],
-                             bound_a1)
-        # packing error margin: |Δhalf| ≤ |half|·2⁻¹⁵ and
-        # |half| ≤ (xx + 2·yymax)/2, doubled through the ·2 recovery,
-        # plus safety factor 2
-        e_pack = (xx[:, 0] + 2.0 * jnp.max(yy_raw)) * 2.0 ** -14
+        cand_pid = decode_packed_pool(cand_p, pos, S_, T, g, pbits)
+        cand_v_hat = 2.0 * cand_p                       # = d2 (xx folded)
+        bound_a1 = 2.0 * a1_sel[:, Ca - 1]
+        a3_half_min = jnp.min(a3p, axis=1)
+        a3_min = jnp.minimum(2.0 * a3_half_min, bound_a1)
+        # packing error margin, PER QUERY from the actual magnitudes in
+        # play: each compared value v = 2·half + xx carries
+        # |Δv| ≤ 2·|half|·2^(pbits−23); bound and θ each contribute one
+        # perturbed half, and the largest |half| among the used values
+        # (candidate heads/tails, the a3 minimum, the Ca-th a1) bounds
+        # both. ×2 for the two sides, ×2 safety. The round-2 formula
+        # used the GLOBAL worst case (xx + 2·yymax)/2 — at clustered
+        # 10M×256 scale that margin (~2× the true bound−θ gap) failed
+        # the certificate for every query (measured).
+        half_mag = jnp.maximum(
+            jnp.maximum(jnp.abs(cand_p[:, 0]), jnp.abs(cand_p[:, C - 1])),
+            jnp.maximum(jnp.abs(a3_half_min), jnp.abs(a1_sel[:, Ca - 1])))
+        e_pack = 8.0 * half_mag * 2.0 ** (pbits - 23)
     else:
         if d > _D_SINGLE_SHOT:
             a1, id1, a2, id2, a3 = fused_l2_group_topk_dchunk(
@@ -268,23 +310,49 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         a3_min = 2.0 * jnp.min(a3, axis=1) + xx_r[:, 0]
         e_pack = jnp.zeros((Q,), jnp.float32)
 
-    # exact f32 rescore of the C candidates (gather + HIGHEST
-    # contraction; safe_pid is clamped to real rows, so gathering from
-    # the row-padded yp returns identical data to the original matrix)
-    safe_pid = jnp.minimum(jnp.maximum(cand_pid, 0), m - 1)
-    yc = jnp.take(yp, safe_pid, axis=0)                         # [Q, C, d]
-    if metric == "ip":
-        d2c = -jnp.einsum("qd,qcd->qc", x, yc,
-                          precision=jax.lax.Precision.HIGHEST)
+    if rescore:
+        if yp is None:
+            raise ValueError("_knn_fused_core: rescore=True needs the "
+                             "stored f32 index (prepare with "
+                             "store_yp=True)")
+        # exact f32 rescore of the C candidates (gather + HIGHEST
+        # contraction; safe_pid is clamped to real rows, so gathering
+        # from the row-padded yp returns identical data to the original
+        # matrix)
+        safe_pid = jnp.minimum(jnp.maximum(cand_pid, 0), m - 1)
+        yc = jnp.take(yp, safe_pid, axis=0)                     # [Q, C, d]
+        if metric == "ip":
+            d2c = -jnp.einsum("qd,qcd->qc", x, yc,
+                              precision=jax.lax.Precision.HIGHEST)
+        else:
+            d2c = (xx + jnp.sum(yc * yc, axis=2)
+                   - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
+                                      precision=jax.lax.Precision.HIGHEST))
+            d2c = jnp.maximum(d2c, 0.0)
+        d2c = jnp.where(cand_pid >= 0, d2c, jnp.inf)
+        neg_k, ord_k = jax.lax.top_k(-d2c, k)
+        vals = -neg_k                                           # exact, asc
+        ids = jnp.take_along_axis(cand_pid, ord_k, axis=1)
     else:
-        d2c = (xx + jnp.sum(yc * yc, axis=2)
-               - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
-                                  precision=jax.lax.Precision.HIGHEST))
-        d2c = jnp.maximum(d2c, 0.0)
-    d2c = jnp.where(cand_pid >= 0, d2c, jnp.inf)
-    neg_k, ord_k = jax.lax.top_k(-d2c, k)
-    vals = -neg_k                                               # exact, asc
-    ids = jnp.take_along_axis(cand_pid, ord_k, axis=1)
+        # LITE mode: the returned top-k is the exact top-k of the
+        # KERNEL score function (bf16 for passes=1, bf16x3 for 3) —
+        # candidates are already sorted ascending by kernel order, so
+        # the head IS the result; values only need the embedded code
+        # bits cleared (≤ |v|·2⁻¹⁵ perturbation — already inside the
+        # e_pack certificate margin). No yp, no rescore gather: the
+        # mode that serves f32-index-larger-than-HBM scales (10M×256).
+        if packed:
+            clean = jax.lax.bitcast_convert_type(
+                jax.lax.bitcast_convert_type(cand_p, jnp.int32)
+                & ~((1 << pbits) - 1), jnp.float32)
+            cand_v_clean = 2.0 * clean                  # = d2 (xx folded)
+        else:
+            cand_v_clean = cand_v_hat
+        vals = cand_v_clean[:, :k]
+        if metric != "ip":
+            vals = jnp.maximum(vals, 0.0)
+        vals = jnp.where(cand_pid[:, :k] >= 0, vals, jnp.inf)
+        ids = cand_pid[:, :k]
 
     # ---- certificate ----
     theta = vals[:, k - 1]
@@ -300,9 +368,15 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
     failed = ~certified
     n_fail = jnp.sum(failed.astype(jnp.int32))
 
-    # ---- fixup: exact f32 sweep for failed queries ----
+    # ---- fixup: exact sweep for failed queries ----
     def exact_rows(xq):
-        """Exact top-k for a [F, d] query block (f32 HIGHEST).
+        """Exact top-k for a [F, d] query block.
+
+        rescore mode: f32 HIGHEST against the stored yp — exact w.r.t.
+        f32 scores. Lite mode (yp is None): the SAME bf16(x3)
+        contraction the kernel runs, against y_hi/y_lo — exact w.r.t.
+        the kernel score function, which is what lite results are
+        certified against.
 
         Small blocks materialize the whole [F, M] distance tile and take
         ONE top_k: MEASURED (v5e, 2048×1M×128) the old per-tile
@@ -313,17 +387,40 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         XLA top_k ≈ single-digit ms."""
         F = xq.shape[0]
         xs = jnp.sum(xq * xq, axis=1)
-        if F <= _FIXUP_TIERS[-1]:
-            s = jax.lax.dot_general(
-                xq, yp, (((1,), (1,)), ((), ())),
-                precision=jax.lax.Precision.HIGHEST,
-                preferred_element_type=jnp.float32)               # [F, M]
-            if metric == "ip":
-                d2 = -s
+        nt_dims = (((1,), (1,)), ((), ()))
+
+        def scores(yt_f32, yt_hi, yt_lo, yy_seg):
+            if yp is not None:
+                s = jax.lax.dot_general(
+                    xq, yt_f32, nt_dims,
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32)
             else:
-                d2 = jnp.maximum(
-                    xs[:, None] + jnp.sum(yp * yp, axis=1)[None, :]
-                    - 2.0 * s, 0.0)
+                xhi = xq.astype(jnp.bfloat16)
+                s = jax.lax.dot_general(
+                    xhi, yt_hi, nt_dims,
+                    preferred_element_type=jnp.float32)
+                if passes == 3:
+                    xlo = (xq - xhi.astype(jnp.float32)).astype(jnp.bfloat16)
+                    s = s + jax.lax.dot_general(
+                        xhi, yt_lo, nt_dims,
+                        preferred_element_type=jnp.float32)
+                    s = s + jax.lax.dot_general(
+                        xlo, yt_hi, nt_dims,
+                        preferred_element_type=jnp.float32)
+            if metric == "ip":
+                # lite operands are the hi/lo split of y/2 (the kernel
+                # feeds them to the same scorer) — recover -x·y with
+                # the ×2 the packed pipeline applies; the stored-yp
+                # path contracts the full-scale y
+                return -s if yp is not None else -2.0 * s
+            return jnp.maximum(
+                xs[:, None] + yy_seg[None, :] - 2.0 * s, 0.0)
+
+        if F <= _FIXUP_TIERS[-1]:
+            yy_all = (yy_raw[0] if yp is None
+                      else jnp.sum(yp * yp, axis=1))
+            d2 = scores(yp, y_hi, y_lo, yy_all)                 # [F, M]
             col = jnp.arange(M, dtype=jnp.int32)
             d2 = jnp.where(col[None, :] < m, d2, jnp.inf)
             nt, ni = jax.lax.top_k(-d2, k)
@@ -335,17 +432,17 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
 
         def body(j, carry):
             bv, bi = carry
-            yt = jax.lax.dynamic_slice_in_dim(yp, j * T, T, axis=0)
-            s = jax.lax.dot_general(
-                xq, yt, (((1,), (1,)), ((), ())),
-                precision=jax.lax.Precision.HIGHEST,
-                preferred_element_type=jnp.float32)
-            if metric == "ip":
-                d2 = -s
+            if yp is not None:
+                yt = jax.lax.dynamic_slice_in_dim(yp, j * T, T, axis=0)
+                yth = ytl = None
+                yy_seg = jnp.sum(yt * yt, axis=1)
             else:
-                d2 = jnp.maximum(
-                    xs[:, None] + jnp.sum(yt * yt, axis=1)[None, :] - 2.0 * s,
-                    0.0)
+                yt = None
+                yth = jax.lax.dynamic_slice_in_dim(y_hi, j * T, T, axis=0)
+                ytl = (jax.lax.dynamic_slice_in_dim(y_lo, j * T, T, axis=0)
+                       if passes == 3 else None)
+                yy_seg = jax.lax.dynamic_slice_in_dim(yy_raw[0], j * T, T)
+            d2 = scores(yt, yth, ytl, yy_seg)
             col = j * T + jnp.arange(T, dtype=jnp.int32)
             d2 = jnp.where(col[None, :] < m, d2, jnp.inf)
             av = jnp.concatenate([bv, d2], axis=1)
@@ -377,10 +474,11 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
 
     if _diag:
         # measurement-only: the certified pipeline WITHOUT the fixup
-        # cascade, plus the failure count — benchmarks/ use this to
-        # attribute time between the always-on stages and the cond'd
-        # fixup; NOT a valid exactness contract
-        return vals, ids, n_fail
+        # cascade, plus the failure count and the certificate internals
+        # (bound, θ, err) — benchmarks/ use this to attribute time and
+        # to see WHY queries fail instead of guessing; NOT a valid
+        # exactness contract
+        return vals, ids, n_fail, bound, theta, err
 
     # tiered cascade: n_fail==0 → no-op; else the smallest tier that
     # covers n_fail; else the full fallback
@@ -503,7 +601,7 @@ class KnnIndex:
 
     def __init__(self, yp, y_hi, y_lo, yyh_k, yy_raw, n_rows: int,
                  T: int, Qb: int, g: int, passes: int, metric: str,
-                 d_orig: int):
+                 d_orig: int, pbits: int = _PACK_BITS):
         # yp is the ROW-PADDED index; the original matrix is yp[:n_rows]
         # (NOT stored separately — at 1M×128 that would pin a redundant
         # ~512 MB f32 copy in HBM for the index lifetime)
@@ -514,12 +612,22 @@ class KnnIndex:
         self.T, self.Qb, self.g = T, Qb, g
         self.passes, self.metric = passes, metric
         self.d_orig = d_orig
+        self.pbits = pbits
 
 
 def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
                       T: Optional[int] = None, Qb: Optional[int] = None,
-                      g: Optional[int] = None) -> KnnIndex:
-    """Build a :class:`KnnIndex` for repeated queries against ``y``."""
+                      g: Optional[int] = None,
+                      store_yp: bool = True) -> KnnIndex:
+    """Build a :class:`KnnIndex` for repeated queries against ``y``.
+
+    ``store_yp=False`` builds a LITE index: the f32 row-padded matrix
+    (and, for passes=1, the unused bf16 lo split) is dropped, ~3×
+    smaller HBM residency — the only index kind that fits f32-larger-
+    than-HBM scales (10M×256 ≈ 10 GB f32 vs ~5.5 GB lite). Queries
+    against a lite index run ``rescore=False``: results are the exact
+    top-k of the KERNEL score function (bf16 / bf16x3), values within
+    2⁻¹⁵ relative of those scores."""
     if metric not in ("l2", "ip"):
         raise ValueError(f"prepare_knn_index: metric must be 'l2' or "
                          f"'ip', got {metric!r}")
@@ -528,19 +636,36 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
     dT, dQb, dg = fused_defaults(passes)
     T = dT if T is None else T
     Qb = dQb if Qb is None else Qb
-    g = dg if g is None else g
-    T, Qb = fit_config(T, Qb, d, passes, g)
+    T, Qb = fit_config(T, Qb, d, passes, g or dg)
+    n_tiles_est = max(1, -(-m // T))
+    if g is None:
+        g = max(dg, (1 << auto_pack_bits(n_tiles_est, T))
+                // (T // _LANES))
+    # codes beyond 13 bits would perturb values past the margins the
+    # certificate budgets for — such a g simply routes to the UNPACKED
+    # kernel (g·n_ch > 2^pbits ⇒ packed=False, +inf sentinels), the
+    # same fallback the core and _prepare_ops agree on
+    import math
+
+    pbits = min(13, max(_PACK_BITS, int(math.ceil(math.log2(
+        max(g * (T // _LANES), 2))))))
     dpad = (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
     if dpad:
         y = jnp.concatenate([y, jnp.zeros((m, dpad), jnp.float32)], axis=1)
-    yp, y_hi, y_lo, yyh_k, yy_raw = _prepare_ops(y, T, g, metric)
+    yp, y_hi, y_lo, yyh_k, yy_raw = _prepare_ops(y, T, g, metric,
+                                                 pbits=pbits)
+    if not store_yp:
+        yp = None
+        if passes == 1:
+            y_lo = None    # the 1-pass kernel and lite fixup never read it
     return KnnIndex(yp, y_hi, y_lo, yyh_k, yy_raw, m, T, Qb, g, passes,
-                    metric, d)
+                    metric, d, pbits=pbits)
 
 
 def knn_fused(x, y, k: int, passes: int = 3,
               T: Optional[int] = None, Qb: Optional[int] = None,
-              g: Optional[int] = None, metric: str = "l2"
+              g: Optional[int] = None, metric: str = "l2",
+              rescore: Optional[bool] = None
               ) -> Tuple[jax.Array, jax.Array]:
     """Certified fused brute-force KNN.
 
@@ -548,6 +673,12 @@ def knn_fused(x, y, k: int, passes: int = 3,
     call) or a :class:`KnnIndex` (prepared once — preferred for repeated
     query batches; its frozen T/Qb/g/passes/metric override the
     corresponding arguments).
+
+    ``rescore`` — None (default) rescores exactly in f32 when the index
+    stores yp (regular indexes) and falls back to lite results on a
+    ``store_yp=False`` index; True forces rescoring (error on a lite
+    index); False forces lite results (exact top-k of the kernel score
+    function, values within 2⁻¹⁵ of those scores).
 
     ``metric="l2"`` (default): (d2 [Q, k] f32 exact ascending, ids).
     ``metric="ip"``: (scores = x·y [Q, k] f32 exact DESCENDING, ids) —
@@ -606,7 +737,7 @@ def knn_fused(x, y, k: int, passes: int = 3,
         if idx is None:
             idx = prepare_knn_index(y, passes=passes, metric=metric,
                                     T=T, Qb=Qb, g=g)
-        outs = [knn_fused(x[s:s + _Q_CHUNK], idx, k)
+        outs = [knn_fused(x[s:s + _Q_CHUNK], idx, k, rescore=rescore)
                 for s in range(0, Q, _Q_CHUNK)]
         return (jnp.concatenate([o[0] for o in outs]),
                 jnp.concatenate([o[1] for o in outs]))
@@ -615,7 +746,7 @@ def knn_fused(x, y, k: int, passes: int = 3,
     if idx is None:
         idx = prepare_knn_index(y, passes=passes, metric=metric,
                                 T=T, Qb=Qb, g=g)
-    dpad = idx.yp.shape[1] - d
+    dpad = idx.y_hi.shape[1] - d
     if dpad:
         x = jnp.concatenate(
             [x, jnp.zeros((Q, dpad), jnp.float32)], axis=1)
@@ -623,9 +754,12 @@ def knn_fused(x, y, k: int, passes: int = 3,
     qpad = (-Q) % Qb
     if qpad:
         x = jnp.concatenate([x, jnp.zeros((qpad, x.shape[1]), x.dtype)])
+    if rescore is None:
+        rescore = idx.yp is not None
     vals, ids = _knn_fused_core(
         x, idx.yp, idx.y_hi, idx.y_lo, idx.yyh_k, idx.yy_raw,
-        k=k, T=T, Qb=Qb, g=g, passes=passes, metric=metric, m=m)
+        k=k, T=T, Qb=Qb, g=g, passes=passes, metric=metric, m=m,
+        rescore=rescore, pbits=idx.pbits)
     if metric == "ip":
         return -vals[:Q], ids[:Q]   # internal −x·y ascending → IP desc
     return vals[:Q], ids[:Q]

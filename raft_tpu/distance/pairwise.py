@@ -18,6 +18,8 @@ from __future__ import annotations
 
 from typing import Optional, Union
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -108,7 +110,33 @@ def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclid
     return _pairwise_dispatch(res, x, y, t, p)
 
 
+_UNEXPANDED_TYPES = frozenset({
+    DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded,
+    DistanceType.L1, DistanceType.Linf, DistanceType.LpUnexpanded,
+    DistanceType.Canberra, DistanceType.HammingUnexpanded,
+    DistanceType.BrayCurtis, DistanceType.KLDivergence,
+    DistanceType.JensenShannon,
+})
+
+
 def _pairwise_dispatch(res, x, y, t: DistanceType, p: float) -> jax.Array:
+    if t not in _UNEXPANDED_TYPES:
+        # ONE jitted program for the expanded metrics: eagerly, the
+        # 5-6 ops each cost a per-op transport dispatch (~2 ms on the
+        # tunneled TPU — config 1's entire 11 ms "compute" was
+        # dispatch overhead, ref contractions.cuh:1's single-launch
+        # small-shape path)
+        return _pairwise_expanded_jit(x, y, t, p)
+    # unexpanded (broadcast-form) metrics: every one of them accumulates
+    # elementwise over features, so the [tile, m, d] broadcast is folded
+    # over FEATURE CHUNKS with a [tile, m]-shaped carry — the d-axis
+    # analog of the reference's k-blocked smem policy
+    # (linalg/detail/contractions.cuh:313). Peak temp = [tile, m, dc].
+    return _unexpanded(res, x, y, t, p)
+
+
+@functools.partial(jax.jit, static_argnames=("t", "p"))
+def _pairwise_expanded_jit(x, y, t: DistanceType, p: float) -> jax.Array:
 
     if t == DistanceType.L2Expanded:
         return _expanded_l2(x, y, sqrt=False)
@@ -139,13 +167,7 @@ def _pairwise_dispatch(res, x, y, t: DistanceType, p: float) -> jax.Array:
             union = jnp.maximum(nx + ny - inter, 1e-30)
             return 1.0 - inter / union
         return 1.0 - 2.0 * inter / jnp.maximum(nx + ny, 1e-30)
-
-    # unexpanded (broadcast-form) metrics: every one of them accumulates
-    # elementwise over features, so the [tile, m, d] broadcast is folded
-    # over FEATURE CHUNKS with a [tile, m]-shaped carry — the d-axis
-    # analog of the reference's k-blocked smem policy
-    # (linalg/detail/contractions.cuh:313). Peak temp = [tile, m, dc].
-    return _unexpanded(res, x, y, t, p)
+    raise ValueError(f"_pairwise_expanded_jit: unexpanded metric {t}")
 
 
 _FEATURE_CHUNK = 32

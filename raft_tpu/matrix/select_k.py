@@ -55,7 +55,7 @@ def _load_select_k_table():
         cells = []
         for row in data.get("rows", []):
             timings = {name: row[name] for name in
-                       ("XLA_TOPK", "SLOTTED", "RADIX")
+                       ("XLA_TOPK", "SLOTTED", "RADIX", "CHUNKED")
                        if isinstance(row.get(name), (int, float))
                        and not isinstance(row.get(name), bool)
                        # 0.0 is a measurement artifact (sub-RTT clamp in
@@ -166,6 +166,20 @@ def select_k(
 
                 warnings.warn(
                     f"select_k: explicit algo=SLOTTED outside its "
+                    f"envelope ({e}); falling back to XLA top-k",
+                    RuntimeWarning, stacklevel=2)
+
+    if algo == SelectAlgo.CHUNKED:
+        from raft_tpu.matrix.select_k_chunked import select_k_chunked
+
+        try:
+            return select_k_chunked(in_val, in_idx, k, select_min)
+        except NotImplementedError as e:
+            if explicit:
+                import warnings
+
+                warnings.warn(
+                    f"select_k: explicit algo=CHUNKED outside its "
                     f"envelope ({e}); falling back to XLA top-k",
                     RuntimeWarning, stacklevel=2)
 

@@ -14,6 +14,10 @@ memory histograms. The variants that exist here:
                       certificate + per-row exact fallback — the
                       bandwidth-bound role of the reference's radix
                       filtering, without sort or histogram
+- ``CHUNKED``       — exact per-chunk top-k + narrow merge
+                      (select_k_chunked.py): the large-k regime where
+                      one wide XLA TopK goes superlinear — the ROLE of
+                      the reference's radix select at large k
 - ``RADIX``         — the Pallas kernel: multi-pass digit-histogram
                       filtering in VMEM (ops/select_k_pallas)
 - ``BITONIC``       — ALIAS of RADIX. The warpsort-family names map here
@@ -40,6 +44,7 @@ class SelectAlgo(enum.Enum):
     AUTO = "auto"
     XLA_TOPK = "xla_topk"
     SLOTTED = "slotted"
+    CHUNKED = "chunked"
     BITONIC = "bitonic"
     RADIX = "radix"
     APPROX = "approx"

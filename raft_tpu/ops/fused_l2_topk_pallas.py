@@ -45,6 +45,14 @@ from raft_tpu.ops.utils import interpret_mode
 
 _LANES = 128
 
+_PACK_BITS = 8                   # default code width; kernels take the
+                                 # actual width as the ``pbits`` static
+                                 # (more codes = wider groups = narrower
+                                 # pool, at 2^(pbits-23) value error)
+_PACK_MASK = (1 << _PACK_BITS) - 1
+_PACK_PAD = float(2.0 ** 125)    # finite "never wins" sentinel
+
+
 # Mosaic's scoped-VMEM stack limit on current TPU generations (the
 # compiler rejects kernels whose live VMEM exceeds it); budget leaves
 # headroom for temporaries the estimator can't see.
@@ -245,11 +253,11 @@ def _check_tiling(T: int, Qb: int):
         raise ValueError(f"Qb={Qb} must be a multiple of 8")
 
 
-def _check_pack_envelope(T: int, tpg: int):
-    if tpg * (T // _LANES) > (1 << _PACK_BITS):
+def _check_pack_envelope(T: int, tpg: int, pbits: int = _PACK_BITS):
+    if tpg * (T // _LANES) > (1 << pbits):
         raise ValueError(
             f"packed group kernel: tpg*T/128 = {tpg * T // _LANES} "
-            f"exceeds the {1 << _PACK_BITS}-code packing envelope")
+            f"exceeds the {1 << pbits}-code packing envelope")
 
 
 def _check_pair_envelope(n_chunks: int):
@@ -503,9 +511,6 @@ def _group_fold_and_write(s, j, yyh_ref, a1_ref, id1_ref, a2_ref,
 # columns use the finite _PACK_PAD sentinel (+inf would become NaN
 # when id bits are OR'd into its mantissa).
 
-_PACK_BITS = 8
-_PACK_MASK = (1 << _PACK_BITS) - 1
-_PACK_PAD = float(2.0 ** 125)    # finite "never wins" sentinel
 
 
 def _merge_chunk_top2_packed(cp, a1, a2, a3):
@@ -526,7 +531,8 @@ def _merge_chunk_top2_packed(cp, a1, a2, a3):
 
 def _group_fold_and_write_packed(s, j, yyh_ref, a1_ref, a2_ref, a3_ref,
                                  *, T: int, Qb: int, tpg: int,
-                                 pair: bool = False):
+                                 pair: bool = False,
+                                 pbits: int = _PACK_BITS, xxh_ref=None):
     """Packed variant of _group_fold_and_write: same VMEM discipline
     (per-chunk half-scores, 3-D carriers, no masking — callers pass
     yy/2 = _PACK_PAD on padded columns), but the merge runs on packed
@@ -554,15 +560,21 @@ def _group_fold_and_write_packed(s, j, yyh_ref, a1_ref, a2_ref, a3_ref,
     a2 = a2_ref[...].reshape(q8, 8, _LANES)
     a3 = a3_ref[...].reshape(q8, 8, _LANES)
     yyh = yyh_ref[...]                                   # [8, T]
+    xxh = (None if xxh_ref is None
+           else xxh_ref[...].reshape(q8, 8, 1))          # [Qb, 1] → 3-D
 
     def half_score(r):
         sl = slice(r * _LANES, (r + 1) * _LANES)
-        return yyh[:, sl] - s[:, sl].reshape(q8, 8, _LANES)
+        c = yyh[:, sl] - s[:, sl].reshape(q8, 8, _LANES)
+        # with the query half-norm folded in, c = d2/2 — SMALL, so the
+        # pack perturbation is relative to the distances being
+        # compared, not to the (often 10×) norm-dominated half-score
+        return c if xxh is None else c + xxh
 
     def pack(c, code):
         return jax.lax.bitcast_convert_type(
-            (jax.lax.bitcast_convert_type(c, jnp.int32) & ~_PACK_MASK)
-            | code, jnp.float32)
+            (jax.lax.bitcast_convert_type(c, jnp.int32)
+             & ~((1 << pbits) - 1)) | code, jnp.float32)
 
     if pair:
         _check_pair_envelope(n_chunks)
@@ -586,18 +598,22 @@ def _group_fold_and_write_packed(s, j, yyh_ref, a1_ref, a2_ref, a3_ref,
 def _group_kernel_packed(m_real_ref, x_ref, yhi_ref, yyh_ref,
                          a1_ref, a2_ref, a3_ref,
                          *, T: int, Qb: int, tpg: int, pair: bool = False,
-                         ylo_ref=None):
+                         pbits: int = _PACK_BITS, ylo_ref=None,
+                         xxh_ref=None):
     j = pl.program_id(1)
     s = _contract(x_ref[...], yhi_ref[...],
                   None if ylo_ref is None else ylo_ref[...])
     _group_fold_and_write_packed(s, j, yyh_ref, a1_ref, a2_ref, a3_ref,
-                                 T=T, Qb=Qb, tpg=tpg, pair=pair)
+                                 T=T, Qb=Qb, tpg=tpg, pair=pair,
+                                 pbits=pbits, xxh_ref=xxh_ref)
 
 
 def _group_kernel_packed_stream(m_real_ref, x_ref, yhi_ref, yyh_ref,
                                 a1_ref, a2_ref, a3_ref,
                                 *, T: int, Qb: int, tpg: int,
-                                pair: bool = False, ylo_ref=None):
+                                pair: bool = False,
+                                pbits: int = _PACK_BITS, ylo_ref=None,
+                                xxh_ref=None):
     """Streamed variant: the [Qb, T] contraction is split into T/LANES
     [Qb, LANES] chunk contractions interleaved with the fold of the
     PREVIOUS chunk. The big-matmul kernel serializes MXU (contract) then
@@ -624,16 +640,20 @@ def _group_kernel_packed_stream(m_real_ref, x_ref, yhi_ref, yyh_ref,
     yhi = yhi_ref[...]
     ylo = None if ylo_ref is None else ylo_ref[...]
     yyh = yyh_ref[...]                                   # [8, T]
+    xxh = (None if xxh_ref is None
+           else xxh_ref[...].reshape(q8, 8, 1))          # [Qb, 1] → 3-D
 
     def chunk_score(r):
         sl = slice(r * _LANES, (r + 1) * _LANES)
         s_r = _contract(x, yhi[sl, :], None if ylo is None else ylo[sl, :])
-        return yyh[:, sl] - s_r.reshape(q8, 8, _LANES)
+        c = yyh[:, sl] - s_r.reshape(q8, 8, _LANES)
+        # c + xx/2 = d2/2 (see _group_fold_and_write_packed)
+        return c if xxh is None else c + xxh
 
     def pack(c, code):
         return jax.lax.bitcast_convert_type(
-            (jax.lax.bitcast_convert_type(c, jnp.int32) & ~_PACK_MASK)
-            | code, jnp.float32)
+            (jax.lax.bitcast_convert_type(c, jnp.int32)
+             & ~((1 << pbits) - 1)) | code, jnp.float32)
 
     if pair:
         _check_pair_envelope(n_chunks)
@@ -656,7 +676,9 @@ def _group_kernel_packed_stream(m_real_ref, x_ref, yhi_ref, yyh_ref,
 def _group_kernel_packed_dchunk(m_real_ref, x_ref, yhi_ref, yyh_ref,
                                 a1_ref, a2_ref, a3_ref, acc_ref,
                                 *, T: int, Qb: int, tpg: int,
-                                pair: bool = False, ylo_ref=None):
+                                pair: bool = False,
+                                pbits: int = _PACK_BITS, ylo_ref=None,
+                                xxh_ref=None):
     j = pl.program_id(1)
     l = pl.program_id(2)
     n_dc = pl.num_programs(2)
@@ -675,7 +697,8 @@ def _group_kernel_packed_dchunk(m_real_ref, x_ref, yhi_ref, yyh_ref,
     def _():
         _group_fold_and_write_packed(acc_ref[...], j, yyh_ref, a1_ref,
                                      a2_ref, a3_ref, T=T, Qb=Qb, tpg=tpg,
-                                     pair=pair)
+                                     pair=pair, pbits=pbits,
+                                     xxh_ref=xxh_ref)
 
 
 def _group_kernel(m_real_ref, x_ref, yhi_ref, yyh_ref,
@@ -718,16 +741,21 @@ def _group_kernel_dchunk(m_real_ref, x_ref, yhi_ref, yyh_ref,
                               a2_ref, id2_ref, a3_ref, T=T, Qb=Qb, tpg=tpg)
 
 
-def _make_group_kernel(base, passes: int, T: int, Qb: int, **fold_kw):
-    """Bind the group-kernel base for the passes mode (group kernels
-    take no xx operand; for passes == 3 reorder the y_lo ref out of the
-    positional stream, as _make_kernel does for the slot kernels)."""
-    if passes != 3:
-        return functools.partial(base, T=T, Qb=Qb, ylo_ref=None, **fold_kw)
+def _make_group_kernel(base, passes: int, T: int, Qb: int,
+                       has_xxh: bool = False, **fold_kw):
+    """Bind the group-kernel base for the passes mode, pulling the
+    optional y_lo (passes == 3) and xxh (packed kernels with the query
+    half-norm folded in) refs out of the positional operand stream."""
 
-    def kernel(m_real_ref, x_ref, yhi_ref, ylo_ref, yyh_ref, *rest):
+    def kernel(m_real_ref, x_ref, yhi_ref, *rest0):
+        rest = list(rest0)
+        ylo_ref = rest.pop(0) if passes == 3 else None
+        yyh_ref = rest.pop(0)
+        kw = dict(fold_kw)
+        if has_xxh:
+            kw["xxh_ref"] = rest.pop(0)
         base(m_real_ref, x_ref, yhi_ref, yyh_ref, *rest,
-             T=T, Qb=Qb, ylo_ref=ylo_ref, **fold_kw)
+             T=T, Qb=Qb, ylo_ref=ylo_ref, **kw)
 
     return kernel
 
@@ -755,7 +783,7 @@ def _packed_out_shape(Q: int, Sg: int):
 def _group_pallas_call(kernel_base, packed: bool,
                        x, y_hi, y_lo, yy_half, m_real,
                        *, T: int, Qb: int, passes: int, tpg: int,
-                       dc=None, **fold_kw):
+                       dc=None, xxh=None, **fold_kw):
     """Shared scaffolding for the four group-fold entry points
     ((un)packed × (single-shot | d-chunked)) — specs, operands, grid and
     pallas_call in ONE place so the variants cannot drift."""
@@ -796,8 +824,12 @@ def _group_pallas_call(kernel_base, packed: bool,
     if passes == 3:
         in_specs.insert(2, y_spec)                      # y_lo
         operands.insert(2, y_lo)
+    if xxh is not None:
+        in_specs.append(pl.BlockSpec((Qb, 1), lambda i, j, *_: (i, 0),
+                                     memory_space=pltpu.VMEM))
+        operands.append(xxh)
     kernel = _make_group_kernel(kernel_base, passes, T, Qb, tpg=tpg,
-                                **fold_kw)
+                                has_xxh=xxh is not None, **fold_kw)
 
     n_out = 3 if packed else 5
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -861,11 +893,12 @@ def fused_l2_group_topk_dchunk(x, y_hi, y_lo, yy_half, m_real,
 
 @functools.partial(jax.jit,
                    static_argnames=("T", "Qb", "passes", "tpg", "pair",
-                                    "stream"))
+                                    "stream", "pbits"))
 def fused_l2_group_topk_packed(x, y_hi, y_lo, yy_half, m_real,
                                T: int, Qb: int, passes: int,
                                tpg: int = 16, pair: bool = False,
-                               stream: bool = False):
+                               stream: bool = False,
+                               pbits: int = _PACK_BITS, xxh=None):
     """Packed-id variant of :func:`fused_l2_group_topk` (see the PACKED
     block comment): returns ``(a1p, a2p, a3p)``, each ``[Q, G·LANES]``
     f32 whose low _PACK_BITS mantissa bits hold the candidate's
@@ -876,26 +909,28 @@ def fused_l2_group_topk_packed(x, y_hi, y_lo, yy_half, m_real,
     pairwise pre-reduction (see _group_fold_and_write_packed);
     ``stream`` the chunked MXU/VPU-overlap contraction (see
     _group_kernel_packed_stream)."""
-    _check_pack_envelope(T, tpg)
+    _check_pack_envelope(T, tpg, pbits)
     base = _group_kernel_packed_stream if stream else _group_kernel_packed
     return _group_pallas_call(base, True, x, y_hi, y_lo,
                               yy_half, m_real, T=T, Qb=Qb, passes=passes,
-                              tpg=tpg, pair=pair)
+                              tpg=tpg, pair=pair, pbits=pbits, xxh=xxh)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("T", "Qb", "passes", "tpg", "dc",
-                                    "pair"))
+                                    "pair", "pbits"))
 def fused_l2_group_topk_packed_dchunk(x, y_hi, y_lo, yy_half, m_real,
                                       T: int, Qb: int, passes: int,
                                       tpg: int = 16, dc: int = 256,
-                                      pair: bool = False):
+                                      pair: bool = False,
+                                      pbits: int = _PACK_BITS, xxh=None):
     """d-chunked packed variant (wide features): same contract as
     :func:`fused_l2_group_topk_packed`."""
-    _check_pack_envelope(T, tpg)
+    _check_pack_envelope(T, tpg, pbits)
     return _group_pallas_call(_group_kernel_packed_dchunk, True, x, y_hi,
                               y_lo, yy_half, m_real, T=T, Qb=Qb,
-                              passes=passes, tpg=tpg, dc=dc, pair=pair)
+                              passes=passes, tpg=tpg, dc=dc, pair=pair,
+                              pbits=pbits, xxh=xxh)
 
 
 def split_hi_lo(y: jax.Array) -> Tuple[jax.Array, jax.Array]:

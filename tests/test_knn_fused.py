@@ -455,8 +455,9 @@ def test_packed_envelope_fallback():
 
     x = rng.normal(size=(16, 16)).astype(np.float32)
     y = rng.normal(size=(9000, 16)).astype(np.float32)
-    # T=512 -> 4 chunks; g=128 -> 512 codes > 256 -> unpacked path
-    vals, ids = kf.knn_fused(x, y, k=8, passes=3, T=512, Qb=16, g=128)
+    # T=512 -> 4 chunks; g=4096 -> 16384 codes > 2^13 (the auto-pbits
+    # clamp) -> unpacked path (g=128's 512 codes now just widen pbits)
+    vals, ids = kf.knn_fused(x, y, k=8, passes=3, T=512, Qb=16, g=4096)
     ref_vals, ref_ids, tol = _oracle(x, y, 8)
     np.testing.assert_allclose(np.asarray(vals), ref_vals, atol=tol)
     assert np.array_equal(np.sort(np.asarray(ids), 1), np.sort(ref_ids, 1))
@@ -511,3 +512,40 @@ def test_empty_query_batch():
     y = rng.normal(size=(2048, 16)).astype(np.float32)
     vals, ids = knn_fused(np.zeros((0, 16), np.float32), y, k=4)
     assert vals.shape == (0, 4) and ids.shape == (0, 4)
+
+
+def test_lite_index_no_rescore():
+    # store_yp=False drops the f32 matrix (and the lo split for p1);
+    # rescore=False results are the exact top-k of the kernel score
+    # function — validated against a high-recall f64 oracle and the
+    # documented 2^-15 value-perturbation contract
+    from raft_tpu.distance.knn_fused import prepare_knn_index
+
+    Q, m, d, k = 64, 8192, 64, 16
+    x = rng.normal(size=(Q, d)).astype(np.float32)
+    y = rng.normal(size=(m, d)).astype(np.float32)
+    for passes, min_recall in ((1, 0.97), (3, 0.99)):
+        idx = prepare_knn_index(y, passes=passes, store_yp=False,
+                                T=512, Qb=64, g=8)
+        assert idx.yp is None
+        if passes == 1:
+            assert idx.y_lo is None
+        vals, ids = knn_fused(x, idx, k)
+        ref_vals, ref_ids, _ = _oracle(x, y, k)
+        recall = np.mean([len(set(np.asarray(ids)[i]) & set(ref_ids[i])) / k
+                          for i in range(Q)])
+        assert recall >= min_recall, (passes, recall)
+        # values sit within the kernel-score envelope of the f64 truth:
+        # bf16 contraction error (p1) resp. bf16x3 + pack error (p3)
+        xf, yf = x.astype(np.float64), y.astype(np.float64)
+        d2_full = np.maximum(
+            (xf ** 2).sum(1)[:, None] + (yf ** 2).sum(1)[None, :]
+            - 2.0 * xf @ yf.T, 0.0)
+        truth = np.take_along_axis(d2_full, np.asarray(ids), axis=1)
+        scale = float(np.max(ref_vals)) + 1.0
+        tol = scale * (2.0 ** -6 if passes == 1 else 2.0 ** -12)
+        assert np.max(np.abs(np.asarray(vals) - truth)) <= tol
+    # explicit rescore=True on a lite index must refuse
+    idx1 = prepare_knn_index(y, passes=1, store_yp=False, T=512, Qb=64, g=8)
+    with pytest.raises(ValueError):
+        knn_fused(x, idx1, k, rescore=True)
