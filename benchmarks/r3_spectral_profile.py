@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Config-4 spectral embedding breakdown (VERDICT r2 item 3b).
+
+Where do the ~6 s go? Attributes the 1M-edge spectral embedding
+end-to-end time across:
+
+  laplacian     normalized Laplacian build (device)
+  tile_csr      host layout conversion
+  spmv_once     one tiled SpMV at the new eb default
+  cycle_once    one jitted thick-restart Lanczos cycle (ncv matvecs +
+                reorth + small eigh)
+  n_cycles      restart cycles until convergence (counted by running
+                the host loop with instrumentation)
+  e2e           SpectralEmbedding.fit_transform, jit_loop=True
+
+Writes R3_SPECTRAL_PROFILE.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._common import gate  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "R3_SPECTRAL_PROFILE.json")
+
+
+def main():
+    dry, skip = gate()
+    if skip:
+        print(json.dumps({"skipped": True, "reason": skip}))
+        return
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import raft_tpu
+    from raft_tpu.benchmark import Fixture
+    from raft_tpu.core.sparse_types import COOMatrix
+    from raft_tpu.random import RngState
+    from raft_tpu.random.rmat import rmat_rectangular_gen
+    from raft_tpu.sparse.linalg import laplacian_normalized, prepare_spmv
+    from raft_tpu.sparse.solver import lanczos as lz
+    from raft_tpu.sparse.solver.lanczos_types import (
+        LANCZOS_WHICH, LanczosSolverConfig)
+
+    res = raft_tpu.device_resources()
+    scale, n_edges = (17, 1_000_000) if not dry else (10, 10_000)
+    src, dst = rmat_rectangular_gen(res, RngState(3), n_edges, scale, scale)
+    rows = jnp.concatenate([src, dst]).astype(jnp.int32)
+    cols = jnp.concatenate([dst, src]).astype(jnp.int32)
+    n = 1 << scale
+    adj = COOMatrix(rows, cols, jnp.ones_like(rows, jnp.float32), (n, n))
+    jax.block_until_ready(rows)
+    fx = Fixture(res=res, reps=3)
+    out = {"n": n, "nnz": int(2 * n_edges), "stages": {}}
+
+    def record(name, val):
+        out["stages"][name] = val
+        print(json.dumps({name: val}), flush=True)
+        if not dry:
+            with open(OUT, "w") as f:
+                json.dump(out, f, indent=1)
+
+    r = fx.run(lambda a: laplacian_normalized(res, a)[0].values, adj)
+    record("laplacian_ms", round(r["seconds"] * 1e3, 2))
+    L, _ = laplacian_normalized(res, adj)
+    jax.block_until_ready(L.values)
+
+    t0 = time.monotonic()
+    Lt = prepare_spmv(L)
+    jax.block_until_ready(Lt.vals)
+    record("tile_csr_host_s", round(time.monotonic() - t0, 2))
+
+    from raft_tpu.ops.spmv_pallas import spmv_tiled
+
+    x = jnp.asarray(np.random.RandomState(0).randn(n).astype(np.float32))
+    r = fx.run(lambda xx: spmv_tiled(Lt, xx), x)
+    record("spmv_ms", round(r["seconds"] * 1e3, 3))
+
+    # one jitted restart cycle at the production ncv
+    k = 5
+    ncv = max(2 * k + 1, 20)
+    V0 = jnp.zeros((ncv + 1, n), jnp.float32).at[0].set(
+        x / jnp.linalg.norm(x))
+    T0 = jnp.zeros((ncv, ncv), jnp.float32)
+    r = fx.run(lambda V, T: lz._restart_cycle(
+        Lt, V, T, jnp.asarray(0, jnp.int32), ncv)[2], V0, T0)
+    record("cycle_ms", round(r["seconds"] * 1e3, 2))
+    record("ncv", ncv)
+
+    # count restart cycles by instrumenting the host loop
+    calls = {"n": 0}
+    orig = lz._restart_cycle
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    lz._restart_cycle = counting
+    cfg = LanczosSolverConfig(n_components=k, max_iterations=400,
+                              ncv=None, tolerance=1e-5, seed=42,
+                              which=LANCZOS_WHICH.SA, jit_loop=False)
+    t0 = time.monotonic()
+    vals, _ = lz.lanczos_compute_eigenpairs(res, Lt, cfg)
+    jax.block_until_ready(vals)
+    record("host_loop_s", round(time.monotonic() - t0, 2))
+    record("n_cycles", calls["n"])
+    lz._restart_cycle = orig
+
+    # e2e, both loop modes
+    from raft_tpu.models import SpectralEmbedding
+
+    for jl in (True, False):
+        r = fx.run(lambda a, j=jl: SpectralEmbedding(
+            n_components=4, max_iterations=400, res=res,
+            jit_loop=j, tiled=True).fit_transform(a), adj)
+        record(f"e2e_jit_loop_{jl}_s", round(r["seconds"], 2))
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
