@@ -40,18 +40,6 @@ def _device_init_healthy(timeout_s: int = 150) -> bool:
         return False
 
 
-def _smoke_select_k_radix():
-    import jax.numpy as jnp
-
-    from raft_tpu.ops import select_k_pallas
-
-    v = jnp.asarray(np.random.default_rng(0).normal(size=(4, 4096)),
-                    jnp.float32)
-    ov, oi = select_k_pallas.select_k(v, None, 32, True)
-    ref = np.sort(np.asarray(v), axis=1)[:, :32]
-    np.testing.assert_allclose(np.asarray(ov), ref, rtol=1e-6)
-
-
 def _smoke_fused_l2_topk():
     from raft_tpu.distance.knn_fused import knn_fused
 
@@ -155,7 +143,6 @@ def _smoke_select_k_slotted_pallas():
 
 
 KERNELS = {
-    "select_k_radix": _smoke_select_k_radix,
     "select_k_slotted_pallas": _smoke_select_k_slotted_pallas,
     "fused_l2_topk": _smoke_fused_l2_topk,
     "fused_l2_topk_dchunk": _smoke_fused_l2_topk_dchunk,

@@ -102,15 +102,17 @@ def main():
         return orig(*a, **kw)
 
     lz._restart_cycle = counting
-    cfg = LanczosSolverConfig(n_components=k, max_iterations=400,
-                              ncv=None, tolerance=1e-5, seed=42,
-                              which=LANCZOS_WHICH.SA, jit_loop=False)
-    t0 = time.monotonic()
-    vals, _ = lz.lanczos_compute_eigenpairs(res, Lt, cfg)
-    jax.block_until_ready(vals)
-    record("host_loop_s", round(time.monotonic() - t0, 2))
-    record("n_cycles", calls["n"])
-    lz._restart_cycle = orig
+    try:
+        cfg = LanczosSolverConfig(n_components=k, max_iterations=400,
+                                  ncv=None, tolerance=1e-5, seed=42,
+                                  which=LANCZOS_WHICH.SA, jit_loop=False)
+        t0 = time.monotonic()
+        vals, _ = lz.lanczos_compute_eigenpairs(res, Lt, cfg)
+        jax.block_until_ready(vals)
+        record("host_loop_s", round(time.monotonic() - t0, 2))
+        record("n_cycles", calls["n"])
+    finally:
+        lz._restart_cycle = orig
 
     # e2e, both loop modes
     from raft_tpu.models import SpectralEmbedding

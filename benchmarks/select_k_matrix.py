@@ -34,11 +34,11 @@ OUT = os.path.join(os.path.dirname(__file__), os.pardir,
 # round-2 battery) — the deadline must live inside the script.
 BUDGET_S = float(os.environ.get("SELECT_K_BUDGET_S", "3000"))
 
-# RADIX is measured 10-50x slower than XLA/SLOTTED at long rows (round-1
-# verdict; confirmed on v5e: 203ms at len=2^20) — skip it there rather
-# than spend the battery's budget re-proving it; the AUTO table treats a
-# missing entry as a non-candidate.
-RADIX_MAX_LEN = 2 ** 17
+# The literal Pallas radix kernel was deleted in round 3 after losing
+# every cell of two measured matrices (round-1 anchor: 203 ms at
+# len=2^20 vs XLA 4.7; round-3: 19-121 ms where XLA/SLOTTED did 2-35).
+# The RADIX enum name now aliases CHUNKED, so the sweep measures the
+# three real algorithms.
 
 
 def main():
@@ -92,10 +92,7 @@ def main():
         jax.block_until_ready(v)
         row = {"batch": batch, "len": length, "k": k}
         for algo in (SelectAlgo.XLA_TOPK, SelectAlgo.SLOTTED,
-                     SelectAlgo.RADIX, SelectAlgo.CHUNKED):
-            if algo is SelectAlgo.RADIX and (length > RADIX_MAX_LEN
-                                             or k > 256):
-                continue
+                     SelectAlgo.CHUNKED):
             try:
                 # an off-envelope explicit request warns and measures the
                 # XLA path — recording THAT under this algo's name would

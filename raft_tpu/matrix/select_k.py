@@ -14,16 +14,16 @@ TPU-first algorithm space (no warp shuffles / SM histograms here):
 certified slot-fold (sort-free, bandwidth-bound, always exact —
 select_k_slotted.py) — it plays the reference warpsort family's ROLE
 (bandwidth-bound selection keeping per-bucket running minima in
-registers) with folds instead of queues; ``BITONIC``/``RADIX`` are the
-Pallas radix kernel (VMEM-resident digit filtering,
-ops/select_k_pallas.py). A literal bitonic lane-queue is an anti-fit
-here: every compare-exchange stage needs cross-lane shuffles the VPU
-only gets via relayouts, and the measured matrix (SELECT_K_MATRIX.json)
-shows even the radix histogram losing to compare/select folds — so the
-warpsort names map to the kernels that serve their roles rather than
-to a losing literal translation. The AUTO heuristic is table-driven
-off measured TPU timings the way the reference's learned tree is
-generated from benchmark sweeps.
+registers) with folds instead of queues; ``CHUNKED`` is the exact
+per-chunk+merge large-k algorithm (select_k_chunked.py). The literal
+Pallas radix kernel was DELETED in round 3 after never winning any of
+66 measured cells over two rounds (a VPU-bound digit histogram loses
+to compare/select folds), and a literal bitonic lane-queue is an
+anti-fit (every compare-exchange needs cross-lane relayouts) — so the
+``RADIX``/``BITONIC`` reference names dispatch to CHUNKED/SLOTTED,
+the algorithms serving their roles. The AUTO heuristic is
+table-driven off measured TPU timings the way the reference's learned
+tree is generated from benchmark sweeps.
 """
 
 from __future__ import annotations
@@ -54,8 +54,11 @@ def _load_select_k_table():
             data = json.load(f)
         cells = []
         for row in data.get("rows", []):
+            # RADIX is deliberately NOT a candidate: its kernel was
+            # deleted (round 3) and the name now aliases CHUNKED, so
+            # historical radix timings must not label cells
             timings = {name: row[name] for name in
-                       ("XLA_TOPK", "SLOTTED", "RADIX", "CHUNKED")
+                       ("XLA_TOPK", "SLOTTED", "CHUNKED")
                        if isinstance(row.get(name), (int, float))
                        and not isinstance(row.get(name), bool)
                        # 0.0 is a measurement artifact (sub-RTT clamp in
@@ -151,6 +154,17 @@ def select_k(
     if not explicit:
         algo = choose_select_k_algorithm(batch, length, k)
 
+    if algo in (SelectAlgo.RADIX, SelectAlgo.BITONIC):
+        # the Pallas radix kernel was DELETED in round 3: across two
+        # measured matrices (66 cells) it never won a single cell —
+        # 5-40× behind XLA/SLOTTED everywhere, including the large-k
+        # regime it nominally served (SELECT_K_MATRIX.json; CHANGELOG).
+        # The reference names keep dispatching to the algorithms that
+        # play their ROLES: radix (large-k filtering) → CHUNKED,
+        # warp-queue → SLOTTED.
+        algo = (SelectAlgo.CHUNKED if algo == SelectAlgo.RADIX
+                else SelectAlgo.SLOTTED)
+
     if algo == SelectAlgo.SLOTTED:
         from raft_tpu.matrix.select_k_slotted import select_k_slotted
 
@@ -189,23 +203,5 @@ def select_k(
         fn = jax.lax.approx_min_k if select_min else jax.lax.approx_max_k
         vals_a, pos = fn(in_val, k, recall_target=float(recall_target))
         return vals_a, jnp.take_along_axis(in_idx, pos, axis=1)
-
-    if algo in (SelectAlgo.BITONIC, SelectAlgo.RADIX):
-        # BITONIC is an alias of the one Pallas kernel (radix): the
-        # warpsort-family names map here for API parity, but no separate
-        # bitonic-queue kernel exists on TPU (see select_k_types docstring)
-        from raft_tpu.ops import select_k_pallas
-
-        try:
-            return select_k_pallas.select_k(in_val, in_idx, k, select_min,
-                                            algo=algo)
-        except NotImplementedError as e:
-            if explicit:
-                import warnings
-
-                warnings.warn(
-                    f"select_k: explicit algo={algo.name} outside the "
-                    f"Pallas kernel envelope ({e}); falling back to XLA "
-                    f"top-k", RuntimeWarning, stacklevel=2)
 
     return _xla_select_k(in_val, in_idx, k, select_min)

@@ -18,12 +18,15 @@ memory histograms. The variants that exist here:
                       (select_k_chunked.py): the large-k regime where
                       one wide XLA TopK goes superlinear — the ROLE of
                       the reference's radix select at large k
-- ``RADIX``         — the Pallas kernel: multi-pass digit-histogram
-                      filtering in VMEM (ops/select_k_pallas)
-- ``BITONIC``       — ALIAS of RADIX. The warpsort-family names map here
-                      for API parity; on TPU the filtered-queue role is
-                      played by SLOTTED (no warp shuffles exist to build
-                      a bitonic queue from)
+- ``RADIX``         — alias of CHUNKED. A literal Pallas digit-histogram
+                      kernel existed through round 3 and never won a
+                      single measured cell (66 cells over two rounds,
+                      5-40× behind XLA/SLOTTED — SELECT_K_MATRIX.json);
+                      it was deleted, and the radix NAME dispatches to
+                      the algorithm serving its large-k filtering role
+- ``BITONIC``       — alias of SLOTTED (the warp-queue role; no warp
+                      shuffles exist on TPU to build a literal bitonic
+                      queue from)
 - ``APPROX``        — ``jax.lax.approx_min_k/approx_max_k``: XLA's
                       TPU-hardware aggregate top-k with a recall target
                       (default 0.95). INEXACT by contract — a TPU-native

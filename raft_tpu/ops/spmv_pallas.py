@@ -157,7 +157,7 @@ def _spmv_tiled_impl(vals, col_local, chunk_col_tile, perm, perm_rows,
     return y3d[:, :, 0]                                # [n_row_tiles, R]
 
 
-def spmv_tiled(tiled, x, eb: int = None) -> jax.Array:
+def spmv_tiled(tiled, x, eb=None) -> jax.Array:
     """y = A @ x for a :class:`raft_tpu.sparse.tiled.TiledELL` operand.
     ``eb`` is the per-grid-step sub-block of each chunk (must divide E);
     larger eb = fewer grid steps (less per-step overhead) at more VMEM
@@ -166,7 +166,10 @@ def spmv_tiled(tiled, x, eb: int = None) -> jax.Array:
     eb=2048 vs 6.1 at the round-2 eb=512 — see R3_SPMV_EXP.json)."""
     n_rows, n_cols = tiled.shape
     if eb is None:
-        eb = min(2048, tiled.E)
+        # largest divisor of E ≤ 2048 (E is a 512-multiple, so one of
+        # these always divides it)
+        eb = next(w for w in (2048, 1024, 512)
+                  if w <= tiled.E and tiled.E % w == 0)
     if tiled.E % eb:
         raise ValueError(f"spmv_tiled: eb={eb} must divide E={tiled.E}")
     x = jnp.asarray(x, jnp.float32)

@@ -32,6 +32,7 @@ device.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -318,20 +319,201 @@ def _pad_groups(order, keys, E):
     return idx, chunk_tile
 
 
+@functools.partial(
+    jax.jit, static_argnames=("C", "R", "E", "n_ct", "n_rt", "NG", "NM"))
+def _tile_csr_device_core(rows, cols, vals, C: int, R: int, E: int,
+                          n_ct: int, n_rt: int, NG: int, NM: int):
+    """Device-side v2 tiled-ELL layout, mirroring the numpy pass above
+    step for step (same stable sort keys ⇒ identical layout). Output
+    arrays are sized to the STATIC worst-case bounds NG/NM (jit needs
+    static shapes; padding inflates only by ≤7 slots per occupied
+    bucket + one E-chunk per tile group); the wrapper fetches the two
+    true sizes (the only host sync) and slices. Exists because the
+    host conversion's device↔host transfers measured 3.8 s of config
+    4's ~4.5 s at 2M nnz on the tunneled v5e.
+
+    Ids are range-validated ON DEVICE, with the verdict fetched in the
+    same host sync as the output sizes — the host paths' ValueError
+    contract is preserved at no extra round trip."""
+    nnz = rows.shape[0]
+    ct = cols // C
+    rt = rows // R
+    bucket = ct * n_rt + rt                          # ct-major key
+    order_g = jnp.lexsort((rows, cols, bucket))
+    bsorted = bucket[order_g]
+    first = jnp.concatenate([jnp.ones((1,), bool),
+                             bsorted[1:] != bsorted[:-1]])
+    bidx = jnp.cumsum(first.astype(jnp.int32)) - 1   # dense bucket index
+    nb = bidx[-1] + 1                                # traced bucket count
+    barange = jnp.arange(nnz, dtype=jnp.int32)
+    bvalid = barange < nb
+    counts = jax.ops.segment_sum(jnp.ones((nnz,), jnp.int32), bidx,
+                                 num_segments=nnz)
+    bstart = jax.ops.segment_min(barange, bidx, num_segments=nnz)
+    padded = (counts + 7) // 8 * 8
+    b_off8 = jnp.cumsum(padded) - padded             # exclusive cumsum
+    within = barange - bstart[bidx]
+    g_slot8 = b_off8[bidx] + within                  # per element
+
+    ub = jax.ops.segment_max(bsorted, bidx, num_segments=nnz)
+    ub_ct = jnp.where(bvalid, ub // n_rt, n_ct - 1)
+    # per-col-tile 8-padded sizes → E-padded group offsets
+    ct_sizes8 = jax.ops.segment_sum(jnp.where(bvalid, padded, 0), ub_ct,
+                                    num_segments=n_ct)
+    ct_start8 = jnp.cumsum(ct_sizes8) - ct_sizes8
+    grp_padded = -(-ct_sizes8 // E) * E
+    grp_foff = jnp.cumsum(grp_padded) - grp_padded
+    n_gather = jnp.sum(grp_padded)
+    elem_final = grp_foff[ct[order_g]] + (g_slot8 - ct_start8[ct[order_g]])
+
+    pv = jnp.zeros((NG,), vals.dtype).at[elem_final].set(vals[order_g])
+    pc = jnp.zeros((NG,), jnp.int32).at[elem_final].set(
+        (cols[order_g] % C).astype(jnp.int32))
+    # chunk j's col tile: the group that owns slot j·E
+    ch_arange = jnp.arange(NG // E, dtype=jnp.int32)
+    chunk_col_tile = jnp.searchsorted(
+        jnp.cumsum(grp_padded), ch_arange * E, side="right"
+    ).astype(jnp.int32)
+
+    # per-bucket start row in the FINAL gather stream
+    bucket_final0 = grp_foff[ub_ct] + (b_off8 - ct_start8[ub_ct])
+    bucket_row0 = bucket_final0 // 8
+
+    # scatter stream: buckets rt-major (stable ⇒ ct-minor within rt)
+    key2 = jnp.where(bvalid, (ub % n_rt) * n_ct + ub // n_rt,
+                     jnp.iinfo(jnp.int32).max)
+    order_b = jnp.argsort(key2, stable=True)         # invalid sort last
+    sc_sizes = jnp.where(bvalid, padded, 0)[order_b]
+    sc_rows = sc_sizes // 8
+    sc_rt = jnp.where(bvalid[order_b], ub[order_b] % n_rt, n_rt - 1)
+    rt_slots = jax.ops.segment_sum(sc_sizes, sc_rt, num_segments=n_rt)
+    rt_padded = -(-rt_slots // E) * E
+    rt_foff = jnp.cumsum(rt_padded) - rt_padded
+    m_slots = jnp.sum(rt_padded)
+    chunk_row_tile = jnp.searchsorted(
+        jnp.cumsum(rt_padded), jnp.arange(NM // E, dtype=jnp.int32) * E,
+        side="right").astype(jnp.int32)
+
+    # per-bucket (scatter order) destination slot
+    csc = jnp.cumsum(sc_sizes) - sc_sizes            # excl. cumsum
+    rt_bstart_slots = jax.ops.segment_min(
+        jnp.where(bvalid[order_b], csc, jnp.iinfo(jnp.int32).max),
+        sc_rt, num_segments=n_rt)
+    dst_slot0 = rt_foff[sc_rt] + (csc - rt_bstart_slots[sc_rt])
+    dst_row0 = dst_slot0 // 8
+    src_row0 = bucket_row0[order_b]
+
+    # perm_rows: virtual scatter 8-row v belongs to scatter-bucket
+    # searchsorted(cumsum(sc_rows), v, right); rows beyond the data or
+    # in pad gaps point at the appended zero row
+    zero_row = n_gather // 8
+    csr_rows = jnp.cumsum(sc_rows)
+    v8 = jnp.arange(NM // 8, dtype=jnp.int32)
+    owner = jnp.searchsorted(csr_rows, v8, side="right").astype(jnp.int32)
+    owner_c = jnp.minimum(owner, nnz - 1)
+    within_rows = v8 - (csr_rows[owner_c] - sc_rows[owner_c])
+    dstr = dst_row0[owner_c] + within_rows
+    srcr = src_row0[owner_c] + within_rows
+    have = (owner < nnz) & bvalid[order_b][owner_c]
+    perm_rows = jnp.full((NM // 8,), zero_row, jnp.int32)
+    perm_rows = perm_rows.at[jnp.where(have, dstr, NM // 8)].set(
+        jnp.where(have, srcr, zero_row).astype(jnp.int32), mode="drop")
+
+    # row_local: element destinations (bucket dst + within-bucket slot)
+    inv_sc = jnp.zeros((nnz,), jnp.int32).at[order_b].set(
+        jnp.arange(nnz, dtype=jnp.int32))
+    elem_dst = dst_slot0[inv_sc[bidx]] + within
+    rloc = jnp.full((NM,), R, jnp.int32).at[elem_dst].set(
+        (rows[order_g] % R).astype(jnp.int32))
+
+    visited = jnp.zeros((n_rt,), bool).at[
+        jnp.where(bvalid, ub % n_rt, n_rt)].set(True, mode="drop")
+    return (pv, pc, chunk_col_tile, perm_rows, rloc, chunk_row_tile,
+            visited, n_gather, m_slots)
+
+
+@jax.jit
+def _ids_in_range(rows, cols, n_rows, n_cols):
+    return (jnp.all((rows >= 0) & (rows < n_rows))
+            & jnp.all((cols >= 0) & (cols < n_cols)))
+
+
+def tile_csr_device(A, C: int = 512, R: int = 256,
+                    E: int = 2048) -> TiledELL:
+    """Device-side tiled-ELL conversion (see _tile_csr_device_core):
+    the big arrays never cross the host boundary — only two size
+    scalars sync. Produces the SAME layout as the numpy/native host
+    passes (identical stable sort keys; asserted in tests)."""
+    if isinstance(A, CSRMatrix):
+        rows = A.row_ids()
+        cols, vals, shape = A.indices, A.values, A.shape
+    elif isinstance(A, COOMatrix):
+        rows, cols, vals, shape = A.rows, A.cols, A.values, A.shape
+    else:
+        raise TypeError(f"tile_csr_device: expected sparse matrix, "
+                        f"got {type(A)}")
+    if E % 512 or C % 128 or R % 8:
+        raise ValueError("tile_csr_device: need E % 512 == 0, "
+                         "C % 128 == 0, R % 8 == 0")
+    rows = jnp.asarray(rows, jnp.int32)
+    cols = jnp.asarray(cols, jnp.int32)
+    vals = jnp.asarray(vals, jnp.float32)
+    nnz = int(rows.shape[0])
+    n_ct = max(1, -(-shape[1] // C))
+    n_rt = max(1, -(-shape[0] // R))
+    if nnz == 0 or n_ct * n_rt >= 2 ** 31:
+        return tile_csr(A, C=C, R=R, E=E, impl="numpy")
+    # static worst-case stream bounds: ≤7 pad slots per occupied bucket
+    # plus up to one E-chunk of pad per tile group
+    nb_max = min(nnz, n_ct * n_rt)
+    ns8 = nnz + 7 * nb_max
+    NG = (-(-(ns8 + (E - 8) * n_ct) // E)) * E
+    NM = (-(-(ns8 + (E - 8) * n_rt) // E)) * E
+    out = _tile_csr_device_core(rows, cols, vals, C, R, E, n_ct, n_rt,
+                                NG, NM)
+    (pv, pc, cct, perm_rows, rloc, crt, visited, n_gather, m_slots) = out
+    ok = _ids_in_range(rows, cols, shape[0], shape[1])
+    # the ONLY host sync: two size scalars + the validation verdict
+    ok, n_gather, m_slots = (bool(ok), int(n_gather), int(m_slots))
+    if not ok:
+        raise ValueError(
+            f"tile_csr_device: row/col ids out of range for shape "
+            f"{shape}")
+    n_chunks = n_gather // E
+    m_chunks = m_slots // E
+    return TiledELL(
+        shape=shape, C=C, R=R, E=E,
+        vals=pv[:n_gather].reshape(n_chunks, E),
+        col_local=pc[:n_gather].reshape(n_chunks, E),
+        chunk_col_tile=cct[:n_chunks],
+        perm=None,
+        perm_rows=perm_rows[:m_slots // 8],
+        row_local=rloc[:m_slots].reshape(m_chunks, E),
+        chunk_row_tile=crt[:m_chunks],
+        visited_row_tiles=visited,
+        n_col_tiles=n_ct, n_row_tiles=n_rt)
+
+
 def tile_csr(A, C: int = 512, R: int = 256, E: int = 2048,
              impl: str = "auto") -> TiledELL:
     """Convert a CSR/COO matrix to the tiled-ELL layout (one-time, host).
 
     ``impl``: "auto" builds the v2 8-aligned-bucket layout (ROW-gather
     bridge — runtime-optimal: the legacy scalar-permutation bridge
-    measured 15.4 of the 17.1 ms SpMV at 2M nnz on v5e) via the native
-    C++ pass when available, else numpy — BIT-IDENTICAL (tested);
-    "numpy" forces the fallback; "native" forces the LEGACY
-    scalar-perm C++ layout (kept for comparison/compat). All layouts
-    produce identical SpMV results (tested)."""
-    if impl not in ("auto", "numpy", "native"):
-        raise ValueError(f"tile_csr: impl must be 'auto', 'numpy' or "
-                         f"'native', got {impl!r}")
+    measured 15.4 of the 17.1 ms SpMV at 2M nnz on v5e): ON DEVICE
+    when an accelerator backend is active (tile_csr_device — the host
+    passes' device↔host transfers measured 3.8 s of config 4 at 2M nnz
+    on the tunneled v5e), else via the native C++ pass, else numpy —
+    all three BIT-IDENTICAL (tested); "device"/"numpy" force those;
+    "native" forces the LEGACY scalar-perm C++ layout (kept for
+    comparison/compat). All layouts produce identical SpMV results
+    (tested)."""
+    if impl not in ("auto", "device", "numpy", "native"):
+        raise ValueError(f"tile_csr: impl must be 'auto', 'device', "
+                         f"'numpy' or 'native', got {impl!r}")
+    if impl == "device" or (
+            impl == "auto" and jax.default_backend() != "cpu"):
+        return tile_csr_device(A, C=C, R=R, E=E)
     coo_rows, coo_cols, vals, shape = _checked_coo_parts(A, C, R, E,
                                                          "tile_csr")
 

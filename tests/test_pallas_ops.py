@@ -1,7 +1,12 @@
-"""Pallas kernel tests (interpret mode on the CPU test platform; the same
-kernels compile on TPU — cross-validated against the XLA path, the
-reference suite's algorithm-cross-validation strategy for select_k).
-Sizes kept small: interpret mode executes the kernel in pure python."""
+"""select_k reference-name dispatch tests.
+
+The literal Pallas radix kernel was DELETED in round 3: across two
+measured matrices (66 cells) it never won a single cell — 5-40× behind
+XLA/SLOTTED everywhere, including the large-k regime it nominally
+served (SELECT_K_MATRIX.json). The reference algorithm NAMES survive as
+aliases of the algorithms that play their roles (RADIX → CHUNKED,
+BITONIC → SLOTTED); these tests pin that dispatch + cross-algorithm
+agreement (the reference suite's validation strategy for select_k)."""
 
 import numpy as np
 import pytest
@@ -9,49 +14,33 @@ import pytest
 jnp = pytest.importorskip("jax.numpy")
 
 from raft_tpu.matrix import SelectAlgo, select_k as matrix_select_k
-from raft_tpu.ops import select_k_pallas
 
 rng = np.random.default_rng(81)
 
 
-@pytest.mark.parametrize("select_min", [True, False])
-def test_pallas_radix_matches_host(res, select_min):
-    v = rng.normal(size=(2, 1024)).astype(np.float32)
-    ov, oi = select_k_pallas.select_k(jnp.asarray(v), None, 8, select_min)
-    ref = np.sort(v, axis=1)[:, :8] if select_min else -np.sort(-v, axis=1)[:, :8]
-    np.testing.assert_allclose(np.asarray(ov), ref, rtol=0)
-    np.testing.assert_allclose(np.take_along_axis(v, np.asarray(oi), axis=1),
-                               ref, rtol=0)
-
-
-def test_pallas_radix_ties(res):
-    v = np.zeros((1, 1024), np.float32)
-    v[0, 100:110] = -1.0
-    ov, oi = select_k_pallas.select_k(jnp.asarray(v), None, 16, True)
-    ov = np.asarray(ov)
-    assert (ov[0, :10] == -1.0).all() and (ov[0, 10:] == 0.0).all()
-    # indices are valid positions of the selected values
-    assert set(np.asarray(oi)[0, :10]) == set(range(100, 110))
-
-
-def test_pallas_radix_padding(res):
-    v = rng.normal(size=(1, 1500)).astype(np.float32)
-    ov, _ = select_k_pallas.select_k(jnp.asarray(v), None, 4, True)
-    np.testing.assert_allclose(np.asarray(ov), np.sort(v, axis=1)[:, :4])
-
-
-def test_pallas_radix_envelope(res):
-    with pytest.raises(NotImplementedError):
-        select_k_pallas.select_k(jnp.zeros((1, 512), jnp.float32), None, 4, True)
-    with pytest.raises(NotImplementedError):
-        select_k_pallas.select_k(jnp.zeros((1, 2048), jnp.float32), None, 512, True)
-
-
 def test_matrix_select_k_radix_dispatch(res):
-    """Explicit RADIX algo routes to the Pallas kernel and agrees with the
-    XLA path (the reference's cross-algorithm validation)."""
     v = rng.normal(size=(2, 1024)).astype(np.float32)
     v_r, i_r = matrix_select_k(res, v, k=8, algo=SelectAlgo.RADIX)
     v_x, i_x = matrix_select_k(res, v, k=8, algo=SelectAlgo.XLA_TOPK)
     np.testing.assert_allclose(np.asarray(v_r), np.asarray(v_x), rtol=0)
     np.testing.assert_array_equal(np.asarray(i_r), np.asarray(i_x))
+
+
+@pytest.mark.parametrize("select_min", [True, False])
+def test_radix_alias_large_k(res, select_min):
+    # the regime the radix name exists for: k in the hundreds+
+    v = rng.normal(size=(2, 8192)).astype(np.float32)
+    ov, oi = matrix_select_k(res, v, k=500, select_min=select_min,
+                             algo=SelectAlgo.RADIX)
+    ref = (np.sort(v, axis=1)[:, :500] if select_min
+           else -np.sort(-v, axis=1)[:, :500])
+    np.testing.assert_allclose(np.asarray(ov), ref, rtol=0)
+    np.testing.assert_allclose(
+        np.take_along_axis(v, np.asarray(oi), axis=1), ref, rtol=0)
+
+
+def test_matrix_select_k_bitonic_dispatch(res):
+    v = rng.normal(size=(2, 8192)).astype(np.float32)
+    v_b, _ = matrix_select_k(res, v, k=8, algo=SelectAlgo.BITONIC)
+    np.testing.assert_allclose(np.asarray(v_b), np.sort(v, axis=1)[:, :8],
+                               rtol=0)

@@ -247,3 +247,30 @@ def test_spmm_tiled_v_envelope():
     # a pairs operand reaching spmm gets an actionable TypeError
     with pytest.raises(TypeError, match="layout='ell'"):
         linalg.spmm(None, prepare_spmv(A, layout="pairs"), B)
+
+
+def test_device_layout_bit_identical_to_numpy():
+    """tile_csr_device mirrors the numpy v2 pass with the same stable
+    sort keys — the layouts must be BIT-identical (same contract the
+    native C++ pass is held to)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.core.sparse_types import COOMatrix
+    from raft_tpu.sparse.tiled import tile_csr, tile_csr_device
+
+    rng = np.random.default_rng(5)
+    for n, nnz, C, R, E in [(4096, 30000, 512, 256, 2048),
+                            (1024, 5000, 128, 64, 512),
+                            (300, 7, 128, 8, 512)]:
+        r = rng.integers(0, n, nnz).astype(np.int32)
+        c = rng.integers(0, n, nnz).astype(np.int32)
+        v = rng.normal(size=nnz).astype(np.float32)
+        A = COOMatrix(jnp.asarray(r), jnp.asarray(c), jnp.asarray(v),
+                      (n, n))
+        tn = tile_csr(A, C=C, R=R, E=E, impl="numpy")
+        td = tile_csr_device(A, C=C, R=R, E=E)
+        for f in ("vals", "col_local", "chunk_col_tile", "perm_rows",
+                  "row_local", "chunk_row_tile", "visited_row_tiles"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(tn, f)), np.asarray(getattr(td, f)),
+                err_msg=f"{f} at ({n},{nnz},{C},{R},{E})")
