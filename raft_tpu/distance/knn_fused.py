@@ -65,7 +65,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.ops.fused_l2_topk_pallas import (
-    _LANES, _PACK_BITS, _PACK_MASK, _PACK_PAD, VMEM_BUDGET,
+    _LANES, _PACK_BITS, _PACK_MASK, _PACK_PAD, _PBITS_MAX, VMEM_BUDGET,
     fused_l2_group_topk, fused_l2_group_topk_dchunk,
     fused_l2_group_topk_packed, fused_l2_group_topk_packed_dchunk,
     split_hi_lo, vmem_footprint)
@@ -131,7 +131,7 @@ def auto_pack_bits(n_tiles: int, T: int) -> int:
     production's."""
     import math
 
-    return min(13, max(_PACK_BITS, int(math.floor(
+    return min(_PBITS_MAX, max(_PACK_BITS, int(math.floor(
         math.log2(max(n_tiles * T / 2560.0, 256.0))))))
 
 
@@ -524,10 +524,14 @@ def footprint_for(T: int, Qb: int, d: int, passes: int,
     uninformed caller fails safe (over-shrinks) rather than shipping a
     Mosaic scoped-VMEM reject."""
     d_eff = d + (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
-    packed = g is not None and g * (T // _LANES) <= (1 << _PACK_BITS)
-    return vmem_footprint(T, Qb, d_eff, passes,
-                          dchunk=d_eff > _D_SINGLE_SHOT,
-                          kernel="packed" if packed else "group")
+    # the auto pack-width clamp makes any g ≤ 2^_PBITS_MAX codes
+    # packed; the single-shot packed path is the STREAM kernel (no
+    # [Qb, T] buffer)
+    packed = g is not None and g * (T // _LANES) <= (1 << _PBITS_MAX)
+    dchunk = d_eff > _D_SINGLE_SHOT
+    kern = ("packed" if dchunk else "stream") if packed else "group"
+    return vmem_footprint(T, Qb, d_eff, passes, dchunk=dchunk,
+                          kernel=kern)
 
 
 def _valid_cfg(T, Qb, g) -> bool:
@@ -650,7 +654,7 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
     # same fallback the core and _prepare_ops agree on
     import math
 
-    pbits = min(13, max(_PACK_BITS, int(math.ceil(math.log2(
+    pbits = min(_PBITS_MAX, max(_PACK_BITS, int(math.ceil(math.log2(
         max(g * (T // _LANES), 2))))))
     dpad = (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
     if dpad:

@@ -50,6 +50,11 @@ _PACK_BITS = 8                   # default code width; kernels take the
                                  # (more codes = wider groups = narrower
                                  # pool, at 2^(pbits-23) value error)
 _PACK_MASK = (1 << _PACK_BITS) - 1
+_PBITS_MAX = 13                  # widest allowed codes: value error
+                                 # 2^(13-23) must stay under the
+                                 # certificate margins (ONE definition —
+                                 # auto_pack_bits, prepare_knn_index and
+                                 # footprint_for all consume this)
 _PACK_PAD = float(2.0 ** 125)    # finite "never wins" sentinel
 
 
@@ -74,6 +79,18 @@ def vmem_footprint(T: int, Qb: int, d: int, passes: int,
       carries +inf — two fewer [Qb, T] buffers) but the in-kernel merge
       holds more fold state, so its factors stay higher than the slot
       kernel's: ~2.2 (p1) / ~3.2 (p3)."""
+    if kernel == "stream":
+        # the streamed packed kernel (single-shot only — the d-chunked
+        # packed kernel models as "packed") never materializes a
+        # [Qb, T] score buffer: per-chunk [Qb, 128] temporaries only
+        # (fold state + pack temps, ~20 live [Qb, 128]
+        # f32-equivalents, conservative vs the ~14 the fold holds)
+        assert not dchunk, "stream models the single-shot kernel"
+        bytes_ = T * d * 2 * 2 * (2 if passes == 3 else 1)  # y hi(/lo)
+        bytes_ += Qb * d * 6 + Qb * 8                 # x f32+bf16, xxh
+        bytes_ += 8 * T * 4 * 2                       # yyh carrier
+        bytes_ += Qb * _LANES * 4 * 20                # fold state + temps
+        return bytes_
     if kernel == "group":
         d2_bufs = 2.2 if passes == 1 else 3.2
         n_out = 5

@@ -277,16 +277,16 @@ def spmv_pair_tiled(t, x) -> jax.Array:
 
 
 def _gather_mm_kernel(col_tile_ref, vals_ref, cols_ref, x_ref, out_ref,
-                      *, C: int, V: int):
+                      *, C: int, V: int, eb: int):
     """contrib[e, :] = val[e] · x_tile[col[e], :] via onehotᵀ @ x — for
     V ≥ ~8 columns the MXU does the selection (the one-hot rows are
     exactly representable in bf16, so with HIGHEST precision the gather
     error is the bf16x3 split residual of x, ~2⁻¹⁶ relative)."""
     x = x_ref[0]                                         # [C, V]
-    cols = cols_ref[0]                                   # [1, EB]
-    onehot = (jnp.broadcast_to(cols, (C, _EB))
-              == jax.lax.broadcasted_iota(jnp.int32, (C, _EB), 0)
-              ).astype(jnp.float32)                      # [C, EB]
+    cols = cols_ref[0]                                   # [1, eb]
+    onehot = (jnp.broadcast_to(cols, (C, eb))
+              == jax.lax.broadcasted_iota(jnp.int32, (C, eb), 0)
+              ).astype(jnp.float32)                      # [C, eb]
     g = jax.lax.dot_general(
         onehot, x, (((0,), (0,)), ((), ())),
         precision=jax.lax.Precision.HIGHEST,
@@ -295,18 +295,18 @@ def _gather_mm_kernel(col_tile_ref, vals_ref, cols_ref, x_ref, out_ref,
 
 
 def _scatter_mm_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
-                       *, R: int, V: int):
+                       *, R: int, V: int, eb: int):
     c = pl.program_id(0)
     b = pl.program_id(1)
     cur = row_tile_ref[c]
     prev = row_tile_ref[jnp.maximum(c - 1, 0)]
     first = ((c == 0) | (cur != prev)) & (b == 0)
 
-    rloc = rloc_ref[0]                                   # [1, EB], pad = R
-    onehot = (jnp.broadcast_to(rloc, (R, _EB))
-              == jax.lax.broadcasted_iota(jnp.int32, (R, _EB), 0)
-              ).astype(jnp.float32)                      # [R, EB]
-    contrib = contrib_ref[0]                             # [EB, V]
+    rloc = rloc_ref[0]                                   # [1, eb], pad = R
+    onehot = (jnp.broadcast_to(rloc, (R, eb))
+              == jax.lax.broadcasted_iota(jnp.int32, (R, eb), 0)
+              ).astype(jnp.float32)                      # [R, eb]
+    contrib = contrib_ref[0]                             # [eb, V]
     acc = jax.lax.dot_general(
         onehot, contrib, (((1,), (0,)), ((), ())),
         precision=jax.lax.Precision.HIGHEST,
@@ -322,31 +322,33 @@ def _scatter_mm_kernel(row_tile_ref, contrib_ref, rloc_ref, y_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("C", "R", "E", "V",
-                                             "n_col_tiles", "n_row_tiles"))
+                                             "n_col_tiles", "n_row_tiles",
+                                             "eb"))
 def _spmm_tiled_impl(vals, col_local, chunk_col_tile, perm, perm_rows,
                      row_local, chunk_row_tile, B_padded,
                      C: int, R: int, E: int, V: int,
-                     n_col_tiles: int, n_row_tiles: int) -> jax.Array:
+                     n_col_tiles: int, n_row_tiles: int,
+                     eb: int = _EB) -> jax.Array:
     n_chunks = vals.shape[0]
     m_chunks = row_local.shape[0]
-    nb = E // _EB
+    nb = E // eb
     x3d = B_padded.reshape(n_col_tiles, C, V)
     vals3 = vals.reshape(n_chunks, E, 1)                 # [EB, 1] blocks
 
     contrib = pl.pallas_call(
-        functools.partial(_gather_mm_kernel, C=C, V=V),
+        functools.partial(_gather_mm_kernel, C=C, V=V, eb=eb),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(n_chunks, nb),
             in_specs=[
-                pl.BlockSpec((1, _EB, 1), lambda c, b, m: (c, b, 0),
+                pl.BlockSpec((1, eb, 1), lambda c, b, m: (c, b, 0),
                              memory_space=pltpu.VMEM),   # vals
-                pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
+                pl.BlockSpec((1, 1, eb), lambda c, b, m: (c, 0, b),
                              memory_space=pltpu.VMEM),   # cols
                 pl.BlockSpec((1, C, V), lambda c, b, m: (m[c], 0, 0),
                              memory_space=pltpu.VMEM),   # x tile
             ],
-            out_specs=pl.BlockSpec((1, _EB, V), lambda c, b, m: (c, b, 0),
+            out_specs=pl.BlockSpec((1, eb, V), lambda c, b, m: (c, b, 0),
                                    memory_space=pltpu.VMEM),
         ),
         out_shape=jax.ShapeDtypeStruct((n_chunks, E, V), jnp.float32),
@@ -367,14 +369,14 @@ def _spmm_tiled_impl(vals, col_local, chunk_col_tile, perm, perm_rows,
                                   axis=0).reshape(m_chunks, E, V)
 
     y3d = pl.pallas_call(
-        functools.partial(_scatter_mm_kernel, R=R, V=V),
+        functools.partial(_scatter_mm_kernel, R=R, V=V, eb=eb),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(m_chunks, nb),
             in_specs=[
-                pl.BlockSpec((1, _EB, V), lambda c, b, m: (c, b, 0),
+                pl.BlockSpec((1, eb, V), lambda c, b, m: (c, b, 0),
                              memory_space=pltpu.VMEM),   # contrib
-                pl.BlockSpec((1, 1, _EB), lambda c, b, m: (c, 0, b),
+                pl.BlockSpec((1, 1, eb), lambda c, b, m: (c, 0, b),
                              memory_space=pltpu.VMEM),   # row_local
             ],
             out_specs=pl.BlockSpec((1, R, V), lambda c, b, m: (m[c], 0, 0),
@@ -407,10 +409,20 @@ def spmm_tiled(tiled, B) -> jax.Array:
     pad = tiled.n_col_tiles * tiled.C - n_cols
     if pad:
         B = jnp.concatenate([B, jnp.zeros((pad, V), jnp.float32)])
+    # sub-block sized so BOTH the [eb, V] contrib tile and the
+    # dominant [max(C,R), eb] one-hot buffers stay ≤ ~2/4 MB (same
+    # grid-step-overhead logic as spmv_tiled's whole-chunk default);
+    # falls back to the 512 floor for tilings where nothing larger fits
+    cr = max(tiled.C, tiled.R)
+    eb = next((w for w in (2048, 1024, 512)
+               if w <= tiled.E and tiled.E % w == 0
+               and w * max(V, 1) * 4 <= (2 << 20)
+               and cr * w * 4 <= (4 << 20)), 512)
     y3d = _spmm_tiled_impl(
         tiled.vals, tiled.col_local, tiled.chunk_col_tile, tiled.perm,
         tiled.perm_rows, tiled.row_local, tiled.chunk_row_tile, B,
         C=tiled.C, R=tiled.R, E=tiled.E, V=V,
-        n_col_tiles=tiled.n_col_tiles, n_row_tiles=tiled.n_row_tiles)
+        n_col_tiles=tiled.n_col_tiles, n_row_tiles=tiled.n_row_tiles,
+        eb=eb)
     y2d = jnp.where(tiled.visited_row_tiles[:, None, None], y3d, 0.0)
     return y2d.reshape(-1, V)[:n_rows]
