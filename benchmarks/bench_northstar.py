@@ -112,7 +112,10 @@ def main():
         valid = (jnp.arange(M, dtype=jnp.int32) < m)[None, :]
         yyh_k = jnp.broadcast_to(
             jnp.where(valid, 0.5 * yy, _PACK_PAD), (8, M))
-        Tf, Qb = fit_config(T, 256, d, passes, g)
+        # request the largest query block the stream-kernel VMEM model
+        # admits (fit_config only shrinks): bigger Qb amortizes each
+        # y-tile DMA over more MXU work (tuned winner at 1M×128)
+        Tf, Qb = fit_config(T, 1024, d, passes, g)
         jax.block_until_ready(y_hi)
         idx = KnnIndex(None, y_hi, y_lo, yyh_k, yy, m, Tf, Qb, g,
                        passes, "l2", d, pbits=pbits)
@@ -132,9 +135,12 @@ def main():
             out["stages"][f"e2e_p{passes}"] = {
                 "ms": round(ms, 3), "gbps_effective": round(gbps, 2),
                 "vs_a100_anchor": round(gbps / 1555.0, 4)}
+            # mirror knn_fused's Qb-vs-Q clamp (the direct core call
+            # bypasses the wrapper; core requires Q % Qb == 0 — in dry
+            # mode n_q can be smaller than the fitted Qb)
             nf = _knn_fused_core(
                 Q, None, idx.y_hi, idx.y_lo, idx.yyh_k, idx.yy_raw,
-                k=k, T=idx.T, Qb=idx.Qb, g=g, passes=passes,
+                k=k, T=idx.T, Qb=min(idx.Qb, n_q), g=g, passes=passes,
                 metric="l2", m=m, rescore=False, pbits=pbits,
                 _diag=True)[2]
             out["stages"][f"n_fail_p{passes}"] = int(nf)
