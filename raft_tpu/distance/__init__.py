@@ -8,6 +8,7 @@ from raft_tpu.distance.fused_l2nn import (
     fused_l2_nn,
     fused_l2_nn_argmin,
     knn,
+    knn_index_sharded,
     knn_sharded,
 )
 from raft_tpu.distance.knn_fused import KnnIndex, prepare_knn_index
@@ -15,5 +16,6 @@ from raft_tpu.distance.knn_fused import KnnIndex, prepare_knn_index
 __all__ = [
     "DistanceType", "METRIC_NAMES", "pairwise_distance",
     "fused_l2_nn", "fused_l2_nn_argmin", "knn", "knn_sharded",
+    "knn_index_sharded",
     "KnnIndex", "prepare_knn_index",
 ]

@@ -431,3 +431,93 @@ def knn_sharded(res, index, queries, k: int, mesh=None, axis: str = "x",
     ir = jax.device_put(index, replicated(mesh))
     d, i = fn(qs, ir)
     return d[:nq], i[:nq]
+
+
+def knn_index_sharded(res, index, queries, k: int, mesh=None,
+                      axis: str = "x", metric: str = "sqeuclidean",
+                      algo: str = "auto") -> Tuple[jax.Array, jax.Array]:
+    """Model-parallel brute-force KNN: the INDEX rows are sharded over
+    ``axis`` (the mode for indexes too large for one chip's HBM — each
+    chip holds n/ndev rows), queries replicated. Every shard selects
+    its local top-k, local ids shift to global by the shard's row
+    offset, the per-shard candidates ride ONE ``all_gather`` over the
+    mesh axis (k·nq values — the only cross-chip traffic), and a final
+    merge top-k assembles the exact global result. (ref: the
+    raft-dask/legacy ``knn_merge_parts`` pattern — per-worker partial
+    KNN + cross-worker merge; SURVEY §2.12's MNMG model with the model
+    axis sharded instead of the data axis.)
+
+    Exact for every metric/algo the single-chip ``knn`` serves: each
+    shard over-selects by the pad count (zero-padded rows — all in the
+    last shard — can rank inside a local top-k, so selecting
+    k + n_pads locally guarantees ≥ k REAL candidates per shard), the
+    merge masks pads by global id, and the global top-k is then a
+    subset of the union of per-shard real candidates."""
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.parallel import replicated, shard_array
+
+    res = ensure_resources(res)
+    if mesh is None:
+        mesh = res.mesh
+    expects(mesh is not None,
+            "knn_index_sharded: pass mesh= or set it on res")
+    expects(axis in mesh.axis_names,
+            "knn_index_sharded: axis %r not in mesh axes %s", axis,
+            tuple(mesh.axis_names))
+    ndev = mesh.shape[axis]
+    index = jnp.asarray(index, jnp.float32)
+    queries = jnp.asarray(queries, jnp.float32)
+    n = index.shape[0]
+    expects(k <= n, "knn_index_sharded: k larger than index size")
+    index_p, _ = _pad_rows(index, ndev)
+    rows_per = index_p.shape[0] // ndev
+    n_pads = index_p.shape[0] - n
+    k_loc = k + n_pads                      # over-select past any pads
+    expects(k_loc <= rows_per,
+            "knn_index_sharded: k=%d (+%d pad slots) exceeds the "
+            "per-shard row count %d — use fewer shards or the "
+            "query-sharded mode", k, n_pads, rows_per)
+
+    # rows_per is baked into the cached closure (the global-id shift):
+    # the index geometry MUST be part of the key
+    key = ("idx", mesh, axis, k_loc, rows_per, n, metric, algo,
+           res.workspace.allocation_limit)
+    fn = _SHARDED_KNN_CACHE.get(key)
+    if fn is None:
+        ws_limit = res.workspace.allocation_limit
+
+        def shard_fn(idx_shard, q_repl):
+            from raft_tpu.core.resources import (
+                DeviceResources, WorkspaceResource)
+
+            local = DeviceResources()
+            local.set_workspace_resource(WorkspaceResource(ws_limit))
+            d_loc, i_loc = knn(local, idx_shard, q_repl, k=k_loc,
+                               metric=metric, algo=algo)
+            gid = i_loc + jax.lax.axis_index(axis) * rows_per
+            dg = jax.lax.all_gather(d_loc, axis, axis=1,
+                                    tiled=True)          # [nq, ndev·k]
+            ig = jax.lax.all_gather(gid, axis, axis=1, tiled=True)
+            return dg, ig
+
+        fn = jax.jit(jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=(P(), P()),
+            check_vma=False))
+        _SHARDED_KNN_CACHE[key] = fn
+
+    idx_s = shard_array(index_p, mesh, axis)
+    qr = jax.device_put(queries, replicated(mesh))
+    dg, ig = fn(idx_s, qr)
+    # merge: exact top-k of the gathered per-shard candidates; padded
+    # rows (global id ≥ n) masked out
+    dg = jnp.where(ig < n, dg, jnp.inf if metric != "inner_product"
+                   else -jnp.inf)
+    if metric == "inner_product":
+        top, pos = jax.lax.top_k(dg, k)
+    else:
+        neg, pos = jax.lax.top_k(-dg, k)
+        top = -neg
+    return top, jnp.take_along_axis(ig, pos, axis=1)
