@@ -129,3 +129,42 @@ def test_fuzz_knn_fused_ip(seed):
     np.testing.assert_allclose(true_ip, ref, atol=tol)
     for q in range(Q):
         assert np.unique(ids[q]).size == k
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_knn_fused_wide_pbits(seed):
+    """Wide pack codes (pbits > 8 — the big-M pool-narrowing mode) must
+    keep the certificate sound: exact results at 9-12 mantissa bits of
+    code, where the value perturbation is up to 16x the default."""
+    rng = np.random.default_rng(4000 + seed)
+    Q = int(rng.integers(4, 24))
+    m = int(rng.integers(3000, 9000))
+    d = int(rng.integers(8, 48))
+    k = int(rng.integers(1, 17))
+    # T=512 -> 4 chunks; g in {128, 256, 1024} -> 512/1024/4096 codes
+    # -> pbits 9/10/12
+    g = [128, 256, 1024, 256][seed]
+    y = rng.normal(size=(m, d)).astype(np.float32)
+    x = (y[rng.integers(0, m, Q)]
+         + 0.1 * rng.normal(size=(Q, d)).astype(np.float32))
+    if seed == 3:
+        # big-norm offset: the regime where norm-scaled pack error broke
+        # the certificate at 10M scale before the xx fold
+        y += 30.0
+        x += 30.0
+    vals, ids = knn_fused(x, y, k=k, passes=3, T=512, Qb=32, g=g)
+    xx = (x.astype(np.float64) ** 2).sum(1)
+    yy = (y.astype(np.float64) ** 2).sum(1)
+    d2 = np.maximum(xx[:, None] + yy[None, :] - 2.0 * (
+        x.astype(np.float64) @ y.astype(np.float64).T), 0)
+    ref = np.sort(d2, axis=1)[:, :k]
+    tol = 8 * float(np.max(xx[:, None] + yy[None, :])) * 2.0 ** -24 + 1e-6
+    np.testing.assert_allclose(np.asarray(vals), ref, atol=tol,
+                               err_msg=f"g={g} Q={Q} m={m} d={d} k={k}")
+    ids = np.asarray(ids)
+    true_d = np.take_along_axis(d2, ids, axis=1)
+    np.testing.assert_allclose(true_d, ref, atol=tol)
+    # duplicate ids are exactly the wide-code failure mode (decode
+    # collisions) — the other half of the contract
+    for q in range(Q):
+        assert np.unique(ids[q]).size == k
