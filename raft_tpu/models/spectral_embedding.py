@@ -16,7 +16,7 @@ class SpectralEmbedding:
     def __init__(self, n_components: int = 2, normalized: bool = True,
                  drop_first: bool = True, ncv: Optional[int] = None,
                  tolerance: float = 1e-5, max_iterations: int = 2000,
-                 seed: int = 42, jit_loop: bool = False, tiled="auto",
+                 seed: int = 42, jit_loop=None, tiled="auto",
                  res: Optional[Resources] = None):
         self.res = ensure_resources(res)
         self.n_components = n_components

@@ -195,7 +195,14 @@ def lanczos_compute_eigenpairs(
     V = V.at[0].set(v0 / jnp.linalg.norm(v0))
     T0 = jnp.zeros((ncv, ncv), dtype)
 
-    if config.jit_loop:
+    jit_loop = config.jit_loop
+    if jit_loop is None:
+        # AUTO: one compiled program on accelerators (per-cycle host
+        # round-trips measured 28 s vs 0.6 s for the same 1M-edge solve
+        # on the tunneled v5e); the host loop — cancellation points +
+        # stagnation early-exit — stays the CPU default
+        jit_loop = jax.default_backend() != "cpu"
+    if jit_loop:
         with nvtx.annotate("lanczos_compute_eigenpairs[jit]"):
             vals, vecs, rel_resid = _solve_jitted(
                 A, V, jnp.asarray(config.tolerance, dtype),

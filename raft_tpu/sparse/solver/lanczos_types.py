@@ -31,6 +31,9 @@ class LANCZOS_WHICH(enum.Enum):
 class LanczosSolverConfig:
     """(ref: lanczos_types.hpp:40 ``lanczos_solver_config``)
 
+    ``jit_loop=None`` (default) compiles the loop on accelerator
+    backends and keeps the host loop on CPU (per-cycle host dispatch
+    measured 28 s vs 0.6 s for the same solve on the tunneled v5e);
     ``jit_loop=True`` compiles the whole thick-restart loop into ONE
     program (``lax.while_loop`` over cycles) — no per-cycle host dispatch,
     the right mode for remote/tunneled devices — at the cost of host-side
@@ -44,4 +47,4 @@ class LanczosSolverConfig:
     tolerance: float = 1e-6
     which: LANCZOS_WHICH = LANCZOS_WHICH.SA
     seed: int = 42
-    jit_loop: bool = False
+    jit_loop: Optional[bool] = None

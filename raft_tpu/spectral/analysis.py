@@ -57,7 +57,7 @@ def analyze_modularity(res, A: Sparse, n_clusters: int, clusters) -> float:
 def fit_embedding(res, A: Sparse, n_components: int, ncv=None,
                   tolerance: float = 1e-5, max_iterations: int = 2000,
                   seed: int = 42, drop_first: bool = True,
-                  normalized: bool = True, jit_loop: bool = False,
+                  normalized: bool = True, jit_loop=None,
                   tiled="auto"):
     """Spectral embedding: smallest eigenvectors of the graph Laplacian.
 
