@@ -140,19 +140,27 @@ def main():
         jnp.where(valid_cols, 0.5 * yy, jnp.inf), (8, M))
     yyh_pck = jnp.broadcast_to(
         jnp.where(valid_cols, 0.5 * yy, F._PACK_PAD), (8, M))
-    # production path: packed-id fold
+    # production path: packed-id STREAMED fold (the kernel knn_fused
+    # ships — the big-matmul variant VMEM-rejects at stream-tuned
+    # configs like (4096, 512))
+    pair_ok = (T // 128) % 2 == 0
     record("kernel_pck_p1", lambda *a: F.fused_l2_group_topk_packed(
-        *a, T=T, Qb=Qb, passes=1, tpg=g), Q, y_hi, y_lo, yyh_pck, m_real)
+        *a, T=T, Qb=Qb, passes=1, tpg=g, stream=True, pair=pair_ok),
+        Q, y_hi, y_lo, yyh_pck, m_real)
     record("kernel_pck_p3", lambda *a: F.fused_l2_group_topk_packed(
-        *a, T=T, Qb=Qb, passes=3, tpg=g), Q, y_hi, y_lo, yyh_pck, m_real)
+        *a, T=T, Qb=Qb, passes=3, tpg=g, stream=True),
+        Q, y_hi, y_lo, yyh_pck, m_real)
+    # legacy comparison kernels at a FIXED known-compiling config (their
+    # [Qb, T] score buffers reject the stream-tuned configs)
+    Tl, Qbl = 2048, 256
     record("kernel_grp_p1", lambda *a: F.fused_l2_group_topk(
-        *a, T=T, Qb=Qb, passes=1, tpg=g), Q, y_hi, y_lo, yyh, m_real)
+        *a, T=Tl, Qb=Qbl, passes=1, tpg=g), Q, y_hi, y_lo, yyh, m_real)
     record("kernel_grp_p3", lambda *a: F.fused_l2_group_topk(
-        *a, T=T, Qb=Qb, passes=3, tpg=g), Q, y_hi, y_lo, yyh, m_real)
+        *a, T=Tl, Qb=Qbl, passes=3, tpg=g), Q, y_hi, y_lo, yyh, m_real)
     record("kernel_slot_p1", lambda *a: F.fused_l2_slot_topk(
-        *a, T=T, Qb=Qb, passes=1), Q, y_hi, y_lo, xx, yy, m_real)
+        *a, T=Tl, Qb=Qbl, passes=1), Q, y_hi, y_lo, xx, yy, m_real)
     record("kernel_slot_minonly", lambda *a: F.fused_l2_slot_topk(
-        *a, T=T, Qb=Qb, passes=1, track=False), Q, y_hi, y_lo, xx, yy,
+        *a, T=Tl, Qb=Qbl, passes=1, track=False), Q, y_hi, y_lo, xx, yy,
         m_real)
 
     # --- post-stage on materialized kernel outputs (skipped — not
@@ -161,7 +169,7 @@ def main():
     grp = None
     try:
         grp = jax.block_until_ready(F.fused_l2_group_topk(
-            Q, y_hi, y_lo, yyh, m_real, T=T, Qb=Qb, passes=1, tpg=g))
+            Q, y_hi, y_lo, yyh, m_real, T=Tl, Qb=Qbl, passes=1, tpg=g))
     except Exception as e:
         out["stages"]["post"] = {
             "error": f"kernel for post-stage inputs failed: "
@@ -189,7 +197,8 @@ def main():
     # (the production post — no id arrays, no pool-id gather)
     try:
         pck = jax.block_until_ready(F.fused_l2_group_topk_packed(
-            Q, y_hi, y_lo, yyh_pck, m_real, T=T, Qb=Qb, passes=1, tpg=g))
+            Q, y_hi, y_lo, yyh_pck, m_real, T=T, Qb=Qb, passes=1, tpg=g,
+            stream=True, pair=pair_ok))
     except Exception:
         pck = None
 
