@@ -401,7 +401,11 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                     xhi, yt_hi, nt_dims,
                     preferred_element_type=jnp.float32)
                 if passes == 3:
-                    xlo = (xq - xhi.astype(jnp.float32)).astype(jnp.bfloat16)
+                    # barrier: XLA:TPU's bf16 pass folds the split
+                    # (see split_hi_lo) — lo would collapse to ~0
+                    xhi_b = jax.lax.optimization_barrier(xhi)
+                    xlo = (xq - xhi_b.astype(jnp.float32)
+                           ).astype(jnp.bfloat16)
                     s = s + jax.lax.dot_general(
                         xhi, yt_lo, nt_dims,
                         preferred_element_type=jnp.float32)

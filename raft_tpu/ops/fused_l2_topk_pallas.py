@@ -126,6 +126,11 @@ def _contract(x, yhi, ylo):
     s = jax.lax.dot_general(
         xhi, yhi, dims, preferred_element_type=jnp.float32)
     if ylo is not None:
+        # unbarriered ON PURPOSE: this body lowers through Mosaic, not
+        # the XLA bf16-propagation pass that folds the split in
+        # split_hi_lo (see its barrier note) — audited on hardware: the
+        # fuzz battery's big-norm p3 rows exercise this exact split and
+        # the kernel matched the numpy bf16x3 emulation bit-for-bit
         xlo = (x - xhi.astype(jnp.float32)).astype(jnp.bfloat16)
         s = s + jax.lax.dot_general(
             xhi, ylo, dims, preferred_element_type=jnp.float32)
@@ -952,8 +957,17 @@ def fused_l2_group_topk_packed_dchunk(x, y_hi, y_lo, yy_half, m_real,
 
 def split_hi_lo(y: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Split f32 into bf16 hi + bf16 lo with y ≈ hi + lo (bf16x3 operand
-    prep; the dropped lo·lo term is O(2⁻¹⁸·‖x‖‖y‖))."""
+    prep; the dropped lo·lo term is O(2⁻¹⁸·‖x‖‖y‖)).
+
+    The optimization_barrier is LOAD-BEARING: without it, XLA:TPU's
+    bf16-propagation pass simplifies the convert/subtract chain so lo
+    collapses to ~0 (MEASURED on v5e: split residual 0.062 = one full
+    bf16 ulp at 25-magnitude data, i.e. the whole lo term — which
+    silently voided the bf16x3 certificate's error bound on
+    norm-offset inputs; caught by the hardware fuzz battery, invisible
+    to CPU interpret tests)."""
     y = jnp.asarray(y, jnp.float32)
     hi = y.astype(jnp.bfloat16)
-    lo = (y - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    hi_f32 = jax.lax.optimization_barrier(hi).astype(jnp.float32)
+    lo = (y - hi_f32).astype(jnp.bfloat16)
     return hi, lo
