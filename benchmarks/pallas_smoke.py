@@ -29,15 +29,14 @@ import numpy as np
 OUT = os.path.join(os.path.dirname(__file__), os.pardir, "PALLAS_SMOKE.json")
 
 
-def _device_init_healthy(timeout_s: int = 150) -> bool:
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; assert jax.devices()[0].platform == 'tpu'"],
-            timeout=timeout_s, capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+def _device_init_healthy() -> bool:
+    # the ONE shared probe (benchmarks/_common.gate) — honors the
+    # RAFT_TPU_BENCH_RETRY_S outage-riding budget like bench.py
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _common import gate
+
+    dry, reason = gate()
+    return not dry and reason is None
 
 
 def _smoke_fused_l2_topk():

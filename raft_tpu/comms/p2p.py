@@ -244,6 +244,15 @@ def isend(mesh_devices, x, src: int, dst: int, tag: int = 0,
 
     expects(src != dst, "isend: src == dst == %d", src)
     t = get_transport()
+    # rendezvous from the MAIN thread, BEFORE the ownership
+    # early-returns and before any send thread exists: the fabric
+    # allgather is collective over processes, so every process must
+    # reach it at the same program point (a process that returned early
+    # at "not ours to issue" while another blocked in the rendezvous
+    # would interleave it with the next JAX collective — deadlock with
+    # nothing but socket timeouts to surface it). Mirrors irecv.
+    if t.n_processes > 1:
+        t._ensure_fabric()
     key = (comm, src, dst, tag)
     src_proc = mesh_devices[src].process_index
     dst_proc = mesh_devices[dst].process_index

@@ -338,7 +338,8 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         # KERNEL score function (bf16 for passes=1, bf16x3 for 3) —
         # candidates are already sorted ascending by kernel order, so
         # the head IS the result; values only need the embedded code
-        # bits cleared (≤ |v|·2⁻¹⁵ perturbation — already inside the
+        # bits cleared (≤ |v|·2^(pbits−23) perturbation, 2⁻¹⁵..2⁻¹⁰
+        # over the allowed pbits range — already inside the
         # e_pack certificate margin). No yp, no rescore gather: the
         # mode that serves f32-index-larger-than-HBM scales (10M×256).
         if packed:
@@ -638,7 +639,8 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
     than-HBM scales (10M×256 ≈ 10 GB f32 vs ~5.5 GB lite). Queries
     against a lite index run ``rescore=False``: results are the exact
     top-k of the KERNEL score function (bf16 / bf16x3), values within
-    2⁻¹⁵ relative of those scores."""
+    2^(pbits−23) relative of those scores (2⁻¹⁵ at the minimum pack
+    width, up to 2⁻¹⁰ at the auto-pack maximum pbits=13)."""
     if metric not in ("l2", "ip"):
         raise ValueError(f"prepare_knn_index: metric must be 'l2' or "
                          f"'ip', got {metric!r}")
@@ -689,7 +691,8 @@ def knn_fused(x, y, k: int, passes: int = 3,
     stores yp (regular indexes) and falls back to lite results on a
     ``store_yp=False`` index; True forces rescoring (error on a lite
     index); False forces lite results (exact top-k of the kernel score
-    function, values within 2⁻¹⁵ of those scores).
+    function, values within 2^(pbits−23) of those scores — 2⁻¹⁵..2⁻¹⁰
+    over the allowed pbits range).
 
     ``metric="l2"`` (default): (d2 [Q, k] f32 exact ascending, ids).
     ``metric="ip"``: (scores = x·y [Q, k] f32 exact DESCENDING, ids) —

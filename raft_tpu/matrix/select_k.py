@@ -80,7 +80,31 @@ def _load_select_k_table():
 _SELECT_K_TABLE = ...   # lazy-loaded sentinel
 
 
-def choose_select_k_algorithm(n_rows: int, length: int, k: int) -> SelectAlgo:
+def _algo_in_envelope(algo: SelectAlgo, length: int, k: int,
+                      dtype=None) -> bool:
+    """Whether (length, k, dtype) is inside ``algo``'s implementation
+    envelope — the same predicates whose violation makes the impls
+    raise NotImplementedError. AUTO consults this BEFORE the table
+    lookup so it never dispatches into a guaranteed internal fallback
+    (wasted dispatch + mislabeled measurement)."""
+    if algo in (SelectAlgo.SLOTTED, SelectAlgo.CHUNKED):
+        from raft_tpu.matrix.select_k_types import f32_comparable_keys
+
+        if dtype is not None and not f32_comparable_keys(dtype):
+            return False
+    if algo == SelectAlgo.SLOTTED:
+        from raft_tpu.matrix.select_k_slotted import slotted_envelope
+
+        return k <= slotted_envelope(length, k)[2]
+    if algo == SelectAlgo.CHUNKED:
+        from raft_tpu.matrix.select_k_chunked import chunked_envelope
+
+        return chunked_envelope(length)
+    return True
+
+
+def choose_select_k_algorithm(n_rows: int, length: int, k: int,
+                              dtype=None) -> SelectAlgo:
     """Heuristic algorithm choice. (ref: select_k-inl.cuh:38 — a learned
     decision tree over (rows, cols, k), generated from benchmark sweeps.)
 
@@ -88,7 +112,9 @@ def choose_select_k_algorithm(n_rows: int, length: int, k: int) -> SelectAlgo:
     ``SELECT_K_MATRIX.json`` exists (produced on real TPU by
     benchmarks/select_k_matrix.py — never from CPU timings), AUTO picks
     the measured-fastest algorithm of the nearest grid cell in
-    (log batch, log len, log k). Without a table the only
+    (log batch, log len, log k), restricted to algorithms whose
+    envelope admits (length, k, dtype) — AUTO never returns a choice
+    that would raise internally. Without a table the only
     measurement-justified choice is XLA's top-k (round-1 anchor: XLA
     ≈4.7ms vs Pallas radix ≈43ms on [16,1M] f32, k=64 — the radix
     histogram is VPU-bound; SLOTTED had no TPU numbers yet)."""
@@ -100,10 +126,15 @@ def choose_select_k_algorithm(n_rows: int, length: int, k: int) -> SelectAlgo:
 
         q = (math.log2(max(n_rows, 1)), math.log2(max(length, 1)),
              math.log2(max(k, 1)))
-        _, algo = min(
-            _SELECT_K_TABLE,
-            key=lambda cell: sum((a - b) ** 2 for a, b in zip(cell[0], q)))
-        return algo
+        ok = {a: _algo_in_envelope(a, length, k, dtype)
+              for a in {cell[1] for cell in _SELECT_K_TABLE}}
+        eligible = [cell for cell in _SELECT_K_TABLE if ok[cell[1]]]
+        if eligible:
+            _, algo = min(
+                eligible,
+                key=lambda cell: sum((a - b) ** 2
+                                     for a, b in zip(cell[0], q)))
+            return algo
     return SelectAlgo.XLA_TOPK
 
 
@@ -152,7 +183,8 @@ def select_k(
 
     explicit = algo != SelectAlgo.AUTO
     if not explicit:
-        algo = choose_select_k_algorithm(batch, length, k)
+        algo = choose_select_k_algorithm(batch, length, k,
+                                         dtype=in_val.dtype)
 
     if algo in (SelectAlgo.RADIX, SelectAlgo.BITONIC):
         # the Pallas radix kernel was DELETED in round 3: across two

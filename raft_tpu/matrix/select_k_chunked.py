@@ -43,23 +43,31 @@ def _chunked_select_min(vals, k: int, nc: int):
     return out_v, out_p
 
 
+def chunked_envelope(length: int, nc: int = 8) -> bool:
+    """Shape envelope of :func:`select_k_chunked` — the SINGLE source
+    AUTO's eligibility check derives from (never re-hardcode)."""
+    return length >= 2 * nc
+
+
 def select_k_chunked(in_val, in_idx, k: int, select_min: bool,
                      nc: int = 8) -> Tuple[jax.Array, jax.Array]:
     """Exact chunked-merge select_k (see module doc). Selection keys
     are compared in f32 — exact for f32/bf16/f16 keys; wider/int keys
-    raise (the f32 cast could collide distinct values), so callers
-    fall back to XLA's native-dtype top-k. Values are gathered from
-    the input, keeping its dtype. ``nc`` = chunk count (k > len/nc
-    degrades to plain XLA cost, never to wrong results — per-chunk k
-    caps at the chunk length)."""
+    raise (the f32 cast could collide distinct values — see
+    select_k_types.f32_comparable_keys), so callers fall back to XLA's
+    native-dtype top-k. Values are gathered from the input, keeping
+    its dtype. ``nc`` = chunk count (k > len/nc degrades to plain XLA
+    cost, never to wrong results — per-chunk k caps at the chunk
+    length)."""
+    from raft_tpu.matrix.select_k_types import f32_comparable_keys
+
     in_val = jnp.asarray(in_val)
-    if not (jnp.issubdtype(in_val.dtype, jnp.floating)
-            and jnp.finfo(in_val.dtype).bits <= 32):
+    if not f32_comparable_keys(in_val.dtype):
         raise NotImplementedError(
             f"chunked select_k: f32/bf16/f16 keys only, got "
             f"{in_val.dtype}")
     B, L = in_val.shape
-    if L < 2 * nc:
+    if not chunked_envelope(L, nc):
         raise NotImplementedError(
             f"chunked select_k: len={L} too short for nc={nc}")
     work = in_val.astype(jnp.float32)

@@ -211,9 +211,10 @@ def select_k_slotted(in_val, in_idx, k: int, select_min: bool
       compared in f32, which is exact for those; f64/int keys would be
       silently rounded, so they take the XLA path instead).
     Returned values are GATHERED from the input, preserving its dtype."""
+    from raft_tpu.matrix.select_k_types import f32_comparable_keys
+
     in_val = jnp.asarray(in_val)
-    if not (jnp.issubdtype(in_val.dtype, jnp.floating)
-            and jnp.finfo(in_val.dtype).bits <= 32):
+    if not f32_comparable_keys(in_val.dtype):
         raise NotImplementedError(
             f"slotted select_k: f32/bf16/f16 keys only, got {in_val.dtype}")
     keys = in_val.astype(jnp.float32)

@@ -68,3 +68,15 @@ class SelectAlgo(enum.Enum):
             "warpdistributedshm": cls.BITONIC,
         }
         return mapping[name]
+
+
+def f32_comparable_keys(dtype) -> bool:
+    """Whether selection keys of ``dtype`` compare EXACTLY after an f32
+    cast — the shared dtype envelope of the SLOTTED and CHUNKED
+    families (both compare keys in f32; f64/int keys could collide
+    distinct values, so they take the XLA path). The ONE definition —
+    the impls and AUTO's envelope check all call this."""
+    import jax.numpy as jnp
+
+    return bool(jnp.issubdtype(dtype, jnp.floating)
+                and jnp.finfo(dtype).bits <= 32)

@@ -190,30 +190,42 @@ def masked_matmul(res, A, B, mask: "BitmapView | BitsetView", alpha=1.0,
     return sddmm(res, A, B.T, structure, alpha=alpha, beta=beta)
 
 
-def add(res, A: Sparse, B: Sparse) -> CSRMatrix:
+def add(res, A: Sparse, B: Sparse, dedup: bool = False) -> CSRMatrix:
     """Sparse + sparse with structure union.
     (ref: sparse/linalg/add.cuh — csr_add_calc_inds/csr_add_finalize two-
     phase; here the union structure is discovered on host once, then values
-    combine on device.)"""
+    combine on device.)
+
+    ``dedup=True`` prunes duplicate slots to the reference's canonical
+    structural nnz (one host sync — see _coalesce_to_csr)."""
     ra, ca, va, shape_a = _as_coo_parts(A)
     rb, cb, vb, shape_b = _as_coo_parts(B)
     expects(shape_a == shape_b, "sparse add: shape mismatch")
     rows = jnp.concatenate([ra, rb])
     cols = jnp.concatenate([ca, cb])
     vals = jnp.concatenate([va, vb])
-    return _coalesce_to_csr(rows, cols, vals, shape_a)
+    return _coalesce_to_csr(rows, cols, vals, shape_a, dedup=dedup)
 
 
-def _coalesce_to_csr(rows, cols, vals, shape) -> CSRMatrix:
+def _coalesce_to_csr(rows, cols, vals, shape, dedup: bool = False
+                     ) -> CSRMatrix:
     """Sum duplicate (row, col) entries → sorted CSR, ON DEVICE with
     static shapes (duplicate slots become explicit zeros — see
     _device_coalesce_sorted for the exact contract; value semantics are
     identical to an exact dedup, structural nnz keeps the slots). The
     exact-dedup host coalesce remains available as the public
-    ``op.sum_duplicates``."""
+    ``op.sum_duplicates``.
+
+    ``dedup=True`` prunes the duplicate slots afterwards — canonical
+    structural nnz like the reference, at the cost of ONE host sync for
+    the kept count (vs zero syncs for the default)."""
     from raft_tpu.sparse.convert import sorted_coo_to_csr
 
-    r, c, v = _device_coalesce_sorted(rows, cols, vals)
+    r, c, v, keep = _device_coalesce_sorted(rows, cols, vals)
+    if dedup and r.shape[0]:
+        n_kept = int(jnp.sum(keep))          # the one host sync
+        idx = jnp.nonzero(keep, size=n_kept)[0]
+        r, c, v = r[idx], c[idx], v[idx]
     return sorted_coo_to_csr(COOMatrix(r, c, v, shape))
 
 
@@ -254,15 +266,18 @@ def transpose(res, A: CSRMatrix) -> CSRMatrix:
     return coo_to_csr(COOMatrix(cols, rows, vals, (shape[1], shape[0])))
 
 
-def symmetrize(res, A: Sparse) -> CSRMatrix:
+def symmetrize(res, A: Sparse, dedup: bool = False) -> CSRMatrix:
     """Return A + Aᵀ on the union structure.
-    (ref: sparse/linalg/detail/symmetrize.cuh COO symmetrization)"""
+    (ref: sparse/linalg/detail/symmetrize.cuh COO symmetrization)
+
+    ``dedup=True`` prunes duplicate slots to canonical structural nnz
+    (one host sync — see _coalesce_to_csr)."""
     rows, cols, vals, shape = _as_coo_parts(A)
     expects(shape[0] == shape[1], "symmetrize: square input required")
     r2 = jnp.concatenate([rows, cols])
     c2 = jnp.concatenate([cols, rows])
     v2 = jnp.concatenate([vals, vals])
-    return _coalesce_to_csr(r2, c2, v2, shape)
+    return _coalesce_to_csr(r2, c2, v2, shape, dedup=dedup)
 
 
 @jax.jit
@@ -275,9 +290,12 @@ def _device_coalesce_sorted(rows, cols, vals):
     counts (``nnz``, ``degree()``'s bincount) by the duplicate slots.
     Exists because the exact host coalesce round-trips the arrays
     through the host (MEASURED: 1.85 s of config 4's 4.8 s at 2M nnz
-    was this one transfer+sort); this runs in ~tens of ms on device."""
+    was this one transfer+sort); this runs in ~tens of ms on device.
+
+    Also returns the run-first mask (True at the slot an exact dedup
+    keeps) so dedup callers don't recompute it."""
     if vals.shape[0] == 0:
-        return rows, cols, vals
+        return rows, cols, vals, jnp.ones((0,), bool)
     order = jnp.lexsort((cols, rows))
     r, c, v = rows[order], cols[order], vals[order]
     first = jnp.concatenate([
@@ -286,10 +304,11 @@ def _device_coalesce_sorted(rows, cols, vals):
     seg = jnp.cumsum(first.astype(jnp.int32)) - 1
     sums = jax.ops.segment_sum(v, seg, num_segments=v.shape[0])
     v_out = jnp.where(first, sums[seg], jnp.zeros_like(v))
-    return r, c, v_out
+    return r, c, v_out, first
 
 
-def compute_graph_laplacian(res, A: Sparse) -> CSRMatrix:
+def compute_graph_laplacian(res, A: Sparse, dedup: bool = False
+                            ) -> CSRMatrix:
     """L = D − A (out-degree Laplacian; diagonal of A ignored, one diagonal
     entry added per row — ref: sparse/linalg/laplacian.cuh:20,32 and the
     kernel in detail/laplacian.cuh: input diagonal treated as zero).
@@ -297,7 +316,9 @@ def compute_graph_laplacian(res, A: Sparse) -> CSRMatrix:
     Duplicate (row, col) entries are coalesced ON DEVICE into explicit
     zeros (static shapes — see _device_coalesce_sorted), so ``L.nnz``
     (and ``degree`` — a structural count) include the input's duplicate
-    slots; VALUES are exact under summation (``to_dense`` identical)."""
+    slots; VALUES are exact under summation (``to_dense`` identical).
+    ``dedup=True`` opts into the reference's canonical structural nnz
+    at the cost of one host sync (see _coalesce_to_csr)."""
     rows, cols, vals, shape = _as_coo_parts(A)
     expects(shape[0] == shape[1],
             "The graph Laplacian can only be computed on a square adjacency matrix")
@@ -310,7 +331,8 @@ def compute_graph_laplacian(res, A: Sparse) -> CSRMatrix:
     all_rows = jnp.concatenate([rows, diag_idx])
     all_cols = jnp.concatenate([cols, diag_idx])
     all_vals = jnp.concatenate([-masked_vals, deg])
-    return _coalesce_to_csr(all_rows, all_cols, all_vals, shape)
+    return _coalesce_to_csr(all_rows, all_cols, all_vals, shape,
+                            dedup=dedup)
 
 
 def laplacian_normalized(res, A: Sparse) -> Tuple[CSRMatrix, jax.Array]:

@@ -464,11 +464,17 @@ def tile_csr_device(A, C: int = 512, R: int = 256,
     if nnz == 0 or n_ct * n_rt >= 2 ** 31:
         return tile_csr(A, C=C, R=R, E=E, impl="numpy")
     # static worst-case stream bounds: ≤7 pad slots per occupied bucket
-    # plus up to one E-chunk of pad per tile group
+    # plus up to one E-chunk of pad per OCCUPIED tile group — empty
+    # tiles contribute zero pad in the core (their segment sums round
+    # up to 0), so the bound uses min(tiles, nnz), not the raw tile
+    # count: a 10M×10M shape with 1k nnz must not allocate one E-chunk
+    # for each of its ~20k col tiles
     nb_max = min(nnz, n_ct * n_rt)
     ns8 = nnz + 7 * nb_max
-    NG = (-(-(ns8 + (E - 8) * n_ct) // E)) * E
-    NM = (-(-(ns8 + (E - 8) * n_rt) // E)) * E
+    occ_ct = min(n_ct, nnz)
+    occ_rt = min(n_rt, nnz)
+    NG = (-(-(ns8 + (E - 8) * occ_ct) // E)) * E
+    NM = (-(-(ns8 + (E - 8) * occ_rt) // E)) * E
     out = _tile_csr_device_core(rows, cols, vals, C, R, E, n_ct, n_rt,
                                 NG, NM)
     (pv, pc, cct, perm_rows, rloc, crt, visited, n_gather, m_slots) = out

@@ -172,6 +172,46 @@ def test_auto_heuristic_is_table_driven(tmp_path, monkeypatch):
         SelectAlgo.XLA_TOPK
 
 
+def test_auto_is_envelope_aware(tmp_path, monkeypatch):
+    """AUTO must never return an algorithm whose envelope rejects the
+    query — the pre-round-4 behavior dispatched into SLOTTED, caught
+    its NotImplementedError, and silently ran XLA while the caller
+    believed SLOTTED was measured."""
+    import importlib
+    import json
+
+    import numpy as np
+
+    sk = importlib.import_module("raft_tpu.matrix.select_k")
+
+    # a table that prefers SLOTTED everywhere
+    table = {"platform": "tpu", "unit": "ms", "rows": [
+        {"batch": 256, "len": 1048576, "k": 64,
+         "XLA_TOPK": 4.7, "SLOTTED": 0.4},
+    ]}
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv("RAFT_TPU_SELECTK_TABLE", str(p))
+    monkeypatch.setattr(sk, "_SELECT_K_TABLE", ...)
+    # in-envelope query follows the table
+    assert sk.choose_select_k_algorithm(
+        256, 1_000_000, 64, np.float32) == SelectAlgo.SLOTTED
+    # k beyond the slotted pool: SLOTTED cell excluded -> default
+    from raft_tpu.matrix.select_k_slotted import slotted_envelope
+
+    big_k = slotted_envelope(65536, 65536)[2] + 1
+    assert sk.choose_select_k_algorithm(
+        4, 65536, big_k, np.float32) == SelectAlgo.XLA_TOPK
+    # integer keys: both Pallas families ineligible -> default
+    assert sk.choose_select_k_algorithm(
+        256, 1_000_000, 64, np.int32) == SelectAlgo.XLA_TOPK
+    # the end-to-end call agrees with an f64 input (no silent fallback)
+    v = np.random.default_rng(0).standard_normal((4, 64)).astype(np.float64)
+    ov, oi = sk.select_k(None, v, k=8)
+    ref = np.sort(v, axis=1)[:, :8]
+    np.testing.assert_allclose(np.asarray(ov), ref)
+
+
 @pytest.mark.parametrize("bad", [-np.inf, np.inf, np.nan])
 @pytest.mark.parametrize("L", [8192, 2048])   # Pallas path + XLA path
 def test_slotted_select_inf_nan_rows(bad, L):
