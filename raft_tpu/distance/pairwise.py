@@ -8,15 +8,18 @@ SURVEY §7 stage 10 / BASELINE configs 1-2.)
 TPU design: "expanded" metrics (L2/cosine/correlation/IP/hellinger/russell-
 rao/jaccard/dice) contract on the MXU as X·Yᵀ plus rank-1 norm corrections —
 that's where the 10M×256 GB/s target comes from. "Unexpanded" metrics
-(L1/Linf/Canberra/Minkowski/Hamming/KL/JS/BrayCurtis) need the |x−y| form;
-they are computed in row tiles sized to the workspace budget so the
-[tile, n, d] broadcast intermediate stays in HBM bounds (the role the
-reference's smem tiling policies play — SURVEY §2.3 contractions row).
+(L1/Linf/Canberra/Minkowski/Hamming/KL/JS/BrayCurtis) need the |x−y| form,
+which has no matmul decomposition: the streaming Pallas kernel
+(ops/unexpanded_pallas.py) forms per-feature terms on VMEM-resident tiles
+and folds them into [Qb, 128] accumulators — no [n, m, d] broadcast at any
+memory level (the role the reference's smem tiling policies play — SURVEY
+§2.3 contractions row, contractions.cuh:313). Ineligible calls take a
+single fully-jitted XLA program whose broadcast-reduce fuses per row tile.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Union
+from typing import Union
 
 import functools
 
@@ -55,25 +58,6 @@ def _correlation(x, y):
     xc = x - jnp.mean(x, axis=1, keepdims=True)
     yc = y - jnp.mean(y, axis=1, keepdims=True)
     return _cosine(xc, yc)
-
-
-def _tile_rows(res, x, y, body, row_bytes: Optional[int] = None):
-    """Apply ``body(x_tile, y) -> [tile, m]`` over row tiles of x, sized by
-    the workspace budget (the contraction-tiling stand-in). ``row_bytes``
-    is the caller's true per-row peak; default assumes a [tile, m, d]
-    broadcast."""
-    res = ensure_resources(res)
-    n, d = x.shape
-    m = y.shape[0]
-    if row_bytes is None:
-        row_bytes = (m * d + m) * 4
-    tile = max(1, min(n, res.workspace.batch_rows(row_bytes)))
-    if tile >= n:
-        return body(x, y)
-    outs = []
-    for start in range(0, n, tile):
-        outs.append(body(x[start:start + tile], y))
-    return jnp.concatenate(outs, axis=0)
 
 
 def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclidean",
@@ -170,12 +154,81 @@ def _pairwise_expanded_jit(x, y, t: DistanceType, p: float) -> jax.Array:
     raise ValueError(f"_pairwise_expanded_jit: unexpanded metric {t}")
 
 
-_FEATURE_CHUNK = 32
-
-
 def _kl_term(a, b):
     r = jnp.where((a > 0) & (b > 0), a / jnp.where(b > 0, b, 1.0), 1.0)
     return jnp.where(a > 0, a * jnp.log(r), 0.0)
+
+
+def _unexp_terms(xs, ys, t: DistanceType, p: float, acc_dtype):
+    """Per-feature terms on a broadcastable (xs, ys) pair — the ONE
+    definition of every unexpanded metric's inner form, shared by the
+    jitted XLA path and the Pallas kernel's emulation tests."""
+    diff = xs - ys
+    if t in (DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded):
+        return (diff * diff,)
+    if t == DistanceType.L1 or t == DistanceType.Linf:
+        return (jnp.abs(diff),)
+    if t == DistanceType.LpUnexpanded:
+        return (jnp.abs(diff) ** p,)
+    if t == DistanceType.Canberra:
+        denom = jnp.abs(xs) + jnp.abs(ys)
+        safe = jnp.where(denom == 0, 1.0, denom)
+        return (jnp.where(denom == 0, 0.0, jnp.abs(diff) / safe),)
+    if t == DistanceType.HammingUnexpanded:
+        return ((xs != ys).astype(acc_dtype),)
+    if t == DistanceType.BrayCurtis:
+        return (jnp.abs(diff), jnp.abs(xs + ys))
+    if t == DistanceType.KLDivergence:
+        return (_kl_term(xs, ys),)
+    if t == DistanceType.JensenShannon:
+        mid = 0.5 * (xs + ys)
+        return (_kl_term(xs, mid) + _kl_term(ys, mid),)
+    raise NotImplementedError(t)
+
+
+def _unexp_finalize(accs, t: DistanceType, p: float, d: int):
+    a = accs[0]
+    if t == DistanceType.L2SqrtUnexpanded:
+        return jnp.sqrt(a)
+    if t == DistanceType.LpUnexpanded:
+        return a ** (1.0 / p)
+    if t == DistanceType.HammingUnexpanded:
+        return a / d
+    if t == DistanceType.BrayCurtis:
+        return a / jnp.maximum(accs[1], 1e-30)
+    if t == DistanceType.JensenShannon:
+        return jnp.sqrt(jnp.maximum(0.5 * a, 0.0))
+    return a
+
+
+@functools.partial(jax.jit, static_argnames=("t", "p", "d_true", "tile"))
+def _unexpanded_jit(x, y, t: DistanceType, p: float, d_true: int,
+                    tile: int) -> jax.Array:
+    """The whole unexpanded pairwise op as ONE compiled program: a scan
+    over row tiles whose body is reduce(term(broadcast)) — XLA:TPU's
+    loop fusion consumes the [tile, m, d] broadcast inside the reduction
+    without materializing it in HBM (verified in the kernel-path bench:
+    benchmarks/bench_unexpanded.py), and the single dispatch removes the
+    per-tile transport RTT the round-3 Python loop paid (measured ~2 ms
+    PER eager op on the tunneled v5e — memory: config-1 floor)."""
+    n, d = x.shape
+    m = y.shape[0]
+    acc_dtype = jnp.promote_types(jnp.promote_types(x.dtype, y.dtype),
+                                  jnp.float32)
+    reduce_d = jnp.max if t == DistanceType.Linf else jnp.sum
+
+    def one_tile(xt):
+        terms = _unexp_terms(xt[:, None, :].astype(acc_dtype),
+                             y[None, :, :].astype(acc_dtype),
+                             t, p, acc_dtype)
+        return _unexp_finalize(tuple(reduce_d(tm, axis=2) for tm in terms),
+                               t, p, d_true)
+
+    n_tiles = -(-n // tile)
+    npad = n_tiles * tile - n
+    xp = jnp.concatenate([x, jnp.zeros((npad, d), x.dtype)]) if npad else x
+    out = jax.lax.map(one_tile, xp.reshape(n_tiles, tile, d))
+    return out.reshape(n_tiles * tile, m)[:n]
 
 
 def _unexpanded(res, x, y, t: DistanceType, p: float) -> jax.Array:
@@ -185,74 +238,29 @@ def _unexpanded(res, x, y, t: DistanceType, p: float) -> jax.Array:
                                   jnp.float32)
     if d == 0:
         return jnp.zeros((n, m), acc_dtype)
-    dc = min(_FEATURE_CHUNK, d)
-    dpad = (-d) % dc
-    if dpad:
-        # zero features are identities for every unexpanded metric's
-        # per-feature term (Canberra/KL/JS mask zero operands; Hamming's
-        # finalize divides by the ORIGINAL d)
-        x = jnp.concatenate([x, jnp.zeros((n, dpad), x.dtype)], axis=1)
-        y = jnp.concatenate([y, jnp.zeros((m, dpad), y.dtype)], axis=1)
-    n_chunks = x.shape[1] // dc
 
-    n_acc = 2 if t == DistanceType.BrayCurtis else 1
-    combine = (jnp.maximum if t == DistanceType.Linf else jnp.add)
+    # Pallas streaming path (TPU): [Qb, T] VMEM accumulators, terms
+    # formed on VMEM-resident tiles — no [tile, m, d] broadcast at any
+    # memory level (the contraction-substrate role, contractions.cuh:313)
+    from raft_tpu.ops.unexpanded_pallas import (unexpanded_eligible,
+                                                unexpanded_pairwise_tiled)
 
-    def chunk_terms(xs, ys):
-        """Per-feature terms on a [tile, m, dc] broadcast."""
-        diff = xs - ys
-        if t in (DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded):
-            return (diff * diff,)
-        if t == DistanceType.L1 or t == DistanceType.Linf:
-            return (jnp.abs(diff),)
-        if t == DistanceType.LpUnexpanded:
-            return (jnp.abs(diff) ** p,)
-        if t == DistanceType.Canberra:
-            denom = jnp.abs(xs) + jnp.abs(ys)
-            safe = jnp.where(denom == 0, 1.0, denom)
-            return (jnp.where(denom == 0, 0.0, jnp.abs(diff) / safe),)
-        if t == DistanceType.HammingUnexpanded:
-            return ((xs != ys).astype(acc_dtype),)
-        if t == DistanceType.BrayCurtis:
-            return (jnp.abs(diff), jnp.abs(xs + ys))
-        if t == DistanceType.KLDivergence:
-            return (_kl_term(xs, ys),)
-        if t == DistanceType.JensenShannon:
-            mid = 0.5 * (xs + ys)
-            return (_kl_term(xs, mid) + _kl_term(ys, mid),)
-        raise NotImplementedError(t)
+    if unexpanded_eligible(t, n, m, d, x.dtype, y.dtype):
+        # kernel envelope: finite inputs (0·inf = NaN through its
+        # one-hot selector dot). The check needs concrete values — a
+        # traced call (inside a user jit) takes the XLA path, whose
+        # semantics cover non-finites
+        concrete = not (isinstance(x, jax.core.Tracer)
+                        or isinstance(y, jax.core.Tracer))
+        if concrete and bool(jnp.isfinite(x).all()) \
+                and bool(jnp.isfinite(y).all()):
+            return unexpanded_pairwise_tiled(x, y, t, p)
 
-    def finalize(accs):
-        a = accs[0]
-        if t == DistanceType.L2SqrtUnexpanded:
-            return jnp.sqrt(a)
-        if t == DistanceType.LpUnexpanded:
-            return a ** (1.0 / p)
-        if t == DistanceType.HammingUnexpanded:
-            return a / d
-        if t == DistanceType.BrayCurtis:
-            return a / jnp.maximum(accs[1], 1e-30)
-        if t == DistanceType.JensenShannon:
-            return jnp.sqrt(jnp.maximum(0.5 * a, 0.0))
-        return a
-
-    def body(xt, yt):
-        tile = xt.shape[0]
-
-        reduce_chunk = jnp.max if t == DistanceType.Linf else jnp.sum
-
-        def step(c, accs):
-            xs = jax.lax.dynamic_slice_in_dim(xt, c * dc, dc, axis=1)
-            ys = jax.lax.dynamic_slice_in_dim(yt, c * dc, dc, axis=1)
-            terms = chunk_terms(xs[:, None, :], ys[None, :, :])
-            return tuple(combine(acc, reduce_chunk(term, axis=2))
-                         for acc, term in zip(accs, terms))
-
-        init = tuple(jnp.zeros((tile, m), acc_dtype)
-                     for _ in range(n_acc))
-        return finalize(jax.lax.fori_loop(0, n_chunks, step, init))
-
-    # budget by the true peak: [tile, m, dc] chunk temps + [tile, m] accs
+    # jitted XLA fallback: one program, fused broadcast-reduce; tile
+    # rows so XLA's scheduling (and any non-fused corner) stays inside
+    # the workspace budget
     itemsize = jnp.dtype(acc_dtype).itemsize
-    return _tile_rows(res, x, y, body,
-                      row_bytes=(m * dc * 3 + m * (n_acc + 1)) * itemsize)
+    res = ensure_resources(res)
+    budget_rows = res.workspace.batch_rows(m * 8 * itemsize)
+    tile = int(max(1, min(n, budget_rows)))
+    return _unexpanded_jit(x, y, t, float(p), d, tile)

@@ -8,10 +8,13 @@ from raft_tpu.sparse import matrix
 from raft_tpu.sparse import op
 from raft_tpu.sparse import solver
 from raft_tpu.sparse.linalg import prepare_sddmm, prepare_spmv
+from raft_tpu.sparse.sharded import (ShardedTiledELL, shard_spmv_operand,
+                                     spmv_sharded)
 from raft_tpu.sparse.tiled import TiledELL, TiledPairs, TiledPairsSpmv
 
 __all__ = [
     "COOMatrix", "COOStructure", "CSRMatrix", "CSRStructure", "TiledELL", "TiledPairsSpmv",
-    "TiledPairs", "convert", "linalg", "matrix", "op", "prepare_sddmm",
-    "prepare_spmv", "solver",
+    "TiledPairs", "ShardedTiledELL", "convert", "linalg", "matrix", "op",
+    "prepare_sddmm", "prepare_spmv", "shard_spmv_operand", "solver",
+    "spmv_sharded",
 ]

@@ -54,6 +54,10 @@ def spmv(res, A, x) -> jax.Array:
     """
     from raft_tpu.sparse.tiled import TiledELL, TiledPairsSpmv
 
+    from raft_tpu.sparse.sharded import ShardedTiledELL, spmv_sharded
+
+    if isinstance(A, ShardedTiledELL):
+        return spmv_sharded(A, x)
     if isinstance(A, TiledPairsSpmv):
         from raft_tpu.ops.spmv_pallas import spmv_pair_tiled
 

@@ -1,0 +1,208 @@
+"""Rank-sharded SpMV — the MNMG tier for the sparse solver stack.
+
+(ref: the reference makes any primitive comms-capable by injecting a
+``comms_t`` into the handle — core/comms.hpp:234 usage model,
+docs/source/using_raft_comms.rst — and its Lanczos hot loop is the SpMV
+at sparse/solver/detail/lanczos.cuh:248. The MNMG decomposition there is
+1-D row partitioning with an allgather of the matvec result.)
+
+TPU-first design: instead of per-rank processes + NCCL, the partitioned
+matrix is ONE jittable operand — the tiled-ELL layout of each contiguous
+row block, padded to a common chunk geometry and stacked on a leading
+mesh axis. ``spmv_sharded`` is a ``jax.shard_map`` over that axis: each
+device runs the UNCHANGED single-device Pallas SpMV pipeline
+(ops/spmv_pallas.spmv_tiled) on its block against a replicated x and the
+row blocks concatenate into y — XLA inserts the all-gather when a
+downstream consumer (the replicated Lanczos recurrence) needs the full
+vector, riding ICI. No solver code changes: the operand dispatches
+through the same ``sparse.linalg.spmv`` entry the single-device layouts
+use, so ``lanczos_compute_eigenpairs`` / ``fit_embedding`` become MNMG
+by swapping the operand.
+
+Why padding is sound (the invariants come from ops/spmv_pallas):
+- gather side: pad chunks carry vals=0 → zero contributions; their
+  chunk_col_tile=0 is a valid x tile.
+- bridge: every row of the padded gather stream beyond a shard's true
+  n_gather is all-zero, so stale zero-row pointers (tile_csr points
+  pads at the old appended-zero-row index) keep reading zeros.
+- scatter side: pad slots carry row_local=R (matches nothing); pad
+  chunks repeat the last real chunk_row_tile so the kernel's
+  first-visit test never re-zeroes a written tile.
+- unvisited row tiles are zeroed by the per-shard visited mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+from raft_tpu.sparse.tiled import TiledELL, tile_csr
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ShardedTiledELL:
+    """P row blocks of one sparse matrix, each a tiled-ELL layout with
+    identical (padded) chunk geometry, stacked on the leading axis and
+    sharded over ``mesh[axis]``. Accepted by ``sparse.linalg.spmv`` and
+    the Lanczos/spectral solvers."""
+
+    shape: Tuple[int, int] = dataclasses.field(metadata=dict(static=True))
+    rb: int = dataclasses.field(metadata=dict(static=True))  # rows/shard
+    C: int = dataclasses.field(metadata=dict(static=True))
+    R: int = dataclasses.field(metadata=dict(static=True))
+    E: int = dataclasses.field(metadata=dict(static=True))
+    n_col_tiles: int = dataclasses.field(metadata=dict(static=True))
+    n_row_tiles: int = dataclasses.field(metadata=dict(static=True))
+    axis: str = dataclasses.field(metadata=dict(static=True))
+    mesh: Mesh = dataclasses.field(metadata=dict(static=True))
+    # stacked leaves, leading axis = shard
+    vals: jax.Array             # [Pn, NC, E] f32
+    col_local: jax.Array        # [Pn, NC, E] int32
+    chunk_col_tile: jax.Array   # [Pn, NC] int32
+    perm_rows: jax.Array        # [Pn, NM/8] int32
+    row_local: jax.Array        # [Pn, MC, E] int32
+    chunk_row_tile: jax.Array   # [Pn, MC] int32
+    visited_row_tiles: jax.Array  # [Pn, n_row_tiles] bool
+
+    @property
+    def n_shards(self) -> int:
+        return self.vals.shape[0]
+
+    @property
+    def nnz(self) -> int:  # pad-inclusive stream size, like TiledELL
+        return int(np.prod(self.vals.shape[1:]))
+
+
+def _pad_axis0(a: np.ndarray, n: int, fill) -> np.ndarray:
+    if a.shape[0] == n:
+        return a
+    pad = np.full((n - a.shape[0],) + a.shape[1:], fill, a.dtype)
+    return np.concatenate([a, pad], axis=0)
+
+
+def shard_spmv_operand(A, mesh: Mesh, axis: str = "x",
+                       C: int = 512, R: int = 256, E: int = 2048,
+                       ) -> ShardedTiledELL:
+    """One-time conversion: partition ``A``'s rows into ``mesh[axis]``
+    contiguous R-aligned blocks, tile each (host pass), pad to common
+    chunk geometry, and place the stack sharded over the mesh axis.
+
+    The sharded sibling of :func:`raft_tpu.sparse.linalg.prepare_spmv`
+    (ref: the raft-dask pattern of partitioning once at fit time)."""
+    expects(axis in mesh.shape, "shard_spmv_operand: mesh has no axis %s",
+            axis)
+    vals_dtype = (A.values.dtype if hasattr(A, "values") else None)
+    if vals_dtype is not None and jnp.dtype(vals_dtype).itemsize > 4:
+        # the tiled kernels compute in f32 (dtype policy, linalg.spmm);
+        # silently downcasting an f64 solve would break tolerances the
+        # caller asked for — make the cast explicit at the call site
+        raise ValueError(
+            "shard_spmv_operand: tiled kernels compute in f32; cast the "
+            "matrix explicitly (or run the single-device CSR path for "
+            "f64 solves)")
+    n_shards = int(mesh.shape[axis])
+    if isinstance(A, CSRMatrix):
+        rows = np.asarray(A.row_ids())
+        cols, vals, shape = (np.asarray(A.indices), np.asarray(A.values),
+                             A.shape)
+    elif isinstance(A, COOMatrix):
+        rows, cols, vals, shape = (np.asarray(A.rows), np.asarray(A.cols),
+                                   np.asarray(A.values), A.shape)
+    else:
+        raise TypeError(f"shard_spmv_operand: expected sparse matrix, "
+                        f"got {type(A)}")
+    n_rows, n_cols = shape
+    rb = -(-n_rows // (n_shards * R)) * R      # R-aligned rows per shard
+    shards = []
+    for p in range(n_shards):
+        lo, hi = p * rb, (p + 1) * rb
+        m = (rows >= lo) & (rows < hi)
+        t = tile_csr(COOMatrix(
+            jnp.asarray(rows[m] - lo, jnp.int32),
+            jnp.asarray(cols[m], jnp.int32),
+            jnp.asarray(vals[m], jnp.float32), (rb, n_cols)),
+            C=C, R=R, E=E, impl="numpy")
+        expects(t.perm_rows is not None,
+                "shard_spmv_operand: need the 8-aligned bucket layout")
+        shards.append(t)
+    NC = max(t.n_chunks for t in shards)
+    MC = max(t.m_chunks for t in shards)
+    stacked = {}
+    for name, fill in (("vals", 0.0), ("col_local", 0), ("row_local", 0)):
+        arrs = []
+        for t in shards:
+            a = np.asarray(getattr(t, name))
+            n = NC if name in ("vals", "col_local") else MC
+            # scatter pad slots must match nothing: row_local pad = R
+            arrs.append(_pad_axis0(a, n, fill if name != "row_local"
+                                   else R))
+        stacked[name] = np.stack(arrs)
+    stacked["chunk_col_tile"] = np.stack([
+        _pad_axis0(np.asarray(t.chunk_col_tile), NC, 0) for t in shards])
+    crt = []
+    for t in shards:
+        a = np.asarray(t.chunk_row_tile)
+        # repeat the last real tile id so the scatter kernel's
+        # first-visit test stays False through the pad chunks
+        last = a[-1] if a.shape[0] else np.int32(0)
+        crt.append(_pad_axis0(a, MC, last))
+    stacked["chunk_row_tile"] = np.stack(crt)
+    stacked["perm_rows"] = np.stack([
+        # point pads at the appended zero row of the PADDED stream
+        _pad_axis0(np.asarray(t.perm_rows), MC * E // 8, NC * E // 8)
+        for t in shards])
+    stacked["visited_row_tiles"] = np.stack(
+        [np.asarray(t.visited_row_tiles) for t in shards])
+
+    # make_array_from_callback (not device_put): under a multi-process
+    # mesh each process can only place its ADDRESSABLE shards — every
+    # process runs this same host pass on the same matrix (SPMD single-
+    # controller-per-process, like the raft-dask fit path), so the
+    # callback serves any local index from the full host stack
+    leaves = {
+        k: jax.make_array_from_callback(
+            v.shape, NamedSharding(mesh, P(axis)),
+            lambda idx, v=v: v[idx])
+        for k, v in stacked.items()}
+    return ShardedTiledELL(
+        shape=shape, rb=rb, C=C, R=R, E=E,
+        n_col_tiles=max(1, -(-n_cols // C)), n_row_tiles=rb // R,
+        axis=axis, mesh=mesh, **leaves)
+
+
+def spmv_sharded(S: ShardedTiledELL, x) -> jax.Array:
+    """y = A @ x for a :class:`ShardedTiledELL`: each mesh device runs
+    the single-device tiled SpMV on its row block (replicated x), and
+    the blocks concatenate on the sharded axis. Jittable; composes with
+    the jitted Lanczos loop (GSPMD all-gathers y where needed)."""
+    from raft_tpu.ops.spmv_pallas import spmv_tiled
+
+    x = jnp.asarray(x, jnp.float32)
+
+    def local(vals, cl, cct, pr, rl, crt, vis, xr):
+        t = TiledELL(
+            shape=(S.rb, S.shape[1]), C=S.C, R=S.R, E=S.E,
+            vals=vals[0], col_local=cl[0], chunk_col_tile=cct[0],
+            perm=None, perm_rows=pr[0], row_local=rl[0],
+            chunk_row_tile=crt[0], visited_row_tiles=vis[0],
+            n_col_tiles=S.n_col_tiles, n_row_tiles=S.n_row_tiles)
+        return spmv_tiled(t, xr)[None, :]          # [1, rb]
+
+    a = S.axis
+    y = jax.shard_map(
+        local, mesh=S.mesh,
+        in_specs=(P(a), P(a), P(a), P(a), P(a), P(a), P(a), P()),
+        # check_vma can't see through pallas_call's ShapeDtypeStruct
+        # outputs; the body is per-shard-pure so the check adds nothing
+        out_specs=P(a), check_vma=False)(
+            S.vals, S.col_local, S.chunk_col_tile, S.perm_rows,
+            S.row_local, S.chunk_row_tile, S.visited_row_tiles, x)
+    return y.reshape(-1)[:S.shape[0]]

@@ -39,9 +39,11 @@ Operand = Union[COOMatrix, CSRMatrix, "TiledELL", "TiledPairsSpmv",
 
 
 def _matvec(A, x):
+    from raft_tpu.sparse.sharded import ShardedTiledELL
     from raft_tpu.sparse.tiled import TiledELL, TiledPairsSpmv
 
-    if isinstance(A, (COOMatrix, CSRMatrix, TiledELL, TiledPairsSpmv)):
+    if isinstance(A, (COOMatrix, CSRMatrix, TiledELL, TiledPairsSpmv,
+                      ShardedTiledELL)):
         from raft_tpu.sparse.linalg import spmv
 
         return spmv(None, A, x)
@@ -170,12 +172,13 @@ def lanczos_compute_eigenpairs(
     """
     res = ensure_resources(res)
     k = config.n_components
+    from raft_tpu.sparse.sharded import ShardedTiledELL
     from raft_tpu.sparse.tiled import TiledELL, TiledPairsSpmv
 
     if isinstance(A, (COOMatrix, CSRMatrix)):
         n = A.shape[0]
         dtype = A.values.dtype
-    elif isinstance(A, (TiledELL, TiledPairsSpmv)):
+    elif isinstance(A, (TiledELL, TiledPairsSpmv, ShardedTiledELL)):
         n = A.shape[0]
         dtype = A.vals.dtype
     else:

@@ -58,7 +58,7 @@ def fit_embedding(res, A: Sparse, n_components: int, ncv=None,
                   tolerance: float = 1e-5, max_iterations: int = 2000,
                   seed: int = 42, drop_first: bool = True,
                   normalized: bool = True, jit_loop=None,
-                  tiled="auto"):
+                  tiled="auto", mesh=None, mesh_axis: str = "x"):
     """Spectral embedding: smallest eigenvectors of the graph Laplacian.
 
     The BASELINE config-4 pipeline (COO Laplacian + Lanczos). Returns
@@ -68,6 +68,13 @@ def fit_embedding(res, A: Sparse, n_components: int, ncv=None,
     (one-time host pass) so the Lanczos hot loop runs the Pallas SpMV
     kernel — on TPU, for graphs past ~200k nonzeros; True/False force
     either path.
+
+    ``mesh``: a ``jax.sharding.Mesh`` makes the solve MNMG — the
+    Laplacian's rows are sharded over ``mesh[mesh_axis]`` and the
+    Lanczos matvec runs as a ``shard_map`` of the per-block Pallas
+    SpMV (sparse/sharded.py; the reference's comms-injected MNMG
+    pipeline — core/comms.hpp:234 usage model). Results match the
+    single-device solve (tested on the 8-device virtual mesh).
     """
     from raft_tpu.sparse.linalg import (
         compute_graph_laplacian, laplacian_normalized, prepare_spmv)
@@ -83,13 +90,26 @@ def fit_embedding(res, A: Sparse, n_components: int, ncv=None,
         raise ValueError(
             f"fit_embedding: tiled must be 'auto', True or False, "
             f"got {tiled!r}")
-    if tiled == "auto":
-        # f64 inputs stay on the CSR path (the tiled kernel computes in
-        # f32 — see the dtype policy in linalg.spmm's docstring)
-        tiled = (jax.default_backend() == "tpu" and L.nnz >= 200_000
-                 and L.values.dtype == jnp.float32)
-    if tiled:
-        L = prepare_spmv(L)
+    if mesh is not None:
+        from raft_tpu.sparse.sharded import shard_spmv_operand
+
+        if tiled is False:
+            raise ValueError(
+                "fit_embedding: tiled=False conflicts with mesh= — the "
+                "MNMG path IS the sharded tiled-ELL operand")
+        if L.values.dtype == jnp.float64:
+            raise ValueError(
+                "fit_embedding: mesh= computes in f32 (tiled kernels); "
+                "cast the input or drop mesh for the f64 CSR path")
+        L = shard_spmv_operand(L, mesh, axis=mesh_axis)
+    else:
+        if tiled == "auto":
+            # f64 inputs stay on the CSR path (the tiled kernel computes
+            # in f32 — see the dtype policy in linalg.spmm's docstring)
+            tiled = (jax.default_backend() == "tpu" and L.nnz >= 200_000
+                     and L.values.dtype == jnp.float32)
+        if tiled:
+            L = prepare_spmv(L)
     # jit_loop=True compiles the whole solve into one program (best for
     # remote/tunneled devices); the host loop (default) keeps cancellation
     # points and the stagnation early-exit for large zero clusters

@@ -90,6 +90,32 @@ def main() -> int:
           f"{'ok' if 'distributed_pca' not in failures else 'FAIL'}",
           flush=True)
 
+    # --- MNMG spectral across the process boundary: rank-sharded SpMV
+    # (sparse/sharded.py) under the jitted Lanczos loop over all 8
+    # devices spanning both processes — BASELINE config 4 as a
+    # distributed fit (ref: comms.hpp:234 + lanczos.cuh:248) ---
+    from raft_tpu import spectral
+    from raft_tpu.core.sparse_types import COOMatrix
+
+    m = 512
+    rng_g = np.random.default_rng(7)       # same graph on both processes
+    er = rng_g.integers(0, m, 4 * m).astype(np.int32)
+    ec = rng_g.integers(0, m, 4 * m).astype(np.int32)
+    keep = er != ec
+    G = COOMatrix(np.concatenate([er[keep], ec[keep]]),
+                  np.concatenate([ec[keep], er[keep]]),
+                  np.ones(2 * int(keep.sum()), np.float32), (m, m))
+    ev_s, emb_s = spectral.fit_embedding(None, G, 2, mesh=mesh, seed=3,
+                                         jit_loop=True)
+    ev_1, _ = spectral.fit_embedding(None, G, 2, tiled=False, seed=3)
+    jax.block_until_ready(emb_s)
+    if not np.allclose(np.asarray(ev_s), np.asarray(ev_1), rtol=1e-2,
+                       atol=1e-3):
+        failures.append("sharded_spectral")
+    print(f"[rank {rank}] sharded spectral eigvals "
+          f"{'ok' if 'sharded_spectral' not in failures else 'FAIL'}",
+          flush=True)
+
     hc.barrier()
     if failures:
         print(f"[rank {rank}] FAILURES: {failures}", flush=True)
