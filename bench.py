@@ -164,12 +164,27 @@ def main():
     # so machine-readably.
     fused_failed = False
     dt_p3 = None
+    dt_af = None
     try:
         dt = fx.run(lambda q: distance.knn(res, knn_index, q, k=k,
                                            tile=tile), Q)["seconds"]
         if knn_index_p3 is not None:
             dt_p3 = fx.run(lambda q: distance.knn(
                 res, knn_index_p3, q, k=k, tile=tile), Q)["seconds"]
+            # adaptive precision: f32-certified at p1 kernel cost
+            # (certify="f32" widens the certificate by the bf16 error
+            # bound; margin failures pay the exact fixup)
+            try:
+                dt_af = fx.run(lambda q: distance.knn(
+                    res, knn_index, q, k=k, tile=tile,
+                    certify="f32"), Q)["seconds"]
+            except Exception:
+                import traceback
+
+                print("bench: adaptive certify='f32' failed "
+                      "(adaptive_f32_ms will be null):\n"
+                      + traceback.format_exc(), file=sys.stderr)
+                dt_af = None
     except Exception:
         import traceback
 
@@ -198,6 +213,9 @@ def main():
         "p3_ms": round(dt_p3 * 1e3, 2) if dt_p3 else None,
         "p3_gbps": round(p3_gbps, 2) if p3_gbps else None,
         "p3_vs_baseline": round(p3_gbps / baseline_gbps, 4) if p3_gbps
+        else None,
+        "adaptive_f32_ms": round(dt_af * 1e3, 2) if dt_af else None,
+        "adaptive_f32_gbps": round(eff_bytes / dt_af / 1e9, 2) if dt_af
         else None,
         "degraded": degraded,
         "fused_failed": fused_failed,

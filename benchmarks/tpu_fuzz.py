@@ -50,10 +50,15 @@ def main():
         passes = int(rng.choice([1, 3]))
         metric = str(rng.choice(["l2", "ip"]))
         lite = bool(rng.integers(0, 2))
+        # adaptive precision (certify="f32"): p1 + rescore only — a new
+        # CERTIFIED path, so it must be fuzzed on real Mosaic like the
+        # others; its tolerance is the f32-exact one
+        adaptive = passes == 1 and not lite and bool(rng.integers(0, 2))
         g = int(rng.choice([8, 16, 64, 192]))      # up to pbits 11-12
         T = 512 if m < 20000 else 2048
         row = {"Q": Q, "m": m, "d": d, "k": k, "passes": passes,
-               "metric": metric, "lite": lite, "g": g, "T": T}
+               "metric": metric, "lite": lite, "adaptive": adaptive,
+               "g": g, "T": T}
         try:
             y = rng.normal(size=(m, d)).astype(np.float32)
             if i % 3 == 0:
@@ -62,7 +67,8 @@ def main():
                  + 0.3 * rng.normal(size=(Q, d)).astype(np.float32))
             idx = prepare_knn_index(y, passes=passes, metric=metric,
                                     T=T, g=g, store_yp=not lite)
-            vals, ids = knn_fused(x, idx, k)
+            vals, ids = knn_fused(x, idx, k,
+                                  certify="f32" if adaptive else "kernel")
             ids = np.asarray(ids)
             xd = x.astype(np.float64)
             yd = y.astype(np.float64)
@@ -86,7 +92,7 @@ def main():
             # single-pass bf16 envelope for p1
             np_scale = (float(np.sqrt((xd ** 2).sum(1)).max())
                         * float(np.sqrt((yd ** 2).sum(1)).max()) + 1.0)
-            if passes == 3 and not lite:
+            if (passes == 3 or adaptive) and not lite:
                 tol = np_scale * d * 2.0 ** -21
             elif passes == 3:
                 tol = np_scale * (2.0 ** -13 + d * 2.0 ** -19)

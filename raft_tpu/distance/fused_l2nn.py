@@ -205,8 +205,8 @@ def _knn_certified_approx(x, y_padded, m_real, k: int, tile: int):
 
 
 def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
-        tile: Optional[int] = None, algo: str = "auto"
-        ) -> Tuple[jax.Array, jax.Array]:
+        tile: Optional[int] = None, algo: str = "auto",
+        certify: str = "kernel") -> Tuple[jax.Array, jax.Array]:
     """Brute-force k nearest neighbors. Returns (distances [nq, k],
     indices [nq, k]), nearest first.
     (ref: pre-cuVS brute_force::knn = pairwise distance + select_k, fused)
@@ -234,24 +234,30 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
     repeated query batches); the metric must match what the index was
     prepared for ("l2" serves sqeuclidean/euclidean/l2, "ip" serves
     inner_product; prepare on pre-normalized data for cosine).
+
+    ``certify="f32"`` (fused pipeline, passes=1 indexes): adaptive
+    precision — f32-certified results at 1-pass kernel cost (see
+    knn_fused).
     """
     res = ensure_resources(res)
     from raft_tpu.distance.knn_fused import KnnIndex, knn_fused
 
+    expects(certify in ("kernel", "f32"),
+            "knn: certify must be 'kernel' or 'f32', got %r", certify)
     if isinstance(index, KnnIndex):
         queries = jnp.asarray(queries, jnp.float32)
         if metric in ("sqeuclidean", "euclidean", "l2"):
             expects(index.metric == "l2",
                     "knn: index prepared for %r, metric %r needs 'l2'",
                     index.metric, metric)
-            dists, idx = knn_fused(queries, index, k)
+            dists, idx = knn_fused(queries, index, k, certify=certify)
             if metric in ("euclidean", "l2"):
                 dists = jnp.sqrt(jnp.maximum(dists, 0.0))
             return dists, idx
         expects(metric == "inner_product" and index.metric == "ip",
                 "knn: prepared-index metric %r cannot serve %r",
                 index.metric, metric)
-        return knn_fused(queries, index, k)
+        return knn_fused(queries, index, k, certify=certify)
     index = jnp.asarray(index, jnp.float32)
     queries = jnp.asarray(queries, jnp.float32)
     expects(metric in ("sqeuclidean", "euclidean", "l2", "inner_product",
@@ -265,7 +271,8 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
             return a / jnp.maximum(n, 1e-30)
 
         d2, idx = knn(res, _unit(index), _unit(queries), k,
-                      metric="sqeuclidean", tile=tile, algo=algo)
+                      metric="sqeuclidean", tile=tile, algo=algo,
+                      certify=certify)
         return d2 * 0.5, idx
     expects(k <= index.shape[0], "knn: k larger than index size")
     expects(algo in ("auto", "fused", "fused_fast", "streamed"),
@@ -302,7 +309,8 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
             dists, idx = knn_fused(
                 queries, index, k,
                 passes=1 if algo == "fused_fast" else 3,
-                metric="ip" if metric == "inner_product" else "l2")
+                metric="ip" if metric == "inner_product" else "l2",
+                certify=certify)
             if metric in ("euclidean", "l2"):
                 dists = jnp.sqrt(jnp.maximum(dists, 0.0))
             return dists, idx
@@ -310,6 +318,10 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
             if algo != "auto":
                 raise
 
+    expects(certify == "kernel",
+            "knn: certify='f32' is a fused-pipeline contract, but this "
+            "call routed to the streamed sweep (shape/backend outside "
+            "the fused envelope) — it cannot be honored silently")
     if tile is None:
         tile = max(128, min(index.shape[0],
                             res.workspace.allocation_limit

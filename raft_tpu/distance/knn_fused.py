@@ -93,16 +93,34 @@ _Q_CHUNK = 2048
 
 def _err_bound_coeff(d: int) -> float:
     """Analytic upper bound on |d2_kernel − d2_exact| / (‖x‖·‖y‖) for the
-    bf16x3 mode. Components (unit roundoffs: bf16 2⁻⁹, f32 2⁻²⁴):
-      - dropped lo·lo term: Σ|lo(x)||lo(y)| ≤ 2⁻¹⁸·‖x‖‖y‖
-      - bf16 re-rounding of the lo factors: ≤ 2·2⁻¹⁸·‖x‖‖y‖
+    bf16x3 mode. Components (unit roundoffs: bf16 2⁻⁸ — 7 stored
+    mantissa bits, round-to-nearest — and f32 2⁻²⁴):
+      - dropped lo·lo term: Σ|lo(x)||lo(y)| ≤ 2⁻¹⁶·‖x‖‖y‖
+      - bf16 re-rounding of the lo factors (x = hi + lo + δ,
+        |δ| ≤ 2⁻¹⁶|x|): ≤ 2·2⁻¹⁶·‖x‖‖y‖
       - f32 accumulation, textbook bound d·2⁻²⁴·Σ|x·y| per matmul, three
         matmuls: ≤ 3d·2⁻²⁴·‖x‖‖y‖
-      - norm/addition rounding of xx + yy − 2S: ≤ ~2⁻²²·‖x‖‖y‖ scale
-    Doubled for d2 = 2·S_err and doubled again as safety margin; the
-    margin's only cost is fixup rate, but the BOUND ITSELF must hold for
-    the exactness certificate to be sound."""
-    return 2.0 ** -15 + d * 2.0 ** -21
+    S_err ≤ (3·2⁻¹⁶ + 3d·2⁻²⁴)·‖x‖‖y‖; doubled for d2 = 2·S_err and
+    doubled again as safety margin ⇒ ≤ (1.5·2⁻¹³ + 1.5·d·2⁻²¹)·‖x‖‖y‖,
+    rounded UP to a clean power of two. The margin's only cost is fixup
+    rate, but the BOUND ITSELF must hold for the exactness certificate
+    to be sound. (Round 4: the first version assumed bf16 u = 2⁻⁹ and
+    shipped 2⁻¹⁵ — understated ~4× against the adversarial worst case,
+    though ~30× above errors observed on random/clustered data.)"""
+    return 2.0 ** -12 + d * 2.0 ** -20
+
+
+def _err_bound_coeff_p1(d: int) -> float:
+    """|d2_kernel − d2_f32| / (‖x‖·‖y‖) bound for the ONE-pass bf16
+    contraction — the margin behind ``certify="f32"`` at passes=1
+    (adaptive precision: p1 speed, f32-exact certificate, failures
+    re-solved by the exact fixup). Components (bf16 u = 2⁻⁸):
+      - bf16 rounding of both factors: ≤ (2·2⁻⁸ + 2⁻¹⁶)·‖x‖‖y‖
+      - f32 accumulation: ≤ d·2⁻²⁴·‖x‖‖y‖
+    Doubled for d2 = 2·S_err and doubled again as safety margin ⇒
+    ≤ (2⁻⁵ + d·2⁻²²)·‖x‖‖y‖ (same discipline as _err_bound_coeff: a
+    loose margin only raises fixup rate; the bound itself must hold)."""
+    return 2.0 ** -5 + d * 2.0 ** -22
 
 
 def decode_packed_pool(cand_p, pos, S_: int, T: int, g: int,
@@ -171,11 +189,12 @@ def _prepare_ops(y, T: int, g: int, metric: str,
 
 @functools.partial(jax.jit,
                    static_argnames=("k", "T", "Qb", "g", "passes", "metric",
-                                    "m", "rescore", "pbits", "_diag"))
+                                    "m", "rescore", "pbits", "certify",
+                                    "_diag"))
 def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                     k: int, T: int, Qb: int, g: int, passes: int,
                     metric: str, m: int, rescore: bool = True,
-                    pbits: int = _PACK_BITS,
+                    pbits: int = _PACK_BITS, certify: str = "kernel",
                     _diag: bool = False) -> Tuple[jax.Array, ...]:
     """Certified fused KNN on PREPARED operands (see _prepare_ops).
 
@@ -360,9 +379,18 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
     # every point outside its group's kept top-2 is ≥ that group's a3;
     # every pool entry not among the C candidates is ≥ the C-th pool value
     bound = jnp.minimum(a3_min, cand_v_hat[:, C - 1])
-    if passes == 3:
+    if passes == 3 or certify == "f32":
+        # ONE margin construction for both f32-certified modes; only
+        # the coefficient differs. certify="f32" at passes=1 is
+        # ADAPTIVE PRECISION: θ is the exact-f32 k-th candidate value
+        # (rescore mode) and every non-candidate's bf16 kernel score is
+        # ≥ bound, hence its f32 score ≥ bound − E1; bound − E1 ≥ θ
+        # proves the f32 top-k lives inside the exactly-rescored
+        # candidate set, and margin failures pay the exact-f32 fixup.
+        coeff = (_err_bound_coeff(d) if passes == 3
+                 else _err_bound_coeff_p1(d))
         ymax = jnp.sqrt(jnp.max(yy_raw))   # finite norms (padded rows: 0)
-        err = _err_bound_coeff(d) * jnp.sqrt(xx[:, 0]) * ymax + e_pack
+        err = coeff * jnp.sqrt(xx[:, 0]) * ymax + e_pack
     else:
         err = e_pack
     certified = bound >= theta + err                            # [Q] bool
@@ -678,7 +706,7 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
 def knn_fused(x, y, k: int, passes: int = 3,
               T: Optional[int] = None, Qb: Optional[int] = None,
               g: Optional[int] = None, metric: str = "l2",
-              rescore: Optional[bool] = None
+              rescore: Optional[bool] = None, certify: str = "kernel"
               ) -> Tuple[jax.Array, jax.Array]:
     """Certified fused brute-force KNN.
 
@@ -704,6 +732,13 @@ def knn_fused(x, y, k: int, passes: int = 3,
     number of consecutive index tiles folded into one certificate
     group inside the kernel (tpg), so the candidate pool holds
     ``2 · ceil(n_tiles/g) · 128`` entries.
+
+    ``certify="f32"`` (ADAPTIVE PRECISION, passes=1 + rescore only):
+    p1 kernel cost with the p3 guarantee — the certificate margin is
+    widened by the one-pass bf16 error bound (_err_bound_coeff_p1), so
+    certified queries are provably exact w.r.t. f32 scores and only
+    margin failures pay the exact-f32 fixup. At passes=3 it is a no-op
+    (p3 is already f32-certified).
     """
     idx: Optional[KnnIndex] = y if isinstance(y, KnnIndex) else None
     if idx is not None:
@@ -713,6 +748,17 @@ def knn_fused(x, y, k: int, passes: int = 3,
     if metric not in ("l2", "ip"):
         raise ValueError(f"knn_fused: metric must be 'l2' or 'ip', "
                          f"got {metric!r}")
+    if certify not in ("kernel", "f32"):
+        raise ValueError(f"knn_fused: certify must be 'kernel' or "
+                         f"'f32', got {certify!r}")
+    if certify == "f32" and rescore is False:
+        raise ValueError("knn_fused: certify='f32' needs the exact "
+                         "rescore (θ must be an f32 value) — a lite "
+                         "index cannot carry the f32 certificate")
+    if passes == 3:
+        certify = "kernel"   # p3 is already f32-certified — normalize
+        #                      so the static arg doesn't fork the jit
+        #                      cache with an identical program
     x = jnp.asarray(x, jnp.float32)
     Q, d_x = x.shape
     if idx is None:
@@ -751,7 +797,8 @@ def knn_fused(x, y, k: int, passes: int = 3,
         if idx is None:
             idx = prepare_knn_index(y, passes=passes, metric=metric,
                                     T=T, Qb=Qb, g=g)
-        outs = [knn_fused(x[s:s + _Q_CHUNK], idx, k, rescore=rescore)
+        outs = [knn_fused(x[s:s + _Q_CHUNK], idx, k, rescore=rescore,
+                          certify=certify)
                 for s in range(0, Q, _Q_CHUNK)]
         return (jnp.concatenate([o[0] for o in outs]),
                 jnp.concatenate([o[1] for o in outs]))
@@ -770,10 +817,13 @@ def knn_fused(x, y, k: int, passes: int = 3,
         x = jnp.concatenate([x, jnp.zeros((qpad, x.shape[1]), x.dtype)])
     if rescore is None:
         rescore = idx.yp is not None
+    if certify == "f32" and not rescore:
+        raise ValueError("knn_fused: certify='f32' needs a yp-storing "
+                         "index (store_yp=True) for the exact rescore")
     vals, ids = _knn_fused_core(
         x, idx.yp, idx.y_hi, idx.y_lo, idx.yyh_k, idx.yy_raw,
         k=k, T=T, Qb=Qb, g=g, passes=passes, metric=metric, m=m,
-        rescore=rescore, pbits=idx.pbits)
+        rescore=rescore, pbits=idx.pbits, certify=certify)
     if metric == "ip":
         return -vals[:Q], ids[:Q]   # internal −x·y ascending → IP desc
     return vals[:Q], ids[:Q]

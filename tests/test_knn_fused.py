@@ -72,6 +72,48 @@ def test_fast_mode_recall():
     assert recall >= 0.99
 
 
+@pytest.mark.parametrize("Q,m,d,k", [
+    (64, 5000, 32, 8),
+    (100, 3000, 130, 16),
+    (8, 2048, 128, 64),
+])
+def test_adaptive_precision_f32_certified(Q, m, d, k):
+    # certify="f32" at passes=1: the f32-widened certificate + exact
+    # fixup must deliver the SAME guarantee as passes=3 (exact w.r.t.
+    # f32 scores, verified against the f64 oracle)
+    x = rng.normal(size=(Q, d)).astype(np.float32)
+    y = rng.normal(size=(m, d)).astype(np.float32)
+    vals, ids = knn_fused(x, y, k=k, passes=1, T=512, Qb=64, g=8,
+                          certify="f32")
+    ref_vals, ref_ids, tol = _oracle(x, y, k)
+    np.testing.assert_allclose(np.asarray(vals), ref_vals, atol=tol)
+    assert np.array_equal(np.sort(np.asarray(ids), 1), np.sort(ref_ids, 1))
+
+
+def test_adaptive_precision_clustered():
+    # clustered near-duplicates: bf16 ranking genuinely diverges from
+    # f32 — the adaptive margin must catch those queries and fix them up
+    Q, m, d, k = 128, 4096, 64, 16
+    base = rng.normal(size=(40, d)).astype(np.float32)
+    y = base[rng.integers(0, 40, m)] + 1e-3 * rng.normal(
+        size=(m, d)).astype(np.float32)
+    x = base[rng.integers(0, 40, Q)] + 1e-3 * rng.normal(
+        size=(Q, d)).astype(np.float32)
+    vals, ids = knn_fused(x, y, k=k, passes=1, T=512, Qb=64, g=8,
+                          certify="f32")
+    ref_vals, _, tol = _oracle(x, y, k)
+    np.testing.assert_allclose(np.asarray(vals), ref_vals, atol=tol)
+
+
+def test_adaptive_rejects_lite():
+    x = rng.normal(size=(8, 32)).astype(np.float32)
+    y = rng.normal(size=(512, 32)).astype(np.float32)
+    with pytest.raises(ValueError, match="certify"):
+        knn_fused(x, y, k=4, passes=1, rescore=False, certify="f32")
+    with pytest.raises(ValueError, match="certify"):
+        knn_fused(x, y, k=4, certify="bogus")
+
+
 def test_query_chunking_matches_single_shot(monkeypatch):
     import raft_tpu.distance.knn_fused as kf
 
