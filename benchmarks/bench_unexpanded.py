@@ -16,8 +16,9 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _common import gate  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from benchmarks._common import gate  # noqa: E402
 
 OUT = os.path.join(os.path.dirname(__file__), os.pardir,
                    "BENCH_UNEXPANDED.json")

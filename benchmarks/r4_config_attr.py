@@ -1,0 +1,115 @@
+"""Round-4 attribution for BASELINE configs 1 and 3 (VERDICT r3 item 7).
+
+Config 1 (pairwise L2 5k×50, 1.02 ms): dispatch vs compute — the
+jitted program's device time vs the public eager call's end-to-end
+time (the delta is transport/dispatch, irreducible per-call cost on
+the tunneled device).
+
+Config 3 (dense-gram Lanczos 76 ms, rsvd 8 ms): per-piece floors —
+the XLA eigh on the same operator (the direct-solve floor), one jitted
+restart cycle, and the pieces of a cycle (matvec, orthogonalization,
+small eigh) — so 76 ms is attributable instead of bare.
+
+Writes R4_CONFIG_ATTR.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from benchmarks._common import gate  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), os.pardir,
+                   "R4_CONFIG_ATTR.json")
+
+
+def main():
+    dry, skip = gate()
+    if skip:
+        print(json.dumps({"skipped": skip}))
+        return
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import raft_tpu
+    from raft_tpu import distance
+    from raft_tpu.benchmark import Fixture
+    from raft_tpu.random import RngState, make_blobs
+
+    res = raft_tpu.device_resources()
+    fx = Fixture(res=res, reps=5 if not dry else 1)
+    results = {"platform": res.platform, "unit": "ms",
+               "representative": not dry}
+
+    # ---- config 1 ----
+    n1 = 5000 if not dry else 500
+    X1, _ = make_blobs(res, RngState(0), n1, 50, n_clusters=8)
+    Q1 = X1[:1000]
+    jax.block_until_ready(X1)
+    r = fx.run(lambda a: distance.pairwise_distance(res, a, Q1), X1)
+    results["c1_public_ms"] = round(r["seconds"] * 1e3, 3)
+    # the same computation as one pre-jitted program (compute floor)
+    from raft_tpu.distance.pairwise import _expanded_l2
+
+    jf = jax.jit(lambda a, b: _expanded_l2(a, b, sqrt=False))
+    _ = jf(X1, Q1)  # warm
+    r = fx.run(jf, X1, Q1)
+    results["c1_jitted_ms"] = round(r["seconds"] * 1e3, 3)
+    results["c1_dispatch_delta_ms"] = round(
+        results["c1_public_ms"] - results["c1_jitted_ms"], 3)
+
+    # ---- config 3: dense-gram Lanczos attribution ----
+    from raft_tpu.sparse.solver.lanczos import (_restart_cycle_impl,
+                                                lanczos_compute_eigenpairs)
+    from raft_tpu.sparse.solver.lanczos_types import LanczosSolverConfig
+
+    n3, d3 = (100_000, 256) if not dry else (2000, 64)
+    X3, _ = make_blobs(res, RngState(2), n3, 1000 if not dry else 100,
+                       n_clusters=16)
+    G = (X3[:, :d3].T @ X3[:, :d3]) / n3
+    jax.block_until_ready(G)
+    ncv = 32
+
+    cfg = LanczosSolverConfig(n_components=8, max_iterations=300,
+                              ncv=ncv, tolerance=1e-6, seed=0,
+                              jit_loop=True)
+    r = fx.run(lambda g: lanczos_compute_eigenpairs(res, g, cfg)[0], G)
+    results["c3_lanczos_e2e_ms"] = round(r["seconds"] * 1e3, 3)
+
+    # direct eigh floor on the same operator
+    r = fx.run(lambda g: jnp.linalg.eigh(g)[0], G)
+    results["c3_eigh_direct_ms"] = round(r["seconds"] * 1e3, 3)
+
+    # one restart cycle (the jitted building block)
+    V = jnp.zeros((ncv + 1, G.shape[0]), G.dtype).at[0].set(
+        jnp.ones((G.shape[0],), G.dtype) / np.sqrt(G.shape[0]))
+    T0 = jnp.zeros((ncv, ncv), G.dtype)
+    cyc = jax.jit(lambda g, v, t: _restart_cycle_impl(g, v, t, 0, ncv)[0])
+    _ = cyc(G, V, T0)
+    r = fx.run(cyc, G, V, T0)
+    results["c3_one_cycle_ms"] = round(r["seconds"] * 1e3, 3)
+
+    # pieces of a cycle
+    v0 = V[0]
+    r = fx.run(jax.jit(lambda g, v: g @ v), G, v0)
+    results["c3_matvec_ms"] = round(r["seconds"] * 1e3, 3)
+    r = fx.run(jax.jit(lambda V, w: V - V * jnp.vdot(w, w)), V, v0)
+    results["c3_ortho_proxy_ms"] = round(r["seconds"] * 1e3, 3)
+    r = fx.run(jax.jit(lambda t: jnp.linalg.eigh(t)[0]), T0 + jnp.eye(ncv))
+    results["c3_small_eigh_ms"] = round(r["seconds"] * 1e3, 3)
+
+    results["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())
+    if not dry:
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+            f.write("\n")
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Round-4 f64 measurement (VERDICT r3 item 8): settle the device-f64
+question with numbers.
+
+The reference instantiates <float, double> device kernels throughout
+(cpp/CMakeLists.txt:275-309; 4 Lanczos type combos under
+cpp/src/raft_runtime/solver/). TPUs have no f64 ALUs — XLA:TPU either
+emulates f64 (slow) or rejects it — so the honest options are:
+  (a) f32 on TPU + f64 CPU oracle error measurement,
+  (b) emulated f64 ON the TPU (JAX_ENABLE_X64 subprocess),
+  (c) f64 on CPU (the committed lane today).
+This measures cost + accuracy of each on the BASELINE config-3 operator
+(gram of 100k×1k) and a Lanczos solve, writes R4_F64_LANE.json; the
+README dtype-policy paragraph cites it.
+
+The x64 runs happen in SUBPROCESSES (JAX_ENABLE_X64 is process-global).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from benchmarks._common import gate  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), os.pardir,
+                   "R4_F64_LANE.json")
+
+_X64_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_enable_x64", True)
+if os.environ.get("F64_PLATFORM") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+n = int(os.environ["F64_N"])
+rng = np.random.default_rng(0)
+A = rng.standard_normal((n, n))
+G64 = (A + A.T) / 2.0
+g = jnp.asarray(G64, jnp.float64)
+try:
+    f = jax.jit(lambda m: jnp.linalg.eigh(m)[0])
+    w = np.asarray(f(g))          # warm/compile
+    t0 = time.monotonic()
+    w = np.asarray(f(g))
+    dt = time.monotonic() - t0
+    ref = np.linalg.eigvalsh(G64)
+    print(json.dumps({"ok": True, "seconds": dt,
+                      "dtype": str(np.asarray(w).dtype),
+                      "max_err": float(np.abs(np.sort(w) - ref).max())}))
+except Exception as e:
+    print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}))
+"""
+
+
+def _run_x64(platform: str, n: int, timeout_s: int = 900):
+    env = dict(os.environ)
+    env["F64_PLATFORM"] = platform
+    env["F64_N"] = str(n)
+    try:
+        r = subprocess.run([sys.executable, "-c", _X64_CHILD], env=env,
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+        return json.loads(line) if line.startswith("{") else {
+            "ok": False, "error": (r.stderr or "no output")[-300:]}
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"timeout {timeout_s}s"}
+    except Exception as e:  # noqa: BLE001
+        return {"ok": False, "error": str(e)[:300]}
+
+
+def main():
+    dry, skip = gate()
+    if skip:
+        print(json.dumps({"skipped": skip}))
+        return
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import raft_tpu
+    from raft_tpu.benchmark import Fixture
+
+    res = raft_tpu.device_resources()
+    fx = Fixture(res=res, reps=3 if not dry else 1)
+    results = {"platform": res.platform, "representative": not dry}
+    n = 1000 if not dry else 128
+
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((n, n))
+    G64 = (A + A.T) / 2.0
+    ref = np.linalg.eigvalsh(G64)
+
+    # (a) f32 on the accelerator
+    g32 = jnp.asarray(G64, jnp.float32)
+    f32 = jax.jit(lambda m: jnp.linalg.eigh(m)[0])
+    w32 = np.asarray(f32(g32))
+    r = fx.run(f32, g32)
+    results["eigh_f32_device"] = {
+        "seconds": round(r["seconds"], 4),
+        "max_err_vs_f64": float(np.abs(np.sort(w32) - ref).max()),
+        "rel_err": float(np.abs(np.sort(w32) - ref).max()
+                         / max(np.abs(ref).max(), 1e-30))}
+
+    # (b) emulated f64 ON the device (subprocess; may be rejected).
+    # In dry/CPU-forced mode the child must not touch the accelerator
+    # backend (a wedged tunnel would hang its init until the timeout)
+    results["eigh_f64_device"] = _run_x64("cpu" if dry else "device", n)
+
+    # (c) f64 on CPU (the committed lane)
+    results["eigh_f64_cpu"] = _run_x64("cpu", n)
+
+    # Lanczos accuracy: f32 solve vs the f64 oracle's top eigenvalues
+    from raft_tpu.sparse.solver.lanczos import lanczos_compute_eigenpairs
+    from raft_tpu.sparse.solver.lanczos_types import (LANCZOS_WHICH,
+                                                      LanczosSolverConfig)
+
+    cfg = LanczosSolverConfig(n_components=6, max_iterations=500,
+                              ncv=40, tolerance=1e-9,
+                              which=LANCZOS_WHICH.LA, seed=0,
+                              jit_loop=True)
+    w_l, _ = lanczos_compute_eigenpairs(res, g32, cfg)
+    r = fx.run(lambda g: lanczos_compute_eigenpairs(res, g, cfg)[0], g32)
+    top = np.sort(ref)[-6:]
+    results["lanczos_f32_device"] = {
+        "seconds": round(r["seconds"], 4),
+        "max_err_vs_f64": float(np.abs(np.sort(np.asarray(w_l)) - top).max()),
+        "rel_err": float(np.abs(np.sort(np.asarray(w_l)) - top).max()
+                         / max(np.abs(top).max(), 1e-30))}
+
+    results["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())
+    if not dry:
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+            f.write("\n")
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Round-4 pool-selection A/B: the [Q, S_] → Ca twin-pool top_k.
+
+VERDICT r3 item 1: ~4.5 ms of the driver e2e (19.3 ms p1) is the
+selection stack, led by the XLA top_k over the a1 pool [2048, ~3968]
+→ 96 — 100× its 40 µs HBM floor. This measures every available
+selection algorithm ON THE SHAPE THE PIPELINE USES, standalone AND
+in-composite (XLA's in-composite TopK measured 2.5× superlinear in
+width and oddly slow on narrow-many-row shapes; standalone numbers
+mislead — round 3).
+
+Writes R4_POOL_SELECT.json; the winner informs knn_fused's pool stage.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from benchmarks._common import gate  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), os.pardir,
+                   "R4_POOL_SELECT.json")
+
+
+def main():
+    dry, skip = gate()
+    if skip:
+        print(json.dumps({"skipped": skip}))
+        return
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import raft_tpu
+    from raft_tpu.benchmark import Fixture
+    from raft_tpu.matrix.select_k_chunked import select_k_chunked
+    from raft_tpu.matrix.select_k_slotted import select_k_slotted
+
+    res = raft_tpu.device_resources()
+    fx = Fixture(res=res, reps=3 if not dry else 1)
+    results = {"platform": res.platform, "unit": "ms",
+               "representative": not dry}
+
+    # the production pool shapes: (T=2048, g=16) → S_=3968 at 1M;
+    # (T=4096, g=8) → S_=3968; plus the 10M shape S_=2560 (T=4096,g=8,
+    # 2442 tiles → ceil(2442/8)·128 = 39168? recompute at runtime) —
+    # sweep the representative family
+    rng = np.random.default_rng(0)
+    shapes = ([(2048, 3968, 96), (2048, 2560, 96), (2048, 7936, 96),
+               (2048, 3968, 48)] if not dry else [(64, 512, 16)])
+    for (B, S, Ca) in shapes:
+        key = f"{B}x{S}_k{Ca}"
+        a1 = jnp.asarray(rng.standard_normal((B, S)).astype(np.float32))
+        jax.block_until_ready(a1)
+
+        # (a) XLA top_k standalone
+        t = fx.run(lambda a: jax.lax.top_k(-a, Ca), a1)["seconds"]
+        results[f"{key}.xla_standalone"] = round(t * 1e3, 3)
+
+        # (b) XLA top_k in-composite (preceded by a big producer the
+        # scheduler can fuse around — approximates the pipeline context)
+        @jax.jit
+        def composite_xla(a):
+            prod = a * 1.0000001 + 0.5       # stand-in producer
+            nv, pos = jax.lax.top_k(-prod, Ca)
+            return -nv, pos
+
+        t = fx.run(composite_xla, a1)["seconds"]
+        results[f"{key}.xla_incomposite"] = round(t * 1e3, 3)
+
+        # (c) slotted (short-row XLA fold at this L)
+        try:
+            idx = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None],
+                                   (B, S))
+            t = fx.run(lambda a: select_k_slotted(a, idx, Ca, True),
+                       a1)["seconds"]
+            results[f"{key}.slotted"] = round(t * 1e3, 3)
+        except Exception as e:  # noqa: BLE001
+            results[f"{key}.slotted"] = f"err: {e}"[:120]
+
+        # (d) chunked
+        try:
+            idx = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None],
+                                   (B, S))
+            t = fx.run(lambda a: select_k_chunked(a, idx, Ca, True),
+                       a1)["seconds"]
+            results[f"{key}.chunked"] = round(t * 1e3, 3)
+        except Exception as e:  # noqa: BLE001
+            results[f"{key}.chunked"] = f"err: {e}"[:120]
+
+        # (e) approx_min_k (hardware aggregate top-k; INEXACT — only to
+        # see the hardware selection floor on this shape)
+        t = fx.run(lambda a: jax.lax.approx_min_k(a, Ca), a1)["seconds"]
+        results[f"{key}.approx_floor"] = round(t * 1e3, 3)
+
+        # (f) two-stage: per-half top_k then merge (narrowness probe)
+        @jax.jit
+        def two_stage(a):
+            h = a.reshape(B, 2, S // 2)
+            nv, pos = jax.lax.top_k(-h.reshape(B * 2, S // 2), Ca)
+            cand = (-nv).reshape(B, 2 * Ca)
+            nv2, p2 = jax.lax.top_k(-cand, Ca)
+            return -nv2, p2
+
+        if S % 2 == 0:
+            t = fx.run(two_stage, a1)["seconds"]
+            results[f"{key}.two_stage"] = round(t * 1e3, 3)
+
+        print(json.dumps({k: v for k, v in results.items()
+                          if k.startswith(key)}), flush=True)
+
+    results["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())
+    if not dry:
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+            f.write("\n")
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""Round-4 stage attribution of tile_csr_device (VERDICT r3 item 6).
+
+Config 4's warm device tile conversion is 0.89 s at 2M nnz — now the
+pipeline's bottleneck (solve ≈ 0.6 s). This measures PREFIXES of the
+conversion's stage graph as separate jitted programs so the deltas
+attribute the time: the 3-key lexsort, the bucket/segment sizing pass,
+the [NG] value/col scatters, the scatter-stream argsort, and the full
+core. Measurement-only mirror of _tile_csr_device_core's stages (the
+production core stays one program).
+
+Writes R4_TILE_PROFILE.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from benchmarks._common import gate  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), os.pardir,
+                   "R4_TILE_PROFILE.json")
+
+
+def main():
+    dry, skip = gate()
+    if skip:
+        print(json.dumps({"skipped": skip}))
+        return
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import raft_tpu
+    from raft_tpu.benchmark import Fixture
+    from raft_tpu.core.sparse_types import COOMatrix
+    from raft_tpu.sparse.tiled import tile_csr_device
+
+    res = raft_tpu.device_resources()
+    fx = Fixture(res=res, reps=3 if not dry else 1)
+    results = {"platform": res.platform, "unit": "ms",
+               "representative": not dry}
+
+    # the config-4 graph scale: 1M edges symmetrized ≈ 2M nnz, n=262k
+    n = (1 << 18) if not dry else (1 << 10)
+    nnz = 2_000_000 if not dry else 8_000
+    rng = np.random.default_rng(0)
+    rows = jnp.asarray(rng.integers(0, n, nnz).astype(np.int32))
+    cols = jnp.asarray(rng.integers(0, n, nnz).astype(np.int32))
+    vals = jnp.asarray(rng.random(nnz).astype(np.float32))
+    C, R, E = 512, 256, 2048
+    n_ct = -(-n // C)
+    n_rt = -(-n // R)
+    jax.block_until_ready(vals)
+
+    @jax.jit
+    def s1_lexsort(rows, cols):
+        ct = cols // C
+        rt = rows // R
+        bucket = ct * n_rt + rt
+        return jnp.lexsort((rows, cols, bucket))
+
+    @jax.jit
+    def s2_sizing(rows, cols):
+        ct = cols // C
+        rt = rows // R
+        bucket = ct * n_rt + rt
+        order_g = jnp.lexsort((rows, cols, bucket))
+        bsorted = bucket[order_g]
+        first = jnp.concatenate([jnp.ones((1,), bool),
+                                 bsorted[1:] != bsorted[:-1]])
+        bidx = jnp.cumsum(first.astype(jnp.int32)) - 1
+        nb = bidx[-1] + 1
+        barange = jnp.arange(nnz, dtype=jnp.int32)
+        bvalid = barange < nb
+        counts = jax.ops.segment_sum(jnp.ones((nnz,), jnp.int32), bidx,
+                                     num_segments=nnz)
+        bstart = jax.ops.segment_min(barange, bidx, num_segments=nnz)
+        padded = (counts + 7) // 8 * 8
+        b_off8 = jnp.cumsum(padded) - padded
+        within = barange - bstart[bidx]
+        g_slot8 = b_off8[bidx] + within
+        ub = jax.ops.segment_max(bsorted, bidx, num_segments=nnz)
+        ub_ct = jnp.where(bvalid, ub // n_rt, n_ct - 1)
+        ct_sizes8 = jax.ops.segment_sum(jnp.where(bvalid, padded, 0),
+                                        ub_ct, num_segments=n_ct)
+        grp_padded = -(-ct_sizes8 // E) * E
+        return jnp.sum(grp_padded), g_slot8
+
+    @jax.jit
+    def s3_scatters(rows, cols, vals):
+        # sizing + the two [NG] scatters (bounds mirror tiled.py r4)
+        n_gather_, g_slot8 = s2_sizing(rows, cols)
+        nb_max = min(nnz, n_ct * n_rt)
+        occ_ct = min(n_ct, nnz)
+        NG = (-(-(nnz + 7 * nb_max + (E - 8) * occ_ct) // E)) * E
+        elem_final = jnp.minimum(g_slot8, NG - 1)   # proxy indexing
+        pv = jnp.zeros((NG,), vals.dtype).at[elem_final].set(vals)
+        pc = jnp.zeros((NG,), jnp.int32).at[elem_final].set(
+            (cols % C).astype(jnp.int32))
+        return pv[0] + pc[0].astype(jnp.float32)
+
+    t1 = fx.run(s1_lexsort, rows, cols)["seconds"]
+    results["s1_lexsort_ms"] = round(t1 * 1e3, 2)
+    t2 = fx.run(s2_sizing, rows, cols)["seconds"]
+    results["s2_sizing_ms"] = round(t2 * 1e3, 2)
+    results["s2_delta_ms"] = round((t2 - t1) * 1e3, 2)
+    t3 = fx.run(s3_scatters, rows, cols, vals)["seconds"]
+    results["s3_scatters_ms"] = round(t3 * 1e3, 2)
+    results["s3_delta_ms"] = round((t3 - t2) * 1e3, 2)
+
+    t_full = fx.run(lambda r, c, v: tile_csr_device(
+        COOMatrix(r, c, v, (n, n)), C=C, R=R, E=E).vals,
+        rows, cols, vals)["seconds"]
+    results["full_conversion_ms"] = round(t_full * 1e3, 2)
+    results["tail_delta_ms"] = round((t_full - t3) * 1e3, 2)
+
+    results["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())
+    if not dry:
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+            f.write("\n")
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-4 TPU measurement battery — run the moment the tunnel is healthy.
+# Each stage is independently probe-guarded and writes its own artifact,
+# so a mid-battery wedge loses only the remaining stages.
+#
+#   bash benchmarks/tpu_measure_r4.sh
+#
+# Order: the driver metric first (refreshes BENCH_LAST_GOOD.json — the
+# outage cache), then correctness (fuzz incl. the new adaptive mode),
+# then the round-4 attribution/A-B harnesses, the new at-scale benches,
+# and the long sweeps last so a wedge costs the least. Timeouts are
+# last-resort only (killing python mid-TPU-execution wedges the tunnel
+# — measured twice); scripts enforce internal deadlines.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== bench.py (driver metric + adaptive; refreshes last-good) ==="
+timeout 3600 python bench.py | tee BENCH_LOCAL.json || echo "bench rc=$?"
+
+echo "=== tpu fuzz (certified paths incl. adaptive certify=f32) ==="
+timeout 3600 python benchmarks/tpu_fuzz.py || echo "fuzz rc=$?"
+
+echo "=== r4 pool-selection A/B (THE driver-gap lever) ==="
+timeout 3600 python benchmarks/r4_pool_select.py || echo "pool rc=$?"
+
+echo "=== fused-pipeline stage profile (r4 baseline attribution) ==="
+timeout 3600 python benchmarks/profile_fused.py || echo "profile rc=$?"
+
+echo "=== unexpanded-metric kernel at scale ==="
+timeout 3600 python benchmarks/bench_unexpanded.py || echo "unexp rc=$?"
+
+echo "=== tile-conversion stage attribution (config 4) ==="
+timeout 3600 python benchmarks/r4_tile_profile.py || echo "tile rc=$?"
+
+echo "=== config 1/3 attribution ==="
+timeout 3600 python benchmarks/r4_config_attr.py || echo "attr rc=$?"
+
+echo "=== f64 lane measurement ==="
+timeout 3600 python benchmarks/r4_f64_lane.py || echo "f64 rc=$?"
+
+echo "=== MST/LAP at reference scale ==="
+timeout 7200 python benchmarks/bench_solvers_scale.py || echo "solvers rc=$?"
+
+echo "=== BASELINE config benchmarks (refresh) ==="
+timeout 7200 python benchmarks/bench_configs.py || echo "configs rc=$?"
+
+echo "=== select_k matrix (long; internal budget; now with 10M rows) ==="
+timeout 7200 python benchmarks/select_k_matrix.py || echo "matrix rc=$?"
