@@ -88,9 +88,11 @@ def main():
 
     results["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                          time.gmtime())
-    with open(OUT, "w") as f:
-        json.dump(results, f, indent=1)
-        f.write("\n")
+    if not dry:
+        # CPU-forced timings must never masquerade as chip numbers
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+            f.write("\n")
     print(json.dumps(results))
 
 
