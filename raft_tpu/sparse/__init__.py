@@ -9,12 +9,12 @@ from raft_tpu.sparse import op
 from raft_tpu.sparse import solver
 from raft_tpu.sparse.linalg import prepare_sddmm, prepare_spmv
 from raft_tpu.sparse.sharded import (ShardedTiledELL, shard_spmv_operand,
-                                     spmv_sharded)
+                                     spmm_sharded, spmv_sharded)
 from raft_tpu.sparse.tiled import TiledELL, TiledPairs, TiledPairsSpmv
 
 __all__ = [
     "COOMatrix", "COOStructure", "CSRMatrix", "CSRStructure", "TiledELL", "TiledPairsSpmv",
     "TiledPairs", "ShardedTiledELL", "convert", "linalg", "matrix", "op",
     "prepare_sddmm", "prepare_spmv", "shard_spmv_operand", "solver",
-    "spmv_sharded",
+    "spmm_sharded", "spmv_sharded",
 ]

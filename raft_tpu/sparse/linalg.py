@@ -106,6 +106,7 @@ def spmm(res, A, B, alpha=1.0, beta=0.0, C=None) -> jax.Array:
     ops.spmv_pallas.spmm_tiled). The tiled perf path computes in f32 —
     the kernel/layout dtype — so f64 operands should stay on the
     COO/CSR path (see the README dtype policy)."""
+    from raft_tpu.sparse.sharded import ShardedTiledELL, spmm_sharded
     from raft_tpu.sparse.tiled import TiledELL, TiledPairsSpmv
 
     B = jnp.asarray(B)
@@ -113,7 +114,9 @@ def spmm(res, A, B, alpha=1.0, beta=0.0, C=None) -> jax.Array:
         raise TypeError(
             "spmm: got a pair-tiled SpMV operand; prepare with "
             "prepare_spmv(A, layout='ell') for multi-vector products")
-    if isinstance(A, TiledELL):
+    if isinstance(A, ShardedTiledELL):
+        out = alpha * spmm_sharded(A, B)   # epilogue shared below
+    elif isinstance(A, TiledELL):
         from raft_tpu.ops.spmv_pallas import spmm_tiled
 
         out = alpha * spmm_tiled(A, B)

@@ -178,6 +178,32 @@ def shard_spmv_operand(A, mesh: Mesh, axis: str = "x",
         axis=axis, mesh=mesh, **leaves)
 
 
+def _shard_map_blocks(S: ShardedTiledELL, per_block_fn, operand):
+    """ONE copy of the shard_map plumbing shared by spmv/spmm: rebuild
+    the shard-local TiledELL from the stacked leaves and apply
+    ``per_block_fn(tiled, operand) -> [1, ...]`` per mesh device; block
+    outputs concatenate on the sharded axis."""
+
+    def local(vals, cl, cct, pr, rl, crt, vis, op):
+        t = TiledELL(
+            shape=(S.rb, S.shape[1]), C=S.C, R=S.R, E=S.E,
+            vals=vals[0], col_local=cl[0], chunk_col_tile=cct[0],
+            perm=None, perm_rows=pr[0], row_local=rl[0],
+            chunk_row_tile=crt[0], visited_row_tiles=vis[0],
+            n_col_tiles=S.n_col_tiles, n_row_tiles=S.n_row_tiles)
+        return per_block_fn(t, op)
+
+    a = S.axis
+    return jax.shard_map(
+        local, mesh=S.mesh,
+        in_specs=(P(a), P(a), P(a), P(a), P(a), P(a), P(a), P()),
+        # check_vma can't see through pallas_call's ShapeDtypeStruct
+        # outputs; the body is per-shard-pure so the check adds nothing
+        out_specs=P(a), check_vma=False)(
+            S.vals, S.col_local, S.chunk_col_tile, S.perm_rows,
+            S.row_local, S.chunk_row_tile, S.visited_row_tiles, operand)
+
+
 def spmv_sharded(S: ShardedTiledELL, x) -> jax.Array:
     """y = A @ x for a :class:`ShardedTiledELL`: each mesh device runs
     the single-device tiled SpMV on its row block (replicated x), and
@@ -186,23 +212,19 @@ def spmv_sharded(S: ShardedTiledELL, x) -> jax.Array:
     from raft_tpu.ops.spmv_pallas import spmv_tiled
 
     x = jnp.asarray(x, jnp.float32)
-
-    def local(vals, cl, cct, pr, rl, crt, vis, xr):
-        t = TiledELL(
-            shape=(S.rb, S.shape[1]), C=S.C, R=S.R, E=S.E,
-            vals=vals[0], col_local=cl[0], chunk_col_tile=cct[0],
-            perm=None, perm_rows=pr[0], row_local=rl[0],
-            chunk_row_tile=crt[0], visited_row_tiles=vis[0],
-            n_col_tiles=S.n_col_tiles, n_row_tiles=S.n_row_tiles)
-        return spmv_tiled(t, xr)[None, :]          # [1, rb]
-
-    a = S.axis
-    y = jax.shard_map(
-        local, mesh=S.mesh,
-        in_specs=(P(a), P(a), P(a), P(a), P(a), P(a), P(a), P()),
-        # check_vma can't see through pallas_call's ShapeDtypeStruct
-        # outputs; the body is per-shard-pure so the check adds nothing
-        out_specs=P(a), check_vma=False)(
-            S.vals, S.col_local, S.chunk_col_tile, S.perm_rows,
-            S.row_local, S.chunk_row_tile, S.visited_row_tiles, x)
+    y = _shard_map_blocks(S, lambda t, xr: spmv_tiled(t, xr)[None, :], x)
     return y.reshape(-1)[:S.shape[0]]
+
+
+def spmm_sharded(S: ShardedTiledELL, B) -> jax.Array:
+    """C = A @ B for a :class:`ShardedTiledELL` and dense replicated
+    ``B`` [n_cols, kB] — the multi-vector building block (the sparse
+    solvers themselves still take single-device operands; wire-up of
+    randomized_svds/spmm-based solvers over the mesh goes through
+    ``sparse.linalg.spmm`` dispatch). Each shard runs the single-device
+    spmm_tiled on its row block; blocks concatenate on the axis."""
+    from raft_tpu.ops.spmv_pallas import spmm_tiled
+
+    B = jnp.asarray(B, jnp.float32)
+    C = _shard_map_blocks(S, lambda t, Br: spmm_tiled(t, Br)[None], B)
+    return C.reshape(-1, B.shape[1])[:S.shape[0]]

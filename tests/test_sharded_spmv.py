@@ -146,6 +146,25 @@ def test_sharded_fit_embedding_matches_single_device():
     assert emb_s.shape == (m, 4)
 
 
+def test_sharded_spmm_matches_dense():
+    from raft_tpu.sparse import linalg
+    from raft_tpu.sparse.sharded import spmm_sharded
+
+    rng = np.random.default_rng(8)
+    A, (r, c, v) = _random_coo(rng, 1500, 1200, 6000)
+    S = shard_spmv_operand(A, make_mesh())
+    B = rng.standard_normal((1200, 5)).astype(np.float32)
+    out = np.asarray(spmm_sharded(S, B))
+    ref = np.zeros((1500, 5), np.float32)
+    np.add.at(ref, r, v[:, None] * B[c])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    # via the public dispatch, with alpha/beta
+    C0 = rng.standard_normal((1500, 5)).astype(np.float32)
+    out2 = np.asarray(linalg.spmm(None, S, B, alpha=2.0, beta=0.5, C=C0))
+    np.testing.assert_allclose(out2, 2.0 * ref + 0.5 * C0, rtol=1e-4,
+                               atol=1e-4)
+
+
 def test_sharded_operand_rejects_missing_axis():
     A, _ = _random_coo(np.random.default_rng(6), 100, 100, 50)
     mesh = make_mesh()
