@@ -33,10 +33,13 @@ Pipeline (all one jit program):
    The bound needs NO second distance pass — it falls out of the fold.
 4. Queries that fail the certificate (THREE true neighbors sharing a
    (lane, group): ~k³/6S'² per query — single digits per 2048 queries
-   at production scale) are re-solved exactly and scattered back:
-   tiered static batches (16, then 128) that materialize an [F, M]
-   distance tile and take one top_k; a full streamed fallback covers
-   pathological batches (cond).
+   at production scale; certify="f32"'s wider margin can fail
+   hundreds) are re-solved exactly and scattered back: tiered static
+   batches (16/128/512/1024, each eligible only while its [F, M] tile
+   fits the fixup budget) that materialize an [F, M] distance tile
+   and take one top_k; a full streamed fallback covers pathological
+   batches (cond) and the empty-ladder regime (M too large for any
+   tile).
 
 Modes:
 - ``passes=3`` (exact): bf16 hi/lo split contraction (hi·hi + hi·lo +
@@ -79,9 +82,18 @@ _DC = 256          # d-chunk width for the wide-feature kernel
 # against the whole index. Tiered (16 first) because the cond pays the
 # whole static tier even for one failed query; with the group kernel's
 # top-2-per-group certificate the typical failure count is single-digit
-# per 2048 queries, so the small tier almost always suffices.
-_FIXUP_TIERS = (16, 128)
-_FIXUP_BATCH = _FIXUP_TIERS[-1]
+# per 2048 queries, so the small tier almost always suffices. The
+# larger tiers exist for certify="f32" (adaptive precision), whose
+# wider margin can fail hundreds of queries — without them anything
+# past the 128 tier hit the catastrophic full streamed fallback. A
+# tier is only eligible when its [F, M] f32 distance tile fits the
+# budget (at 10M rows the 512+ tiers would be 20+ GB).
+_FIXUP_TIERS = (16, 128, 512, 1024)
+# budget for ONE [F, M] f32 tile; the materialized branch holds ~2 live
+# copies (d2 + the negated top_k input), so peak ≈ 2× this + operands —
+# 4.2 GB keeps the 1024 tier at the 1M driver shape (2·4.1 GB + ~2 GB
+# of index operands < 16 GB v5e HBM) and sheds it past ~1.05M rows
+_FIXUP_TILE_BUDGET = 4_200_000_000
 # pool oversampling beyond k before exact rescoring
 _POOL_PAD = 32
 # query-chunk bound: the [Q, S] slot arrays + [Q, C, d] rescore gather are
@@ -398,6 +410,13 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
     n_fail = jnp.sum(failed.astype(jnp.int32))
 
     # ---- fixup: exact sweep for failed queries ----
+    # shape-aware tier ladder: only tiers whose [F, M] f32 tile fits
+    # the budget are built (static — M is known at trace time). An
+    # EMPTY ladder (M > ~65M rows) routes every failure to the
+    # streamed full fallback — never a budget-busting tile
+    fix_tiers = tuple(t for t in _FIXUP_TIERS
+                      if t * M * 4 <= _FIXUP_TILE_BUDGET)
+
     def exact_rows(xq):
         """Exact top-k for a [F, d] query block.
 
@@ -450,7 +469,7 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
             return jnp.maximum(
                 xs[:, None] + yy_seg[None, :] - 2.0 * s, 0.0)
 
-        if F <= _FIXUP_TIERS[-1]:
+        if fix_tiers and F <= fix_tiers[-1]:
             yy_all = (yy_raw[0] if yp is None
                       else jnp.sum(yp * yp, axis=1))
             d2 = scores(yp, y_hi, y_lo, yy_all)                 # [F, M]
@@ -463,7 +482,7 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
             return -nt, ni
 
         # full-batch fallback: streamed per-tile merge (the [Q, M] tile
-        # would not fit HBM); rare — needs >_FIXUP_TIERS[-1] failures
+        # would not fit HBM); rare — needs > fix_tiers[-1] failures
         n_tiles = M // T
 
         def body(j, carry):
@@ -519,7 +538,7 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
     # tiered cascade: n_fail==0 → no-op; else the smallest tier that
     # covers n_fail; else the full fallback
     branch = full_fallback
-    for t in [t for t in reversed(_FIXUP_TIERS) if t < Q]:
+    for t in [t for t in reversed(fix_tiers) if t < Q]:
         branch = (lambda op, t=t, nxt=branch: jax.lax.cond(
             n_fail <= t, make_fixup(t), nxt, op))
     vals, ids = jax.lax.cond(n_fail == 0, no_fixup, branch, (vals, ids))

@@ -203,8 +203,9 @@ def test_fused_inner_product_matches_oracle():
 def test_fused_ip_clustered_forces_fixup():
     """Near-duplicate index points share slots → the IP certificate
     fails → fixup path; the result must still be oracle-exact.
-    Q=256 > _FIXUP_BATCH so the small_fixup scatter branch is reachable
-    (Q ≤ 128 can only take the full fallback)."""
+    Q=256 exceeds the first two fixup tiers (16, 128) so the tiered
+    scatter branch is reachable (smaller Q can only take the full
+    fallback)."""
     Q, m, d, k = 256, 4096, 64, 16
     base = rng.normal(size=(40, d)).astype(np.float32)
     y = base[rng.integers(0, 40, m)] + 1e-3 * rng.normal(
