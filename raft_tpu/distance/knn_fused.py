@@ -431,8 +431,10 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         merge loop (489 sequential top_k's on [F, k+T]) cost ~90 ms —
         3× the entire rest of the pipeline — and ran on nearly every
         batch because the certificate fires for a handful of queries at
-        production scale. [F≤128, 1M] is ≤512 MB: one matmul + one
-        XLA top_k ≈ single-digit ms."""
+        production scale. Tile size is bounded by the ladder filter:
+        fix_tiers[-1]·M·4 ≤ _FIXUP_TILE_BUDGET (≤ ~4 GB — e.g.
+        [128, 1M] = 512 MB single-digit ms; [1024, 1M] = 4 GB, the
+        certify="f32" deep-failure regime)."""
         F = xq.shape[0]
         xs = jnp.sum(xq * xq, axis=1)
         nt_dims = (((1,), (1,)), ((), ()))

@@ -105,6 +105,37 @@ def test_adaptive_precision_clustered():
     np.testing.assert_allclose(np.asarray(vals), ref_vals, atol=tol)
 
 
+def test_adaptive_deep_fixup_tier():
+    # enough near-duplicate structure that the adaptive margin fails
+    # MANY queries (>128): the new 512 tier must absorb them instead of
+    # the full streamed fallback, and results stay f32-exact. The
+    # failure count is asserted via the _diag path so the test really
+    # covers the 512-tier routing (n_fail in (128, 512]).
+    from raft_tpu.distance.knn_fused import (_knn_fused_core,
+                                             prepare_knn_index)
+
+    Q, m, d, k = 640, 2048, 24, 8
+    rng_t = np.random.default_rng(7)   # pinned: n_fail targeted in-band
+    base = rng_t.normal(size=(64, d)).astype(np.float32)
+    y = base[rng_t.integers(0, 64, m)] + 3e-3 * rng_t.normal(
+        size=(m, d)).astype(np.float32)
+    x = base[rng_t.integers(0, 64, Q)] + 3e-3 * rng_t.normal(
+        size=(Q, d)).astype(np.float32)
+    idx = prepare_knn_index(y, passes=1, T=512, Qb=64, g=8)
+    import jax.numpy as jnp
+
+    xp = jnp.asarray(np.pad(x, ((0, 0), (0, (-d) % 128))))
+    _, _, n_fail, *_ = _knn_fused_core(
+        xp, idx.yp, idx.y_hi, idx.y_lo, idx.yyh_k, idx.yy_raw,
+        k=k, T=idx.T, Qb=idx.Qb, g=idx.g, passes=1, metric="l2",
+        m=m, rescore=True, pbits=idx.pbits, certify="f32", _diag=True)
+    assert 128 < int(n_fail) <= 512, int(n_fail)
+
+    vals, ids = knn_fused(x, idx, k=k, certify="f32")
+    ref_vals, _, tol = _oracle(x, y, k)
+    np.testing.assert_allclose(np.asarray(vals), ref_vals, atol=tol)
+
+
 def test_adaptive_rejects_lite():
     x = rng.normal(size=(8, 32)).astype(np.float32)
     y = rng.normal(size=(512, 32)).astype(np.float32)
