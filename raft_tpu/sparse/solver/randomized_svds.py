@@ -44,22 +44,45 @@ def sign_correction(U, V):
     return U * signs, V * signs
 
 
-def randomized_svds(res, A: Sparse, config: SvdsConfig
+def randomized_svds(res, A: Sparse, config: SvdsConfig, At=None
                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Truncated SVD of a sparse matrix. Returns (U [m,k], S [k], V [n,k]).
-    (ref: sparse/solver/randomized_svds.cuh ``randomized_svds``)"""
+    (ref: sparse/solver/randomized_svds.cuh ``randomized_svds``)
+
+    MNMG: ``A`` may be a :class:`~raft_tpu.sparse.sharded.ShardedTiledELL`
+    — then ``At`` must be the transposed matrix's sharded operand
+    (``shard_spmv_operand(transpose(A), mesh)``; a sharded layout has no
+    cheap transpose). Every product runs the shard_map SpMM."""
+    from raft_tpu.sparse.sharded import ShardedTiledELL
+
     res = ensure_resources(res)
     k = config.n_components
     m, n = A.shape
     expects(0 < k <= min(m, n), "randomized_svds: bad n_components")
     ell = min(k + config.n_oversamples, min(m, n))
-    dtype = A.values.dtype
+    if isinstance(A, ShardedTiledELL):
+        expects(At is not None,
+                "randomized_svds: a sharded operand needs At "
+                "(shard_spmv_operand of the transposed matrix)")
+        expects(isinstance(At, ShardedTiledELL)
+                and At.shape == (n, m),
+                "randomized_svds: At must be the [n, m] sharded "
+                "transpose operand")
+        dtype = A.vals.dtype
+    else:
+        dtype = A.values.dtype
+        if isinstance(A, COOMatrix):
+            from raft_tpu.sparse.convert import coo_to_csr
 
-    if isinstance(A, COOMatrix):
-        from raft_tpu.sparse.convert import coo_to_csr
-
-        A = coo_to_csr(A)
-    At = sp_transpose(res, A)
+            A = coo_to_csr(A)
+        if At is None:
+            At = sp_transpose(res, A)
+        else:
+            # same contract the sharded branch enforces — a wrong-shaped
+            # At would feed clamped gathers and return silent garbage
+            expects(At.shape == (n, m),
+                    "randomized_svds: At must be [n, m], got %r",
+                    At.shape)
 
     key = jax.random.key(config.seed)
     omega = jax.random.normal(key, (n, ell), dtype)

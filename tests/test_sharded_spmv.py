@@ -165,6 +165,34 @@ def test_sharded_spmm_matches_dense():
                                atol=1e-4)
 
 
+def test_sharded_randomized_svds_matches_single_device():
+    from raft_tpu.sparse.convert import coo_to_csr
+    from raft_tpu.sparse.solver.randomized_svds import (SvdsConfig,
+                                                        randomized_svds)
+
+    rng = np.random.default_rng(9)
+    m, n, nnz = 1200, 900, 8000
+    r = rng.integers(0, m, nnz).astype(np.int32)
+    c = rng.integers(0, n, nnz).astype(np.int32)
+    v = rng.standard_normal(nnz).astype(np.float32)
+    A = COOMatrix(r, c, v, (m, n))
+    mesh = make_mesh()
+    S = shard_spmv_operand(A, mesh)
+    St = shard_spmv_operand(COOMatrix(c, r, v, (n, m)), mesh)
+    cfg = SvdsConfig(n_components=5, seed=0)
+    U_s, sv_s, V_s = randomized_svds(None, S, cfg, At=St)
+    U_1, sv_1, V_1 = randomized_svds(None, coo_to_csr(A), cfg)
+    np.testing.assert_allclose(np.asarray(sv_s), np.asarray(sv_1),
+                               rtol=1e-3, atol=1e-3)
+    # subspace agreement (signs fixed by sign_correction)
+    np.testing.assert_allclose(np.abs(np.asarray(U_s.T) @ np.asarray(U_1)),
+                               np.eye(5), atol=2e-2)
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        randomized_svds(None, S, cfg)          # missing At
+
+
 def test_sharded_operand_rejects_missing_axis():
     A, _ = _random_coo(np.random.default_rng(6), 100, 100, 50)
     mesh = make_mesh()
