@@ -17,7 +17,12 @@ class SpectralEmbedding:
                  drop_first: bool = True, ncv: Optional[int] = None,
                  tolerance: float = 1e-5, max_iterations: int = 2000,
                  seed: int = 42, jit_loop=None, tiled="auto",
+                 mesh=None, mesh_axis: str = "x",
                  res: Optional[Resources] = None):
+        """``mesh``: a ``jax.sharding.Mesh`` makes the fit MNMG — the
+        Laplacian's rows shard over ``mesh[mesh_axis]`` and the Lanczos
+        matvec runs the shard_map SpMV (see
+        spectral.analysis.fit_embedding)."""
         self.res = ensure_resources(res)
         self.n_components = n_components
         self.normalized = normalized
@@ -28,6 +33,8 @@ class SpectralEmbedding:
         self.seed = seed
         self.jit_loop = jit_loop
         self.tiled = tiled
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
         self.eigenvalues_ = None
         self.embedding_ = None
 
@@ -37,7 +44,7 @@ class SpectralEmbedding:
             tolerance=self.tolerance, max_iterations=self.max_iterations,
             seed=self.seed, drop_first=self.drop_first,
             normalized=self.normalized, jit_loop=self.jit_loop,
-            tiled=self.tiled)
+            tiled=self.tiled, mesh=self.mesh, mesh_axis=self.mesh_axis)
         self.eigenvalues_ = vals
         self.embedding_ = emb
         return self
