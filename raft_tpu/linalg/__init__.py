@@ -68,6 +68,7 @@ from raft_tpu.linalg.pca import (
     PCAModel,
     Solver,
     pca_fit,
+    pca_fit_distributed,
     pca_transform,
     pca_inverse_transform,
 )

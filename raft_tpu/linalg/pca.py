@@ -50,14 +50,11 @@ class PCAModel(NamedTuple):
     noise_vars: jnp.ndarray        # scalar
 
 
-def pca_fit(res, X, prms: ParamsPCA) -> PCAModel:
-    """(ref: pca.cuh:41 ``pca_fit``; pipeline detail/pca.cuh)"""
-    X = jnp.asarray(X)
-    n, p = X.shape
-    expects(0 < prms.n_components <= p, "pca_fit: bad n_components")
-    mu = jnp.mean(X, axis=0)
-    Xc = X - mu[None, :]
-    cov = (Xc.T @ Xc) / (n - 1)
+def _model_from_cov(res, cov, mu, n: int, p: int,
+                    prms: ParamsPCA) -> PCAModel:
+    """Shared model-build tail: eig → descending → sign flip →
+    variance bookkeeping (detail/pca.cuh's post-covariance pipeline) —
+    one copy for the single-device and distributed fits."""
     if prms.algorithm == Solver.COV_EIG_JACOBI:
         w, v = eig_jacobi(res, cov, tol=prms.tol, sweeps=prms.n_iterations)
     else:
@@ -75,6 +72,56 @@ def pca_fit(res, X, prms: ParamsPCA) -> PCAModel:
     noise_vars = jnp.where(k < p, jnp.sum(w[k:]) / jnp.maximum(p - k, 1), 0.0)
     return PCAModel(components, explained_var, explained_var_ratio,
                     singular_vals, mu, noise_vars)
+
+
+def pca_fit(res, X, prms: ParamsPCA) -> PCAModel:
+    """(ref: pca.cuh:41 ``pca_fit``; pipeline detail/pca.cuh)"""
+    X = jnp.asarray(X)
+    n, p = X.shape
+    expects(0 < prms.n_components <= p, "pca_fit: bad n_components")
+    mu = jnp.mean(X, axis=0)
+    Xc = X - mu[None, :]
+    cov = (Xc.T @ Xc) / (n - 1)
+    return _model_from_cov(res, cov, mu, n, p, prms)
+
+
+def pca_fit_distributed(res, X, prms: ParamsPCA, mesh,
+                        axis: str = "x") -> PCAModel:
+    """MNMG PCA fit: rows sharded over ``mesh[axis]``, mean/cov via
+    psum inside ``shard_map``, the eig tail replicated — the OPG
+    pattern the reference documents (docs/source/using_raft_comms.rst;
+    the raft-dask distributed-fit role). Rows that don't divide the
+    shard count are zero-padded and masked out of the statistics."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    X = jnp.asarray(X)
+    n, p = X.shape
+    expects(0 < prms.n_components <= p,
+            "pca_fit_distributed: bad n_components")
+    n_shards = int(mesh.shape[axis])
+    npad = (-n) % n_shards
+    valid = jnp.concatenate(
+        [jnp.ones((n,), jnp.float32), jnp.zeros((npad,), jnp.float32)])
+    if npad:
+        X = jnp.concatenate([X, jnp.zeros((npad, p), X.dtype)])
+    sharding = NamedSharding(mesh, P(axis))
+    Xs = jax.device_put(X, sharding)
+    vs = jax.device_put(valid, sharding)
+
+    def stats(x, v):
+        # n is static/global; psums reduce the shard partials
+        mu = jax.lax.psum(jnp.sum(x * v[:, None], axis=0), axis) / n
+        xc = (x - mu[None, :]) * v[:, None]     # padded rows zeroed
+        cov = jax.lax.psum(
+            jnp.matmul(xc.T, xc, preferred_element_type=jnp.float32),
+            axis) / (n - 1)
+        return mu, cov
+
+    mu, cov = jax.shard_map(
+        stats, mesh=mesh, in_specs=(P(axis), P(axis)),
+        out_specs=(P(), P()))(Xs, vs)
+    return _model_from_cov(res, cov, mu, n, p, prms)
 
 
 def pca_transform(res, X, model: PCAModel, prms: ParamsPCA):

@@ -13,6 +13,7 @@ from raft_tpu.linalg.pca import (
     PCAModel,
     Solver,
     pca_fit,
+    pca_fit_distributed,
     pca_inverse_transform,
     pca_transform,
 )
@@ -20,15 +21,24 @@ from raft_tpu.linalg.pca import (
 
 class PCA:
     def __init__(self, n_components: int, whiten: bool = False,
-                 solver: Solver = Solver.COV_EIG_DC,
-                 res: Optional[Resources] = None):
+                 solver: Solver = Solver.COV_EIG_DC, mesh=None,
+                 mesh_axis: str = "x", res: Optional[Resources] = None):
+        """``mesh``: a ``jax.sharding.Mesh`` makes ``fit`` MNMG — rows
+        shard over ``mesh[mesh_axis]`` and the mean/cov statistics run
+        as psums inside shard_map (linalg.pca.pca_fit_distributed)."""
         self.res = ensure_resources(res)
         self.prms = ParamsPCA(n_components=n_components, whiten=whiten,
                               algorithm=solver)
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
         self.model: Optional[PCAModel] = None
 
     def fit(self, X) -> "PCA":
-        self.model = pca_fit(self.res, X, self.prms)
+        if self.mesh is not None:
+            self.model = pca_fit_distributed(self.res, X, self.prms,
+                                             self.mesh, self.mesh_axis)
+        else:
+            self.model = pca_fit(self.res, X, self.prms)
         return self
 
     def transform(self, X):

@@ -34,6 +34,25 @@ def test_pca_model(res):
     assert (np.diff(ev) <= 1e-6).all()
 
 
+def test_pca_model_distributed(res):
+    # MNMG fit over the 8-way virtual mesh must match the single-device
+    # model, including the non-divisible-rows padding-mask path
+    from raft_tpu.parallel import make_mesh
+
+    X = (rng.normal(size=(517, 12))
+         @ np.diag(np.linspace(4, 0.5, 12))).astype(np.float32)
+    m1 = models.PCA(n_components=4, res=res).fit(X)
+    m2 = models.PCA(n_components=4, mesh=make_mesh(), res=res).fit(X)
+    np.testing.assert_allclose(np.asarray(m2.explained_variance_),
+                               np.asarray(m1.explained_variance_),
+                               rtol=2e-3)
+    np.testing.assert_allclose(np.abs(np.asarray(m2.components_)),
+                               np.abs(np.asarray(m1.components_)),
+                               atol=2e-3)
+    np.testing.assert_allclose(np.asarray(m2.mean_),
+                               np.asarray(m1.mean_), atol=1e-4)
+
+
 def test_tsvd_model(res):
     X = rng.normal(size=(60, 6)).astype(np.float32)
     m = models.TruncatedSVD(n_components=2, res=res).fit(X)
