@@ -76,6 +76,7 @@ from raft_tpu.linalg.tsvd import (
     ParamsTSVD,
     TSVDModel,
     tsvd_fit,
+    tsvd_fit_distributed,
     tsvd_transform,
     tsvd_inverse_transform,
 )

@@ -85,6 +85,25 @@ def pca_fit(res, X, prms: ParamsPCA) -> PCAModel:
     return _model_from_cov(res, cov, mu, n, p, prms)
 
 
+def pad_mask_shard(X, mesh, axis: str = "x"):
+    """Zero-pad rows to a shard-count multiple and place both the array
+    and a validity mask rank-sharded over ``mesh[axis]`` — the shared
+    preamble of every distributed fit (masked statistics exclude the
+    pad rows)."""
+    from raft_tpu.parallel.mesh import shard_array
+
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    n_shards = int(mesh.shape[axis])
+    npad = (-n) % n_shards
+    valid = jnp.concatenate(
+        [jnp.ones((n,), jnp.float32), jnp.zeros((npad,), jnp.float32)])
+    if npad:
+        X = jnp.concatenate(
+            [X, jnp.zeros((npad,) + X.shape[1:], X.dtype)])
+    return shard_array(X, mesh, axis), shard_array(valid, mesh, axis)
+
+
 def pca_fit_distributed(res, X, prms: ParamsPCA, mesh,
                         axis: str = "x") -> PCAModel:
     """MNMG PCA fit: rows sharded over ``mesh[axis]``, mean/cov via
@@ -93,21 +112,13 @@ def pca_fit_distributed(res, X, prms: ParamsPCA, mesh,
     the raft-dask distributed-fit role). Rows that don't divide the
     shard count are zero-padded and masked out of the statistics."""
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     X = jnp.asarray(X)
     n, p = X.shape
     expects(0 < prms.n_components <= p,
             "pca_fit_distributed: bad n_components")
-    n_shards = int(mesh.shape[axis])
-    npad = (-n) % n_shards
-    valid = jnp.concatenate(
-        [jnp.ones((n,), jnp.float32), jnp.zeros((npad,), jnp.float32)])
-    if npad:
-        X = jnp.concatenate([X, jnp.zeros((npad, p), X.dtype)])
-    sharding = NamedSharding(mesh, P(axis))
-    Xs = jax.device_put(X, sharding)
-    vs = jax.device_put(valid, sharding)
+    Xs, vs = pad_mask_shard(X, mesh, axis)
 
     def stats(x, v):
         # n is static/global; psums reduce the shard partials

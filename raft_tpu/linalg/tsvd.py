@@ -36,12 +36,9 @@ class TSVDModel(NamedTuple):
     singular_vals: jnp.ndarray
 
 
-def tsvd_fit(res, X, prms: ParamsTSVD) -> TSVDModel:
-    """(ref: tsvd.cuh ``tsvd_fit``)"""
-    X = jnp.asarray(X)
-    n, p = X.shape
-    expects(0 < prms.n_components <= p, "tsvd_fit: bad n_components")
-    G = X.T @ X
+def _components_from_gram(res, G, prms: ParamsTSVD):
+    """Shared eig tail (solver branch → descending → sign flip →
+    truncate) — ONE copy for the single-device and distributed fits."""
     if prms.algorithm == Solver.COV_EIG_JACOBI:
         w, v = eig_jacobi(res, G, tol=prms.tol, sweeps=prms.n_iterations)
     else:
@@ -49,12 +46,81 @@ def tsvd_fit(res, X, prms: ParamsTSVD) -> TSVDModel:
     w = jnp.maximum(w[::-1], 0.0)
     v = v[:, ::-1]
     components = sign_flip(res, v).T[: prms.n_components]
+    return w, components
+
+
+def tsvd_fit(res, X, prms: ParamsTSVD) -> TSVDModel:
+    """(ref: tsvd.cuh ``tsvd_fit``)"""
+    X = jnp.asarray(X)
+    n, p = X.shape
+    expects(0 < prms.n_components <= p, "tsvd_fit: bad n_components")
+    G = X.T @ X
+    w, components = _components_from_gram(res, G, prms)
     singular_vals = jnp.sqrt(w[: prms.n_components])
     # explained variance of the projected coordinates (population variance,
     # as the reference computes from the transform)
     T = X @ components.T
     explained_var = jnp.var(T, axis=0)
     total_var = jnp.sum(jnp.var(X, axis=0))
+    explained_var_ratio = explained_var / total_var
+    return TSVDModel(components, explained_var, explained_var_ratio,
+                     singular_vals)
+
+
+def tsvd_fit_distributed(res, X, prms: ParamsTSVD, mesh,
+                         axis: str = "x") -> TSVDModel:
+    """MNMG TSVD fit: rows sharded over ``mesh[axis]``; the gram matrix
+    (+ column sums) and a CENTERED second variance pass run as psums
+    inside ``shard_map``, the eig tail replicated (the OPG twin of
+    linalg.pca.pca_fit_distributed; ref: the raft-dask distributed-fit
+    role). The variance pass subtracts the exact means computed from
+    pass 1 — the one-pass E[x²]−(E[x])² form cancels catastrophically
+    in f32 for large-mean data, where jnp.var's two-pass (the
+    single-device fit) does not. Non-divisible row counts are
+    zero-padded and masked out of the statistics."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.linalg.pca import pad_mask_shard
+
+    X = jnp.asarray(X)
+    n, p = X.shape
+    expects(0 < prms.n_components <= p,
+            "tsvd_fit_distributed: bad n_components")
+    Xs, vs = pad_mask_shard(X, mesh, axis)
+
+    def gram_and_colsum(x, v):
+        xm = x * v[:, None]
+        G = jax.lax.psum(
+            jnp.matmul(xm.T, xm, preferred_element_type=jnp.float32),
+            axis)
+        return G, jax.lax.psum(jnp.sum(xm, axis=0), axis)
+
+    G, colsum = jax.shard_map(
+        gram_and_colsum, mesh=mesh, in_specs=(P(axis), P(axis)),
+        out_specs=(P(), P()))(Xs, vs)
+    w, components = _components_from_gram(res, G, prms)
+    singular_vals = jnp.sqrt(w[: prms.n_components])
+    mu_x = colsum / n
+    mu_t = components @ mu_x                 # mean of T = X @ compᵀ
+
+    def centered_var(x, vm, comp, mt, mx):
+        t = x @ comp.T
+        s2c = jax.lax.psum(
+            jnp.sum(((t - mt[None, :]) ** 2) * vm[:, None], axis=0),
+            axis)
+        x2c = jax.lax.psum(
+            jnp.sum(((x - mx[None, :]) ** 2) * vm[:, None], axis=0),
+            axis)
+        return s2c, x2c
+
+    s2c, x2c = jax.shard_map(
+        centered_var, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P(), P()),
+        out_specs=(P(), P()))(Xs, vs, components, mu_t, mu_x)
+    # population variance, matching jnp.var in the single-device fit
+    explained_var = s2c / n
+    total_var = jnp.sum(x2c) / n
     explained_var_ratio = explained_var / total_var
     return TSVDModel(components, explained_var, explained_var_ratio,
                      singular_vals)

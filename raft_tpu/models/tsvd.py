@@ -10,6 +10,7 @@ from raft_tpu.linalg.tsvd import (
     ParamsTSVD,
     TSVDModel,
     tsvd_fit,
+    tsvd_fit_distributed,
     tsvd_inverse_transform,
     tsvd_transform,
 )
@@ -17,13 +18,22 @@ from raft_tpu.linalg.tsvd import (
 
 class TruncatedSVD:
     def __init__(self, n_components: int, solver: Solver = Solver.COV_EIG_DC,
+                 mesh=None, mesh_axis: str = "x",
                  res: Optional[Resources] = None):
+        """``mesh``: a ``jax.sharding.Mesh`` makes ``fit`` MNMG (rows
+        shard over ``mesh[mesh_axis]``; see tsvd_fit_distributed)."""
         self.res = ensure_resources(res)
         self.prms = ParamsTSVD(n_components=n_components, algorithm=solver)
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
         self.model: Optional[TSVDModel] = None
 
     def fit(self, X) -> "TruncatedSVD":
-        self.model = tsvd_fit(self.res, X, self.prms)
+        if self.mesh is not None:
+            self.model = tsvd_fit_distributed(self.res, X, self.prms,
+                                              self.mesh, self.mesh_axis)
+        else:
+            self.model = tsvd_fit(self.res, X, self.prms)
         return self
 
     def transform(self, X):

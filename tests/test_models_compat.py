@@ -60,6 +60,31 @@ def test_tsvd_model(res):
     np.testing.assert_allclose(np.asarray(m.singular_values_), s_ref, rtol=1e-3)
 
 
+def test_tsvd_model_distributed(res):
+    from raft_tpu.parallel import make_mesh
+
+    X = rng.normal(size=(133, 10)).astype(np.float32)   # n % 8 != 0
+    m1 = models.TruncatedSVD(n_components=3, res=res).fit(X)
+    m2 = models.TruncatedSVD(n_components=3, mesh=make_mesh(),
+                             res=res).fit(X)
+    np.testing.assert_allclose(np.asarray(m2.singular_values_),
+                               np.asarray(m1.singular_values_), rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(m2.explained_variance_),
+                               np.asarray(m1.explained_variance_),
+                               rtol=5e-3, atol=1e-4)
+    # large-mean data: the distributed variance pass is CENTERED
+    # (two-pass) — a one-pass E[x²]−(E[x])² form catastrophically
+    # cancels in f32 here (negative/inf ratios); sane finite ratios
+    # are the property (the residual spread vs single-device is gram
+    # conditioning at mean≫std, shared by both paths)
+    Xm = (rng.normal(size=(96, 6)) + 1e4).astype(np.float32)
+    mm = models.TruncatedSVD(n_components=2, mesh=make_mesh(),
+                             res=res).fit(Xm)
+    r = np.asarray(mm.explained_variance_ratio_)
+    assert np.all(np.isfinite(r)) and np.all(r > 0) and np.all(r < 1.5)
+    assert np.all(np.asarray(mm.explained_variance_) >= 0)
+
+
 def test_spectral_embedding_model(res):
     n = 30
     adj = np.zeros((n, n), np.float32)
