@@ -5,17 +5,19 @@ stage 10.)"""
 from raft_tpu.distance.types import DistanceType, METRIC_NAMES
 from raft_tpu.distance.pairwise import pairwise_distance
 from raft_tpu.distance.fused_l2nn import (
+    ShardedKnnIndex,
     fused_l2_nn,
     fused_l2_nn_argmin,
     knn,
     knn_index_sharded,
     knn_sharded,
+    prepare_index_sharded,
 )
 from raft_tpu.distance.knn_fused import KnnIndex, prepare_knn_index
 
 __all__ = [
     "DistanceType", "METRIC_NAMES", "pairwise_distance",
     "fused_l2_nn", "fused_l2_nn_argmin", "knn", "knn_sharded",
-    "knn_index_sharded",
+    "knn_index_sharded", "ShardedKnnIndex", "prepare_index_sharded",
     "KnnIndex", "prepare_knn_index",
 ]

@@ -17,7 +17,7 @@ over a static tile count so the whole sweep is one compiled program.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -445,6 +445,46 @@ def knn_sharded(res, index, queries, k: int, mesh=None, axis: str = "x",
     return d[:nq], i[:nq]
 
 
+class ShardedKnnIndex(NamedTuple):
+    """A row-sharded, row-padded KNN index prepared ONCE
+    (:func:`prepare_index_sharded`) — the build/query split for the
+    model-parallel mode: queries against it never re-pad or re-shard
+    the index."""
+
+    idx_s: jax.Array       # [n_pad, d] f32, sharded over (mesh, axis)
+    n: int                 # true (unpadded) row count
+    mesh: object           # the Mesh it was sharded over
+    axis: str
+
+
+def prepare_index_sharded(res, index, mesh=None, axis: str = "x"
+                          ) -> ShardedKnnIndex:
+    """Pad the index rows to a shard multiple ON HOST and place the
+    shards directly (device_put with a NamedSharding streams each
+    shard from host memory — the full matrix never materializes on one
+    device, which is the point of the bigger-than-HBM index mode)."""
+    import numpy as np
+
+    from raft_tpu.parallel import shard_array
+
+    res = ensure_resources(res)
+    if mesh is None:
+        mesh = res.mesh
+    expects(mesh is not None,
+            "prepare_index_sharded: pass mesh= or set it on res")
+    expects(axis in mesh.axis_names,
+            "prepare_index_sharded: axis %r not in mesh axes %s", axis,
+            tuple(mesh.axis_names))
+    arr = np.asarray(index, np.float32)
+    n = arr.shape[0]
+    ndev = int(mesh.shape[axis])
+    npad = (-n) % ndev
+    if npad:
+        arr = np.concatenate(
+            [arr, np.zeros((npad, arr.shape[1]), np.float32)])
+    return ShardedKnnIndex(shard_array(arr, mesh, axis), n, mesh, axis)
+
+
 def knn_index_sharded(res, index, queries, k: int, mesh=None,
                       axis: str = "x", metric: str = "sqeuclidean",
                       algo: str = "auto") -> Tuple[jax.Array, jax.Array]:
@@ -478,11 +518,25 @@ def knn_index_sharded(res, index, queries, k: int, mesh=None,
             "knn_index_sharded: axis %r not in mesh axes %s", axis,
             tuple(mesh.axis_names))
     ndev = mesh.shape[axis]
-    index = jnp.asarray(index, jnp.float32)
     queries = jnp.asarray(queries, jnp.float32)
-    n = index.shape[0]
+    if isinstance(index, ShardedKnnIndex):
+        expects(index.axis == axis,
+                "knn_index_sharded: index prepared for axis %r, got %r",
+                index.axis, axis)
+        # the PREPARED mesh wins — a mismatched mesh= would silently
+        # re-lay-out the whole index across devices on every query
+        # (full cross-device transfer at bigger-than-HBM scale)
+        expects(index.mesh == mesh,
+                "knn_index_sharded: index prepared for a different "
+                "mesh — re-prepare or pass its mesh")
+        idx_prepared, n = index.idx_s, index.n
+        index_p = idx_prepared
+    else:
+        index = jnp.asarray(index, jnp.float32)
+        n = index.shape[0]
+        index_p, _ = _pad_rows(index, ndev)
+        idx_prepared = None
     expects(k <= n, "knn_index_sharded: k larger than index size")
-    index_p, _ = _pad_rows(index, ndev)
     rows_per = index_p.shape[0] // ndev
     n_pads = index_p.shape[0] - n
     k_loc = k + n_pads                      # over-select past any pads
@@ -520,7 +574,8 @@ def knn_index_sharded(res, index, queries, k: int, mesh=None,
             check_vma=False))
         _SHARDED_KNN_CACHE[key] = fn
 
-    idx_s = shard_array(index_p, mesh, axis)
+    idx_s = (idx_prepared if idx_prepared is not None
+             else shard_array(index_p, mesh, axis))
     qr = jax.device_put(queries, replicated(mesh))
     dg, ig = fn(idx_s, qr)
     # merge: exact top-k of the gathered per-shard candidates; padded

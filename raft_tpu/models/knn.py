@@ -14,13 +14,31 @@ from raft_tpu.distance.fused_l2nn import knn as _knn
 
 class NearestNeighbors:
     def __init__(self, n_neighbors: int = 5, metric: str = "sqeuclidean",
+                 mesh=None, mesh_axis: str = "x",
                  res: Optional[Resources] = None):
+        """``mesh``: a ``jax.sharding.Mesh`` makes ``kneighbors`` MNMG
+        — the INDEX rows shard over ``mesh[mesh_axis]`` (the
+        bigger-than-HBM index mode: per-shard local select + one
+        all-gather merge; distance.knn_index_sharded)."""
         self.res = ensure_resources(res)
         self.n_neighbors = n_neighbors
         self.metric = metric
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
         self._index = None
 
     def fit(self, X) -> "NearestNeighbors":
+        if self.mesh is not None:
+            # MNMG: pad + shard ONCE, straight from host — the full
+            # matrix never materializes on one device (the
+            # bigger-than-HBM index mode this exists for)
+            from raft_tpu.distance.fused_l2nn import prepare_index_sharded
+
+            self._index = prepare_index_sharded(self.res, X, self.mesh,
+                                                self.mesh_axis)
+            self._n_index = self._index.n
+            self._prepared = None
+            return self
         self._index = jnp.asarray(X, jnp.float32)
         self._n_index = self._index.shape[0]
         # build/query split: prepare the fused-pipeline index operands
@@ -48,6 +66,9 @@ class NearestNeighbors:
 
     @property
     def _index_matrix(self):
+        if self.mesh is not None:
+            # sharded fit: slice the true rows of the global array
+            return self._index.idx_s[:self._index.n]
         if self._index is not None:
             return self._index
         p = self._prepared
@@ -56,6 +77,12 @@ class NearestNeighbors:
     def kneighbors(self, queries, n_neighbors: Optional[int] = None
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         k = n_neighbors or self.n_neighbors
+        if self.mesh is not None:
+            from raft_tpu.distance.fused_l2nn import knn_index_sharded
+
+            return knn_index_sharded(self.res, self._index, queries, k,
+                                     mesh=self.mesh, axis=self.mesh_axis,
+                                     metric=self.metric)
         if self._prepared is not None and k <= self._prepared.n_rows:
             try:
                 return _knn(self.res, self._prepared, queries, k,

@@ -53,6 +53,22 @@ def test_pca_model_distributed(res):
                                np.asarray(m1.mean_), atol=1e-4)
 
 
+def test_nearest_neighbors_model_distributed(res):
+    from raft_tpu.parallel import make_mesh
+
+    X = rng.normal(size=(2051, 12)).astype(np.float32)
+    Q = rng.normal(size=(7, 12)).astype(np.float32)
+    m = models.NearestNeighbors(n_neighbors=4, mesh=make_mesh(),
+                                res=res).fit(X)
+    d, i = m.kneighbors(Q)
+    d2 = ((Q[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+    ref = np.sort(d2, axis=1)[:, :4]
+    np.testing.assert_allclose(np.asarray(d), ref, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(
+        np.take_along_axis(d2, np.asarray(i), axis=1), ref,
+        rtol=1e-3, atol=1e-3)
+
+
 def test_tsvd_model(res):
     X = rng.normal(size=(60, 6)).astype(np.float32)
     m = models.TruncatedSVD(n_components=2, res=res).fit(X)
