@@ -141,6 +141,33 @@ def _smoke_select_k_slotted_pallas():
     np.testing.assert_allclose(got, ref, rtol=1e-6)
 
 
+def _smoke_unexpanded_pairwise():
+    # round-4 kernel: dc multi-ref (1,128) blocks + one-hot selector
+    # dot over the bf16x3 split — the Mosaic-lowering risk points
+    from scipy.spatial.distance import cdist
+
+    from raft_tpu.distance.types import DistanceType as DT
+    from raft_tpu.ops.unexpanded_pallas import unexpanded_pairwise_tiled
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(300, 96)).astype(np.float32)
+    y = rng.normal(size=(2000, 96)).astype(np.float32)
+    for t, ref, p in ((DT.L1, "cityblock", 2.0),
+                      (DT.Linf, "chebyshev", 2.0),
+                      (DT.Canberra, "canberra", 2.0),
+                      (DT.LpUnexpanded, "minkowski", 3.0)):
+        kw = {"p": 3.0} if ref == "minkowski" else {}
+        out = np.asarray(unexpanded_pairwise_tiled(x, y, t, p))
+        np.testing.assert_allclose(out, cdist(x, y, ref, **kw),
+                                   rtol=1e-3, atol=1e-3)
+    # BrayCurtis: the structurally different two-output pallas_call
+    xa, ya = np.abs(x), np.abs(y)
+    out = np.asarray(unexpanded_pairwise_tiled(xa, ya, DT.BrayCurtis,
+                                               2.0))
+    np.testing.assert_allclose(out, cdist(xa, ya, "braycurtis"),
+                               rtol=1e-3, atol=1e-3)
+
+
 KERNELS = {
     "select_k_slotted_pallas": _smoke_select_k_slotted_pallas,
     "fused_l2_topk": _smoke_fused_l2_topk,
@@ -149,6 +176,7 @@ KERNELS = {
     "spmm_tiled": _smoke_spmm_tiled,
     "sddmm_tiled": _smoke_sddmm_tiled,
     "histogram_blocked": _smoke_histogram_blocked,
+    "unexpanded_pairwise": _smoke_unexpanded_pairwise,
 }
 
 

@@ -17,6 +17,9 @@ cd "$(dirname "$0")/.."
 echo "=== bench.py (driver metric + adaptive; refreshes last-good) ==="
 timeout 3600 python bench.py | tee BENCH_LOCAL.json || echo "bench rc=$?"
 
+echo "=== pallas smoke (Mosaic lowering, incl. the r4 unexpanded kernel) ==="
+timeout 3600 python benchmarks/pallas_smoke.py || echo "smoke rc=$?"
+
 echo "=== tpu fuzz (certified paths incl. adaptive certify=f32) ==="
 timeout 3600 python benchmarks/tpu_fuzz.py || echo "fuzz rc=$?"
 
