@@ -168,6 +168,32 @@ def _smoke_unexpanded_pairwise():
                                rtol=1e-3, atol=1e-3)
 
 
+def _smoke_unexpanded_guarded_dispatch():
+    # round-5: the finiteness guard is a lax.cond INSIDE the program —
+    # a jitted public-API caller must lower the kernel branch through
+    # real Mosaic, and the XLA branch must serve non-finite inputs
+    import jax
+    from scipy.spatial.distance import cdist
+
+    from raft_tpu import distance
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(1024, 64)).astype(np.float32)  # n*m = 2^20:
+    y = rng.normal(size=(1024, 64)).astype(np.float32)  # TPU-eligible
+
+    def f(a, b):
+        return distance.pairwise_distance(None, a, b, metric="l1")
+
+    assert "pallas_call" in str(jax.make_jaxpr(f)(x, y))
+    out = np.asarray(jax.jit(f)(x, y))
+    np.testing.assert_allclose(out, cdist(x, y, "cityblock"),
+                               rtol=1e-3, atol=1e-3)
+    xinf = x.copy()
+    xinf[0, 0] = np.inf
+    out = np.asarray(jax.jit(f)(xinf, y))
+    assert np.all(np.isinf(out[0])) and np.all(np.isfinite(out[1:]))
+
+
 KERNELS = {
     "select_k_slotted_pallas": _smoke_select_k_slotted_pallas,
     "fused_l2_topk": _smoke_fused_l2_topk,
@@ -177,6 +203,7 @@ KERNELS = {
     "sddmm_tiled": _smoke_sddmm_tiled,
     "histogram_blocked": _smoke_histogram_blocked,
     "unexpanded_pairwise": _smoke_unexpanded_pairwise,
+    "unexpanded_guarded_dispatch": _smoke_unexpanded_guarded_dispatch,
 }
 
 

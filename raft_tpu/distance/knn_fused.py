@@ -130,9 +130,11 @@ def _err_bound_coeff_p1(d: int) -> float:
       - bf16 rounding of both factors: ≤ (2·2⁻⁸ + 2⁻¹⁶)·‖x‖‖y‖
       - f32 accumulation: ≤ d·2⁻²⁴·‖x‖‖y‖
     Doubled for d2 = 2·S_err and doubled again as safety margin ⇒
-    ≤ (2⁻⁵ + d·2⁻²²)·‖x‖‖y‖ (same discipline as _err_bound_coeff: a
-    loose margin only raises fixup rate; the bound itself must hold)."""
-    return 2.0 ** -5 + d * 2.0 ** -22
+    ≤ (2⁻⁵ + 2⁻¹⁴ + d·2⁻²²)·‖x‖‖y‖ — the 2⁻¹⁴ is the doubled 2⁻¹⁶
+    cross term, kept so every component is rounded UP like
+    _err_bound_coeff's (a loose margin only raises fixup rate; the
+    bound itself must hold)."""
+    return 2.0 ** -5 + 2.0 ** -14 + d * 2.0 ** -22
 
 
 def decode_packed_pool(cand_p, pos, S_: int, T: int, g: int,

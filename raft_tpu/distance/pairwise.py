@@ -61,7 +61,8 @@ def _correlation(x, y):
 
 
 def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclidean",
-                      p: float = 2.0, precision=None) -> jax.Array:
+                      p: float = 2.0, precision=None,
+                      assume_finite: bool = False) -> jax.Array:
     """Full [n, m] distance matrix. (ref: pre-cuVS
     raft::distance::pairwise_distance; pylibraft.distance.pairwise_distance)
 
@@ -72,6 +73,13 @@ def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclid
     Pass ``precision=jax.lax.Precision.HIGHEST`` for f32-grade
     contractions (3-pass bf16 split — BEYOND the reference's default), or
     use ``jax.default_matmul_precision`` to set it globally.
+
+    ``assume_finite=True`` promises the inputs contain no inf/NaN,
+    letting the unexpanded metrics skip the in-program finiteness guard
+    in front of the streaming Pallas kernel (non-finite values would
+    poison its one-hot selector contraction; with the default guard
+    they are routed to the XLA path, which preserves inf/NaN
+    semantics).
 
     Examples
     --------
@@ -90,8 +98,8 @@ def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclid
         if isinstance(precision, jax.lax.Precision):
             precision = precision.name.lower()
         with jax.default_matmul_precision(precision):
-            return _pairwise_dispatch(res, x, y, t, p)
-    return _pairwise_dispatch(res, x, y, t, p)
+            return _pairwise_dispatch(res, x, y, t, p, assume_finite)
+    return _pairwise_dispatch(res, x, y, t, p, assume_finite)
 
 
 _UNEXPANDED_TYPES = frozenset({
@@ -103,7 +111,8 @@ _UNEXPANDED_TYPES = frozenset({
 })
 
 
-def _pairwise_dispatch(res, x, y, t: DistanceType, p: float) -> jax.Array:
+def _pairwise_dispatch(res, x, y, t: DistanceType, p: float,
+                       assume_finite: bool = False) -> jax.Array:
     if t not in _UNEXPANDED_TYPES:
         # ONE jitted program for the expanded metrics: eagerly, the
         # 5-6 ops each cost a per-op transport dispatch (~2 ms on the
@@ -116,7 +125,7 @@ def _pairwise_dispatch(res, x, y, t: DistanceType, p: float) -> jax.Array:
     # over FEATURE CHUNKS with a [tile, m]-shaped carry — the d-axis
     # analog of the reference's k-blocked smem policy
     # (linalg/detail/contractions.cuh:313). Peak temp = [tile, m, dc].
-    return _unexpanded(res, x, y, t, p)
+    return _unexpanded(res, x, y, t, p, assume_finite)
 
 
 @functools.partial(jax.jit, static_argnames=("t", "p"))
@@ -201,37 +210,85 @@ def _unexp_finalize(accs, t: DistanceType, p: float, d: int):
     return a
 
 
-@functools.partial(jax.jit, static_argnames=("t", "p", "d_true", "tile"))
+@functools.partial(jax.jit,
+                   static_argnames=("t", "p", "d_true", "tile", "dc"))
 def _unexpanded_jit(x, y, t: DistanceType, p: float, d_true: int,
-                    tile: int) -> jax.Array:
-    """The whole unexpanded pairwise op as ONE compiled program: a scan
-    over row tiles whose body is reduce(term(broadcast)) — XLA:TPU's
-    loop fusion consumes the [tile, m, d] broadcast inside the reduction
-    without materializing it in HBM (verified in the kernel-path bench:
-    benchmarks/bench_unexpanded.py), and the single dispatch removes the
-    per-tile transport RTT the round-3 Python loop paid (measured ~2 ms
-    PER eager op on the tunneled v5e — memory: config-1 floor)."""
-    n, d = x.shape
+                    tile: int, dc: int = 16) -> jax.Array:
+    """The whole unexpanded pairwise op as ONE compiled program: a map
+    over row tiles whose body folds FEATURE CHUNKS of ``dc`` with a
+    [tile, m] carry — the d-axis analog of the reference's k-blocked
+    smem policy (linalg/detail/contractions.cuh:313). The explicit
+    chunk fold makes peak temp [tile, m, dc] by construction instead of
+    trusting XLA to fuse a [tile, m, d] broadcast into the reduction
+    (round-4 advisor: multi-term metrics / non-TPU backends may not
+    fuse, and an unfused broadcast would be d/dc times the budgeted
+    memory). Single dispatch — the round-3 Python loop paid ~2 ms
+    transport RTT PER eager op on the tunneled v5e."""
+    n, d0 = x.shape
     m = y.shape[0]
     acc_dtype = jnp.promote_types(jnp.promote_types(x.dtype, y.dtype),
                                   jnp.float32)
     reduce_d = jnp.max if t == DistanceType.Linf else jnp.sum
+    combine = jnp.maximum if t == DistanceType.Linf else jnp.add
+    n_acc = 2 if t == DistanceType.BrayCurtis else 1
+
+    dc = max(1, min(dc, d0))
+    dpad = (-d0) % dc
+    if dpad:
+        # zero features are term identities for every unexpanded metric
+        # (tested: test_kernel_odd_shapes_and_padding)
+        x = jnp.concatenate([x, jnp.zeros((n, dpad), x.dtype)], axis=1)
+        y = jnp.concatenate([y, jnp.zeros((m, dpad), y.dtype)], axis=1)
+    n_ch = (d0 + dpad) // dc
+    yc = y.astype(acc_dtype).reshape(m, n_ch, dc).transpose(1, 0, 2)
 
     def one_tile(xt):
-        terms = _unexp_terms(xt[:, None, :].astype(acc_dtype),
-                             y[None, :, :].astype(acc_dtype),
-                             t, p, acc_dtype)
-        return _unexp_finalize(tuple(reduce_d(tm, axis=2) for tm in terms),
-                               t, p, d_true)
+        xc = xt.astype(acc_dtype).reshape(tile, n_ch, dc)
+        xc = xc.transpose(1, 0, 2)                   # [n_ch, tile, dc]
+
+        def fold(carry, ch):
+            xcc, ycc = ch                # [tile, dc], [m, dc]
+            terms = _unexp_terms(xcc[:, None, :], ycc[None, :, :],
+                                 t, p, acc_dtype)
+            return tuple(combine(c, reduce_d(tm, axis=2))
+                         for c, tm in zip(carry, terms)), None
+
+        init = tuple(jnp.zeros((tile, m), acc_dtype)
+                     for _ in range(n_acc))
+        accs, _ = jax.lax.scan(fold, init, (xc, yc))
+        return _unexp_finalize(accs, t, p, d_true)
 
     n_tiles = -(-n // tile)
     npad = n_tiles * tile - n
-    xp = jnp.concatenate([x, jnp.zeros((npad, d), x.dtype)]) if npad else x
-    out = jax.lax.map(one_tile, xp.reshape(n_tiles, tile, d))
+    xp = jnp.concatenate([x, jnp.zeros((npad, x.shape[1]), x.dtype)]) \
+        if npad else x
+    out = jax.lax.map(one_tile, xp.reshape(n_tiles, tile, x.shape[1]))
     return out.reshape(n_tiles * tile, m)[:n]
 
 
-def _unexpanded(res, x, y, t: DistanceType, p: float) -> jax.Array:
+@functools.partial(jax.jit,
+                   static_argnames=("t", "p", "d_true", "tile", "dc"))
+def _unexpanded_guarded(x, y, t: DistanceType, p: float, d_true: int,
+                        tile: int, dc: int) -> jax.Array:
+    """Kernel-or-XLA chosen by an IN-PROGRAM finiteness check: the
+    streaming Pallas path is reachable from jitted callers (the round-4
+    dispatch required concrete inputs, so every estimator pipeline got
+    the fallback), and eager callers pay one dispatch with no host
+    sync instead of two blocking isfinite scans. Non-finite inputs take
+    the XLA branch, whose semantics cover inf/NaN (the kernel's one-hot
+    selector dot would turn them into whole-chunk NaNs)."""
+    finite = jnp.isfinite(x).all() & jnp.isfinite(y).all()
+    from raft_tpu.ops.unexpanded_pallas import unexpanded_pairwise_tiled
+
+    return jax.lax.cond(
+        finite,
+        lambda a, b: unexpanded_pairwise_tiled(a, b, t, p),
+        lambda a, b: _unexpanded_jit(a, b, t, p, d_true, tile, dc=dc),
+        x, y)
+
+
+def _unexpanded(res, x, y, t: DistanceType, p: float,
+                assume_finite: bool = False) -> jax.Array:
     n, d = x.shape
     m = y.shape[0]
     acc_dtype = jnp.promote_types(jnp.promote_types(x.dtype, y.dtype),
@@ -245,22 +302,18 @@ def _unexpanded(res, x, y, t: DistanceType, p: float) -> jax.Array:
     from raft_tpu.ops.unexpanded_pallas import (unexpanded_eligible,
                                                 unexpanded_pairwise_tiled)
 
-    if unexpanded_eligible(t, n, m, d, x.dtype, y.dtype):
-        # kernel envelope: finite inputs (0·inf = NaN through its
-        # one-hot selector dot). The check needs concrete values — a
-        # traced call (inside a user jit) takes the XLA path, whose
-        # semantics cover non-finites
-        concrete = not (isinstance(x, jax.core.Tracer)
-                        or isinstance(y, jax.core.Tracer))
-        if concrete and bool(jnp.isfinite(x).all()) \
-                and bool(jnp.isfinite(y).all()):
-            return unexpanded_pairwise_tiled(x, y, t, p)
-
-    # jitted XLA fallback: one program, fused broadcast-reduce; tile
-    # rows so XLA's scheduling (and any non-fused corner) stays inside
-    # the workspace budget
+    # fallback tiling: budget the materialized [tile, m, dc] chunk temp
+    # (×3 for term intermediates) — holds whether or not XLA fuses
     itemsize = jnp.dtype(acc_dtype).itemsize
     res = ensure_resources(res)
-    budget_rows = res.workspace.batch_rows(m * 8 * itemsize)
+    dc = max(1, min(16, d))
+    budget_rows = res.workspace.batch_rows(m * dc * 3 * itemsize)
     tile = int(max(1, min(n, budget_rows)))
-    return _unexpanded_jit(x, y, t, float(p), d, tile)
+
+    if unexpanded_eligible(t, n, m, d, x.dtype, y.dtype):
+        if assume_finite:
+            # caller vouches for the kernel envelope: skip even the
+            # in-program finiteness reduction
+            return unexpanded_pairwise_tiled(x, y, t, p)
+        return _unexpanded_guarded(x, y, t, float(p), d, tile, dc)
+    return _unexpanded_jit(x, y, t, float(p), d, tile, dc=dc)

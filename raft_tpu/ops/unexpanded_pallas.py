@@ -64,21 +64,34 @@ _SUPPORTED = (
 )
 
 
+def _interpret_dispatch_enabled() -> bool:
+    """Interpreted Pallas is a TEST vehicle (orders of magnitude slower
+    than the jitted XLA path): production non-TPU callers keep the XLA
+    path unless the suite explicitly opts in (tests/conftest.py sets
+    this; round-4 advisor finding)."""
+    import os
+
+    return os.environ.get("RAFT_TPU_PALLAS_INTERPRET_DISPATCH",
+                          "0") == "1"
+
+
 def unexpanded_eligible(t: DistanceType, n: int, m: int, d: int,
                         x_dtype, y_dtype) -> bool:
     """Whether the streaming kernel path serves this call. Small shapes
     stay on the fused-XLA path (kernel dispatch isn't worth it below
     ~1M output cells); non-f32-representable inputs keep XLA's native
-    dtype semantics."""
+    dtype semantics. Shape/dtype-only, so the decision is valid under
+    trace (the finiteness envelope is handled in-program by the
+    dispatcher's lax.cond)."""
     if t not in _SUPPORTED:
         return False
-    if interpret_mode() and n * m * d > 2 ** 22:
-        return False                 # interpret mode: tests only
     for dt in (x_dtype, y_dtype):
         if not (jnp.issubdtype(dt, jnp.floating)
                 and jnp.finfo(dt).bits <= 32):
             return False
-    return n * m >= (1 << 20) or interpret_mode()
+    if interpret_mode():
+        return _interpret_dispatch_enabled() and n * m * d <= 2 ** 22
+    return n * m >= (1 << 20)
 
 
 def _kl(a, b):
@@ -252,8 +265,9 @@ def unexpanded_pairwise_tiled(x, y, t: DistanceType, p: float
 
     Envelope: FINITE inputs only — a non-finite x value would turn the
     one-hot selector dot into 0·inf = NaN for its whole feature chunk
-    (the dispatch in distance.pairwise guards this; direct callers with
-    possibly non-finite data should use the XLA path)."""
+    (distance.pairwise guards this with an in-program lax.cond on
+    finiteness; direct callers with possibly non-finite data should use
+    the XLA path)."""
     x = jnp.asarray(x)
     y = jnp.asarray(y)
     n, d = x.shape

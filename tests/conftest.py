@@ -18,6 +18,10 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Let the pairwise dispatch route through interpreted Pallas kernels on
+# this CPU platform (production CPU callers keep the XLA path; the suite
+# opts in to exercise the kernel code path).
+os.environ.setdefault("RAFT_TPU_PALLAS_INTERPRET_DISPATCH", "1")
 
 import jax  # noqa: E402
 

@@ -99,8 +99,8 @@ def test_public_api_routes_unexpanded():
 
 def test_nonfinite_inputs_take_exact_path():
     # inf in x would become NaN through the kernel's one-hot dot — the
-    # dispatch must route such inputs to the XLA path, which preserves
-    # inf semantics
+    # in-program finiteness cond must route such inputs to the XLA
+    # branch, which preserves inf semantics
     from raft_tpu import distance
 
     x = X.copy()
@@ -109,6 +109,51 @@ def test_nonfinite_inputs_take_exact_path():
     assert np.all(np.isinf(out[0]))
     assert np.all(np.isfinite(out[1:]))
     np.testing.assert_allclose(out[1:], cdist(x[1:], Y, "cityblock"),
+                               atol=1e-3, rtol=1e-3)
+
+
+def test_kernel_path_reachable_under_jit():
+    # round-4 verdict #4: the dispatch used to demand CONCRETE inputs,
+    # so every jitted caller silently got the XLA fallback. Now the
+    # finiteness guard is a lax.cond inside the program — the traced
+    # caller must carry the pallas_call, and both finiteness outcomes
+    # must be correct from inside jit.
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu import distance
+
+    def f(a, b):
+        return distance.pairwise_distance(None, a, b, metric="l1")
+
+    jaxpr = str(jax.make_jaxpr(f)(X, Y))
+    assert "pallas_call" in jaxpr
+
+    out = np.asarray(jax.jit(f)(X, Y))
+    np.testing.assert_allclose(out, cdist(X, Y, "cityblock"),
+                               atol=1e-3, rtol=1e-3)
+
+    x = X.copy()
+    x[0, 0] = np.inf
+    out = np.asarray(jax.jit(f)(jnp.asarray(x), jnp.asarray(Y)))
+    assert np.all(np.isinf(out[0])) and np.all(np.isfinite(out[1:]))
+
+
+def test_assume_finite_skips_guard():
+    # assume_finite vouches for the envelope: no isfinite reduction and
+    # no cond in the program, and the kernel result is unchanged
+    import jax
+
+    from raft_tpu import distance
+
+    def f(a, b):
+        return distance.pairwise_distance(None, a, b, metric="l1",
+                                          assume_finite=True)
+
+    jaxpr = str(jax.make_jaxpr(f)(X, Y))
+    assert "pallas_call" in jaxpr and "is_finite" not in jaxpr
+    out = np.asarray(f(X, Y))
+    np.testing.assert_allclose(out, cdist(X, Y, "cityblock"),
                                atol=1e-3, rtol=1e-3)
 
 
