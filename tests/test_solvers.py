@@ -275,6 +275,70 @@ def test_lap_integer_costs_zero_gap(res):
     assert float(obj) == float(cost[ri, ci].sum())
 
 
+def test_lap_exact_tail_jv(res):
+    # the exact Jonker–Volgenant tail alone: optimal assignment and a
+    # ~0 certified gap on float and adversarial costs
+    from scipy.optimize import linear_sum_assignment
+
+    from raft_tpu.solver.linear_assignment import _jv_solve
+
+    for seed, n in [(0, 8), (1, 33), (2, 96)]:
+        r = np.random.default_rng(seed)
+        cost = r.random((n, n)).astype(np.float32)
+        assign, gap = _jv_solve(cost, n)
+        assign = np.asarray(assign)
+        assert sorted(assign.tolist()) == list(range(n))
+        obj = float(cost[np.arange(n), assign].sum())
+        ri, ci = linear_sum_assignment(cost.astype(np.float64))
+        ref = float(cost.astype(np.float64)[ri, ci].sum())
+        assert obj == pytest.approx(ref, abs=n * 1e-6)
+        assert 0.0 <= float(gap) <= n * 1e-5
+
+
+def test_lap_tol_contract(res):
+    # tol: large-magnitude float costs push the auction's ε-floor
+    # certificate above a tight tol — solve(tol=...) must then hand the
+    # instance to the exact tail and return the true optimum
+    from scipy.optimize import linear_sum_assignment
+
+    r = np.random.default_rng(11)
+    n = 48
+    cost = (r.random((n, n)) * 1e6).astype(np.float32)
+    # tol must sit above the f32 dual-resolution floor
+    # (~n·max|cost|·2⁻²⁴ ≈ 2.9 here) — the contract is ENFORCED, so an
+    # unmeetable tol raises instead of under-delivering silently
+    tol = n * 1e6 * 2.0 ** -24 * 4
+    lap = solver.LinearAssignmentProblem(res, n)
+    _, obj = lap.solve(cost, tol=tol)
+    gap = float(lap.get_optimality_gap_bound())
+    ri, ci = linear_sum_assignment(cost.astype(np.float64))
+    ref = float(cost.astype(np.float64)[ri, ci].sum())
+    assert float(obj) == pytest.approx(ref, rel=1e-6)
+    assert gap <= tol
+
+    # an unmeetable contract beyond the exact tail's envelope must
+    # raise, not silently return a non-conforming answer
+    import raft_tpu.solver.linear_assignment as la
+
+    orig = la._EXACT_TAIL_MAX_N
+    la._EXACT_TAIL_MAX_N = 4
+    try:
+        cost8 = (r.random((8, 8)) * 1e8).astype(np.float32)
+        lap8 = solver.LinearAssignmentProblem(res, 8)
+        # tol=-1 < any gap (gaps are >= 0), so the refinement branch is
+        # taken DETERMINISTICALLY and must hit the envelope raise
+        with pytest.raises(ValueError, match="exact tail"):
+            lap8.solve(cost8, tol=-1.0)
+    finally:
+        la._EXACT_TAIL_MAX_N = orig
+
+    # an unmeetable tol within the envelope must also raise (enforced
+    # contract), not silently return a non-conforming certificate
+    with pytest.raises(ValueError, match="exceeds tol"):
+        solver.LinearAssignmentProblem(res, 8).solve(
+            (r.random((8, 8)) * 1e8).astype(np.float32), tol=-1.0)
+
+
 def test_lap_batched(res):
     r = np.random.default_rng(9)
     costs = r.integers(0, 50, size=(4, 8, 8)).astype(np.float32)
