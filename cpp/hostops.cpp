@@ -311,14 +311,17 @@ void tiled_layout_v2_fill(const int32_t* rows, const int32_t* cols,
   auto bkey = [&](int64_t i) {
     return (int64_t)(cols[i] / C) * n_rt + rows[i] / R;
   };
-  // order: (bucket, col, row, original) — np.lexsort((rows, cols, bucket))
+  // order: (bucket, original) — a stable single-key bucket sort;
+  // within-bucket order is the INPUT order, matching
+  // np.argsort(bucket, kind="stable") and the device pass's stable
+  // argsort. Chunk-internal order is irrelevant to both SpMV phases
+  // (one-hot accumulation), and one comparison key sorts markedly
+  // faster than the old (bucket, col, row) triple.
   std::vector<int64_t> order(nnz);
   for (int64_t i = 0; i < nnz; ++i) order[i] = i;
   std::sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
     int64_t ka = bkey(a), kb = bkey(b);
     if (ka != kb) return ka < kb;
-    if (cols[a] != cols[b]) return cols[a] < cols[b];
-    if (rows[a] != rows[b]) return rows[a] < rows[b];
     return a < b;
   });
   // bucket boundaries in sorted order
