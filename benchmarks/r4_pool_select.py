@@ -111,6 +111,34 @@ def main():
         print(json.dumps({k: v for k, v in results.items()
                           if k.startswith(key)}), flush=True)
 
+    # ---- e2e: the ACTUAL driver pipeline under each exact routing ----
+    # (round 5: knn_fused routes its pool selection via
+    # RAFT_TPU_POOL_SELECT — the in-composite winner here IS the
+    # production decision, no code edits needed)
+    if not dry:
+        from raft_tpu import distance
+        from raft_tpu.random import RngState, make_blobs
+
+        X, _ = make_blobs(res, RngState(0), 1_000_000, 128,
+                          n_clusters=64, cluster_std=2.0)
+        Q = X[:2048]
+        jax.block_until_ready(X)
+        idx = distance.prepare_knn_index(X, passes=1)
+        for algo in ("xla", "two_stage", "slotted", "chunked"):
+            os.environ["RAFT_TPU_POOL_SELECT"] = algo
+            try:
+                t = fx.run(lambda q: distance.knn(res, idx, q, k=64,
+                                                  tile=8192),
+                           Q)["seconds"]
+                results[f"e2e_p1.{algo}_ms"] = round(t * 1e3, 3)
+                results[f"e2e_p1.{algo}_gbps"] = round(
+                    2048 * 1_000_000 * 4.0 / t / 1e9, 2)
+            except Exception as e:  # noqa: BLE001
+                results[f"e2e_p1.{algo}_ms"] = f"err: {e}"[:120]
+            print(json.dumps({k: v for k, v in results.items()
+                              if algo in k}), flush=True)
+        os.environ.pop("RAFT_TPU_POOL_SELECT", None)
+
     results["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                          time.gmtime())
     if not dry:

@@ -62,6 +62,7 @@ precision expanded scores (validated in tests against an f64 oracle).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -137,6 +138,62 @@ def _err_bound_coeff_p1(d: int) -> float:
     return 2.0 ** -5 + 2.0 ** -14 + d * 2.0 ** -22
 
 
+def pool_select_algo() -> str:
+    """The pool-selection routing for knn_fused, from
+    ``RAFT_TPU_POOL_SELECT`` (xla | two_stage | slotted | chunked).
+    Read by the NON-jitted entry points and threaded into the core as a
+    static argument — an env read inside the jitted core would be
+    frozen into the first-traced executable and silently ignore later
+    changes (A/B harnesses flip this between calls)."""
+    algo = os.environ.get("RAFT_TPU_POOL_SELECT", "xla")
+    if algo not in ("xla", "two_stage", "slotted", "chunked"):
+        from raft_tpu.core.logger import log_warn
+
+        log_warn("RAFT_TPU_POOL_SELECT=%r unknown — using 'xla'", algo)
+        algo = "xla"
+    return algo
+
+
+def _pool_smallest(a, c: int, algo: str = "xla"):
+    """Exact c smallest per row of the candidate pool ``a`` →
+    (values ascending, positions). The driver profile attributes ~4.5
+    of 19.3 ms e2e to this selection (XLA's TopK measured ~2.5×
+    superlinear in width in-composite, round 3) — route it to any of
+    the repo's EXACT selection algorithms so the A/B
+    (benchmarks/r4_pool_select.py) can flip algorithms end-to-end
+    without code edits. Exactness is non-negotiable here: the twin-pool
+    certificate's bound_a1 / C-th-pruned terms assume exact selection
+    (an approximate selector leaves skipped bucket-top-2 entries with
+    no floor — the a3 term does not cover them). Values are re-gathered
+    from ``a`` so packed mantissa codes survive bit-exactly. An algo
+    whose envelope rejects this shape falls back to XLA with a logged
+    warning (A/B results must not mislabel what actually ran)."""
+    B, S = a.shape
+    if algo in ("two_stage", "slotted", "chunked"):
+        from raft_tpu.matrix.select_k_chunked import select_k_chunked
+        from raft_tpu.matrix.select_k_slotted import select_k_slotted
+
+        idx = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None],
+                               (B, S))
+        try:
+            if algo == "slotted":
+                vals, pos = select_k_slotted(a, idx, c, True)
+            else:
+                # two_stage IS the chunked merge with 2 chunks
+                vals, pos = select_k_chunked(
+                    a, idx, c, True, nc=2 if algo == "two_stage" else 8)
+            # bit-exact packed codes: re-gather from the input
+            return jnp.take_along_axis(a, pos, axis=1), pos
+        except NotImplementedError as e:
+            from raft_tpu.core.logger import log_warn
+
+            log_warn("pool select %r outside envelope on [%d, %d]→%d "
+                     "(%s) — falling back to XLA top_k", algo, B, S, c,
+                     e)
+    neg, pos = jax.lax.top_k(-a, c)
+    return -neg, pos
+
+
 def decode_packed_pool(cand_p, pos, S_: int, T: int, g: int,
                        pbits: int = _PACK_BITS):
     """Candidate columns from (packed value, pool position) — THE
@@ -204,11 +261,12 @@ def _prepare_ops(y, T: int, g: int, metric: str,
 @functools.partial(jax.jit,
                    static_argnames=("k", "T", "Qb", "g", "passes", "metric",
                                     "m", "rescore", "pbits", "certify",
-                                    "_diag"))
+                                    "pool_algo", "_diag"))
 def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                     k: int, T: int, Qb: int, g: int, passes: int,
                     metric: str, m: int, rescore: bool = True,
                     pbits: int = _PACK_BITS, certify: str = "kernel",
+                    pool_algo: str = "xla",
                     _diag: bool = False) -> Tuple[jax.Array, ...]:
     """Certified fused KNN on PREPARED operands (see _prepare_ops).
 
@@ -294,8 +352,7 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         C = min(k + _POOL_PAD, 2 * Ca)
         # packed f32 order == value order (negation flips only the sign
         # bit, so codes survive the top_k round-trip)
-        neg1, pos1 = jax.lax.top_k(-a1p, Ca)
-        a1_sel = -neg1
+        a1_sel, pos1 = _pool_smallest(a1p, Ca, pool_algo)
         a2_sel = jnp.take_along_axis(a2p, pos1, axis=1)
         cands = jnp.concatenate([a1_sel, a2_sel], axis=1)       # [Q, 2Ca]
         cpos = jnp.concatenate([pos1, pos1], axis=1)
@@ -336,8 +393,7 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         pool_v = jnp.concatenate([a1, a2], axis=1)              # [Q, 2S']
         pool_id = jnp.concatenate([id1, id2], axis=1)
         C = min(k + _POOL_PAD, pool_v.shape[1])
-        neg_top, pos = jax.lax.top_k(-pool_v, C)                # ascending
-        cand_v_hat = -neg_top                                   # kernel vals
+        cand_v_hat, pos = _pool_smallest(pool_v, C, pool_algo)  # ascending
         cand_pid = jnp.take_along_axis(pool_id, pos, axis=1)    # point ids
         cand_pid = jnp.where(jnp.isfinite(cand_v_hat), cand_pid, -1)
         a3_min = 2.0 * jnp.min(a3, axis=1) + xx_r[:, 0]
@@ -846,7 +902,8 @@ def knn_fused(x, y, k: int, passes: int = 3,
     vals, ids = _knn_fused_core(
         x, idx.yp, idx.y_hi, idx.y_lo, idx.yyh_k, idx.yy_raw,
         k=k, T=T, Qb=Qb, g=g, passes=passes, metric=metric, m=m,
-        rescore=rescore, pbits=idx.pbits, certify=certify)
+        rescore=rescore, pbits=idx.pbits, certify=certify,
+        pool_algo=pool_select_algo())
     if metric == "ip":
         return -vals[:Q], ids[:Q]   # internal −x·y ascending → IP desc
     return vals[:Q], ids[:Q]
