@@ -138,7 +138,7 @@ def main():
     # the auction certificate misses, vs the auction's vector path
     for nn in ([512, 1024] if not dry else [32]):
         if time.monotonic() > deadline:
-            results["budget_expired_before"] = f"jv_{nn}"
+            results["budget_expired_before_jv"] = f"jv_{nn}"
             break
         from raft_tpu.solver.linear_assignment import _jv_solve
 
