@@ -904,6 +904,10 @@ def knn_fused(x, y, k: int, passes: int = 3,
         k=k, T=T, Qb=Qb, g=g, passes=passes, metric=metric, m=m,
         rescore=rescore, pbits=idx.pbits, certify=certify,
         pool_algo=pool_select_algo())
+    if vals.shape[0] != Q:
+        vals, ids = vals[:Q], ids[:Q]
+    # else: identity slices would still cost an eager dispatch each
+    # (~2 ms RTT on the tunneled device) — skip when Q needed no pad
     if metric == "ip":
-        return -vals[:Q], ids[:Q]   # internal −x·y ascending → IP desc
-    return vals[:Q], ids[:Q]
+        return -vals, ids           # internal −x·y ascending → IP desc
+    return vals, ids
