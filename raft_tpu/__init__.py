@@ -28,6 +28,9 @@ surface TPU-first:
 - ``raft_tpu.models``   — estimator-style wrappers (PCA, TSVD, spectral
   embedding, brute-force KNN).
 - ``raft_tpu.ops``      — Pallas TPU kernels for the hot paths.
+- ``raft_tpu.observability`` — unified metrics + span tracing (counters/
+  gauges/histograms, nvtx-attributed spans, Prometheus/JSONL exporters).
+  (ref: core/nvtx.hpp + mr/resource_monitor.hpp, unified)
 """
 
 from raft_tpu.version import __version__

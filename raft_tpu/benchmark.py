@@ -53,9 +53,16 @@ class Fixture:
         self._rtt = rtt if self._rtt is None else min(self._rtt, rtt)
         return self._rtt
 
-    def run(self, fn: Callable, *args) -> Dict[str, float]:
+    def run(self, fn: Callable, *args, name: Optional[str] = None
+            ) -> Dict[str, float]:
         """Time fn(*args); returns {"seconds", "rtt"} with transport
         round-trip subtracted. (ref: ``cuda_event_timer`` role)
+
+        The result is also emitted through the observability registry
+        (``raft_tpu_benchmark_seconds{bench=<name>}`` + a ``benchmark``
+        event, keyed by ``name`` or the function's ``__name__``) so
+        BENCH_*.json trajectories and ad-hoc measurements flow from one
+        code path — see ``observability.bench_results()``.
 
         All ``reps`` dispatches are timed in ONE span with a single
         completion fetch at the end: a single device queues executions in
@@ -84,13 +91,18 @@ class Fixture:
         # an RTT) is UNRESOLVED; callers should escalate reps or report
         # `resolution` (= rtt/reps, the per-rep upper bound) marked as a
         # bound, never the noise-derived number.
-        return {"seconds": max(op_total / self.reps, 1e-9),
-                "rtt": rtt,
-                "resolved": op_total >= 0.25 * rtt,
-                "resolution": rtt / self.reps}
+        result = {"seconds": max(op_total / self.reps, 1e-9),
+                  "rtt": rtt,
+                  "resolved": op_total >= 0.25 * rtt,
+                  "resolution": rtt / self.reps}
+        from raft_tpu.observability import record_benchmark
 
-    def throughput(self, fn: Callable, nbytes: float, *args) -> Dict[str, float]:
-        r = self.run(fn, *args)
+        record_benchmark(name or getattr(fn, "__name__", repr(fn)), result)
+        return result
+
+    def throughput(self, fn: Callable, nbytes: float, *args,
+                   name: Optional[str] = None) -> Dict[str, float]:
+        r = self.run(fn, *args, name=name)
         r["gb_per_s"] = nbytes / r["seconds"] / 1e9
         return r
 

@@ -64,6 +64,15 @@ class Status(enum.Enum):
     ABORT = 2
 
 
+def _count(collective: str, x, axis_name) -> None:
+    """Report one collective to the metrics registry (lazy import keeps
+    the comms module importable without observability and vice versa).
+    Fires at trace time — see hooks.record_collective for the contract."""
+    from raft_tpu.observability import record_collective
+
+    record_collective(collective, x, axis_name)
+
+
 def _psum_like(x, op: Op, axis_name):
     if op == Op.SUM:
         return jax.lax.psum(x, axis_name)
@@ -132,11 +141,13 @@ class MeshComms:
     # -- collectives -------------------------------------------------------
     def allreduce(self, x, op: Op = Op.SUM):
         """(ref: comms_iface::allreduce → ncclAllReduce)"""
+        _count("allreduce", x, self.axis_name)
         return _psum_like(x, op, self.axis_name)
 
     def bcast(self, x, root: int = 0):
         """Broadcast from root as masked psum — O(|x|) memory per device,
         no [size, |x|] all-gather transient. (ref: comms_iface::bcast(2))"""
+        _count("bcast", x, self.axis_name)
         is_root = jax.lax.axis_index(self.axis_name) == root
         masked = jnp.where(is_root, x, jnp.zeros_like(x))
         return jax.lax.psum(masked, self.axis_name)
@@ -146,12 +157,14 @@ class MeshComms:
         unchanged — the reference's in-place reduce leaves non-root
         buffers untouched and its test asserts only the root
         (comms_iface::reduce, detail/test.hpp:97-124)."""
+        _count("reduce", x, self.axis_name)
         full = _psum_like(x, op, self.axis_name)
         is_root = jax.lax.axis_index(self.axis_name) == root
         return jnp.where(is_root, full, x)
 
     def allgather(self, x):
         """(ref: comms_iface::allgather)"""
+        _count("allgather", x, self.axis_name)
         return jax.lax.all_gather(x, self.axis_name)
 
     def allgatherv(self, x, counts: Sequence[int]):
@@ -159,19 +172,25 @@ class MeshComms:
         caller; this returns the concatenation with padding stripped.
         (ref: comms_iface::allgatherv — static counts, like the reference's
         host-provided recvcounts.)"""
+        _count("allgatherv", x, self.axis_name)
+        return self._allgatherv_impl(x, counts)
+
+    def _allgatherv_impl(self, x, counts: Sequence[int]):
         gathered = jax.lax.all_gather(x, self.axis_name)  # [size, maxlen, ...]
         parts = [gathered[i, : counts[i]] for i in range(len(counts))]
         return jnp.concatenate(parts, axis=0)
 
     def gather(self, x, root: int = 0):
         """(ref: comms_iface::gather; non-root gets zeros)"""
+        _count("gather", x, self.axis_name)
         gathered = jax.lax.all_gather(x, self.axis_name)
         is_root = jax.lax.axis_index(self.axis_name) == root
         return jnp.where(is_root, gathered, jnp.zeros_like(gathered))
 
     def gatherv(self, x, counts: Sequence[int], root: int = 0):
         """(ref: comms_iface::gatherv)"""
-        out = self.allgatherv(x, counts)
+        _count("gatherv", x, self.axis_name)
+        out = self._allgatherv_impl(x, counts)
         is_root = jax.lax.axis_index(self.axis_name) == root
         return jnp.where(is_root, out, jnp.zeros_like(out))
 
@@ -179,6 +198,7 @@ class MeshComms:
         """Each rank gets its slice of the reduction.
         (ref: comms_iface::reducescatter)"""
         expects(op == Op.SUM, "reducescatter: SUM only (like psum_scatter)")
+        _count("reducescatter", x, self.axis_name)
         return jax.lax.psum_scatter(x, self.axis_name, tiled=True)
 
     # -- device p2p ---------------------------------------------------------
@@ -194,6 +214,7 @@ class MeshComms:
         """Send shard to ``dst`` while receiving from whoever targets us.
         dst may be an int (uniform shift pattern) or a list of (src, dst)
         pairs. (ref: comms_iface::device_sendrecv → here ppermute on ICI)"""
+        _count("sendrecv", x, self.axis_name)
         size = self._size
         expects(size is not None,
                 "device_sendrecv needs MeshComms(axis, size=...) for the "
@@ -207,6 +228,7 @@ class MeshComms:
     def device_multicast_sendrecv(self, x, dsts: Optional[Sequence[int]] = None):
         """One shard to many ranks: all_gather then select is the XLA-native
         multicast. (ref: comms_iface::device_multicast_sendrecv)"""
+        _count("multicast_sendrecv", x, self.axis_name)
         return jax.lax.all_gather(x, self.axis_name)
 
     # -- grouping -----------------------------------------------------------

@@ -8,6 +8,9 @@ of :mod:`logging` with the same env-var contract:
 
 - ``RAFT_DEBUG_LOG_FILE`` — if set, log to that file instead of stderr.
 - ``RAFT_TPU_LOG_LEVEL``  — initial level name (default ``INFO``).
+- ``RAFT_LOG_ACTIVE_LEVEL`` — reference-spelled alias for the level
+  (honored when ``RAFT_TPU_LOG_LEVEL`` is unset; accepts both plain
+  names and the reference's ``RAFT_LEVEL_*`` macro spellings).
 """
 
 from __future__ import annotations
@@ -17,6 +20,32 @@ import os
 import sys
 
 _LOGGER_NAME = "raft_tpu"
+
+# The reference's finest level (RAFT_LEVEL_TRACE); register the name so
+# log_trace output renders as "[TRACE]" rather than "[Level 5]".
+TRACE = 5
+logging.addLevelName(TRACE, "TRACE")
+
+
+def _level_from_name(name: str, default: int = logging.INFO) -> int:
+    """Level name → int, knowing TRACE and the reference's
+    ``RAFT_LEVEL_<NAME>`` spellings; unknown names fall back to
+    ``default``."""
+    name = name.strip().upper()
+    if name.startswith("RAFT_LEVEL_"):
+        name = name[len("RAFT_LEVEL_"):]
+    name = {"WARN": "WARNING", "ERR": "ERROR", "OFF": "CRITICAL"}.get(
+        name, name)
+    level = logging.getLevelName(name)
+    return level if isinstance(level, int) else default
+
+
+def _env_level(default: int = logging.INFO) -> int:
+    """Initial level from env: ``RAFT_TPU_LOG_LEVEL`` wins, then the
+    reference-compatible ``RAFT_LOG_ACTIVE_LEVEL`` alias."""
+    raw = (os.environ.get("RAFT_TPU_LOG_LEVEL")
+           or os.environ.get("RAFT_LOG_ACTIVE_LEVEL"))
+    return _level_from_name(raw, default) if raw else default
 
 
 def default_logger() -> logging.Logger:
@@ -34,20 +63,19 @@ def default_logger() -> logging.Logger:
             logging.Formatter("[%(levelname)s] [%(asctime)s] %(message)s")
         )
         logger.addHandler(handler)
-        level = os.environ.get("RAFT_TPU_LOG_LEVEL", "INFO").upper()
-        logger.setLevel(getattr(logging, level, logging.INFO))
+        logger.setLevel(_env_level())
     return logger
 
 
 def set_level(level: int | str) -> None:
     if isinstance(level, str):
-        level = getattr(logging, level.upper())
+        level = _level_from_name(level)
     default_logger().setLevel(level)
 
 
 # RAFT_LOG_* macro equivalents (ref: core/logger.hpp:58+).
 def log_trace(fmt: str, *args) -> None:
-    default_logger().log(5, fmt, *args)
+    default_logger().log(TRACE, fmt, *args)
 
 
 def log_debug(fmt: str, *args) -> None:

@@ -46,11 +46,21 @@ class MemoryTracker:
             self.current_count += 1
             self.total_count += 1
             self.peak_count = max(self.peak_count, self.current_count)
+            current, peak = self.current_bytes, self.peak_bytes
+        # bridge to the unified metrics registry (outside our lock; the
+        # hook is a no-op when tracing is disabled)
+        from raft_tpu.observability import record_alloc
+
+        record_alloc(nbytes, current, peak)
 
     def deallocate(self, nbytes: int) -> None:
         with self._lock:
             self.current_bytes -= nbytes
             self.current_count -= 1
+            current = self.current_bytes
+        from raft_tpu.observability import record_free
+
+        record_free(nbytes, current)
 
 
 class StatisticsAdaptor:

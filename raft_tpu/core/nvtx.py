@@ -60,8 +60,23 @@ class _RangeEntry:
 
     def exit(self):
         st = _stack()
-        if st and st[-1] == self.name:
-            st.pop()
+        # Pop DEFENSIVELY: the entry being exited is done either way, and
+        # leaving a mismatched top on the stack would permanently skew it,
+        # mis-attributing every later monitor sample / span (the old code
+        # skipped the pop on mismatch and never recovered).
+        if st:
+            top = st.pop()
+            if top != self.name:
+                from raft_tpu.core.logger import log_warn
+
+                log_warn(
+                    "nvtx: range stack imbalance — exiting %r but top "
+                    "was %r (interleaved push/pop?)", self.name, top)
+        else:
+            from raft_tpu.core.logger import log_warn
+
+            log_warn("nvtx: range stack imbalance — exiting %r on an "
+                     "empty stack", self.name)
         self._scope.__exit__(None, None, None)
         self._ann.__exit__(None, None, None)
 

@@ -45,5 +45,10 @@ class ResourceType(enum.Enum):
     # compiled-executable cache (replaces the "legacy handle caches")
     COMPILE_CACHE = enum.auto()
 
+    # metrics sink (plays the role of the reference's resource_monitor /
+    # NVTX attribution surface: spans, comms counters, cache hit rates —
+    # see raft_tpu.observability; defaults to the process-global registry)
+    METRICS = enum.auto()
+
     # user-defined (ref: CUSTOM)
     CUSTOM = enum.auto()

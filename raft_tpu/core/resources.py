@@ -70,12 +70,22 @@ class CompileCache:
         self.hits = 0
         self.misses = 0
 
+    _MISS = object()
+
     def get_or_compile(self, key, compile_fn: Callable[[], Any]):
+        # observability bridge: lazy import (core must import first) and
+        # called outside the cache lock (the hook takes the registry lock)
+        from raft_tpu.observability import record_cache
+
         with self._lock:
-            if key in self._cache:
+            value = self._cache.get(key, CompileCache._MISS)
+            if value is not CompileCache._MISS:
                 self.hits += 1
-                return self._cache[key]
+        if value is not CompileCache._MISS:
+            record_cache(hit=True)
+            return value
         value = compile_fn()
+        record_cache(hit=False)
         with self._lock:
             self.misses += 1
             self._cache.setdefault(key, value)
@@ -193,6 +203,24 @@ class Resources:
     def compile_cache(self) -> CompileCache:
         return self.get_resource(ResourceType.COMPILE_CACHE)
 
+    # metrics sink (ref role: mr/resource_monitor.hpp + nvtx attribution;
+    # here: the raft_tpu.observability registry)
+    @property
+    def metrics(self):
+        """The handle's metrics sink. Falls back to the process-global
+        :func:`raft_tpu.observability.get_registry` when no factory is
+        registered, so every handle is observable by default."""
+        if not self.has_resource_factory(ResourceType.METRICS):
+            from raft_tpu.observability import get_registry
+
+            return get_registry()
+        return self.get_resource(ResourceType.METRICS)
+
+    def set_metrics(self, registry) -> None:
+        """Install a handle-scoped MetricsRegistry (e.g. to isolate one
+        tenant's counters from the process-global registry)."""
+        self.set_resource(ResourceType.METRICS, registry)
+
     @property
     def workspace(self) -> WorkspaceResource:
         return self.get_resource(ResourceType.WORKSPACE_RESOURCE)
@@ -245,6 +273,15 @@ def _default_device_index() -> int:
     return 0
 
 
+def _default_metrics_factory(res: Resources):
+    """Default METRICS slot: the process-global observability registry
+    (one substrate shared by all handles; override per handle with
+    ``set_metrics``)."""
+    from raft_tpu.observability import get_registry
+
+    return get_registry()
+
+
 class DeviceResources(Resources):
     """The concrete per-device handle.
 
@@ -289,6 +326,7 @@ class DeviceResources(Resources):
         )
         self.add_resource_factory(ResourceType.MEMORY_KIND, lambda r: "device")
         self.add_resource_factory(ResourceType.HOST_MEMORY_KIND, lambda r: "pinned_host")
+        self.add_resource_factory(ResourceType.METRICS, _default_metrics_factory)
 
 
 def _device_resources_reduce(self):

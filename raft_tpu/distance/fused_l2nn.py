@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from raft_tpu.core.error import expects
 from raft_tpu.core.kvp import KeyValuePair
 from raft_tpu.core.resources import ensure_resources
+from raft_tpu.observability import instrument
 
 
 def _pad_rows(y, tile):
@@ -66,6 +67,7 @@ def _fused_l2nn(x, y_padded, m_real: jax.Array, tile: int, sqrt: bool):
     return best_v, best_i
 
 
+@instrument("distance.fused_l2_nn_argmin")
 def fused_l2_nn_argmin(res, x, y, sqrt: bool = False,
                        tile: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
     """For each row of x, the nearest row of y under (squared) L2.
@@ -204,6 +206,7 @@ def _knn_certified_approx(x, y_padded, m_real, k: int, tile: int):
     return jax.lax.cond(all_certified, keep, exact, None)
 
 
+@instrument("distance.knn")
 def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
         tile: Optional[int] = None, algo: str = "auto",
         certify: str = "kernel") -> Tuple[jax.Array, jax.Array]:
@@ -376,6 +379,7 @@ def _ip_sweep(x, y_padded, m_real, k: int, tile: int):
 _SHARDED_KNN_CACHE: dict = {}
 
 
+@instrument("distance.knn_sharded")
 def knn_sharded(res, index, queries, k: int, mesh=None, axis: str = "x",
                 metric: str = "sqeuclidean", algo: str = "auto"
                 ) -> Tuple[jax.Array, jax.Array]:

@@ -68,6 +68,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.observability import instrument
+
 from raft_tpu.ops.fused_l2_topk_pallas import (
     _LANES, _PACK_BITS, _PACK_MASK, _PACK_PAD, _PBITS_MAX, VMEM_BUDGET,
     fused_l2_group_topk, fused_l2_group_topk_dchunk,
@@ -782,6 +784,7 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
                     metric, d, pbits=pbits)
 
 
+@instrument("distance.knn_fused")
 def knn_fused(x, y, k: int, passes: int = 3,
               T: Optional[int] = None, Qb: Optional[int] = None,
               g: Optional[int] = None, metric: str = "l2",

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from raft_tpu.core.error import expects
 from raft_tpu.core.resources import ensure_resources
 from raft_tpu.distance.types import METRIC_NAMES, DistanceType
+from raft_tpu.observability import instrument
 
 
 def _as_type(metric: Union[str, DistanceType]) -> DistanceType:
@@ -60,6 +61,7 @@ def _correlation(x, y):
     return _cosine(xc, yc)
 
 
+@instrument("distance.pairwise_distance")
 def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclidean",
                       p: float = 2.0, precision=None,
                       assume_finite: bool = False) -> jax.Array:

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 from raft_tpu.matrix.select_k_types import SelectAlgo
+from raft_tpu.observability import instrument
 
 
 def _load_select_k_table():
@@ -146,6 +147,7 @@ def _xla_select_k(in_val, in_idx, k: int, select_min: bool):
     return out_val, out_idx
 
 
+@instrument("matrix.select_k")
 def select_k(
     res,
     in_val,

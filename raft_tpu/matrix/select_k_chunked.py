@@ -19,6 +19,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from raft_tpu.observability import instrument
 
 
 @partial(jax.jit, static_argnames=("k", "nc"))
@@ -49,6 +50,7 @@ def chunked_envelope(length: int, nc: int = 8) -> bool:
     return length >= 2 * nc
 
 
+@instrument("matrix.select_k_chunked")
 def select_k_chunked(in_val, in_idx, k: int, select_min: bool,
                      nc: int = 8) -> Tuple[jax.Array, jax.Array]:
     """Exact chunked-merge select_k (see module doc). Selection keys

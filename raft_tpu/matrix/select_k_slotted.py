@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.ops.folds import fold_group_top2
+from raft_tpu.observability import instrument
 
 _POOL_PAD = 32
 
@@ -198,6 +199,7 @@ def slotted_envelope(L: int, k: int = None) -> Tuple[int, int, int]:
     return slot, g, 2 * (S // min(g, S))
 
 
+@instrument("matrix.select_k_slotted")
 def select_k_slotted(in_val, in_idx, k: int, select_min: bool
                      ) -> Tuple[jax.Array, jax.Array]:
     """select_k via certified slot folding.

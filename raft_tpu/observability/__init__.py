@@ -1,0 +1,99 @@
+"""raft_tpu.observability — unified metrics + span tracing.
+
+The reference scatters observability across three headers — NVTX ranges
+(core/nvtx.hpp), the rapids_logger-backed logger, and the range-attributed
+memory monitor (mr/resource_monitor.hpp). This package unifies the
+TPU-native port's equivalents behind ONE substrate:
+
+- :class:`MetricsRegistry` — counters / gauges / fixed-bucket histograms,
+  thread-safe, with a disabled mode whose fast path is a no-op attribute
+  lookup (:data:`NULL_METRIC`).
+- :func:`span` / :func:`instrument` — tracing layered on the
+  ``core.nvtx`` thread-local range stack; every span attributes its
+  metrics to the innermost enclosing range, the same attribution rule
+  ``core.memory.ResourceMonitor`` applies to memory samples.
+- hooks — comms collectives, ``CompileCache`` hit/miss, ``MemoryTracker``
+  allocations and ``benchmark.Fixture`` results all report in
+  (:mod:`raft_tpu.observability.hooks`).
+- exporters — Prometheus text exposition, JSON lines, and a human
+  summary table (:mod:`raft_tpu.observability.exporters`).
+
+Disabled globally when env ``RAFT_TPU_DISABLE_TRACING`` is set (the same
+switch ``core/nvtx.py`` honors): ``instrument`` then returns functions
+undecorated and the registry records nothing.
+
+Examples
+--------
+>>> from raft_tpu.observability import MetricsRegistry
+>>> from raft_tpu.observability.exporters import export_prometheus
+>>> reg = MetricsRegistry()
+>>> reg.counter("demo_total", {"kind": "x"}).inc(3)
+>>> print(export_prometheus(reg), end="")
+# TYPE demo_total counter
+demo_total{kind="x"} 3
+"""
+
+from raft_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NULL_METRIC,
+    DEFAULT_TIME_BUCKETS,
+    get_registry,
+    set_registry,
+    enable,
+    disable,
+    tracing_enabled,
+)
+from raft_tpu.observability.spans import (
+    instrument,
+    span,
+    tree_nbytes,
+)
+from raft_tpu.observability.hooks import (
+    record_alloc,
+    record_benchmark,
+    record_cache,
+    record_collective,
+    record_free,
+)
+from raft_tpu.observability.exporters import (
+    bench_results,
+    export_jsonl,
+    export_prometheus,
+    summary_table,
+)
+
+
+def reset() -> None:
+    """Clear the process-global registry (metrics AND events)."""
+    get_registry().reset()
+
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NULL_METRIC",
+    "DEFAULT_TIME_BUCKETS",
+    "get_registry",
+    "set_registry",
+    "enable",
+    "disable",
+    "tracing_enabled",
+    "instrument",
+    "span",
+    "tree_nbytes",
+    "record_alloc",
+    "record_benchmark",
+    "record_cache",
+    "record_collective",
+    "record_free",
+    "bench_results",
+    "export_jsonl",
+    "export_prometheus",
+    "summary_table",
+    "reset",
+]

@@ -1,0 +1,151 @@
+"""Registry exporters: Prometheus text exposition, JSON lines, summary table.
+
+Three views of one :class:`~raft_tpu.observability.metrics.MetricsRegistry`:
+
+- :func:`export_prometheus` — text exposition format (the shape
+  ``prometheus_client.generate_latest()`` emits), scrapeable as-is.
+- :func:`export_jsonl` — one JSON object per line: first the buffered
+  event stream (span ends, benchmark results), then a snapshot line per
+  metric. The substrate future ``BENCH_*.json`` trajectories are cut from.
+- :func:`summary_table` — human-readable aligned table for terminals.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import math
+from typing import Dict, Optional
+
+from raft_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+
+
+def _fmt_value(v: float) -> str:
+    """Prometheus value rendering: integers without a trailing .0."""
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    return repr(int(f)) if f.is_integer() else repr(f)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\"", "\\\"").replace("\n", "\\n")
+
+
+def _label_str(labels: Dict[str, str], extra: Optional[Dict[str, str]] = None
+               ) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    body = ",".join(f'{k}="{_escape_label(str(v))}"'
+                    for k, v in sorted(merged.items()))
+    return "{" + body + "}"
+
+
+def export_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+    """Render the registry in Prometheus text exposition format v0.0.4."""
+    reg = registry if registry is not None else get_registry()
+    out = io.StringIO()
+    seen_header = set()
+
+    def header(name: str, kind: str) -> None:
+        if name in seen_header:
+            return
+        seen_header.add(name)
+        help_text = reg.help_of(name)
+        if help_text:
+            out.write(f"# HELP {name} {help_text}\n")
+        out.write(f"# TYPE {name} {kind}\n")
+
+    for metric in reg.collect():
+        if isinstance(metric, Counter):
+            header(metric.name, "counter")
+            out.write(f"{metric.name}{_label_str(metric.labels)} "
+                      f"{_fmt_value(metric.value)}\n")
+        elif isinstance(metric, Gauge):
+            header(metric.name, "gauge")
+            out.write(f"{metric.name}{_label_str(metric.labels)} "
+                      f"{_fmt_value(metric.value)}\n")
+        elif isinstance(metric, Histogram):
+            header(metric.name, "histogram")
+            cumulative = metric.cumulative_counts()
+            bounds = [*metric.buckets, math.inf]
+            for le, c in zip(bounds, cumulative):
+                ls = _label_str(metric.labels, {"le": _fmt_value(le)})
+                out.write(f"{metric.name}_bucket{ls} {c}\n")
+            out.write(f"{metric.name}_sum{_label_str(metric.labels)} "
+                      f"{_fmt_value(metric.sum)}\n")
+            out.write(f"{metric.name}_count{_label_str(metric.labels)} "
+                      f"{metric.count}\n")
+    return out.getvalue()
+
+
+def export_jsonl(registry: Optional[MetricsRegistry] = None,
+                 events: bool = True) -> str:
+    """One JSON object per line: buffered events (oldest first), then a
+    ``{"type": "metric", ...}`` snapshot line per live metric."""
+    reg = registry if registry is not None else get_registry()
+    lines = []
+    if events:
+        for ev in list(reg.events):
+            lines.append(json.dumps(ev, sort_keys=True, default=str))
+    for metric in reg.collect():
+        rec = {"type": "metric", "name": metric.name, "labels": metric.labels}
+        if isinstance(metric, Counter):
+            rec.update(kind="counter", value=metric.value)
+        elif isinstance(metric, Gauge):
+            rec.update(kind="gauge", value=metric.value)
+        elif isinstance(metric, Histogram):
+            rec.update(kind="histogram", sum=metric.sum, count=metric.count,
+                       buckets=list(metric.buckets),
+                       bucket_counts=metric.bucket_counts())
+        lines.append(json.dumps(rec, sort_keys=True, default=str))
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def summary_table(registry: Optional[MetricsRegistry] = None) -> str:
+    """Aligned human-readable metric table (histograms as count/mean/sum)."""
+    reg = registry if registry is not None else get_registry()
+    rows = []
+    for metric in reg.collect():
+        label_s = ",".join(f"{k}={v}" for k, v in sorted(metric.labels.items()))
+        if isinstance(metric, Histogram):
+            cnt = metric.count
+            mean = metric.sum / cnt if cnt else 0.0
+            rows.append((metric.name, label_s,
+                         f"count={cnt} mean={mean:.6g} sum={metric.sum:.6g}"))
+        else:
+            rows.append((metric.name, label_s, _fmt_value(metric.value)))
+    if not rows:
+        return "(no metrics recorded)\n"
+    w0 = max(len(r[0]) for r in rows)
+    w1 = max(len(r[1]) for r in rows)
+    out = io.StringIO()
+    out.write(f"{'metric'.ljust(w0)}  {'labels'.ljust(w1)}  value\n")
+    out.write(f"{'-' * w0}  {'-' * w1}  {'-' * 5}\n")
+    for name, label_s, val in rows:
+        out.write(f"{name.ljust(w0)}  {label_s.ljust(w1)}  {val}\n")
+    return out.getvalue()
+
+
+def bench_results(registry: Optional[MetricsRegistry] = None) -> Dict[str, Dict]:
+    """{bench name: latest benchmark-event payload} — the queryable form
+    of what :meth:`raft_tpu.benchmark.Fixture.run` emitted; BENCH_*.json
+    writers consume this instead of re-implementing collection."""
+    reg = registry if registry is not None else get_registry()
+    out: Dict[str, Dict] = {}
+    for ev in reg.events:
+        if ev.get("type") == "benchmark":
+            out[ev["bench"]] = {k: v for k, v in ev.items()
+                                if k not in ("type", "bench")}
+    return out

@@ -1,0 +1,115 @@
+"""Reporting hooks the rest of raft_tpu calls into.
+
+One tiny function per instrumented subsystem, so call sites stay a
+single line and the naming scheme lives in exactly one place:
+
+- comms      → :func:`record_collective`  (``raft_tpu.comms.comms``)
+- compile    → :func:`record_cache`       (``core.resources.CompileCache``)
+- memory     → :func:`record_alloc` / :func:`record_free`
+  (``core.memory.MemoryTracker``)
+- benchmarks → :func:`record_benchmark`   (``benchmark.Fixture.run``)
+
+Every hook is a no-op after one ``enabled`` check when tracing is
+disabled, and none of them may raise into the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from raft_tpu.observability.metrics import get_registry
+
+COMMS_CALLS = "raft_tpu_comms_calls_total"
+COMMS_BYTES = "raft_tpu_comms_bytes_total"
+CACHE_HITS = "raft_tpu_compile_cache_hits_total"
+CACHE_MISSES = "raft_tpu_compile_cache_misses_total"
+MEM_ALLOC_CALLS = "raft_tpu_memory_alloc_total"
+MEM_ALLOC_BYTES = "raft_tpu_memory_alloc_bytes_total"
+MEM_FREE_CALLS = "raft_tpu_memory_free_total"
+MEM_CURRENT = "raft_tpu_memory_current_bytes"
+MEM_PEAK = "raft_tpu_memory_peak_bytes"
+BENCH_SECONDS = "raft_tpu_benchmark_seconds"
+BENCH_RUNS = "raft_tpu_benchmark_runs_total"
+
+
+def record_collective(collective: str, x, axis_name: str = "") -> None:
+    """Count one collective invocation and its payload bytes.
+
+    Called from inside ``shard_map``-traced code, so it fires at TRACE
+    time: counts are per *traced program build*, not per device
+    execution (a jitted program re-running from cache does not re-count).
+    That is the honest countable event on an XLA runtime — the collective
+    is compiled in once. Payload bytes come from the tracer's aval, which
+    carries the true per-shard shape/dtype.
+    """
+    reg = get_registry()
+    if not reg.enabled:
+        return
+    labels = {"collective": collective, "axis": str(axis_name)}
+    reg.counter(COMMS_CALLS, labels,
+                help="Collective invocations (counted at trace time)").inc()
+    n = getattr(x, "nbytes", None)
+    if isinstance(n, int):
+        reg.counter(COMMS_BYTES, labels,
+                    help="Per-shard payload bytes entering collectives"
+                    ).inc(n)
+
+
+def record_cache(hit: bool) -> None:
+    """CompileCache hit/miss accounting."""
+    reg = get_registry()
+    if not reg.enabled:
+        return
+    if hit:
+        reg.counter(CACHE_HITS, help="CompileCache lookups served from "
+                                     "an already-compiled executable").inc()
+    else:
+        reg.counter(CACHE_MISSES, help="CompileCache lookups that paid a "
+                                       "compilation").inc()
+
+
+def record_alloc(nbytes: int, current_bytes: int, peak_bytes: int) -> None:
+    """MemoryTracker.allocate bridge: counters + live/peak gauges."""
+    reg = get_registry()
+    if not reg.enabled:
+        return
+    reg.counter(MEM_ALLOC_CALLS, help="Logical allocations through "
+                                      "MemoryTracker").inc()
+    reg.counter(MEM_ALLOC_BYTES, help="Logical bytes allocated through "
+                                      "MemoryTracker").inc(max(0, nbytes))
+    reg.gauge(MEM_CURRENT, help="Live logical bytes (MemoryTracker)"
+              ).set(current_bytes)
+    reg.gauge(MEM_PEAK, help="Peak logical bytes (MemoryTracker)"
+              ).set(peak_bytes)
+
+
+def record_free(nbytes: int, current_bytes: int) -> None:
+    """MemoryTracker.deallocate bridge."""
+    reg = get_registry()
+    if not reg.enabled:
+        return
+    reg.counter(MEM_FREE_CALLS, help="Logical deallocations through "
+                                     "MemoryTracker").inc()
+    reg.gauge(MEM_CURRENT, help="Live logical bytes (MemoryTracker)"
+              ).set(current_bytes)
+
+
+def record_benchmark(name: str, result: Dict[str, float],
+                     nbytes: Optional[float] = None) -> None:
+    """Benchmark result → registry: ``Fixture.run`` calls this with its
+    RTT-corrected ``seconds`` (device-execute time, unlike the dispatch
+    time spans record), so every BENCH artifact flows from one code path.
+    """
+    reg = get_registry()
+    if not reg.enabled:
+        return
+    labels = {"bench": name}
+    reg.histogram(BENCH_SECONDS, labels,
+                  help="RTT-corrected execute seconds from benchmark."
+                       "Fixture.run").observe(result.get("seconds", 0.0))
+    reg.counter(BENCH_RUNS, labels, help="Fixture.run invocations").inc()
+    event = {"type": "benchmark", "bench": name}
+    event.update({k: v for k, v in result.items()})
+    if nbytes is not None:
+        event["nbytes"] = nbytes
+    reg.emit(event)

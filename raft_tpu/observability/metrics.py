@@ -1,0 +1,338 @@
+"""Metrics substrate: counters, gauges, fixed-bucket histograms.
+
+The TPU-native rendering of the observability the reference scatters
+across NVTX (core/nvtx.hpp), rapids_logger, and the range-attributed
+``resource_monitor`` (mr/resource_monitor.hpp): one process-wide
+:class:`MetricsRegistry` every layer reports into, exported by
+:mod:`raft_tpu.observability.exporters`.
+
+Design constraints (why this is not just ``prometheus_client``):
+
+- **Cheap enough to leave on.** Metric handles are get-or-create by
+  ``(name, labels)``; the hot path after creation is one lock-guarded
+  float add. Callers that run per-dispatch cache their handles.
+- **A disabled mode that is a no-op attribute lookup.** When the
+  registry is disabled (``RAFT_TPU_DISABLE_TRACING``, or
+  :func:`disable`), ``counter()``/``gauge()``/``histogram()`` return the
+  shared :data:`NULL_METRIC` whose methods do nothing and which never
+  creates a registry entry — the same contract ``core/nvtx.py``
+  documents for ranges.
+- **Thread-safe.** Registry creation and every metric mutation hold a
+  lock; the ``ResourceMonitor`` sampling thread and user threads can
+  report concurrently.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import os
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+LabelDict = Optional[Dict[str, str]]
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+# Fixed default buckets for wall-time histograms: 1 µs .. 30 s, the span
+# from a cached-dispatch no-op to a cold north-star compile.
+DEFAULT_TIME_BUCKETS: Tuple[float, ...] = (
+    1e-6, 1e-5, 1e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 3e-1, 1.0, 3.0, 10.0, 30.0,
+)
+
+
+def _label_key(labels: LabelDict) -> _LabelKey:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonically increasing value. (Prometheus counter semantics.)"""
+
+    __slots__ = ("name", "labels", "_lock", "_value")
+
+    def __init__(self, name: str, labels: LabelDict = None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: negative increment")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Value that can go up and down."""
+
+    __slots__ = ("name", "labels", "_lock", "_value")
+
+    def __init__(self, name: str, labels: LabelDict = None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket cumulative histogram (Prometheus ``le`` semantics).
+
+    ``buckets`` are the finite upper bounds; an implicit ``+Inf`` bucket
+    always exists, so ``bucket_counts`` has ``len(buckets) + 1`` entries
+    and the last one equals ``count``.
+    """
+
+    __slots__ = ("name", "labels", "buckets", "_lock", "_bucket_counts",
+                 "_sum", "_count")
+
+    def __init__(self, name: str, labels: LabelDict = None,
+                 buckets: Iterable[float] = DEFAULT_TIME_BUCKETS):
+        self.name = name
+        self.labels = dict(labels or {})
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError(f"histogram {name}: needs at least one bucket")
+        self._lock = threading.Lock()
+        self._bucket_counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        # linear scan: bucket lists are short (≤ ~16) and the scan is
+        # branch-predictable; bisect would pay more in call overhead
+        idx = len(self.buckets)
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                idx = i
+                break
+        with self._lock:
+            self._bucket_counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def bucket_counts(self) -> List[int]:
+        """Per-bucket (non-cumulative) counts, +Inf bucket last."""
+        with self._lock:
+            return list(self._bucket_counts)
+
+    def cumulative_counts(self) -> List[int]:
+        """Cumulative counts per ``le`` bound, +Inf last (== count)."""
+        with self._lock:
+            out, acc = [], 0
+            for c in self._bucket_counts:
+                acc += c
+                out.append(acc)
+            return out
+
+
+class _NullMetric:
+    """Shared do-nothing metric returned by a disabled registry.
+
+    Every mutating method of Counter/Gauge/Histogram exists here as a
+    no-op, so call sites never branch on enablement — the disabled fast
+    path is one attribute lookup plus an empty call.
+    """
+
+    __slots__ = ()
+
+    def inc(self, amount: float = 1.0) -> None:
+        pass
+
+    def dec(self, amount: float = 1.0) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+    @property
+    def value(self) -> float:
+        return 0.0
+
+    @property
+    def count(self) -> int:
+        return 0
+
+    @property
+    def sum(self) -> float:
+        return 0.0
+
+
+NULL_METRIC = _NullMetric()
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Process-wide (or test-local) store of named metrics + event log.
+
+    Metrics are keyed by ``(name, labels)``; ``name`` is bound to one
+    kind (counter/gauge/histogram) at first creation and a kind
+    collision raises. The event log is a bounded deque of dicts — the
+    substrate of the JSON-lines exporter (span ends, benchmark results).
+    """
+
+    def __init__(self, enabled: bool = True, max_events: int = 4096):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, _LabelKey], object] = {}
+        self._kinds: Dict[str, str] = {}
+        self._help: Dict[str, str] = {}
+        self.events: collections.deque = collections.deque(maxlen=max_events)
+
+    # -- get-or-create ----------------------------------------------------
+    def _get(self, kind: str, name: str, labels: LabelDict, help: str = "",
+             **kw):
+        if not self.enabled:
+            return NULL_METRIC
+        key = (name, _label_key(labels))
+        with self._lock:
+            bound = self._kinds.get(name)
+            if bound is not None and bound != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {bound}, "
+                    f"requested {kind}")
+            metric = self._metrics.get(key)
+            if metric is None:
+                metric = _KINDS[kind](name, labels, **kw)
+                self._metrics[key] = metric
+                self._kinds[name] = kind
+                if help:
+                    self._help.setdefault(name, help)
+            return metric
+
+    def counter(self, name: str, labels: LabelDict = None,
+                help: str = "") -> Counter:
+        return self._get("counter", name, labels, help)
+
+    def gauge(self, name: str, labels: LabelDict = None,
+              help: str = "") -> Gauge:
+        return self._get("gauge", name, labels, help)
+
+    def histogram(self, name: str, labels: LabelDict = None, help: str = "",
+                  buckets: Iterable[float] = DEFAULT_TIME_BUCKETS) -> Histogram:
+        return self._get("histogram", name, labels, help, buckets=buckets)
+
+    # -- events -----------------------------------------------------------
+    def emit(self, event: Dict) -> None:
+        """Append an event (a JSON-serializable dict) to the bounded log;
+        a ``ts`` wall-clock field is stamped if absent."""
+        if not self.enabled:
+            return
+        event.setdefault("ts", time.time())
+        self.events.append(event)
+
+    # -- introspection ----------------------------------------------------
+    def kind_of(self, name: str) -> Optional[str]:
+        with self._lock:
+            return self._kinds.get(name)
+
+    def help_of(self, name: str) -> str:
+        with self._lock:
+            return self._help.get(name, "")
+
+    def collect(self) -> List[object]:
+        """Stable-ordered snapshot of all live metrics (by name, then
+        label key) — the exporters' single entry point."""
+        with self._lock:
+            items = sorted(self._metrics.items(), key=lambda kv: kv[0])
+            return [m for _, m in items]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._metrics)
+
+    def reset(self) -> None:
+        """Drop every metric and event (tests; long-running re-baselining)."""
+        with self._lock:
+            self._metrics.clear()
+            self._kinds.clear()
+            self._help.clear()
+        self.events.clear()
+
+
+def validate_buckets(buckets: Iterable[float]) -> Tuple[float, ...]:
+    """Sorted finite bucket bounds or raise — shared by callers that
+    accept user-provided bucket lists."""
+    bs = tuple(sorted(float(b) for b in buckets))
+    if not bs or any(not math.isfinite(b) for b in bs):
+        raise ValueError("buckets must be a non-empty list of finite bounds")
+    return bs
+
+
+# -- the process-global registry -----------------------------------------
+# RAFT_TPU_DISABLE_TRACING is the one switch shared with core/nvtx.py: set,
+# it disables ranges, spans, AND metrics (the "--no-nvtx build").
+ENV_DISABLED = bool(os.environ.get("RAFT_TPU_DISABLE_TRACING"))
+
+_global_registry = MetricsRegistry(enabled=not ENV_DISABLED)
+_global_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry every built-in hook reports into."""
+    return _global_registry
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process-global registry (tests; multi-tenant embedding).
+    Returns the previous one."""
+    global _global_registry
+    with _global_lock:
+        prev, _global_registry = _global_registry, registry
+        return prev
+
+
+def enable() -> None:
+    """Runtime re-enable (no effect on already-decorated functions if the
+    process started with RAFT_TPU_DISABLE_TRACING — those compiled to the
+    bare function; see spans.instrument)."""
+    _global_registry.enabled = True
+
+
+def disable() -> None:
+    """Runtime disable: hooks fall through to NULL_METRIC no-ops and new
+    registry entries stop appearing."""
+    _global_registry.enabled = False
+
+
+def tracing_enabled() -> bool:
+    return _global_registry.enabled

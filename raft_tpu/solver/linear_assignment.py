@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.error import expects
+from raft_tpu.observability import instrument
 
 
 @partial(jax.jit, static_argnames=("n",))
@@ -319,6 +320,7 @@ class LinearAssignmentProblem:
         return self._gap_bound
 
 
+@instrument("solver.solve_lap")
 def solve_lap(res, cost, tol: float = None):
     """Functional convenience wrapper. See
     :meth:`LinearAssignmentProblem.solve` for the ``tol`` contract."""

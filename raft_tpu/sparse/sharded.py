@@ -44,6 +44,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu.core.error import expects
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
 from raft_tpu.sparse.tiled import TiledELL, tile_csr
+from raft_tpu.observability import instrument
 
 
 @jax.tree_util.register_dataclass
@@ -204,6 +205,7 @@ def _shard_map_blocks(S: ShardedTiledELL, per_block_fn, operand):
             S.row_local, S.chunk_row_tile, S.visited_row_tiles, operand)
 
 
+@instrument("sparse.spmv_sharded")
 def spmv_sharded(S: ShardedTiledELL, x) -> jax.Array:
     """y = A @ x for a :class:`ShardedTiledELL`: each mesh device runs
     the single-device tiled SpMV on its row block (replicated x), and
@@ -216,6 +218,7 @@ def spmv_sharded(S: ShardedTiledELL, x) -> jax.Array:
     return y.reshape(-1)[:S.shape[0]]
 
 
+@instrument("sparse.spmm_sharded")
 def spmm_sharded(S: ShardedTiledELL, B) -> jax.Array:
     """C = A @ B for a :class:`ShardedTiledELL` and dense replicated
     ``B`` [n_cols, kB] — the multi-vector building block (the sparse

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+from raft_tpu.observability import instrument
 
 
 @jax.tree_util.register_pytree_node_class
@@ -204,6 +205,7 @@ class TiledPairsSpmv:
         return cls(*leaves)
 
 
+@instrument("sparse.tile_csr_pairs")
 def tile_csr_pairs(A, R: int = 256, C: int = 512, E: int = 2048,
                    impl: str = "auto") -> TiledPairsSpmv:
     """One-time conversion of a sparse MATRIX (values included) to the
@@ -504,6 +506,7 @@ def tile_csr_device(A, C: int = 512, R: int = 256,
         n_col_tiles=n_ct, n_row_tiles=n_rt)
 
 
+@instrument("sparse.tile_csr")
 def tile_csr(A, C: int = 512, R: int = 256, E: int = 2048,
              impl: str = "auto") -> TiledELL:
     """Convert a CSR/COO matrix to the tiled-ELL layout (one-time, host).

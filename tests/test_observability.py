@@ -1,0 +1,420 @@
+"""Observability subsystem tests: registry semantics, span→range
+attribution, comms/cache/memory bridges, exporters, the disabled-mode
+contract, and the satellite fixes (nvtx stack imbalance, TRACE level)."""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import raft_tpu.observability as obs
+from raft_tpu.core import nvtx
+from raft_tpu.core import logger as raft_logger
+from raft_tpu.observability import (
+    MetricsRegistry,
+    NULL_METRIC,
+    export_jsonl,
+    export_prometheus,
+    instrument,
+    span,
+    summary_table,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    """Each test sees an empty process-global registry (other suites may
+    have recorded spans already) and leaves it enabled."""
+    obs.reset()
+    obs.enable()
+    yield
+    obs.reset()
+    obs.enable()
+
+
+# ---------------------------------------------------------------- registry
+def test_counter_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", {"k": "a"})
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    # same (name, labels) → same object; different labels → different
+    assert reg.counter("c_total", {"k": "a"}) is c
+    assert reg.counter("c_total", {"k": "b"}) is not c
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_semantics():
+    reg = MetricsRegistry()
+    g = reg.gauge("g")
+    g.set(10)
+    g.inc(5)
+    g.dec(2)
+    assert g.value == 13
+
+
+def test_histogram_semantics():
+    reg = MetricsRegistry()
+    h = reg.histogram("h", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(55.55)
+    assert h.bucket_counts() == [1, 1, 1, 1]
+    assert h.cumulative_counts() == [1, 2, 3, 4]
+
+
+def test_kind_collision_raises():
+    reg = MetricsRegistry()
+    reg.counter("m")
+    with pytest.raises(ValueError):
+        reg.gauge("m")
+
+
+def test_disabled_registry_is_null_and_empty():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("nope")
+    assert c is NULL_METRIC
+    c.inc()
+    reg.histogram("h").observe(1.0)
+    reg.emit({"type": "x"})
+    assert len(reg) == 0
+    assert len(reg.events) == 0
+    assert export_prometheus(reg) == ""
+
+
+# ------------------------------------------------------------------- spans
+def test_span_attributes_to_enclosing_range():
+    with nvtx.annotate("outer"):
+        with span("inner.work"):
+            pass
+    reg = obs.get_registry()
+    c = reg.counter("raft_tpu_span_calls_total",
+                    {"span": "inner.work", "range": "outer"})
+    assert c.value == 1
+
+
+def test_instrument_records_calls_time_and_bytes():
+    @instrument("test.op")
+    def op(x):
+        return x * 2
+
+    x = np.ones((4, 8), np.float32)
+    out = op(x)
+    np.testing.assert_array_equal(np.asarray(out), x * 2)
+    reg = obs.get_registry()
+    labels = {"span": "test.op", "range": ""}
+    assert reg.counter("raft_tpu_span_calls_total", labels).value == 1
+    assert reg.counter("raft_tpu_span_bytes_in_total", labels).value == 128
+    assert reg.counter("raft_tpu_span_bytes_out_total", labels).value == 128
+    assert reg.histogram("raft_tpu_span_seconds", labels).count == 1
+    ev = list(reg.events)[-1]
+    assert ev["type"] == "span" and ev["span"] == "test.op"
+
+
+def test_instrument_counts_errors_and_reraises():
+    @instrument("test.err")
+    def bad():
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        bad()
+    reg = obs.get_registry()
+    labels = {"span": "test.err", "range": ""}
+    assert reg.counter("raft_tpu_span_errors_total", labels).value == 1
+    # the stack must be balanced after the exception path
+    assert nvtx.current_range() is None
+
+
+def test_runtime_disable_records_nothing():
+    @instrument("test.quiet")
+    def op():
+        return 1
+
+    obs.disable()
+    op()
+    assert len(obs.get_registry()) == 0
+    obs.enable()
+    op()
+    assert len(obs.get_registry()) > 0
+
+
+def test_env_disabled_instrument_is_identity():
+    """With RAFT_TPU_DISABLE_TRACING set at import, instrument() must
+    return the function object unchanged (the near-zero-overhead
+    contract) and a full primitive run must record zero metrics."""
+    code = (
+        "import numpy as np\n"
+        "import raft_tpu.observability as o\n"
+        "from raft_tpu.observability import instrument\n"
+        "def f(): pass\n"
+        "assert instrument('x')(f) is f, 'expected identity decoration'\n"
+        "from raft_tpu.matrix import select_k\n"
+        "select_k(None, np.random.rand(4, 64).astype(np.float32), k=3)\n"
+        "assert len(o.get_registry()) == 0, 'metrics recorded while disabled'\n"
+        "assert o.export_prometheus() == ''\n"
+    )
+    env = dict(os.environ, RAFT_TPU_DISABLE_TRACING="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+
+
+# ------------------------------------------------------- instrumented prims
+def test_select_k_records_span_and_prometheus_is_valid():
+    from raft_tpu.matrix import select_k
+
+    select_k(None, np.random.rand(4, 128).astype(np.float32), k=4)
+    text = export_prometheus()
+    assert 'raft_tpu_span_calls_total{range="",span="matrix.select_k"} 1' \
+        in text
+    # minimal exposition-format validity: TYPE precedes samples, and
+    # histogram series carry _bucket/_sum/_count
+    lines = text.splitlines()
+    typed = {ln.split()[2] for ln in lines if ln.startswith("# TYPE")}
+    assert "raft_tpu_span_seconds" in typed
+    assert any(ln.startswith("raft_tpu_span_seconds_bucket{") for ln in lines)
+    assert any(ln.startswith("raft_tpu_span_seconds_count{") for ln in lines)
+
+
+def test_nested_primitive_attributes_to_parent_span():
+    """select_k invoked under an enclosing range attributes to it."""
+    from raft_tpu.matrix import select_k
+
+    with nvtx.annotate("caller"):
+        select_k(None, np.random.rand(2, 64).astype(np.float32), k=2)
+    reg = obs.get_registry()
+    c = reg.counter("raft_tpu_span_calls_total",
+                    {"span": "matrix.select_k", "range": "caller"})
+    assert c.value == 1
+
+
+# ------------------------------------------------------------------- comms
+def test_comms_counters_one_device_mesh():
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.comms import MeshComms
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("obx",))
+    comms = MeshComms("obx")
+
+    def fn(x):
+        y = comms.allreduce(x)
+        z = comms.allgather(x)
+        w = comms.reducescatter(z.reshape(-1))
+        return y + w.sum()
+
+    x = np.ones((4, 32), np.float32)
+    shard_map(fn, mesh=mesh, in_specs=(P("obx"),), out_specs=P("obx"))(x)
+    reg = obs.get_registry()
+    for coll, nbytes in (("allreduce", 4 * 32 * 4), ("allgather", 4 * 32 * 4),
+                         ("reducescatter", 4 * 32 * 4)):
+        labels = {"collective": coll, "axis": "obx"}
+        assert reg.counter("raft_tpu_comms_calls_total", labels).value == 1, coll
+        assert reg.counter("raft_tpu_comms_bytes_total", labels).value == nbytes
+
+
+# ----------------------------------------------------------- cache / memory
+def test_compile_cache_hit_miss_counters():
+    from raft_tpu.core.resources import CompileCache
+
+    cc = CompileCache()
+    cc.get_or_compile("a", lambda: 1)
+    cc.get_or_compile("a", lambda: 2)
+    cc.get_or_compile("b", lambda: 3)
+    assert (cc.hits, cc.misses) == (1, 2)
+    reg = obs.get_registry()
+    assert reg.counter("raft_tpu_compile_cache_hits_total").value == 1
+    assert reg.counter("raft_tpu_compile_cache_misses_total").value == 2
+
+
+def test_memory_tracker_bridge():
+    from raft_tpu.core.memory import MemoryTracker
+
+    mt = MemoryTracker()
+    mt.allocate(1000)
+    mt.allocate(24)
+    mt.deallocate(1000)
+    reg = obs.get_registry()
+    assert reg.counter("raft_tpu_memory_alloc_total").value == 2
+    assert reg.counter("raft_tpu_memory_alloc_bytes_total").value == 1024
+    assert reg.gauge("raft_tpu_memory_current_bytes").value == 24
+    assert reg.gauge("raft_tpu_memory_peak_bytes").value == 1024
+
+
+def test_resources_metrics_slot():
+    from raft_tpu.core import DeviceResources, ResourceType
+
+    res = DeviceResources()
+    assert res.metrics is obs.get_registry()
+    private = MetricsRegistry()
+    res.set_metrics(private)
+    assert res.metrics is private
+    assert res.has_resource_factory(ResourceType.METRICS)
+
+
+# -------------------------------------------------------------- benchmark
+def test_fixture_run_emits_through_registry():
+    import jax.numpy as jnp
+
+    from raft_tpu.benchmark import Fixture
+
+    fx = Fixture(reps=2)
+    r = fx.run(lambda x: x + 1, jnp.ones((8,)), name="obs_bench")
+    assert "seconds" in r
+    results = obs.bench_results()
+    assert "obs_bench" in results
+    assert results["obs_bench"]["seconds"] == r["seconds"]
+    reg = obs.get_registry()
+    assert reg.histogram("raft_tpu_benchmark_seconds",
+                         {"bench": "obs_bench"}).count == 1
+
+
+# -------------------------------------------------------------- exporters
+def _golden_registry():
+    reg = MetricsRegistry()
+    reg.counter("t_total", {"k": "v"}, help="a counter").inc(3)
+    reg.gauge("t_gauge").set(1.5)
+    reg.histogram("t_seconds", buckets=(0.1, 1.0)).observe(0.5)
+    return reg
+
+
+def test_prometheus_golden():
+    assert export_prometheus(_golden_registry()) == (
+        '# TYPE t_gauge gauge\n'
+        't_gauge 1.5\n'
+        '# TYPE t_seconds histogram\n'
+        't_seconds_bucket{le="0.1"} 0\n'
+        't_seconds_bucket{le="1"} 1\n'
+        't_seconds_bucket{le="+Inf"} 1\n'
+        't_seconds_sum 0.5\n'
+        't_seconds_count 1\n'
+        '# HELP t_total a counter\n'
+        '# TYPE t_total counter\n'
+        't_total{k="v"} 3\n'
+    )
+
+
+def test_prometheus_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter("e_total", {"p": 'a"b\\c\nd'}).inc()
+    assert 'e_total{p="a\\"b\\\\c\\nd"} 1' in export_prometheus(reg)
+
+
+def test_jsonl_golden():
+    reg = _golden_registry()
+    reg.emit({"type": "span", "span": "s", "range": "", "seconds": 0.25,
+              "bytes_in": 1, "bytes_out": 2, "error": False, "ts": 0.0})
+    lines = export_jsonl(reg).strip().split("\n")
+    recs = [json.loads(ln) for ln in lines]
+    assert recs[0] == {"type": "span", "span": "s", "range": "",
+                       "seconds": 0.25, "bytes_in": 1, "bytes_out": 2,
+                       "error": False, "ts": 0.0}
+    by_name = {r["name"]: r for r in recs[1:]}
+    assert by_name["t_total"] == {"type": "metric", "name": "t_total",
+                                  "labels": {"k": "v"}, "kind": "counter",
+                                  "value": 3.0}
+    assert by_name["t_seconds"]["bucket_counts"] == [0, 1, 0]
+
+
+def test_summary_table_renders():
+    out = summary_table(_golden_registry())
+    assert "t_total" in out and "count=1" in out
+    assert summary_table(MetricsRegistry()).startswith("(no metrics")
+
+
+# ------------------------------------------------- satellite: nvtx stack
+def test_nvtx_exception_path_balances_stack():
+    with pytest.raises(ValueError):
+        with nvtx.annotate("doomed"):
+            assert nvtx.current_range() == "doomed"
+            raise ValueError("x")
+    assert nvtx.current_range() is None
+    assert nvtx.range_stack() == []
+
+
+def test_nvtx_mismatch_pops_defensively_and_warns(caplog):
+    nvtx.push_range("a")
+    # simulate the skew a buggy caller creates: a stale name on top
+    nvtx._stack().append("stale")
+    with caplog.at_level(logging.WARNING, logger="raft_tpu"):
+        nvtx.pop_range()   # exits entry "a", finds "stale" on top
+    assert nvtx.range_stack() == ["a"]   # stale entry evicted, not stuck
+    assert any("imbalance" in r.message for r in caplog.records)
+    nvtx._stack().clear()  # leave no residue for other tests
+    getattr(nvtx._tls, "entries", []).clear()
+
+
+def test_nvtx_empty_stack_pop_warns(caplog):
+    entry = nvtx._RangeEntry("ghost")
+    entry._ann.__enter__()
+    entry._scope.__enter__()
+    with caplog.at_level(logging.WARNING, logger="raft_tpu"):
+        entry.exit()
+    assert any("imbalance" in r.message for r in caplog.records)
+    assert nvtx.range_stack() == []
+
+
+# ---------------------------------------------------- satellite: logger
+def test_trace_level_is_named():
+    assert logging.getLevelName(raft_logger.TRACE) == "TRACE"
+
+
+def test_log_trace_renders_trace(caplog):
+    with caplog.at_level(raft_logger.TRACE, logger="raft_tpu"):
+        raft_logger.log_trace("hello %s", "trace")
+    assert any(r.levelname == "TRACE" for r in caplog.records)
+
+
+def test_raft_log_active_level_alias(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_LOG_LEVEL", raising=False)
+    monkeypatch.setenv("RAFT_LOG_ACTIVE_LEVEL", "RAFT_LEVEL_TRACE")
+    assert raft_logger._env_level() == raft_logger.TRACE
+    monkeypatch.setenv("RAFT_LOG_ACTIVE_LEVEL", "warn")
+    assert raft_logger._env_level() == logging.WARNING
+    # RAFT_TPU_LOG_LEVEL wins when both are set
+    monkeypatch.setenv("RAFT_TPU_LOG_LEVEL", "error")
+    assert raft_logger._env_level() == logging.ERROR
+
+
+def test_set_level_knows_trace():
+    lg = raft_logger.default_logger()
+    before = lg.level
+    try:
+        raft_logger.set_level("trace")
+        assert lg.level == raft_logger.TRACE
+    finally:
+        lg.setLevel(before)
+
+
+# ------------------------------------------------------- static checker
+def test_hot_paths_are_instrumented():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import check_instrumented
+    finally:
+        sys.path.pop(0)
+    errors = check_instrumented.check()
+    assert errors == []
+
+
+def test_checker_catches_missing_instrumentation(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import check_instrumented
+    finally:
+        sys.path.pop(0)
+    mod = tmp_path / "raw.py"
+    mod.write_text("def hot(x):\n    return x\n")
+    errors = check_instrumented.check(
+        root=str(tmp_path), hot_paths={"raw.py": ("hot",)})
+    assert len(errors) == 2  # missing import + undecorated function
+    assert any("not decorated" in e for e in errors)

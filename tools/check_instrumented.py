@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Static check: every hot-path primitive carries @instrument.
+
+Pure-AST, no TPU (and no raft_tpu import) needed, so it runs anywhere —
+it is wired into the tier-1 suite via tests/test_observability.py. The
+check asserts, per module in :data:`HOT_PATHS`:
+
+1. the module imports ``instrument`` from ``raft_tpu.observability``, and
+2. each listed function is decorated with it (bare ``@instrument`` or
+   ``@instrument(...)``, plain name or attribute spelling).
+
+Extend HOT_PATHS when a new primitive ships — forgetting to is exactly
+the regression this check exists to catch: a hot path that silently
+ships unobserved.
+
+Usage: ``python tools/check_instrumented.py`` (exit 0 = clean).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Sequence
+
+# module (repo-relative) → functions that must be instrumented
+HOT_PATHS: Dict[str, Sequence[str]] = {
+    "raft_tpu/matrix/select_k.py": ("select_k",),
+    "raft_tpu/matrix/select_k_chunked.py": ("select_k_chunked",),
+    "raft_tpu/matrix/select_k_slotted.py": ("select_k_slotted",),
+    "raft_tpu/distance/pairwise.py": ("pairwise_distance",),
+    "raft_tpu/distance/fused_l2nn.py": (
+        "fused_l2_nn_argmin", "knn", "knn_sharded"),
+    "raft_tpu/distance/knn_fused.py": ("knn_fused",),
+    "raft_tpu/sparse/tiled.py": ("tile_csr", "tile_csr_pairs"),
+    "raft_tpu/sparse/sharded.py": ("spmv_sharded", "spmm_sharded"),
+    "raft_tpu/solver/linear_assignment.py": ("solve_lap",),
+}
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _decorator_is_instrument(dec: ast.expr) -> bool:
+    """True for @instrument, @instrument(...), @observability.instrument,
+    and @raft_tpu.observability.instrument(...)."""
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    if isinstance(dec, ast.Attribute):
+        return dec.attr == "instrument"
+    return isinstance(dec, ast.Name) and dec.id == "instrument"
+
+
+def _imports_instrument(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if (node.module or "").startswith("raft_tpu.observability"):
+                if any(a.name == "instrument" for a in node.names):
+                    return True
+        elif isinstance(node, ast.Import):
+            if any(a.name.startswith("raft_tpu.observability")
+                   for a in node.names):
+                return True
+    return False
+
+
+def check(root: str = _REPO_ROOT,
+          hot_paths: Dict[str, Sequence[str]] = None) -> List[str]:
+    """Returns a list of violation messages (empty = clean)."""
+    hot_paths = HOT_PATHS if hot_paths is None else hot_paths
+    errors: List[str] = []
+    for rel, funcs in sorted(hot_paths.items()):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            errors.append(f"{rel}: hot-path module missing")
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        if not _imports_instrument(tree):
+            errors.append(
+                f"{rel}: does not import instrument from "
+                f"raft_tpu.observability")
+        found = {}
+        for node in tree.body:  # top-level defs only
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                found[node.name] = node
+        for fn in funcs:
+            node = found.get(fn)
+            if node is None:
+                errors.append(f"{rel}: expected hot-path function "
+                              f"{fn!r} not found at module level")
+            elif not any(_decorator_is_instrument(d)
+                         for d in node.decorator_list):
+                errors.append(f"{rel}: {fn}() is not decorated with "
+                              f"@instrument")
+    return errors
+
+
+def main(argv: Sequence[str] = ()) -> int:
+    errors = check()
+    for e in errors:
+        print(f"check_instrumented: {e}", file=sys.stderr)
+    if not errors:
+        print(f"check_instrumented: OK — "
+              f"{sum(len(v) for v in HOT_PATHS.values())} functions in "
+              f"{len(HOT_PATHS)} modules instrumented")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
