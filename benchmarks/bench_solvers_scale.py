@@ -140,10 +140,13 @@ def main():
         if time.monotonic() > deadline:
             results["budget_expired_before_jv"] = f"jv_{nn}"
             break
-        from raft_tpu.solver.linear_assignment import _jv_solve
+        from raft_tpu.solver.linear_assignment import (_certify_f64,
+                                                       _jv_solve)
 
         cost = rng.random((nn, nn)).astype(np.float32) * 100.0
-        a, gap = _jv_solve(cost, nn)                  # warm/compile
+        a, u = _jv_solve(cost, nn)                    # warm/compile
+        gap = _certify_f64(cost[None], np.asarray(a)[None],
+                           np.asarray(u)[None])[0]
         r = fx.run(lambda c: _jv_solve(c, nn)[0], cost)
         results[f"jv_{nn}"] = {"n": nn,
                                "seconds": round(r["seconds"], 2),

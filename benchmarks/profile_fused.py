@@ -207,13 +207,15 @@ def main():
     if pck is not None and time.monotonic() < deadline:
         from raft_tpu.distance.knn_fused import (
             _PACK_BITS, _POOL_PAD, _pool_smallest, decode_packed_pool,
-            pool_select_algo)
+            pool_select_algo, resolve_pool_algo)
 
         a1p_m, a2p_m = pck[0], pck[1]
         S_ = a1p_m.shape[1]
         Ca = min(k + _POOL_PAD, S_)
         C = min(k + _POOL_PAD, 2 * Ca)
-        algo = pool_select_algo()
+        # resolve the envelope like production's wrapper, so the profile
+        # labels the algorithm that actually ran
+        algo = resolve_pool_algo(pool_select_algo(), S_, Ca)
 
         # sub-stages mirror knn_fused's PRODUCTION twin-pool post
         # (top_k over a1p only + twin pull — NOT the old 2S'-wide

@@ -64,6 +64,16 @@ class Fixture:
         BENCH_*.json trajectories and ad-hoc measurements flow from one
         code path — see ``observability.bench_results()``.
 
+        When tracing is enabled the result ALSO carries the static cost
+        model: ``flops``, ``bytes_accessed``, ``arithmetic_intensity``,
+        ``peak_hbm_bytes``, ``bound`` (compute-/memory-bound at the
+        chip's ridge) and ``roofline_frac`` (roofline-perfect time /
+        measured time) — captured once per (name, shape signature) via
+        ``res.profiler`` (one analysis lowering, memoized), so every
+        future BENCH artifact records FLOPs/bytes, not just seconds. A
+        callable the cost model cannot lower (host-side control flow)
+        simply omits the fields.
+
         All ``reps`` dispatches are timed in ONE span with a single
         completion fetch at the end: a single device queues executions in
         dispatch order, so total = reps·t_op + one RTT. This amortizes the
@@ -95,10 +105,36 @@ class Fixture:
                   "rtt": rtt,
                   "resolved": op_total >= 0.25 * rtt,
                   "resolution": rtt / self.reps}
+        bench_name = name or getattr(fn, "__name__", repr(fn))
+        result.update(self._cost_fields(bench_name, fn, args,
+                                        result["seconds"]))
         from raft_tpu.observability import record_benchmark
 
-        record_benchmark(name or getattr(fn, "__name__", repr(fn)), result)
+        record_benchmark(bench_name, result)
         return result
+
+    def _cost_fields(self, name: str, fn: Callable, args,
+                     seconds: float) -> Dict[str, float]:
+        """Static-cost + roofline fields for one measured callable (see
+        run()); {} when tracing is disabled or the fn resists analysis.
+        Runs AFTER timing, so the analysis compile never pollutes the
+        measurement."""
+        from raft_tpu import observability as obs
+        from raft_tpu.observability import costmodel
+
+        if not obs.tracing_enabled():
+            return {}
+        profiler = self.res.profiler
+        rec = profiler.capture_fn(name, fn, *args)
+        if rec is None:
+            return {}
+        est = costmodel.roofline(rec, profiler.spec, seconds=seconds)
+        out = {"flops": rec.flops, "bytes_accessed": rec.bytes_accessed,
+               "arithmetic_intensity": rec.arithmetic_intensity,
+               "peak_hbm_bytes": rec.peak_hbm_bytes, "bound": est.bound}
+        if est.utilization is not None:
+            out["roofline_frac"] = est.utilization
+        return out
 
     def throughput(self, fn: Callable, nbytes: float, *args,
                    name: Optional[str] = None) -> Dict[str, float]:

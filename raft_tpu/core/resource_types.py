@@ -50,5 +50,11 @@ class ResourceType(enum.Enum):
     # see raft_tpu.observability; defaults to the process-global registry)
     METRICS = enum.auto()
 
+    # cost-model profiler (static XLA cost capture + roofline attribution
+    # against the handle's device generation — see
+    # raft_tpu.observability.profiler; defaults to the process-global
+    # Profiler, like METRICS)
+    PROFILER = enum.auto()
+
     # user-defined (ref: CUSTOM)
     CUSTOM = enum.auto()

@@ -221,6 +221,25 @@ class Resources:
         tenant's counters from the process-global registry)."""
         self.set_resource(ResourceType.METRICS, registry)
 
+    # cost-model profiler (static XLA cost capture + roofline — see
+    # raft_tpu.observability.profiler)
+    @property
+    def profiler(self):
+        """The handle's cost-model profiler. Falls back to the
+        process-global :func:`raft_tpu.observability.get_profiler` when
+        no factory is registered — the same default-observable contract
+        as ``metrics``."""
+        if not self.has_resource_factory(ResourceType.PROFILER):
+            from raft_tpu.observability import get_profiler
+
+            return get_profiler()
+        return self.get_resource(ResourceType.PROFILER)
+
+    def set_profiler(self, profiler) -> None:
+        """Install a handle-scoped Profiler (e.g. to pin roofline peaks
+        to a non-default device, or isolate records per tenant)."""
+        self.set_resource(ResourceType.PROFILER, profiler)
+
     @property
     def workspace(self) -> WorkspaceResource:
         return self.get_resource(ResourceType.WORKSPACE_RESOURCE)
@@ -282,6 +301,20 @@ def _default_metrics_factory(res: Resources):
     return get_registry()
 
 
+def _default_profiler_factory(res: Resources):
+    """Default PROFILER slot: a profiler whose roofline peaks match the
+    HANDLE's device (not necessarily jax.devices()[0]) and whose records
+    publish into the handle's metrics sink."""
+    from raft_tpu.observability.profiler import Profiler
+    from raft_tpu.utils.arch import chip_spec
+
+    try:
+        spec = chip_spec(res.device)
+    except Exception:
+        spec = None
+    return Profiler(registry=None, spec=spec)
+
+
 class DeviceResources(Resources):
     """The concrete per-device handle.
 
@@ -327,6 +360,7 @@ class DeviceResources(Resources):
         self.add_resource_factory(ResourceType.MEMORY_KIND, lambda r: "device")
         self.add_resource_factory(ResourceType.HOST_MEMORY_KIND, lambda r: "pinned_host")
         self.add_resource_factory(ResourceType.METRICS, _default_metrics_factory)
+        self.add_resource_factory(ResourceType.PROFILER, _default_profiler_factory)
 
 
 def _device_resources_reduce(self):

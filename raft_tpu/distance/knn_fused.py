@@ -156,6 +156,40 @@ def pool_select_algo() -> str:
     return algo
 
 
+def resolve_pool_algo(algo: str, pool_len: int, c: int) -> str:
+    """Decide the EFFECTIVE pool-selection algorithm for a pool of width
+    ``pool_len`` selecting ``c`` — called from the NON-jitted wrapper
+    BEFORE the core, so the downgrade decision (and its warning) happens
+    per call. Deciding inside the jitted core was an observability-
+    truthfulness bug: the trace-time ``log_warn`` fired once, and every
+    later call served from the compiled cache ran the XLA fallback
+    silently — A/B runs flipping ``RAFT_TPU_POOL_SELECT`` after the
+    first trace were mislabeled. The envelope predicates mirror the
+    selectors' own NotImplementedError checks (pool values are always
+    f32, so only the shape envelopes apply)."""
+    if algo == "slotted":
+        from raft_tpu.matrix.select_k_slotted import slotted_envelope
+
+        _, _, pool_cap = slotted_envelope(pool_len, c)
+        if c <= pool_cap:
+            return algo
+        reason = f"k={c} exceeds slotted pool {pool_cap}"
+    elif algo in ("two_stage", "chunked"):
+        from raft_tpu.matrix.select_k_chunked import chunked_envelope
+
+        nc = 2 if algo == "two_stage" else 8
+        if chunked_envelope(pool_len, nc):
+            return algo
+        reason = f"len={pool_len} too short for nc={nc}"
+    else:
+        return "xla"
+    from raft_tpu.core.logger import log_warn
+
+    log_warn("pool select %r outside envelope on len=%d→%d (%s) — "
+             "using XLA top_k for this call", algo, pool_len, c, reason)
+    return "xla"
+
+
 def _pool_smallest(a, c: int, algo: str = "xla"):
     """Exact c smallest per row of the candidate pool ``a`` →
     (values ascending, positions). The driver profile attributes ~4.5
@@ -167,9 +201,13 @@ def _pool_smallest(a, c: int, algo: str = "xla"):
     certificate's bound_a1 / C-th-pruned terms assume exact selection
     (an approximate selector leaves skipped bucket-top-2 entries with
     no floor — the a3 term does not cover them). Values are re-gathered
-    from ``a`` so packed mantissa codes survive bit-exactly. An algo
-    whose envelope rejects this shape falls back to XLA with a logged
-    warning (A/B results must not mislabel what actually ran)."""
+    from ``a`` so packed mantissa codes survive bit-exactly.
+
+    ``algo`` must already be the EFFECTIVE algorithm: the non-jitted
+    wrapper resolves the shape envelope via :func:`resolve_pool_algo`
+    per call (an out-of-envelope algo here raises at trace time instead
+    of silently mislabeling what ran — the old in-core fallback logged
+    once at trace time and lied for every cached call after)."""
     B, S = a.shape
     if algo in ("two_stage", "slotted", "chunked"):
         from raft_tpu.matrix.select_k_chunked import select_k_chunked
@@ -177,21 +215,14 @@ def _pool_smallest(a, c: int, algo: str = "xla"):
 
         idx = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None],
                                (B, S))
-        try:
-            if algo == "slotted":
-                vals, pos = select_k_slotted(a, idx, c, True)
-            else:
-                # two_stage IS the chunked merge with 2 chunks
-                vals, pos = select_k_chunked(
-                    a, idx, c, True, nc=2 if algo == "two_stage" else 8)
-            # bit-exact packed codes: re-gather from the input
-            return jnp.take_along_axis(a, pos, axis=1), pos
-        except NotImplementedError as e:
-            from raft_tpu.core.logger import log_warn
-
-            log_warn("pool select %r outside envelope on [%d, %d]→%d "
-                     "(%s) — falling back to XLA top_k", algo, B, S, c,
-                     e)
+        if algo == "slotted":
+            vals, pos = select_k_slotted(a, idx, c, True)
+        else:
+            # two_stage IS the chunked merge with 2 chunks
+            vals, pos = select_k_chunked(
+                a, idx, c, True, nc=2 if algo == "two_stage" else 8)
+        # bit-exact packed codes: re-gather from the input
+        return jnp.take_along_axis(a, pos, axis=1), pos
     neg, pos = jax.lax.top_k(-a, c)
     return -neg, pos
 
@@ -902,11 +933,20 @@ def knn_fused(x, y, k: int, passes: int = 3,
     if certify == "f32" and not rescore:
         raise ValueError("knn_fused: certify='f32' needs a yp-storing "
                          "index (store_yp=True) for the exact rescore")
+    # effective pool-selection algorithm, decided (and logged) HERE in
+    # the non-jitted wrapper, per call — the core's static pool geometry
+    # reproduced exactly (S' = ceil(n_tiles/g)·128; packed pools are S'
+    # wide, unpacked 2·S')
+    S_pool = -(-n_tiles // g) * _LANES
+    pool_len = (S_pool if g * (T // _LANES) <= (1 << idx.pbits)
+                else 2 * S_pool)
+    pool_algo = resolve_pool_algo(pool_select_algo(), pool_len,
+                                  min(k + _POOL_PAD, pool_len))
     vals, ids = _knn_fused_core(
         x, idx.yp, idx.y_hi, idx.y_lo, idx.yyh_k, idx.yy_raw,
         k=k, T=T, Qb=Qb, g=g, passes=passes, metric=metric, m=m,
         rescore=rescore, pbits=idx.pbits, certify=certify,
-        pool_algo=pool_select_algo())
+        pool_algo=pool_algo)
     if vals.shape[0] != Q:
         vals, ids = vals[:Q], ids[:Q]
     # else: identity slices would still cost an eager dispatch each

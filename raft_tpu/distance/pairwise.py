@@ -278,7 +278,14 @@ def _unexpanded_guarded(x, y, t: DistanceType, p: float, d_true: int,
     the fallback), and eager callers pay one dispatch with no host
     sync instead of two blocking isfinite scans. Non-finite inputs take
     the XLA branch, whose semantics cover inf/NaN (the kernel's one-hot
-    selector dot would turn them into whole-chunk NaNs)."""
+    selector dot would turn them into whole-chunk NaNs).
+
+    Cost note for ``vmap`` callers: under vmap, ``lax.cond`` lowers to
+    ``select`` — BOTH branches execute for every batch element, so a
+    vmapped caller pays kernel + XLA fallback distance computation and
+    keeps only one result. A batched pipeline that can vouch for finite
+    inputs should call with ``assume_finite=True`` (skips the guard and
+    the dead branch) instead of vmapping this dispatcher."""
     finite = jnp.isfinite(x).all() & jnp.isfinite(y).all()
     from raft_tpu.ops.unexpanded_pallas import unexpanded_pairwise_tiled
 

@@ -17,6 +17,13 @@ TPU-native port's equivalents behind ONE substrate:
   (:mod:`raft_tpu.observability.hooks`).
 - exporters — Prometheus text exposition, JSON lines, and a human
   summary table (:mod:`raft_tpu.observability.exporters`).
+- cost model — static XLA ``cost_analysis``/``memory_analysis`` capture
+  per compiled executable plus roofline attribution against the
+  per-TPU-generation peaks in :mod:`raft_tpu.utils.arch`
+  (:mod:`raft_tpu.observability.costmodel`); :class:`Profiler` is the
+  ``res.profiler`` resource slot and :func:`roofline_report` the
+  per-primitive %%-of-roofline summary
+  (:mod:`raft_tpu.observability.profiler`).
 
 Disabled globally when env ``RAFT_TPU_DISABLE_TRACING`` is set (the same
 switch ``core/nvtx.py`` honors): ``instrument`` then returns functions
@@ -64,6 +71,19 @@ from raft_tpu.observability.exporters import (
     export_prometheus,
     summary_table,
 )
+from raft_tpu.observability.costmodel import (
+    CostRecord,
+    RooflineEstimate,
+    classify,
+    extract_cost,
+    roofline,
+    roofline_report,
+)
+from raft_tpu.observability.profiler import (
+    Profiler,
+    get_profiler,
+    set_profiler,
+)
 
 
 def reset() -> None:
@@ -96,4 +116,13 @@ __all__ = [
     "export_prometheus",
     "summary_table",
     "reset",
+    "CostRecord",
+    "RooflineEstimate",
+    "classify",
+    "extract_cost",
+    "roofline",
+    "roofline_report",
+    "Profiler",
+    "get_profiler",
+    "set_profiler",
 ]

@@ -1,0 +1,280 @@
+"""Static XLA cost capture + roofline attribution.
+
+The *analytical* half of observability (the PR-1 registry/spans are the
+*measured* half): for every AOT-compiled executable we record what XLA
+says the program must do — FLOPs and bytes accessed from
+``compiled.cost_analysis()``, peak/temp HBM from
+``compiled.memory_analysis()`` — and combine it with the per-generation
+hardware peaks in :mod:`raft_tpu.utils.arch` to answer the question every
+perf PR must answer: *how far is this primitive from what the hardware
+allows?* (Roofline model — Williams et al., CACM 2009.)
+
+Everything here is measurement-free and backend-agnostic: on the CPU
+tier-1 suite the same capture → classify → report path runs against the
+synthetic :data:`raft_tpu.utils.arch.CPU_SPEC` peaks, so the pipeline is
+tested end-to-end without TPU hardware.
+
+Capture NEVER raises into the caller: ``cost_analysis`` is best-effort
+across backends/JAX versions (dict vs list-of-dict, missing keys), and a
+primitive without a cost record simply shows up without roofline columns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional
+
+from raft_tpu.observability.metrics import MetricsRegistry, get_registry
+from raft_tpu.utils.arch import ChipSpec, chip_spec
+
+COST_FLOPS = "raft_tpu_cost_flops"
+COST_BYTES = "raft_tpu_cost_bytes_accessed"
+COST_PEAK_HBM = "raft_tpu_cost_peak_hbm_bytes"
+COST_TEMP_BYTES = "raft_tpu_cost_temp_bytes"
+COST_CAPTURES = "raft_tpu_cost_captures_total"
+
+
+@dataclasses.dataclass
+class CostRecord:
+    """Static cost of ONE compiled executable (entry + shape signature).
+
+    ``flops``/``bytes_accessed`` come from XLA's cost analysis of the
+    optimized HLO; ``*_bytes`` fields from the compiled memory analysis.
+    ``peak_hbm_bytes`` is the arguments + outputs + temporaries sum — the
+    executable's HBM high-water mark (code size excluded)."""
+
+    entry: str                      # primitive name (e.g. "randomized_svds")
+    key: str = ""                   # shape+sharding signature
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    transcendentals: float = 0.0
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    temp_bytes: int = 0
+    peak_hbm_bytes: int = 0
+    generated_code_bytes: int = 0
+    platform: str = ""
+
+    @property
+    def arithmetic_intensity(self) -> float:
+        """FLOP per HBM byte; inf for byte-free programs (degenerate)."""
+        if self.bytes_accessed <= 0:
+            return math.inf if self.flops > 0 else 0.0
+        return self.flops / self.bytes_accessed
+
+    def to_event(self) -> Dict:
+        ev = dataclasses.asdict(self)
+        ev["type"] = "cost"
+        ev["arithmetic_intensity"] = self.arithmetic_intensity
+        return ev
+
+
+def _first_cost_dict(cost) -> Dict:
+    """Normalize ``compiled.cost_analysis()`` across JAX versions: a dict,
+    a list of per-program dicts, or None."""
+    if cost is None:
+        return {}
+    if isinstance(cost, dict):
+        return cost
+    if isinstance(cost, (list, tuple)) and cost:
+        return cost[0] if isinstance(cost[0], dict) else {}
+    return {}
+
+
+def extract_cost(compiled, entry: str, key: str = "") -> Optional[CostRecord]:
+    """Build a :class:`CostRecord` from a ``jax.stages.Compiled`` (or any
+    object exposing ``cost_analysis``/``memory_analysis``). Returns None
+    when the backend exposes neither — never raises."""
+    rec = CostRecord(entry=entry, key=key)
+    got = False
+    try:
+        cost = _first_cost_dict(compiled.cost_analysis())
+        if cost:
+            rec.flops = float(cost.get("flops", 0.0))
+            rec.bytes_accessed = float(cost.get("bytes accessed", 0.0))
+            rec.transcendentals = float(cost.get("transcendentals", 0.0))
+            got = True
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            rec.argument_bytes = int(
+                getattr(mem, "argument_size_in_bytes", 0))
+            rec.output_bytes = int(getattr(mem, "output_size_in_bytes", 0))
+            rec.temp_bytes = int(getattr(mem, "temp_size_in_bytes", 0))
+            rec.generated_code_bytes = int(
+                getattr(mem, "generated_code_size_in_bytes", 0))
+            rec.peak_hbm_bytes = (rec.argument_bytes + rec.output_bytes
+                                  + rec.temp_bytes)
+            got = True
+    except Exception:
+        pass
+    return rec if got else None
+
+
+def publish(rec: CostRecord,
+            registry: Optional[MetricsRegistry] = None) -> None:
+    """Cost record → registry: per-entry gauges (latest capture wins —
+    static facts, not accumulating measurements) + a ``cost`` event that
+    carries the full record including the shape key."""
+    reg = registry if registry is not None else get_registry()
+    if not reg.enabled:
+        return
+    labels = {"entry": rec.entry}
+    reg.counter(COST_CAPTURES, labels,
+                help="XLA cost/memory analyses captured").inc()
+    reg.gauge(COST_FLOPS, labels,
+              help="XLA cost_analysis FLOPs of the latest compiled "
+                   "executable").set(rec.flops)
+    reg.gauge(COST_BYTES, labels,
+              help="XLA cost_analysis bytes accessed (HBM traffic)"
+              ).set(rec.bytes_accessed)
+    reg.gauge(COST_PEAK_HBM, labels,
+              help="args+outputs+temps of the compiled executable"
+              ).set(rec.peak_hbm_bytes)
+    reg.gauge(COST_TEMP_BYTES, labels,
+              help="XLA temp (scratch) bytes of the compiled executable"
+              ).set(rec.temp_bytes)
+    reg.emit(rec.to_event())
+
+
+# ---------------------------------------------------------------- roofline
+COMPUTE_BOUND = "compute-bound"
+MEMORY_BOUND = "memory-bound"
+
+
+def classify(arithmetic_intensity: float, spec: Optional[ChipSpec] = None,
+             f32: bool = False) -> str:
+    """Compute- vs memory-bound at the chip's ridge point
+    (peak FLOP/s ÷ HBM bytes/s)."""
+    spec = spec if spec is not None else chip_spec()
+    ridge = spec.ridge_f32 if f32 else spec.ridge
+    return COMPUTE_BOUND if arithmetic_intensity >= ridge else MEMORY_BOUND
+
+
+@dataclasses.dataclass
+class RooflineEstimate:
+    """One primitive placed on the roofline.
+
+    ``roof_flops`` is the ATTAINABLE FLOP/s at this arithmetic intensity
+    — ``min(peak_flops, AI · hbm_bw)``; ``roof_seconds`` the time a
+    roofline-perfect execution would take. With a measured ``seconds``,
+    ``utilization`` = roof_seconds / seconds (1.0 = at the roofline) and
+    ``achieved_flops``/``achieved_bw`` are the realized rates."""
+
+    entry: str
+    flops: float
+    bytes_accessed: float
+    arithmetic_intensity: float
+    bound: str
+    roof_flops: float
+    roof_seconds: float
+    seconds: Optional[float] = None
+    achieved_flops: Optional[float] = None
+    achieved_bw: Optional[float] = None
+    utilization: Optional[float] = None
+    spec_name: str = ""
+
+
+def roofline(rec: CostRecord, spec: Optional[ChipSpec] = None,
+             seconds: Optional[float] = None,
+             f32: bool = False) -> RooflineEstimate:
+    """Place one cost record on the roofline, optionally attributing a
+    measured execute time (``benchmark.Fixture.run`` seconds)."""
+    spec = spec if spec is not None else chip_spec()
+    ai = rec.arithmetic_intensity
+    peak = spec.peak_flops_f32 if f32 else spec.peak_flops
+    bound = classify(ai, spec, f32=f32)
+    roof_flops = peak if bound == COMPUTE_BOUND else ai * spec.hbm_bw
+    # roofline-perfect time: compute time or memory time, whichever rules
+    roof_seconds = max(rec.flops / peak if peak else 0.0,
+                       rec.bytes_accessed / spec.hbm_bw if spec.hbm_bw
+                       else 0.0)
+    est = RooflineEstimate(
+        entry=rec.entry, flops=rec.flops,
+        bytes_accessed=rec.bytes_accessed, arithmetic_intensity=ai,
+        bound=bound, roof_flops=roof_flops, roof_seconds=roof_seconds,
+        seconds=seconds, spec_name=spec.name)
+    if seconds and seconds > 0:
+        est.achieved_flops = rec.flops / seconds
+        est.achieved_bw = rec.bytes_accessed / seconds
+        est.utilization = min(roof_seconds / seconds, 1.0) \
+            if roof_seconds else None
+    return est
+
+
+def _fmt_count(v: float) -> str:
+    for unit, scale in (("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(v) >= scale:
+            return f"{v / scale:.3g}{unit}"
+    return f"{v:.3g}"
+
+
+def _cost_records_from_registry(reg: MetricsRegistry) -> List[CostRecord]:
+    """Latest ``cost`` event per entry → CostRecords (events hold the
+    full record; the gauges are only the scrape surface)."""
+    latest: Dict[str, CostRecord] = {}
+    for ev in reg.events:
+        if ev.get("type") != "cost":
+            continue
+        fields = {f.name: ev[f.name] for f in dataclasses.fields(CostRecord)
+                  if f.name in ev}
+        latest[ev.get("entry", "?")] = CostRecord(**fields)
+    return list(latest.values())
+
+
+def roofline_report(registry: Optional[MetricsRegistry] = None,
+                    spec: Optional[ChipSpec] = None,
+                    records: Optional[List[CostRecord]] = None,
+                    timings: Optional[Dict[str, float]] = None) -> str:
+    """Per-primitive roofline summary table, worst utilization first.
+
+    Rows come from ``records`` (e.g. ``res.profiler.records()``) or, by
+    default, the latest ``cost`` event per entry in the registry; execute
+    times from ``timings`` (entry → seconds) or, by default, matching
+    benchmark events (``observability.bench_results()``). Entries with no
+    measured time still rank (by static distance data) but show ``-`` in
+    the measured columns — the report must degrade to the static story
+    rather than hide uncaptured primitives."""
+    from raft_tpu.observability.exporters import bench_results
+
+    reg = registry if registry is not None else get_registry()
+    spec = spec if spec is not None else chip_spec()
+    if records is None:
+        records = _cost_records_from_registry(reg)
+    if timings is None:
+        timings = {name: r["seconds"]
+                   for name, r in bench_results(reg).items()
+                   if isinstance(r.get("seconds"), (int, float))}
+    ests = [roofline(r, spec, seconds=timings.get(r.entry))
+            for r in records]
+    # worst-first: measured rows by utilization ascending, then unmeasured
+    ests.sort(key=lambda e: (e.utilization is None,
+                             e.utilization if e.utilization is not None
+                             else 0.0))
+    header = (f"roofline: {spec.name} — peak {spec.peak_flops / 1e12:.3g} "
+              f"TFLOP/s, HBM {spec.hbm_bw / 1e9:.4g} GB/s, ridge "
+              f"{spec.ridge:.3g} FLOP/B")
+    cols = ("entry", "flops", "bytes", "AI", "bound", "time", "GB/s",
+            "%roof")
+    rows = []
+    for e in ests:
+        rows.append((
+            e.entry, _fmt_count(e.flops), _fmt_count(e.bytes_accessed),
+            f"{e.arithmetic_intensity:.3g}", e.bound,
+            f"{e.seconds * 1e3:.3g}ms" if e.seconds else "-",
+            f"{e.achieved_bw / 1e9:.3g}" if e.achieved_bw else "-",
+            f"{e.utilization * 100:.1f}" if e.utilization is not None
+            else "-"))
+    if not rows:
+        return header + "\n(no cost records captured)\n"
+    widths = [max(len(c), *(len(r[i]) for r in rows))
+              for i, c in enumerate(cols)]
+    lines = [header,
+             "  ".join(c.ljust(w) for c, w in zip(cols, widths)),
+             "  ".join("-" * w for w in widths)]
+    for r in rows:
+        lines.append("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,199 @@
+"""The ``res.profiler`` resource: cost capture + roofline + XLA tracing.
+
+One :class:`Profiler` per handle (shared through the process-default
+handle exactly like ``res.metrics``): it owns the chip's roofline peaks,
+keeps the latest :class:`~raft_tpu.observability.costmodel.CostRecord`
+per (entry, shape signature), and publishes every capture into the
+metrics registry so the exporters and :func:`roofline_report` see them.
+
+Capture sites (asserted statically by ``tools/check_instrumented.py``):
+
+- ``runtime.entry_points._aot_call`` — every AOT-compiled runtime entry
+  records its executable's cost on the compile miss (hits reuse the
+  stored record; the cost of an executable is a property of the
+  executable, not of the dispatch).
+- ``benchmark.Fixture.run`` — benchmarks lower/compile the measured
+  callable once per (name, signature) for analysis, so BENCH artifacts
+  carry FLOPs/bytes/roofline%% alongside seconds.
+
+Tracing bridge: :meth:`Profiler.trace` wraps ``jax.profiler.trace`` (the
+xprof trace writer) and re-announces the current nvtx range stack as
+``TraceAnnotation``s inside the trace window, so XLA host-timeline events
+attribute to the same range stack the span metrics use. (``core.nvtx``
+already opens a ``TraceAnnotation`` per range — the bridge covers ranges
+pushed BEFORE the trace window opened, which xprof would otherwise drop.)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Callable, Dict, Iterator, Optional
+
+import jax
+
+from raft_tpu.core import nvtx
+from raft_tpu.observability import costmodel
+from raft_tpu.observability.costmodel import CostRecord
+from raft_tpu.observability.metrics import MetricsRegistry, get_registry
+from raft_tpu.observability.spans import span
+from raft_tpu.utils.arch import ChipSpec, chip_spec
+
+
+def _signature(args, kwargs=None) -> str:
+    """Shape+dtype+sharding signature of a call — the cost-record key
+    (mirrors the CompileCache key structure in runtime.entry_points)."""
+    parts = []
+    for a in jax.tree_util.tree_leaves((args, kwargs or {})):
+        shape = getattr(a, "shape", None)
+        if shape is None:
+            parts.append(repr(a))
+        else:
+            parts.append(f"{getattr(a, 'dtype', '?')}{tuple(shape)}"
+                         f"@{getattr(a, 'sharding', None)}")
+    return ";".join(parts)
+
+
+class Profiler:
+    """Cost-model store + roofline attribution for one handle.
+
+    Thread-safe; capture never raises into the caller (a failed analysis
+    just leaves the entry without a record)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 spec: Optional[ChipSpec] = None):
+        self._registry = registry
+        self.spec = spec if spec is not None else chip_spec()
+        self._lock = threading.Lock()
+        self._records: Dict[str, CostRecord] = {}       # latest per entry
+        self._by_key: Dict[tuple, CostRecord] = {}      # (entry, key)
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        # late-bound default so a post-construction set_registry() swap
+        # (tests, multi-tenant embedding) is honored
+        return self._registry if self._registry is not None \
+            else get_registry()
+
+    # -- capture ----------------------------------------------------------
+    def capture(self, entry: str, compiled, key: str = ""
+                ) -> Optional[CostRecord]:
+        """Record ``compiled``'s cost/memory analysis under ``entry``.
+        Returns the record (None when the backend exposes no analysis)."""
+        rec = costmodel.extract_cost(compiled, entry, key=key)
+        if rec is None:
+            return None
+        rec.platform = jax.default_backend()
+        with self._lock:
+            self._records[entry] = rec
+            self._by_key[(entry, key)] = rec
+        costmodel.publish(rec, self.registry)
+        return rec
+
+    def capture_fn(self, entry: str, fn: Callable, *args,
+                   **kwargs) -> Optional[CostRecord]:
+        """Lower+compile ``fn(*args)`` FOR ANALYSIS ONLY and capture its
+        cost, memoized by (entry, signature) — repeated benchmark runs of
+        the same shape pay one analysis compile total. Jitted callables
+        reuse their own lowering path; plain callables are wrapped. Any
+        failure (non-jittable fn, backend without analysis) returns the
+        memoized/None record without raising."""
+        key = _signature(args, kwargs)
+        with self._lock:
+            hit = self._by_key.get((entry, key))
+        if hit is not None:
+            # refresh the latest-per-entry pointer and the registry view
+            with self._lock:
+                self._records[entry] = hit
+            return hit
+        try:
+            target = fn if hasattr(fn, "lower") else jax.jit(fn)
+            compiled = target.lower(*args, **kwargs).compile()
+        except Exception:
+            return None
+        return self.capture(entry, compiled, key=key)
+
+    # -- queries ----------------------------------------------------------
+    def records(self) -> Dict[str, CostRecord]:
+        """Latest record per entry (a copy)."""
+        with self._lock:
+            return dict(self._records)
+
+    def get(self, entry: str) -> Optional[CostRecord]:
+        with self._lock:
+            return self._records.get(entry)
+
+    def roofline(self, entry: str, seconds: Optional[float] = None,
+                 f32: bool = False):
+        """RooflineEstimate for one captured entry (None if uncaptured).
+        ``seconds`` defaults to the entry's latest benchmark event."""
+        rec = self.get(entry)
+        if rec is None:
+            return None
+        if seconds is None:
+            from raft_tpu.observability.exporters import bench_results
+
+            r = bench_results(self.registry).get(entry, {})
+            s = r.get("seconds")
+            seconds = s if isinstance(s, (int, float)) else None
+        return costmodel.roofline(rec, self.spec, seconds=seconds, f32=f32)
+
+    def report(self) -> str:
+        """Roofline summary over THIS profiler's records (see
+        :func:`raft_tpu.observability.costmodel.roofline_report`)."""
+        return costmodel.roofline_report(
+            registry=self.registry, spec=self.spec,
+            records=list(self.records().values()))
+
+    # -- xprof bridge -----------------------------------------------------
+    @contextlib.contextmanager
+    def trace(self, log_dir: Optional[str] = None,
+              name: str = "raft_tpu.trace") -> Iterator[None]:
+        """Scoped xprof trace attributed to the span range stack.
+
+        With ``log_dir``, starts ``jax.profiler.trace`` (viewable in
+        xprof/TensorBoard); without, it is a pure annotation bridge. The
+        nvtx ranges already active at entry are re-entered as
+        ``TraceAnnotation``s inside the window (ranges opened after entry
+        carry their own — see core.nvtx), and the window itself is a
+        span, so the trace shows up in the metrics registry too."""
+        with contextlib.ExitStack() as stack:
+            if log_dir is not None:
+                try:
+                    stack.enter_context(jax.profiler.trace(log_dir))
+                except Exception:
+                    from raft_tpu.core.logger import log_warn
+
+                    log_warn("profiler.trace: jax.profiler.trace(%r) "
+                             "unavailable — continuing with annotations "
+                             "only", log_dir)
+            for rng in nvtx.range_stack():
+                try:
+                    stack.enter_context(jax.profiler.TraceAnnotation(rng))
+                except Exception:
+                    break
+            stack.enter_context(span(name))
+            yield
+
+
+# -- process-global default (the METRICS pattern) -------------------------
+_global_profiler: Optional[Profiler] = None
+_global_lock = threading.Lock()
+
+
+def get_profiler() -> Profiler:
+    """Process-global Profiler, created lazily on first use — what
+    ``res.profiler`` resolves to when no handle-scoped one is set."""
+    global _global_profiler
+    with _global_lock:
+        if _global_profiler is None:
+            _global_profiler = Profiler()
+        return _global_profiler
+
+
+def set_profiler(profiler: Profiler) -> Optional[Profiler]:
+    """Swap the process-global Profiler (tests). Returns the previous."""
+    global _global_profiler
+    with _global_lock:
+        prev, _global_profiler = _global_profiler, profiler
+        return prev

@@ -21,15 +21,29 @@ def _aot_call(res, name: str, statics: tuple, fn, *args):
     reuse the executable from the handle's CompileCache — the TPU-native
     analog of the reference's precompiled libraft.so instantiations
     (ref: cpp/CMakeLists.txt:275-309). ``res.compile_cache.hits`` counts
-    reuse (tested in tests/test_runtime_aot.py)."""
+    reuse (tested in tests/test_runtime_aot.py).
+
+    Every compile miss also records the executable's static cost — XLA
+    ``cost_analysis`` FLOPs/bytes and ``memory_analysis`` peak HBM — into
+    ``res.profiler``, keyed by the same (entry, statics, shapes, sharding)
+    signature as the cache, so roofline attribution covers every runtime
+    entry without a second lowering (cache hits reuse the stored record)."""
     args = tuple(jnp.asarray(a) for a in args)
     # sharding/placement is part of the compiled executable's signature —
     # a cache hit with differently-committed args would raise at dispatch
     key = (name, statics,
            tuple((a.shape, str(a.dtype),
                   str(getattr(a, "sharding", None))) for a in args))
-    compiled = res.compile_cache.get_or_compile(
-        key, lambda: jax.jit(fn).lower(*args).compile())
+
+    def _compile():
+        compiled = jax.jit(fn).lower(*args).compile()
+        try:
+            res.profiler.capture(name, compiled, key=str(key[1:]))
+        except Exception:
+            pass  # cost capture must never fail the entry point
+        return compiled
+
+    compiled = res.compile_cache.get_or_compile(key, _compile)
     return compiled(*args)
 
 

@@ -158,10 +158,13 @@ def _jv_solve(cost, n: int):
     for small n behind the auction solver, closing the contract gap
     with the reference's exact Hungarian (linear_assignment.cuh:125).
 
-    Returns (row→col assignment [n], certified gap bound): the duals it
-    maintains are projected to feasibility (v_j ← min_i cost[i,j]−u_i)
-    and LP duality turns any residual f32 rounding into a PROVEN bound
-    ``objective − optimum ≤ obj − Σu − Σv`` (0 in exact arithmetic)."""
+    Returns (row→col assignment [n], row duals u [n]): the certificate
+    itself — project the duals to feasibility (v_j ← min_i cost[i,j]−u_i),
+    then LP duality bounds ``objective − optimum ≤ obj − Σu − Σv`` (0 in
+    exact arithmetic) — is NOT computed here: the ENFORCED tol contract
+    recomputes it in float64 on the host-synced duals/assignment (see
+    :func:`_certify_f64`), because an in-graph f32 reduction of obj/Σu/Σv
+    can round a positive gap down to below tol and under-report it."""
     INF = jnp.float32(3e38)
     cost = cost.astype(jnp.float32)
     virt = jnp.int32(n)  # virtual start column (the e-maxx "column 0")
@@ -222,12 +225,29 @@ def _jv_solve(cost, n: int):
     assign = jnp.zeros((n,), jnp.int32).at[row_of].set(
         jnp.arange(n, dtype=jnp.int32))          # row → col
 
-    # certify: project duals to feasibility, then LP duality bounds the
-    # gap by obj − Σu − Σv regardless of f32 rounding along the way
-    v_feas = jnp.min(cost - u[:, None], axis=0)
-    obj = jnp.take_along_axis(cost, assign[:, None], axis=1)[:, 0].sum()
-    gap = jnp.maximum(obj - (jnp.sum(u) + jnp.sum(v_feas)), 0.0)
-    return assign, gap
+    return assign, u
+
+
+def _certify_f64(cost_np: np.ndarray, assign_np: np.ndarray,
+                 u_np: np.ndarray) -> np.ndarray:
+    """ENFORCED optimality-gap certificate, float64 on the host.
+
+    For each batched instance: project the row duals to feasibility
+    (v_j = min_i cost[i,j] − u_i), then LP duality proves
+    ``objective − optimum ≤ obj − Σu − Σv_feas``. All three terms
+    (objective, Σu, Σv_feas) are evaluated in float64 via numpy on the
+    host-synced duals/assignment, so f32 reduction rounding cannot
+    under-report the gap a tol check then trusts — the dual VALUES still
+    carry f32 solver noise, but duality makes the bound valid for ANY
+    duals; only the arithmetic that sums them must not round down.
+    Inputs: cost [b, n, n], assign [b, n] (row→col), u [b, n]."""
+    cost64 = np.asarray(cost_np, np.float64)
+    u64 = np.asarray(u_np, np.float64)
+    a = np.asarray(assign_np, np.int64)
+    v_feas = (cost64 - u64[:, :, None]).min(axis=1)          # [b, n]
+    obj = np.take_along_axis(cost64, a[:, :, None], axis=2)[:, :, 0].sum(
+        axis=1)
+    return np.maximum(obj - (u64.sum(axis=1) + v_feas.sum(axis=1)), 0.0)
 
 
 class LinearAssignmentProblem:
@@ -290,11 +310,19 @@ class LinearAssignmentProblem:
                         "loosen tol or reduce n")
                 # re-solve ONLY the instances that missed the contract
                 idx = np.flatnonzero(need)
-                assign_x, gap_x = jax.vmap(
+                assign_x, u_x = jax.vmap(
                     lambda c: _jv_solve(c, self.size))(cost[idx])
                 assign = assign.at[idx].set(assign_x)
-                gap = gap.at[idx].set(gap_x)
-                worst = float(np.asarray(gap).max())
+                # ENFORCED certificate: recomputed in float64 on the
+                # host-synced duals/assignment — an in-graph f32
+                # reduction could round a >tol gap below tol
+                gap_x = _certify_f64(np.asarray(cost[idx]),
+                                     np.asarray(assign_x),
+                                     np.asarray(u_x))
+                gap = gap.at[idx].set(
+                    jnp.asarray(gap_x, jnp.float32))
+                worst = float(max(gap_x.max(initial=0.0),
+                                  float(np.asarray(gap).max())))
                 if worst > tol:
                     raise ValueError(
                         f"LAP: certified gap {worst:.3g} exceeds "

@@ -12,14 +12,19 @@ select/reduce. See raft_tpu.ops.spmv_pallas for the kernels.)
 
 Layout produced by :func:`tile_csr`:
 
-- nonzeros sorted by (column tile, then row), padded per column tile to a
-  multiple of ``E`` (pad entries carry value 0 → contribute nothing);
-  stored as ``[n_chunks, E]`` arrays of values, LOCAL column ids
-  (col % C) and global row ids. ``chunk_col_tile [n_chunks]`` maps each
-  chunk to its x-tile (the Pallas scalar-prefetch block index).
-- the same nonzeros re-sorted by (row tile, then row), with
-  ``perm [n_chunks·E]`` being the gather permutation from col-sorted
-  contribution order to row-sorted order, ``row_local`` the in-tile row
+- nonzeros grouped by (column tile, row tile) bucket, column-tile-major —
+  within a bucket they keep stable INPUT order (a single-key stable sort
+  on the bucket id; they are NOT sorted by row within a tile, which no
+  consumer requires — the fold is order-insensitive within a bucket) —
+  padded per column tile to a multiple of ``E`` (pad entries carry value
+  0 → contribute nothing); stored as ``[n_chunks, E]`` arrays of values,
+  LOCAL column ids (col % C) and global row ids. ``chunk_col_tile
+  [n_chunks]`` maps each chunk to its x-tile (the Pallas scalar-prefetch
+  block index).
+- the same nonzeros re-grouped by row-tile bucket (stable ⇒
+  column-tile-minor within a row tile, input order within a bucket), with
+  ``perm [n_chunks·E]`` being the gather permutation from col-grouped
+  contribution order to row-grouped order, ``row_local`` the in-tile row
   ids, and ``chunk_row_tile`` the per-chunk output tile index.
 
 Conversion is one-time host work (like the reference's native cusparse

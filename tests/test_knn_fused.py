@@ -635,17 +635,25 @@ def test_pool_select_routings_agree(monkeypatch):
 
     from raft_tpu.distance.knn_fused import (_pool_smallest,
                                              pool_select_algo,
-                                             prepare_knn_index)
+                                             prepare_knn_index,
+                                             resolve_pool_algo)
 
     rng = np.random.default_rng(5)
     a = jnp.asarray(rng.standard_normal((32, 512)).astype(np.float32))
     ref_v, _ = _pool_smallest(a, 48, "xla")
     for algo in ("two_stage", "slotted", "chunked"):
-        v, p = _pool_smallest(a, 48, algo)
+        # the wrapper resolves the shape envelope BEFORE the jitted core
+        # (slotted's short-row pool caps below 48 here → downgrade to
+        # xla, decided and logged per call, not at trace time)
+        eff = resolve_pool_algo(algo, a.shape[1], 48)
+        v, p = _pool_smallest(a, 48, eff)
         np.testing.assert_array_equal(np.asarray(ref_v), np.asarray(v))
         np.testing.assert_array_equal(
             np.take_along_axis(np.asarray(a), np.asarray(p), 1),
             np.asarray(v))
+    assert resolve_pool_algo("slotted", 512, 48) == "xla"
+    assert resolve_pool_algo("two_stage", 512, 48) == "two_stage"
+    assert resolve_pool_algo("chunked", 4, 2) == "xla"  # len < 2·nc
     monkeypatch.setenv("RAFT_TPU_POOL_SELECT", "two_stage")
     assert pool_select_algo() == "two_stage"
     monkeypatch.setenv("RAFT_TPU_POOL_SELECT", "bogus")

@@ -395,7 +395,315 @@ def test_set_level_knows_trace():
         lg.setLevel(before)
 
 
+# ------------------------------------------------ cost model / roofline
+def _tools_import(name):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def test_chip_spec_cpu_fallback_and_tpu_table():
+    from raft_tpu.utils import arch
+
+    spec = arch.chip_spec()   # CPU platform under the tier-1 suite
+    assert spec is arch.CPU_SPEC
+    assert spec.ridge == spec.peak_flops / spec.hbm_bw
+    # table entries: the v5e row is the chip the round-5 verdict's
+    # 460-vs-819 GB/s gap is measured against
+    v5e = arch.TPU_SPECS[(5, "e")]
+    assert v5e.hbm_bw == pytest.approx(819e9)
+    assert v5e.ridge > 100  # TPUs: heavily compute-biased ridge
+
+
+def test_cost_capture_pairwise_distance():
+    import jax.numpy as jnp
+
+    from raft_tpu.distance import pairwise_distance
+    from raft_tpu.observability.profiler import Profiler
+
+    prof = Profiler()
+    x = jnp.asarray(np.random.rand(32, 16).astype(np.float32))
+    y = jnp.asarray(np.random.rand(24, 16).astype(np.float32))
+    rec = prof.capture_fn("pairwise_distance",
+                          lambda a, b: pairwise_distance(None, a, b), x, y)
+    assert rec is not None
+    assert rec.flops > 0
+    assert rec.bytes_accessed > 0
+    # the capture published into the registry: gauge + cost event
+    reg = obs.get_registry()
+    assert reg.gauge("raft_tpu_cost_flops",
+                     {"entry": "pairwise_distance"}).value == rec.flops
+    assert any(ev.get("type") == "cost" and
+               ev.get("entry") == "pairwise_distance"
+               for ev in reg.events)
+    # memoized: same signature → same record, no second analysis compile
+    assert prof.capture_fn("pairwise_distance",
+                           lambda a, b: pairwise_distance(None, a, b),
+                           x, y) is rec
+
+
+def test_cost_capture_select_k_and_tiled_spmv():
+    import jax.numpy as jnp
+
+    from raft_tpu.core.sparse_types import CSRMatrix
+    from raft_tpu.matrix import select_k
+    from raft_tpu.observability.costmodel import MEMORY_BOUND, classify
+    from raft_tpu.observability.profiler import Profiler
+    from raft_tpu.sparse.linalg import spmv
+    from raft_tpu.sparse.tiled import tile_csr
+
+    prof = Profiler()
+    a = jnp.asarray(np.random.rand(8, 256).astype(np.float32))
+    rec = prof.capture_fn("select_k", lambda v: select_k(None, v, k=8), a)
+    assert rec is not None and rec.bytes_accessed > 0
+
+    rng = np.random.default_rng(0)
+    dense = (rng.random((256, 256))
+             * (rng.random((256, 256)) < 0.1)).astype(np.float32)
+    tiled = tile_csr(CSRMatrix.from_dense(dense), C=128, R=8, E=512)
+    xv = jnp.asarray(rng.random(256), jnp.float32)
+    rec2 = prof.capture_fn("spmv_tiled", lambda t, v: spmv(None, t, v),
+                           tiled, xv)
+    assert rec2 is not None and rec2.bytes_accessed > 0
+    assert prof.get("spmv_tiled") is rec2
+    # SpMV streams its operand once: memory-bound on any spec table entry
+    assert classify(rec2.arithmetic_intensity, prof.spec) == MEMORY_BOUND
+
+
+def test_roofline_classification_sanity():
+    """GEMM → compute-bound, SpMV-like streaming → memory-bound, on the
+    deterministic CPU fallback peaks."""
+    import jax.numpy as jnp
+
+    from raft_tpu.observability import costmodel
+    from raft_tpu.observability.profiler import Profiler
+    from raft_tpu.utils.arch import CPU_SPEC
+
+    prof = Profiler(spec=CPU_SPEC)
+    n = 256
+    a = jnp.ones((n, n), jnp.float32)
+    gemm = prof.capture_fn("gemm", jax.jit(lambda p, q: p @ q), a, a)
+    assert gemm is not None
+    # AI ≈ n/6 = 42.7 FLOP/B >> ridge 8
+    assert costmodel.classify(gemm.arithmetic_intensity, CPU_SPEC) \
+        == costmodel.COMPUTE_BOUND
+    v = jnp.ones((1 << 18,), jnp.float32)
+    axpy = prof.capture_fn("axpy", jax.jit(lambda p: p * 2.0 + 1.0), v)
+    assert axpy is not None
+    assert costmodel.classify(axpy.arithmetic_intensity, CPU_SPEC) \
+        == costmodel.MEMORY_BOUND
+    # roofline estimate math: utilization in (0, 1], roof time positive
+    est = costmodel.roofline(gemm, CPU_SPEC, seconds=1.0)
+    assert est.bound == costmodel.COMPUTE_BOUND
+    assert est.roof_seconds > 0
+    assert 0 < est.utilization <= 1
+
+
+def test_fixture_run_emits_cost_model_fields():
+    import jax.numpy as jnp
+
+    from raft_tpu.benchmark import Fixture
+
+    fx = Fixture(reps=2)
+    f = jax.jit(lambda p, q: p @ q)
+    a = jnp.ones((128, 128), jnp.float32)
+    r = fx.run(f, a, a, name="obs_cost_bench")
+    for field in ("flops", "bytes_accessed", "arithmetic_intensity",
+                  "peak_hbm_bytes", "bound", "roofline_frac"):
+        assert field in r, field
+    assert r["flops"] > 0 and r["bytes_accessed"] > 0
+    assert r["bound"] in ("compute-bound", "memory-bound")
+    assert 0 < r["roofline_frac"] <= 1
+    # the benchmark event (the BENCH_*.json substrate) carries them too
+    ev = obs.bench_results()["obs_cost_bench"]
+    assert ev["flops"] == r["flops"]
+    assert ev["bound"] == r["bound"]
+
+
+def test_roofline_report_instrumented_hot_paths():
+    """Acceptance: a CPU run of instrumented hot paths produces a
+    roofline_report with per-primitive FLOPs, bytes, AI, and bound."""
+    import jax.numpy as jnp
+
+    from raft_tpu.benchmark import Fixture
+    from raft_tpu.distance import pairwise_distance
+    from raft_tpu.matrix import select_k
+    from raft_tpu.observability import roofline_report
+
+    fx = Fixture(reps=1)
+    x = jnp.asarray(np.random.rand(64, 32).astype(np.float32))
+    y = jnp.asarray(np.random.rand(48, 32).astype(np.float32))
+    fx.run(lambda a, b: pairwise_distance(None, a, b), x, y,
+           name="pairwise_distance")
+    fx.run(lambda v: select_k(None, v, k=8)[0],
+           jnp.asarray(np.random.rand(16, 512).astype(np.float32)),
+           name="matrix.select_k")
+    out = roofline_report()
+    assert "pairwise_distance" in out and "matrix.select_k" in out
+    for col in ("flops", "bytes", "AI", "bound", "%roof"):
+        assert col in out
+    assert "bound" in out and ("memory-bound" in out
+                               or "compute-bound" in out)
+
+
+def test_aot_call_captures_cost():
+    import jax.numpy as jnp
+
+    from raft_tpu.core.resources import DeviceResources
+    from raft_tpu.observability.profiler import Profiler
+    from raft_tpu.runtime.entry_points import _aot_call
+
+    res = DeviceResources()
+    res.set_profiler(Profiler())
+    out = _aot_call(res, "aot_double", (), lambda v: v * 2.0,
+                    jnp.ones((64,), jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    rec = res.profiler.get("aot_double")
+    assert rec is not None
+    assert rec.bytes_accessed > 0
+    assert rec.key  # shape+sharding signature recorded
+    # cache hit: no re-capture needed, record survives
+    _aot_call(res, "aot_double", (), lambda v: v * 2.0,
+              jnp.ones((64,), jnp.float32))
+    assert res.profiler.get("aot_double") is rec
+
+
+def test_resources_profiler_slot():
+    from raft_tpu.core import DeviceResources
+    from raft_tpu.observability.profiler import Profiler, get_profiler
+
+    res = DeviceResources()
+    p = res.profiler
+    assert isinstance(p, Profiler)
+    assert res.profiler is p          # lazily built once, then cached
+    mine = Profiler()
+    res.set_profiler(mine)
+    assert res.profiler is mine
+    # the process-global fallback exists and is a Profiler too
+    assert isinstance(get_profiler(), Profiler)
+
+
+def test_profiler_trace_bridges_range_stack():
+    from raft_tpu.observability.profiler import Profiler
+
+    prof = Profiler()
+    with nvtx.annotate("outer.phase"):
+        with prof.trace(name="trace.window"):
+            pass
+    reg = obs.get_registry()
+    c = reg.counter("raft_tpu_span_calls_total",
+                    {"span": "trace.window", "range": "outer.phase"})
+    assert c.value == 1
+    assert nvtx.current_range() is None  # balanced on exit
+
+
+# ------------------------------------------------------- bench_report
+def _write(path, obj):
+    with open(path, "w") as f:
+        json.dump(obj, f)
+
+
+def _bench_dir(tmp_path, latest_value, baseline_value=460.0,
+               with_baseline=True, degraded=False, unit="GB/s"):
+    metric = "fused top-64 2048x1000000x128"
+    _write(tmp_path / "BENCH_r01.json",
+           {"n": 1, "parsed": {"metric": metric, "value": 100.0,
+                               "unit": unit, "git_commit": "aaa"}})
+    _write(tmp_path / "BENCH_r02.json",
+           {"n": 2, "parsed": {"metric": metric + " (tpu)",
+                               "value": latest_value, "unit": unit,
+                               "degraded": degraded,
+                               "git_commit": "bbb"}})
+    if with_baseline:
+        _write(tmp_path / "BENCH_LAST_GOOD.json",
+               {"metric": metric, "value": baseline_value, "unit": unit})
+    return str(tmp_path)
+
+
+def test_bench_report_trajectory_and_pass(tmp_path, capsys):
+    br = _tools_import("bench_report")
+    d = _bench_dir(tmp_path, latest_value=470.0)
+    rounds = br.collect_rounds(d)
+    assert [n for n, _, _ in rounds] == [1, 2]
+    out = br.trajectory(rounds, br.load_record(
+        os.path.join(d, "BENCH_LAST_GOOD.json")))
+    assert "r01" in out and "r02" in out and "LAST_GOOD" in out
+    assert br.main(["--dir", d, "--check"]) == 0
+    assert "pass" in capsys.readouterr().out
+
+
+def test_bench_report_detects_regression(tmp_path, capsys):
+    br = _tools_import("bench_report")
+    # 300 GB/s vs 460 last-good: −35% >> 15% threshold
+    d = _bench_dir(tmp_path, latest_value=300.0)
+    assert br.main(["--dir", d, "--check"]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    # generous threshold: passes again
+    assert br.main(["--dir", d, "--check", "--threshold", "0.5"]) == 0
+
+
+def test_bench_report_missing_baseline(tmp_path, capsys):
+    br = _tools_import("bench_report")
+    d = _bench_dir(tmp_path, latest_value=300.0, with_baseline=False)
+    assert br.main(["--dir", d, "--check"]) == 2
+    assert "missing-baseline" in capsys.readouterr().out
+
+
+def test_bench_report_skips_degraded_and_empty(tmp_path, capsys):
+    br = _tools_import("bench_report")
+    # degraded latest → no-op exit 0 even though the value regressed
+    d = _bench_dir(tmp_path, latest_value=1.0, degraded=True)
+    assert br.main(["--dir", d, "--check"]) == 0
+    # seconds-style unit: regression is UPWARD
+    d2 = tmp_path / "ms"
+    d2.mkdir()
+    _write(d2 / "BENCH_r01.json",
+           {"parsed": {"metric": "op", "value": 30.0, "unit": "ms"}})
+    _write(d2 / "BENCH_LAST_GOOD.json",
+           {"metric": "op", "value": 20.0, "unit": "ms"})
+    assert br.main(["--dir", str(d2), "--check"]) == 1
+    # empty dir → nothing to gate
+    d3 = tmp_path / "empty"
+    d3.mkdir()
+    assert br.main(["--dir", str(d3), "--check"]) == 0
+    capsys.readouterr()
+
+
+def test_bench_report_check_on_repo_is_noop():
+    """The tier-1 wiring: ``bench_report.py --check`` on the repo's real
+    artifacts must exit 0 (no new gateable artifact → no-op) — the same
+    invocation CI runs."""
+    root = os.path.join(os.path.dirname(__file__), "..")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "bench_report.py"),
+         "--check"], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_bench_report_trajectory_on_repo_artifacts():
+    """Acceptance: a trajectory over the committed BENCH_r01..r05.json."""
+    root = os.path.join(os.path.dirname(__file__), "..")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "bench_report.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    for tag in ("r01", "r05", "LAST_GOOD"):
+        assert tag in proc.stdout
+
+
 # ------------------------------------------------------- static checker
+def test_cost_capture_sites_checked(tmp_path):
+    ci = _tools_import("check_instrumented")
+    assert ci.check_cost_capture() == []
+    mod = tmp_path / "bench_like.py"
+    mod.write_text("def run():\n    return 1\n")
+    errors = ci.check_cost_capture(
+        root=str(tmp_path), sites={"bench_like.py": ("capture_fn",)})
+    assert len(errors) == 1 and "capture_fn" in errors[0]
+
+
 def test_hot_paths_are_instrumented():
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
     try:

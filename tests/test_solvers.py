@@ -280,12 +280,14 @@ def test_lap_exact_tail_jv(res):
     # ~0 certified gap on float and adversarial costs
     from scipy.optimize import linear_sum_assignment
 
-    from raft_tpu.solver.linear_assignment import _jv_solve
+    from raft_tpu.solver.linear_assignment import _certify_f64, _jv_solve
 
     for seed, n in [(0, 8), (1, 33), (2, 96)]:
         r = np.random.default_rng(seed)
         cost = r.random((n, n)).astype(np.float32)
-        assign, gap = _jv_solve(cost, n)
+        assign, u = _jv_solve(cost, n)
+        gap = _certify_f64(cost[None], np.asarray(assign)[None],
+                           np.asarray(u)[None])[0]
         assign = np.asarray(assign)
         assert sorted(assign.tolist()) == list(range(n))
         obj = float(cost[np.arange(n), assign].sum())

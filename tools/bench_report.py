@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Perf-evidence pipeline: BENCH_*.json → one trajectory + CI gate.
+
+The repo accumulates one ``BENCH_r<NN>.json`` per measurement round (the
+driver wraps ``bench.py``'s one-line JSON in ``{"n", "cmd", "rc",
+"tail", "parsed"}``) plus ``BENCH_LAST_GOOD.json`` — the last known-good
+flat record. This tool turns that pile of disconnected artifacts into:
+
+1. a **trajectory report** (default): per-round series of the headline
+   metric and its sub-metrics (p1/p3 GB/s), commit labels, degraded
+   flags, and — for artifacts produced after the cost-model PR — the
+   static FLOPs/bytes and %-of-roofline columns ``benchmark.Fixture.run``
+   now emits;
+2. a **regression gate** (``--check``): the newest round is compared
+   against BENCH_LAST_GOOD with a configurable threshold (a degraded
+   newest round is a no-op — outage artifacts are history, not gates).
+   Exit 0 = pass or nothing to gate (no new comparable artifact — the
+   tier-1 no-op), exit 1 = regression, exit 2 = a gateable artifact
+   exists but the baseline is missing.
+
+Degraded rounds (tunnel down, CPU fallback, cached re-emission) are
+shown in the trajectory but never gated — gating an outage artifact
+against a TPU baseline would fail every PR the tunnel is down for.
+
+Usage::
+
+    python tools/bench_report.py                  # trajectory report
+    python tools/bench_report.py --check          # CI gate (tier-1)
+    python tools/bench_report.py --check --threshold 0.10
+    python tools/bench_report.py --dir /path/to/artifacts --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ROUND_GLOB = "BENCH_r*.json"
+BASELINE_NAME = "BENCH_LAST_GOOD.json"
+DEFAULT_THRESHOLD = 0.15   # 15% relative drop (or slowdown) fails
+
+# cost-model fields Fixture.run emits into BENCH artifacts (PR 2+)
+COST_FIELDS = ("flops", "bytes_accessed", "arithmetic_intensity",
+               "peak_hbm_bytes", "bound", "roofline_frac")
+
+PASS, REGRESS, MISSING_BASELINE, SKIP = ("pass", "regress",
+                                         "missing-baseline", "skip")
+
+
+def load_record(path: str) -> Optional[Dict]:
+    """Flat benchmark record from a BENCH artifact: unwraps the driver's
+    ``{"parsed": ...}`` envelope; None for unreadable/recordless files."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict):
+        return None
+    rec = data.get("parsed", data)
+    if not isinstance(rec, dict) or "metric" not in rec:
+        return None
+    return rec
+
+
+def normalize_metric(name: str) -> str:
+    """Comparison key for a metric name: the bare primitive+shape, with
+    parenthesized platform notes and bracketed cache/outage annotations
+    stripped — ``"fused_l2nn+select_k top-64 2048x... (tpu, ...) [CACHED
+    ...]"`` and its BENCH_LAST_GOOD spelling compare equal."""
+    base = re.sub(r"\s*\[[^\]]*\]", "", name)
+    base = re.sub(r"\s*\([^)]*\)", "", base)
+    return base.strip()
+
+
+def higher_is_better(unit: str) -> bool:
+    """GB/s-style rates improve upward; ms/seconds improve downward."""
+    return unit.strip().lower().endswith("/s")
+
+
+def collect_rounds(directory: str) -> List[Tuple[int, str, Optional[Dict]]]:
+    """(round number, path, record) for every BENCH_r*.json, in round
+    order; unparseable files keep their slot with record=None so the
+    trajectory shows the hole instead of silently closing it."""
+    out = []
+    for path in glob.glob(os.path.join(directory, ROUND_GLOB)):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        out.append((int(m.group(1)), path, load_record(path)))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def check_regression(record: Optional[Dict], baseline: Optional[Dict],
+                     threshold: float = DEFAULT_THRESHOLD
+                     ) -> Tuple[str, str]:
+    """Gate one candidate record against the baseline.
+
+    Returns (status, message) with status one of PASS / REGRESS /
+    MISSING_BASELINE / SKIP. SKIP covers: no candidate, degraded
+    candidate, or metric/unit not comparable with the baseline — the
+    no-op cases CI must treat as success."""
+    if record is None:
+        return SKIP, "no new BENCH artifact to gate"
+    if record.get("degraded"):
+        return SKIP, ("latest artifact is degraded (outage/CPU fallback)"
+                      " — not gated")
+    value = record.get("value")
+    if not isinstance(value, (int, float)):
+        return SKIP, "latest artifact has no numeric value"
+    if baseline is None:
+        return MISSING_BASELINE, (
+            f"no {BASELINE_NAME} to gate against (candidate "
+            f"{record.get('metric', '?')!r} = {value})")
+    base_value = baseline.get("value")
+    if not isinstance(base_value, (int, float)) or base_value <= 0:
+        return MISSING_BASELINE, f"{BASELINE_NAME} has no usable value"
+    if normalize_metric(record.get("metric", "")) != \
+            normalize_metric(baseline.get("metric", "")) \
+            or record.get("unit") != baseline.get("unit"):
+        return SKIP, ("latest artifact measures a different metric/unit "
+                      "than the baseline — not comparable")
+    unit = record.get("unit", "")
+    if higher_is_better(unit):
+        floor = base_value * (1.0 - threshold)
+        if value < floor:
+            return REGRESS, (
+                f"REGRESSION: {value:g} {unit} < {floor:g} "
+                f"(last good {base_value:g} − {threshold:.0%})")
+        return PASS, (f"ok: {value:g} {unit} vs last good "
+                      f"{base_value:g} (threshold {threshold:.0%})")
+    ceil = base_value * (1.0 + threshold)
+    if value > ceil:
+        return REGRESS, (
+            f"REGRESSION: {value:g} {unit} > {ceil:g} "
+            f"(last good {base_value:g} + {threshold:.0%})")
+    return PASS, (f"ok: {value:g} {unit} vs last good {base_value:g} "
+                  f"(threshold {threshold:.0%})")
+
+
+def _fmt(v, nd=4) -> str:
+    if isinstance(v, bool):
+        return "yes" if v else "no"
+    if isinstance(v, (int, float)):
+        return f"{v:.{nd}g}"
+    return "-" if v is None else str(v)
+
+
+def trajectory(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
+               baseline: Optional[Dict]) -> str:
+    """Human trajectory: one row per round (headline value, p1/p3
+    sub-series, commit, degraded) + roofline columns when present."""
+    lines = ["perf trajectory (BENCH_r*.json)",
+             "================================"]
+    cols = ("round", "value", "unit", "p1 GB/s", "p3 GB/s", "p3 ms",
+            "%roof", "bound", "degraded", "commit", "metric")
+    rows = []
+    any_cost = any(rec and any(f in rec for f in COST_FIELDS)
+                   for _, _, rec in rounds)
+    for n, path, rec in rounds:
+        if rec is None:
+            rows.append((f"r{n:02d}", "?", "-", "-", "-", "-", "-", "-",
+                         "-", "-", f"<unparseable: {os.path.basename(path)}>"))
+            continue
+        rf = rec.get("roofline_frac")
+        rows.append((
+            f"r{n:02d}", _fmt(rec.get("value")), rec.get("unit", "-"),
+            _fmt(rec.get("p1_gbps")), _fmt(rec.get("p3_gbps")),
+            _fmt(rec.get("p3_ms")),
+            f"{rf * 100:.1f}" if isinstance(rf, (int, float)) else "-",
+            _fmt(rec.get("bound")), _fmt(bool(rec.get("degraded"))),
+            rec.get("git_commit", "-"),
+            normalize_metric(rec.get("metric", "?"))))
+    if baseline is not None:
+        rf = baseline.get("roofline_frac")
+        rows.append((
+            "LAST_GOOD", _fmt(baseline.get("value")),
+            baseline.get("unit", "-"), _fmt(baseline.get("p1_gbps")),
+            _fmt(baseline.get("p3_gbps")), _fmt(baseline.get("p3_ms")),
+            f"{rf * 100:.1f}" if isinstance(rf, (int, float)) else "-",
+            _fmt(baseline.get("bound")), "-",
+            baseline.get("git_commit", "-"),
+            normalize_metric(baseline.get("metric", "?"))))
+    if not rows:
+        return "\n".join(lines + ["(no BENCH_r*.json artifacts found)"]) + "\n"
+    widths = [max(len(c), *(len(str(r[i])) for r in rows))
+              for i, c in enumerate(cols)]
+    lines.append("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    lines.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        lines.append("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+    if not any_cost:
+        lines.append("")
+        lines.append("(no cost-model fields yet — artifacts produced "
+                     "before the roofline profiler carry only seconds; "
+                     "the next measurement round fills flops/bytes/%roof)")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Sequence[str] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dir", default=_REPO_ROOT,
+                   help="directory holding BENCH_*.json (default: repo root)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: <dir>/{BASELINE_NAME})")
+    p.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                   help="relative regression threshold (default 0.15)")
+    p.add_argument("--check", action="store_true",
+                   help="gate the newest non-degraded round against the "
+                        "baseline; exit 1 on regression, 2 on missing "
+                        "baseline, 0 otherwise")
+    p.add_argument("--json", action="store_true",
+                   help="emit the trajectory as JSON instead of a table")
+    args = p.parse_args(argv)
+
+    rounds = collect_rounds(args.dir)
+    baseline_path = args.baseline or os.path.join(args.dir, BASELINE_NAME)
+    baseline = load_record(baseline_path)
+
+    if args.check:
+        # newest round wins; older rounds are history, not candidates
+        candidate = None
+        for _, _, rec in reversed(rounds):
+            if rec is not None:
+                candidate = rec
+                break
+        status, msg = check_regression(candidate, baseline, args.threshold)
+        print(f"bench_report --check: {status}: {msg}")
+        return {PASS: 0, SKIP: 0, REGRESS: 1, MISSING_BASELINE: 2}[status]
+
+    if args.json:
+        payload = {
+            "rounds": [{"round": n, "path": os.path.basename(path),
+                        "record": rec} for n, path, rec in rounds],
+            "baseline": baseline,
+        }
+        print(json.dumps(payload, indent=1, sort_keys=True, default=str))
+        return 0
+
+    sys.stdout.write(trajectory(rounds, baseline))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,13 +1,22 @@
 #!/usr/bin/env python
-"""Static check: every hot-path primitive carries @instrument.
+"""Static check: every hot-path primitive carries @instrument, and the
+cost-capture sites feed the roofline profiler.
 
 Pure-AST, no TPU (and no raft_tpu import) needed, so it runs anywhere —
 it is wired into the tier-1 suite via tests/test_observability.py. The
-check asserts, per module in :data:`HOT_PATHS`:
+check asserts:
 
-1. the module imports ``instrument`` from ``raft_tpu.observability``, and
-2. each listed function is decorated with it (bare ``@instrument`` or
-   ``@instrument(...)``, plain name or attribute spelling).
+1. per module in :data:`HOT_PATHS`: the module imports ``instrument``
+   from ``raft_tpu.observability``, and each listed function is
+   decorated with it (bare ``@instrument`` or ``@instrument(...)``,
+   plain name or attribute spelling);
+2. per module in :data:`COST_CAPTURE_SITES`: the module calls the named
+   profiler capture method — the static guarantee that everything the
+   hot-path list reports (AOT runtime entries via ``_aot_call``,
+   benchmark measurements via ``Fixture.run``) also flows through XLA
+   cost capture, so ``roofline_report()`` can attribute it. Removing a
+   capture call silently reverts BENCH artifacts to seconds-only — the
+   exact evidence regression this gate exists to catch.
 
 Extend HOT_PATHS when a new primitive ships — forgetting to is exactly
 the regression this check exists to catch: a hot path that silently
@@ -37,6 +46,13 @@ HOT_PATHS: Dict[str, Sequence[str]] = {
     "raft_tpu/solver/linear_assignment.py": ("solve_lap",),
 }
 
+# module (repo-relative) → profiler capture methods it must call
+# (attribute calls, e.g. ``res.profiler.capture(...)``)
+COST_CAPTURE_SITES: Dict[str, Sequence[str]] = {
+    "raft_tpu/runtime/entry_points.py": ("capture",),
+    "raft_tpu/benchmark.py": ("capture_fn",),
+}
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -61,6 +77,37 @@ def _imports_instrument(tree: ast.Module) -> bool:
                    for a in node.names):
                 return True
     return False
+
+
+def _calls_attribute(tree: ast.Module, attr: str) -> bool:
+    """True when the module contains a call ``<expr>.<attr>(...)``."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == attr):
+            return True
+    return False
+
+
+def check_cost_capture(root: str = _REPO_ROOT,
+                       sites: Dict[str, Sequence[str]] = None) -> List[str]:
+    """Violations for :data:`COST_CAPTURE_SITES` (empty = clean)."""
+    sites = COST_CAPTURE_SITES if sites is None else sites
+    errors: List[str] = []
+    for rel, methods in sorted(sites.items()):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            errors.append(f"{rel}: cost-capture module missing")
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        for m in methods:
+            if not _calls_attribute(tree, m):
+                errors.append(
+                    f"{rel}: no call to profiler .{m}(...) — hot-path "
+                    f"measurements would stop flowing through XLA cost "
+                    f"capture")
+    return errors
 
 
 def check(root: str = _REPO_ROOT,
@@ -92,6 +139,10 @@ def check(root: str = _REPO_ROOT,
                          for d in node.decorator_list):
                 errors.append(f"{rel}: {fn}() is not decorated with "
                               f"@instrument")
+    if hot_paths is HOT_PATHS:
+        # the default invocation also gates the cost-capture sites;
+        # callers probing a custom hot_paths table (tests) opt out
+        errors.extend(check_cost_capture(root))
     return errors
 
 
@@ -102,7 +153,9 @@ def main(argv: Sequence[str] = ()) -> int:
     if not errors:
         print(f"check_instrumented: OK — "
               f"{sum(len(v) for v in HOT_PATHS.values())} functions in "
-              f"{len(HOT_PATHS)} modules instrumented")
+              f"{len(HOT_PATHS)} modules instrumented; "
+              f"{sum(len(v) for v in COST_CAPTURE_SITES.values())} "
+              f"cost-capture sites verified")
     return 1 if errors else 0
 
 
