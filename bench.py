@@ -251,9 +251,31 @@ def main():
     fused_failed = False
     dt_p3 = None
     dt_af = None
+    # analytic HBM-traffic model for the config actually measured (the
+    # predicted half of the predicted-vs-measured bytes evidence; None
+    # on the raw-matrix CPU smoke path)
+    traffic_model = None
+    fused_cfg = None
     try:
-        dt = fx.run(lambda q: distance.knn(res, knn_index, q, k=k,
-                                           tile=tile), Q)["seconds"]
+        from raft_tpu.distance.knn_fused import KnnIndex
+        from raft_tpu.observability import costmodel
+
+        if isinstance(knn_index, KnnIndex):
+            fused_cfg = {"T": knn_index.T, "Qb": knn_index.Qb,
+                         "g": knn_index.g,
+                         "grid_order": knn_index.grid_order,
+                         "passes": knn_index.passes,
+                         "pbits": knn_index.pbits}
+            traffic_model = costmodel.fused_traffic_model(
+                n_queries, n_index, dim, k, knn_index.T, knn_index.Qb,
+                knn_index.g, knn_index.passes, knn_index.grid_order)
+    except Exception:
+        traffic_model = fused_cfg = None
+    try:
+        r1 = fx.run(lambda q: distance.knn(res, knn_index, q, k=k,
+                                           tile=tile), Q,
+                    name="bench.fused_knn_p1", model=traffic_model)
+        dt = r1["seconds"]
         if knn_index_p3 is not None:
             dt_p3 = fx.run(lambda q: distance.knn(
                 res, knn_index_p3, q, k=k, tile=tile), Q)["seconds"]
@@ -277,8 +299,11 @@ def main():
         print("bench: fused path failed, falling back to streamed:\n"
               + traceback.format_exc(), file=sys.stderr)
         fused_failed = True
-        dt = fx.run(lambda q: distance.knn(res, X, q, k=k, tile=tile,
-                                           algo="streamed"), Q)["seconds"]
+        traffic_model = fused_cfg = None
+        r1 = fx.run(lambda q: distance.knn(res, X, q, k=k, tile=tile,
+                                           algo="streamed"), Q,
+                    name="bench.streamed_knn")
+        dt = r1["seconds"]
 
     eff_bytes = n_queries * n_index * 4.0
     gbps = eff_bytes / dt / 1e9
@@ -309,6 +334,25 @@ def main():
         "git_commit": _git_commit(),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    # perf-evidence fields (PR 2 cost capture + the ISSUE-3 traffic
+    # model): the static XLA cost of the measured executable, its
+    # %-of-roofline at the measured time, the analytic per-variant HBM
+    # bytes of the config that ran, and the predicted-vs-measured
+    # ratio. tools/bench_report.py gates the roofline_frac trend.
+    for f in ("flops", "bytes_accessed", "arithmetic_intensity",
+              "peak_hbm_bytes", "bound", "roofline_frac"):
+        if f in r1:
+            result[f] = r1[f]
+    if fused_cfg is not None:
+        result["fused_config"] = fused_cfg
+    if traffic_model is not None:
+        result["model_total_bytes"] = traffic_model["total_bytes"]
+        result["model_y_bytes"] = traffic_model["y_bytes"]
+        result["model_y_stream_factor"] = traffic_model["y_stream_factor"]
+        measured_bytes = result.get("bytes_accessed")
+        if isinstance(measured_bytes, (int, float)) and measured_bytes > 0:
+            result["model_vs_measured_bytes"] = round(
+                traffic_model["total_bytes"] / measured_bytes, 4)
 
     if platform == "tpu" and not fused_failed:
         _save_last_good(result)

@@ -1,21 +1,22 @@
 #!/usr/bin/env python
 """Parameter sweep for the fused distance+top-k pipeline on real TPU.
 
-Sweeps (T, Qb, g, passes) for the bench.py shape (1M x 128 index, 2048
-queries, k=64) and prints one JSON line per point plus a "best" line.
-Used to choose the defaults baked into distance.knn / bench.py — the
-fused-pipeline analog of the reference's select_k heuristic fitting
-(cpp/scripts/heuristics/select_k). Writes TUNE_FUSED.json.
+Thin measurement-script wrapper over the :mod:`raft_tpu.tune` autotuner
+(the sweep, pruning, measurement, schema validation and provenance all
+live there — one implementation for the CLI, the tier-1 deterministic
+fallback and this probe-gated TPU script). Sweeps
+(T, Qb, g, grid_order, passes) for the bench.py shape (1M x 128 index,
+2048 queries, k=64), prints one JSON line per point plus a "best" line,
+and writes the schema-versioned TUNE_FUSED.json that
+``fused_config()``/``RAFT_TPU_TUNE_FUSED`` consume.
 
 Probe-guarded like every measurement script; RAFT_TPU_BENCH_FORCE=cpu
 runs a tiny-shape harness validation (no artifact).
 """
 
-import itertools
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from benchmarks._common import gate  # noqa: E402
@@ -30,71 +31,23 @@ def main():
         print(json.dumps({"skipped": True, "reason": skip}))
         return
 
-    import jax
-    import numpy as np
+    from raft_tpu.tune.fused import DRIVER_SHAPE, autotune_fused
 
-    import raft_tpu
-    from raft_tpu.benchmark import Fixture
-    from raft_tpu.distance.knn_fused import knn_fused
-    from raft_tpu.random import RngState, make_blobs
-
-    res = raft_tpu.device_resources()
     if dry:
-        n_index, dim, n_q, k = 20_000, 128, 256, 64
-        Ts, Qbs, gs, passes_l = [2048], [256], [32], [1, 3]
-        reps = 1
+        # g=8 keeps the db super-block inside the VMEM budget so the
+        # dry run exercises all three grid orders, not just query
+        tbl = autotune_fused(
+            shape=(256, 20_000, 128, 64), out_path=None, reps=1,
+            budget_s=BUDGET_S, measure=True,
+            axes={"T": (1024,), "Qb": (256,), "g": (8,),
+                  "grid_order": ("query", "db", "dbuf")})
     else:
-        n_index, dim, n_q, k = 1_000_000, 128, 2048, 64
-        Ts = [1024, 2048, 4096]
-        Qbs = [256, 512, 1024]
-        gs = [8, 16, 32]     # tiles per certificate group (tpg)
-        passes_l = [1, 3]
-        reps = 3
-
-    X, _ = make_blobs(res, RngState(0), n_index, dim, n_clusters=64,
-                      cluster_std=2.0)
-    Q = X[:n_q]
-    jax.block_until_ready(X)
-    fx = Fixture(res=res, reps=reps)
-
-    eff_bytes = n_q * n_index * 4.0
-    rows = []
-    deadline = time.monotonic() + BUDGET_S
-    for T, Qb, g, p in itertools.product(Ts, Qbs, gs, passes_l):
-        if time.monotonic() > deadline:
-            print(json.dumps({"budget_expired_after": len(rows)}))
-            break
-        # skip configs the scoped-VMEM estimator rejects — they are
-        # guaranteed Mosaic compile failures (knn_fused would silently
-        # shrink them to a point already swept, double-counting it);
-        # footprint_for is the SAME predicate knn_fused's guard uses
-        from raft_tpu.distance.knn_fused import footprint_for
-        from raft_tpu.ops.fused_l2_topk_pallas import VMEM_BUDGET
-        if footprint_for(T, Qb, dim, p, g) > VMEM_BUDGET:
-            rows.append({"T": T, "Qb": Qb, "g": g, "passes": p,
-                         "skipped": "vmem_footprint"})
-            continue
-        try:
-            dt = fx.run(lambda q: knn_fused(q, X, k=k, passes=p,
-                                            T=T, Qb=Qb, g=g)[0], Q)["seconds"]
-            row = {"T": T, "Qb": Qb, "g": g, "passes": p,
-                   "seconds": round(dt, 5),
-                   "gbps": round(eff_bytes / dt / 1e9, 1)}
-        except Exception as e:  # point off-envelope / lowering failure
-            row = {"T": T, "Qb": Qb, "g": g, "passes": p,
-                   "error": f"{type(e).__name__}: {e}"[:200]}
-        rows.append(row)
+        tbl = autotune_fused(shape=DRIVER_SHAPE,
+                             out_path="TUNE_FUSED.json",
+                             budget_s=BUDGET_S, measure=True)
+    for row in tbl.get("rows", []):
         print(json.dumps(row), flush=True)
-        if not dry:  # incremental: a kill/wedge loses only this point
-            ok = [r for r in rows if "gbps" in r]
-            best = max(ok, key=lambda r: r["gbps"]) if ok else None
-            with open("TUNE_FUSED.json", "w") as f:
-                json.dump({"shape": [n_q, n_index, dim, k], "rows": rows,
-                           "best": best}, f, indent=1)
-
-    ok = [r for r in rows if "gbps" in r]
-    best = max(ok, key=lambda r: r["gbps"]) if ok else None
-    print(json.dumps({"best": best}))
+    print(json.dumps({"best": tbl.get("best")}))
 
 
 if __name__ == "__main__":

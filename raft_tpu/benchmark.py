@@ -53,10 +53,17 @@ class Fixture:
         self._rtt = rtt if self._rtt is None else min(self._rtt, rtt)
         return self._rtt
 
-    def run(self, fn: Callable, *args, name: Optional[str] = None
-            ) -> Dict[str, float]:
+    def run(self, fn: Callable, *args, name: Optional[str] = None,
+            model: Optional[Dict] = None) -> Dict[str, float]:
         """Time fn(*args); returns {"seconds", "rtt"} with transport
         round-trip subtracted. (ref: ``cuda_event_timer`` role)
+
+        ``model`` (optional) is an analytic-prediction dict (e.g.
+        ``costmodel.fused_traffic_model``) merged into the result under
+        ``model_*`` keys — the predicted half of every
+        predicted-vs-measured comparison rides the same artifact as the
+        measured half, so divergence is visible wherever the numbers
+        land (BENCH_*.json, tune tables, the metrics registry).
 
         The result is also emitted through the observability registry
         (``raft_tpu_benchmark_seconds{bench=<name>}`` + a ``benchmark``
@@ -108,6 +115,10 @@ class Fixture:
         bench_name = name or getattr(fn, "__name__", repr(fn))
         result.update(self._cost_fields(bench_name, fn, args,
                                         result["seconds"]))
+        if model:
+            result.update({
+                (k if str(k).startswith("model_") else f"model_{k}"): v
+                for k, v in model.items()})
         from raft_tpu.observability import record_benchmark
 
         record_benchmark(bench_name, result)

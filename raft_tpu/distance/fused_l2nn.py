@@ -287,12 +287,16 @@ def knn(res, index, queries, k: int, metric: str = "sqeuclidean",
     # entries per query under its active (possibly tuned) tiling —
     # mirror knn_fused's own envelope so auto never round-trips an
     # exception
-    from raft_tpu.distance.knn_fused import fused_defaults
+    from raft_tpu.distance.knn_fused import fused_config
 
     # auto-routing only ever runs passes=3, and FORCED fused requests
     # rely on knn_fused's own envelope errors (re-raised below), so the
-    # pool precheck mirrors the passes=3 defaults
-    _T, _, _g = fused_defaults(3)
+    # pool precheck mirrors the passes=3 defaults. (The tuned config
+    # may carry a database-major grid_order; the pool geometry below is
+    # order-invariant — ceil(ceil(n/T)/g) == ceil(n/(g·T)), so the
+    # db-padded index yields the same group count.)
+    _cfg = fused_config(3)
+    _T, _g = _cfg.T, _cfg.g
     # pool = 2·128 per tile-GROUP (g = tiles per group), matching
     # knn_fused's own pool construction — NOT 2·128/g per tile
     _n_tiles = -(-max(n, _T) // _T)

@@ -73,8 +73,19 @@ from raft_tpu.observability import instrument
 from raft_tpu.ops.fused_l2_topk_pallas import (
     _LANES, _PACK_BITS, _PACK_MASK, _PACK_PAD, _PBITS_MAX, VMEM_BUDGET,
     fused_l2_group_topk, fused_l2_group_topk_dchunk,
-    fused_l2_group_topk_packed, fused_l2_group_topk_packed_dchunk,
+    fused_l2_group_topk_packed, fused_l2_group_topk_packed_db,
+    fused_l2_group_topk_packed_dbuf, fused_l2_group_topk_packed_dchunk,
     split_hi_lo, vmem_footprint)
+
+# grid iteration orders for the packed fused kernel (see the
+# DATABASE-MAJOR block comment in ops.fused_l2_topk_pallas):
+#   "query" — grid (nq, n_tiles): y re-fetched per query block
+#             (y HBM traffic nq·M·d bytes — the historical default);
+#   "db"    — super-blocked grid (n_groups, nq): each [g·T, d] group
+#             VMEM-resident, y streams from HBM once (M·d·2 bytes);
+#   "dbuf"  — grid (n_groups,): explicit 2-slot double-buffered y-tile
+#             DMA, y streams once and only 2 tiles are VMEM-resident.
+GRID_ORDERS = ("query", "db", "dbuf")
 
 # past this feature width the single-shot kernel's [Qb/T, d] VMEM tiles
 # stop fitting; the d-chunked kernel (VMEM scratch accumulator) takes over
@@ -264,15 +275,20 @@ def _pad_rows_to(y, mult: int):
 
 
 @functools.partial(jax.jit, static_argnames=("T", "g", "metric",
-                                             "pbits"))
+                                             "pbits", "grid_order"))
 def _prepare_ops(y, T: int, g: int, metric: str,
-                 pbits: int = _PACK_BITS):
+                 pbits: int = _PACK_BITS, grid_order: str = "query"):
     """Index-side operand prep: row padding, bf16 hi/lo split, norms and
     the [8, M] half-norm sentinel carrier. ~3 ms at 1M×128 on v5e —
     hoisted out of the query path so a prepared index (KnnIndex) pays
-    it ONCE instead of per query batch."""
+    it ONCE instead of per query batch.
+
+    Database-major grid orders pad the index to WHOLE certificate
+    groups (g·T rows — each super-block is one resident y block /
+    one DMA group); padded columns carry the same never-wins sentinel
+    either way, so the extra rows are certificate-invisible."""
     m = y.shape[0]
-    yp = _pad_rows_to(y, T)
+    yp = _pad_rows_to(y, g * T if grid_order in ("db", "dbuf") else T)
     M = yp.shape[0]
     yy_raw = jnp.sum(yp * yp, axis=1)[None, :]                  # [1,M] f32
     n_ch = T // _LANES
@@ -294,12 +310,12 @@ def _prepare_ops(y, T: int, g: int, metric: str,
 @functools.partial(jax.jit,
                    static_argnames=("k", "T", "Qb", "g", "passes", "metric",
                                     "m", "rescore", "pbits", "certify",
-                                    "pool_algo", "_diag"))
+                                    "pool_algo", "grid_order", "_diag"))
 def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                     k: int, T: int, Qb: int, g: int, passes: int,
                     metric: str, m: int, rescore: bool = True,
                     pbits: int = _PACK_BITS, certify: str = "kernel",
-                    pool_algo: str = "xla",
+                    pool_algo: str = "xla", grid_order: str = "query",
                     _diag: bool = False) -> Tuple[jax.Array, ...]:
     """Certified fused KNN on PREPARED operands (see _prepare_ops).
 
@@ -342,6 +358,16 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         if d > _D_SINGLE_SHOT:
             kern, kw = fused_l2_group_topk_packed_dchunk, {
                 "dc": _DC, "pbits": pbits}
+        elif grid_order in ("db", "dbuf"):
+            # database-major: y streams from HBM once instead of nq
+            # times (see GRID_ORDERS / the DATABASE-MAJOR block comment
+            # in ops.fused_l2_topk_pallas); same outputs, codes and
+            # certificate semantics, so everything downstream of the
+            # kernel call is untouched
+            kern = (fused_l2_group_topk_packed_db if grid_order == "db"
+                    else fused_l2_group_topk_packed_dbuf)
+            kw = {"pbits": pbits,
+                  "pair": passes == 1 and (T // _LANES) % 2 == 0}
         else:
             # streamed chunk contraction (MXU/VPU co-issue — measured
             # p1 10.9→4.4 ms, p3 15.6→9.8 ms at 2048×1M×128); the pair
@@ -642,24 +668,28 @@ _TUNED = ...   # lazy sentinel: {passes: (T, Qb, g)} once loaded
 
 
 def fit_config(T: int, Qb: int, d: int, passes: int,
-               g: Optional[int] = None):
+               g: Optional[int] = None, grid_order: str = "query"):
     """Scoped-VMEM guard: shrink (T, Qb) until the kernel footprint fits
     Mosaic's stack budget — a config over it is a guaranteed compile
     failure (observed: the tuned-at-passes=1 winner OOMs at passes=3).
     Shrinks Qb first (pure throughput knob), then T (weakens the
     certificate's slot count, so last). Shared by knn_fused and the
     measurement scripts so they can never profile a config production
-    would silently shrink."""
-    while (footprint_for(T, Qb, d, passes, g) > VMEM_BUDGET and Qb > 8):
+    would silently shrink. (For grid_order="dbuf" the Qb loop is a
+    no-op — its footprint prices the whole query batch — so the T loop
+    carries the shrink.)"""
+    while (footprint_for(T, Qb, d, passes, g, grid_order) > VMEM_BUDGET
+           and Qb > 8):
         Qb = max(8, (Qb // 2) // 8 * 8)
-    while (footprint_for(T, Qb, d, passes, g) > VMEM_BUDGET
+    while (footprint_for(T, Qb, d, passes, g, grid_order) > VMEM_BUDGET
            and T > 2 * _LANES):
         T = max(2 * _LANES, (T // 2) // _LANES * _LANES)
     return T, Qb
 
 
 def footprint_for(T: int, Qb: int, d: int, passes: int,
-                  g: Optional[int] = None) -> int:
+                  g: Optional[int] = None,
+                  grid_order: str = "query") -> int:
     """Scoped-VMEM footprint of the fused kernel at a RAW (unpadded)
     feature width — applies the same d-padding / d-chunk routing AND
     packed-vs-unpacked kernel choice ``knn_fused`` itself uses, so
@@ -667,30 +697,188 @@ def footprint_for(T: int, Qb: int, d: int, passes: int,
     can't diverge from it. ``g`` (tiles per group) decides the packed
     envelope; None assumes UNPACKED — the larger footprint, so an
     uninformed caller fails safe (over-shrinks) rather than shipping a
-    Mosaic scoped-VMEM reject."""
+    Mosaic scoped-VMEM reject.
+
+    ``grid_order`` routes to the database-major models; "dbuf" prices
+    the worst-case padded query batch (_Q_CHUNK) instead of Qb, because
+    its one-cell-per-group design holds the whole batch's fold state
+    (the wrapper chunks queries at _Q_CHUNK, so that IS the bound)."""
     d_eff = d + (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
     # the auto pack-width clamp makes any g ≤ 2^_PBITS_MAX codes
     # packed; the single-shot packed path is the STREAM kernel (no
     # [Qb, T] buffer)
     packed = g is not None and g * (T // _LANES) <= (1 << _PBITS_MAX)
     dchunk = d_eff > _D_SINGLE_SHOT
+    if packed and not dchunk and grid_order in ("db", "dbuf"):
+        kern = "stream_db" if grid_order == "db" else "stream_dbuf"
+        if grid_order == "dbuf":
+            Qb = _Q_CHUNK
+        return vmem_footprint(T, Qb, d_eff, passes, kernel=kern,
+                              g=g or 16)
     kern = ("packed" if dchunk else "stream") if packed else "group"
     return vmem_footprint(T, Qb, d_eff, passes, dchunk=dchunk,
                           kernel=kern)
 
 
-def _valid_cfg(T, Qb, g) -> bool:
+def resolve_grid_order(grid_order: str, d: int, packed: bool) -> str:
+    """EFFECTIVE grid order for a call — decided (and logged) in the
+    non-jitted wrapper like resolve_pool_algo, so a downgraded request
+    is visible per call instead of silently mislabeling what ran. The
+    database-major kernels are packed-only and single-shot-only
+    (d ≤ _D_SINGLE_SHOT); anything outside that envelope runs the
+    query-major pipeline."""
+    if grid_order not in GRID_ORDERS:
+        raise ValueError(f"grid_order must be one of {GRID_ORDERS}, "
+                         f"got {grid_order!r}")
+    if grid_order == "query":
+        return grid_order
+    reason = None
+    if d > _D_SINGLE_SHOT:
+        reason = f"d={d} > {_D_SINGLE_SHOT} takes the d-chunked kernel"
+    elif not packed:
+        reason = "config is outside the packed-code envelope"
+    if reason is None:
+        return grid_order
+    from raft_tpu.core.logger import log_warn
+
+    log_warn("grid_order=%r outside the database-major envelope (%s) — "
+             "using 'query' for this call", grid_order, reason)
+    return "query"
+
+
+def _valid_cfg(T, Qb, g, grid_order: str = "query") -> bool:
     # semantic validation, not just parseability: bad values would crash
     # every knn() call downstream; g = tiles-per-group ≥ 1
     return (T > 0 and T % _LANES == 0 and Qb > 0 and Qb % 8 == 0
-            and 0 < g <= 4096)
+            and 0 < g <= 4096 and grid_order in GRID_ORDERS)
 
 
-def fused_defaults(passes: int = 3) -> Tuple[int, int, int]:
-    """(T, Qb, g) for the fused pipeline: the measured-best point from
-    ``TUNE_FUSED.json`` (produced on real TPU by benchmarks/tune_fused.py
-    — the analog of the reference's fitted select_k heuristic) when one
-    is committed, else the hand-chosen defaults.
+class FusedConfig(Tuple[int, int, int, str]):
+    """(T, Qb, g, grid_order) — the fused pipeline's tiling config."""
+
+    __slots__ = ()
+
+    def __new__(cls, T: int, Qb: int, g: int, grid_order: str = "query"):
+        return tuple.__new__(cls, (T, Qb, g, grid_order))
+
+    T = property(lambda s: s[0])
+    Qb = property(lambda s: s[1])
+    g = property(lambda s: s[2])
+    grid_order = property(lambda s: s[3])
+
+
+_BUILTIN_CONFIG = FusedConfig(2048, 256, 16, "query")
+
+
+def _row_config(row, d: Optional[int], passes: int) -> Optional[FusedConfig]:
+    """A validated FusedConfig from one table row, or None. Beyond
+    parseability, the config must (a) pass _valid_cfg and (b) survive
+    fit_config UNshrunk at the table's feature width — a config the
+    scoped-VMEM guard would shrink was never actually measured as
+    written, so serving it would route production to an unswept point
+    (the round-2 failure mode, now rejected at load instead of
+    shipped)."""
+    try:
+        cfg = FusedConfig(int(row["T"]), int(row["Qb"]), int(row["g"]),
+                          str(row.get("grid_order", "query")))
+    except (KeyError, TypeError, ValueError):
+        return None
+    if not _valid_cfg(*cfg):
+        return None
+    if d is not None and fit_config(cfg.T, cfg.Qb, d, passes, cfg.g,
+                                    cfg.grid_order) != (cfg.T, cfg.Qb):
+        from raft_tpu.core.logger import log_warn
+
+        log_warn("TUNE_FUSED row (T=%d, Qb=%d, g=%d, %s, passes=%d) "
+                 "fails the scoped-VMEM fit at d=%d — rejected",
+                 cfg.T, cfg.Qb, cfg.g, cfg.grid_order, passes, d)
+        return None
+    return cfg
+
+
+def _load_tuned() -> dict:
+    """Parse + validate the tune table → {passes: FusedConfig}. Any
+    corrupt, stale or future-schema table degrades to {} (built-in
+    defaults) with a logged reason — it must never break knn."""
+    import json
+    import os
+
+    from raft_tpu.core.logger import log_info, log_warn
+    from raft_tpu.native import _REPO_ROOT
+
+    path = os.environ.get("RAFT_TPU_TUNE_FUSED") or os.path.join(
+        _REPO_ROOT, "TUNE_FUSED.json")
+    tuned: dict = {}
+    try:
+        with open(path) as f:
+            tbl = json.load(f)
+        from raft_tpu.tune.fused import TUNE_SCHEMA_VERSION, \
+            validate_tune_table
+
+        errors = validate_tune_table(tbl)
+        if errors:
+            log_warn("TUNE_FUSED table %s rejected (%s) — using "
+                     "built-in fused defaults", path, "; ".join(errors))
+            return {}
+        if int(tbl.get("schema", 1)) > TUNE_SCHEMA_VERSION:
+            log_warn("TUNE_FUSED table %s has future schema %s (this "
+                     "build understands ≤ %d) — using built-in fused "
+                     "defaults", path, tbl.get("schema"),
+                     TUNE_SCHEMA_VERSION)
+            return {}
+        shape = tbl.get("shape")
+        d = (int(shape[2]) if isinstance(shape, (list, tuple))
+             and len(shape) >= 3 else None)
+        # per-passes winners from the measured rows; the legacy
+        # single "best" entry seeds any mode its passes matches (or
+        # both, for tables that never recorded passes)
+        for row in sorted((r for r in tbl.get("rows", [])
+                           if "seconds" in r),
+                          key=lambda r: r["seconds"], reverse=True):
+            p = int(row.get("passes", 0)) or None
+            cfg = _row_config(row, d, p or 3)
+            if cfg is not None:
+                tuned[p] = cfg
+        # explicit per-passes winners (schema ≥ 3 — the only signal a
+        # deterministic model-ranked table carries) take precedence
+        # over the legacy single "best"
+        best_by = tbl.get("best_by_passes") or {}
+        for p_str, row in best_by.items():
+            try:
+                p = int(p_str)
+            except (TypeError, ValueError):
+                continue
+            cfg = _row_config(row, d, p)
+            if cfg is not None:
+                tuned.setdefault(p, cfg)
+        best = tbl.get("best")
+        if best:
+            for p in (1, 3):
+                if int(best.get("passes", p)) == p:
+                    cfg = _row_config(best, d, p)
+                    if cfg is not None:
+                        tuned.setdefault(p, cfg)
+        prov = tbl.get("provenance", {})
+        log_info("fused_defaults: loaded %s (schema %s, chip=%s, "
+                 "commit=%s, measured=%s, written=%s)", path,
+                 tbl.get("schema", "legacy"),
+                 prov.get("chip", "unknown"),
+                 prov.get("git_commit", "unknown"),
+                 prov.get("measured", "unknown"),
+                 prov.get("timestamp", "unknown"))
+    except Exception:
+        return {}  # malformed table must never break knn
+    return tuned
+
+
+def fused_config(passes: int = 3) -> FusedConfig:
+    """(T, Qb, g, grid_order) for the fused pipeline: the measured-best
+    point from ``TUNE_FUSED.json`` (produced by the
+    :mod:`raft_tpu.tune` autotuner — the analog of the reference's
+    fitted select_k heuristic) when one is committed, else the
+    hand-chosen defaults. The table is schema-validated and its rows
+    re-checked against the scoped-VMEM fit at load; a corrupt/stale/
+    future table degrades to the built-ins with a logged reason.
 
     Best rows are keyed by ``passes``: the score-tile VMEM footprint
     differs ~2× between the modes (see ops.fused_l2_topk_pallas.
@@ -700,37 +888,14 @@ def fused_defaults(passes: int = 3) -> Tuple[int, int, int]:
     tuning knob."""
     global _TUNED
     if _TUNED is ...:
-        import json
-        import os
+        _TUNED = _load_tuned()
+    return (_TUNED.get(passes) or _TUNED.get(None) or _BUILTIN_CONFIG)
 
-        from raft_tpu.native import _REPO_ROOT
 
-        path = os.environ.get("RAFT_TPU_TUNE_FUSED") or os.path.join(
-            _REPO_ROOT, "TUNE_FUSED.json")
-        _TUNED = {}
-        try:
-            with open(path) as f:
-                tbl = json.load(f)
-            # per-passes winners from the measured rows; the legacy
-            # single "best" entry seeds any mode its passes matches (or
-            # both, for tables that never recorded passes)
-            for row in sorted((r for r in tbl.get("rows", [])
-                               if "seconds" in r),
-                              key=lambda r: r["seconds"], reverse=True):
-                cfg = (int(row["T"]), int(row["Qb"]), int(row["g"]))
-                if _valid_cfg(*cfg):
-                    _TUNED[int(row.get("passes", 0)) or None] = cfg
-            best = tbl.get("best")
-            if best:
-                cfg = (int(best["T"]), int(best["Qb"]), int(best["g"]))
-                if _valid_cfg(*cfg):
-                    for p in (1, 3):
-                        if int(best.get("passes", p)) == p:
-                            _TUNED.setdefault(p, cfg)
-        except Exception:
-            _TUNED = {}  # malformed table must never break knn
-    return (_TUNED.get(passes) or _TUNED.get(None)
-            or (2048, 256, 16))
+def fused_defaults(passes: int = 3) -> Tuple[int, int, int]:
+    """(T, Qb, g) — :func:`fused_config` without the grid order (the
+    historical surface; callers that route kernels want fused_config)."""
+    return tuple(fused_config(passes)[:3])
 
 
 def fused_eligible(n_rows: int, d: int) -> bool:
@@ -753,7 +918,8 @@ class KnnIndex:
 
     def __init__(self, yp, y_hi, y_lo, yyh_k, yy_raw, n_rows: int,
                  T: int, Qb: int, g: int, passes: int, metric: str,
-                 d_orig: int, pbits: int = _PACK_BITS):
+                 d_orig: int, pbits: int = _PACK_BITS,
+                 grid_order: str = "query"):
         # yp is the ROW-PADDED index; the original matrix is yp[:n_rows]
         # (NOT stored separately — at 1M×128 that would pin a redundant
         # ~512 MB f32 copy in HBM for the index lifetime)
@@ -765,12 +931,16 @@ class KnnIndex:
         self.passes, self.metric = passes, metric
         self.d_orig = d_orig
         self.pbits = pbits
+        # frozen at build: database-major indexes are row-padded to
+        # whole [g·T] groups, so the grid order cannot change per query
+        self.grid_order = grid_order
 
 
 def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
                       T: Optional[int] = None, Qb: Optional[int] = None,
                       g: Optional[int] = None,
-                      store_yp: bool = True) -> KnnIndex:
+                      store_yp: bool = True,
+                      grid_order: Optional[str] = None) -> KnnIndex:
     """Build a :class:`KnnIndex` for repeated queries against ``y``.
 
     ``store_yp=False`` builds a LITE index: the f32 row-padded matrix
@@ -786,13 +956,17 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
                          f"'ip', got {metric!r}")
     y = jnp.asarray(y, jnp.float32)
     m, d = y.shape
-    dT, dQb, dg = fused_defaults(passes)
-    T = dT if T is None else T
-    Qb = dQb if Qb is None else Qb
-    T, Qb = fit_config(T, Qb, d, passes, g or dg)
+    dcfg = fused_config(passes)
+    T = dcfg.T if T is None else T
+    Qb = dcfg.Qb if Qb is None else Qb
+    grid_order = dcfg.grid_order if grid_order is None else grid_order
+    if grid_order not in GRID_ORDERS:
+        raise ValueError(f"prepare_knn_index: grid_order must be one of "
+                         f"{GRID_ORDERS}, got {grid_order!r}")
+    T, Qb = fit_config(T, Qb, d, passes, g or dcfg.g, grid_order)
     n_tiles_est = max(1, -(-m // T))
     if g is None:
-        g = max(dg, (1 << auto_pack_bits(n_tiles_est, T))
+        g = max(dcfg.g, (1 << auto_pack_bits(n_tiles_est, T))
                 // (T // _LANES))
     # codes beyond 13 bits would perturb values past the margins the
     # certificate budgets for — such a g simply routes to the UNPACKED
@@ -802,24 +976,32 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
 
     pbits = min(_PBITS_MAX, max(_PACK_BITS, int(math.ceil(math.log2(
         max(g * (T // _LANES), 2))))))
+    # the database-major kernels are packed-only/single-shot-only:
+    # resolve the EFFECTIVE order now so the index rows are padded for
+    # the kernel that will actually run (a db-padded index serves the
+    # query-major kernel fine, but not vice versa)
+    grid_order = resolve_grid_order(
+        grid_order, d, g * (T // _LANES) <= (1 << pbits))
     dpad = (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
     if dpad:
         y = jnp.concatenate([y, jnp.zeros((m, dpad), jnp.float32)], axis=1)
     yp, y_hi, y_lo, yyh_k, yy_raw = _prepare_ops(y, T, g, metric,
-                                                 pbits=pbits)
+                                                 pbits=pbits,
+                                                 grid_order=grid_order)
     if not store_yp:
         yp = None
         if passes == 1:
             y_lo = None    # the 1-pass kernel and lite fixup never read it
     return KnnIndex(yp, y_hi, y_lo, yyh_k, yy_raw, m, T, Qb, g, passes,
-                    metric, d, pbits=pbits)
+                    metric, d, pbits=pbits, grid_order=grid_order)
 
 
 @instrument("distance.knn_fused")
 def knn_fused(x, y, k: int, passes: int = 3,
               T: Optional[int] = None, Qb: Optional[int] = None,
               g: Optional[int] = None, metric: str = "l2",
-              rescore: Optional[bool] = None, certify: str = "kernel"
+              rescore: Optional[bool] = None, certify: str = "kernel",
+              grid_order: Optional[str] = None
               ) -> Tuple[jax.Array, jax.Array]:
     """Certified fused brute-force KNN.
 
@@ -852,12 +1034,21 @@ def knn_fused(x, y, k: int, passes: int = 3,
     certified queries are provably exact w.r.t. f32 scores and only
     margin failures pay the exact-f32 fixup. At passes=3 it is a no-op
     (p3 is already f32-certified).
+
+    ``grid_order`` selects the kernel's grid iteration order (see
+    :data:`GRID_ORDERS`): "query" re-fetches the database per query
+    block; "db"/"dbuf" stream it from HBM ~once (the round-6 roofline
+    work). None takes the tuned default; requests outside the
+    database-major envelope (unpacked configs, d > 512) downgrade to
+    "query" with a logged reason. A :class:`KnnIndex` freezes the
+    order at build time.
     """
     idx: Optional[KnnIndex] = y if isinstance(y, KnnIndex) else None
     if idx is not None:
         T, Qb, g = idx.T, idx.Qb, idx.g
         passes, metric = idx.passes, idx.metric
         m, d = idx.n_rows, idx.d_orig
+        grid_order = idx.grid_order
     if metric not in ("l2", "ip"):
         raise ValueError(f"knn_fused: metric must be 'l2' or 'ip', "
                          f"got {metric!r}")
@@ -877,11 +1068,15 @@ def knn_fused(x, y, k: int, passes: int = 3,
     if idx is None:
         y = jnp.asarray(y, jnp.float32)
         m, d = y.shape
-        dT, dQb, dg = fused_defaults(passes)
-        T = dT if T is None else T
-        Qb = dQb if Qb is None else Qb
-        g = dg if g is None else g
-        T, Qb = fit_config(T, Qb, d, passes, g)
+        dcfg = fused_config(passes)
+        T = dcfg.T if T is None else T
+        Qb = dcfg.Qb if Qb is None else Qb
+        g = dcfg.g if g is None else g
+        grid_order = dcfg.grid_order if grid_order is None else grid_order
+        if grid_order not in GRID_ORDERS:
+            raise ValueError(f"knn_fused: grid_order must be one of "
+                             f"{GRID_ORDERS}, got {grid_order!r}")
+        T, Qb = fit_config(T, Qb, d, passes, g, grid_order)
     if d_x != d:
         raise ValueError(f"knn_fused: query width {d_x} != index {d}")
     if k > m:
@@ -909,7 +1104,8 @@ def knn_fused(x, y, k: int, passes: int = 3,
         # queries (prepare once so chunks share the index operands)
         if idx is None:
             idx = prepare_knn_index(y, passes=passes, metric=metric,
-                                    T=T, Qb=Qb, g=g)
+                                    T=T, Qb=Qb, g=g,
+                                    grid_order=grid_order)
         outs = [knn_fused(x[s:s + _Q_CHUNK], idx, k, rescore=rescore,
                           certify=certify)
                 for s in range(0, Q, _Q_CHUNK)]
@@ -919,7 +1115,10 @@ def knn_fused(x, y, k: int, passes: int = 3,
     # block size
     if idx is None:
         idx = prepare_knn_index(y, passes=passes, metric=metric,
-                                T=T, Qb=Qb, g=g)
+                                T=T, Qb=Qb, g=g, grid_order=grid_order)
+    # the EFFECTIVE order (prepare resolves the database-major envelope
+    # and pads the index rows accordingly)
+    grid_order = idx.grid_order
     dpad = idx.y_hi.shape[1] - d
     if dpad:
         x = jnp.concatenate(
@@ -946,7 +1145,7 @@ def knn_fused(x, y, k: int, passes: int = 3,
         x, idx.yp, idx.y_hi, idx.y_lo, idx.yyh_k, idx.yy_raw,
         k=k, T=T, Qb=Qb, g=g, passes=passes, metric=metric, m=m,
         rescore=rescore, pbits=idx.pbits, certify=certify,
-        pool_algo=pool_algo)
+        pool_algo=pool_algo, grid_order=grid_order)
     if vals.shape[0] != Q:
         vals, ids = vals[:Q], ids[:Q]
     # else: identity slices would still cost an eager dispatch each

@@ -61,10 +61,21 @@ def _correlation(x, y):
     return _cosine(xc, yc)
 
 
+def _is_batch_traced(*arrays) -> bool:
+    """Best-effort vmap detection: True when any operand is a batching
+    tracer at dispatch time (vmap(pairwise_distance), or vmap inside an
+    enclosing jit). ``vmap(jit(f))`` callers trace f under the jit
+    trace — invisible here — and should pass ``batched=True``."""
+    from jax.interpreters import batching
+
+    return any(isinstance(a, batching.BatchTracer) for a in arrays)
+
+
 @instrument("distance.pairwise_distance")
 def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclidean",
                       p: float = 2.0, precision=None,
-                      assume_finite: bool = False) -> jax.Array:
+                      assume_finite: bool = False,
+                      batched: bool = None) -> jax.Array:
     """Full [n, m] distance matrix. (ref: pre-cuVS
     raft::distance::pairwise_distance; pylibraft.distance.pairwise_distance)
 
@@ -83,6 +94,16 @@ def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclid
     they are routed to the XLA path, which preserves inf/NaN
     semantics).
 
+    ``batched=True`` tells the unexpanded dispatch the caller is
+    vmapped: under vmap the guard's ``lax.cond`` lowers to ``select``
+    and BOTH branches execute per batch element (round-5 finding), so
+    batched callers are short-circuited straight to the XLA path
+    (inf/NaN-correct, one branch). ``None`` auto-detects a batching
+    trace on the operands; ``vmap(jit(...))`` callers — invisible to
+    the detection — should pass it explicitly (or vouch with
+    ``assume_finite=True``, which skips the guard entirely and keeps
+    the Pallas kernel).
+
     Examples
     --------
     >>> import numpy as np
@@ -96,12 +117,15 @@ def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclid
     expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1],
             "pairwise_distance: inputs must be [n,d],[m,d]")
     t = _as_type(metric)
+    if batched is None:
+        batched = _is_batch_traced(x, y)
     if precision is not None:
         if isinstance(precision, jax.lax.Precision):
             precision = precision.name.lower()
         with jax.default_matmul_precision(precision):
-            return _pairwise_dispatch(res, x, y, t, p, assume_finite)
-    return _pairwise_dispatch(res, x, y, t, p, assume_finite)
+            return _pairwise_dispatch(res, x, y, t, p, assume_finite,
+                                      batched)
+    return _pairwise_dispatch(res, x, y, t, p, assume_finite, batched)
 
 
 _UNEXPANDED_TYPES = frozenset({
@@ -114,7 +138,8 @@ _UNEXPANDED_TYPES = frozenset({
 
 
 def _pairwise_dispatch(res, x, y, t: DistanceType, p: float,
-                       assume_finite: bool = False) -> jax.Array:
+                       assume_finite: bool = False,
+                       batched: bool = False) -> jax.Array:
     if t not in _UNEXPANDED_TYPES:
         # ONE jitted program for the expanded metrics: eagerly, the
         # 5-6 ops each cost a per-op transport dispatch (~2 ms on the
@@ -127,7 +152,7 @@ def _pairwise_dispatch(res, x, y, t: DistanceType, p: float,
     # over FEATURE CHUNKS with a [tile, m]-shaped carry — the d-axis
     # analog of the reference's k-blocked smem policy
     # (linalg/detail/contractions.cuh:313). Peak temp = [tile, m, dc].
-    return _unexpanded(res, x, y, t, p, assume_finite)
+    return _unexpanded(res, x, y, t, p, assume_finite, batched)
 
 
 @functools.partial(jax.jit, static_argnames=("t", "p"))
@@ -283,9 +308,12 @@ def _unexpanded_guarded(x, y, t: DistanceType, p: float, d_true: int,
     Cost note for ``vmap`` callers: under vmap, ``lax.cond`` lowers to
     ``select`` — BOTH branches execute for every batch element, so a
     vmapped caller pays kernel + XLA fallback distance computation and
-    keeps only one result. A batched pipeline that can vouch for finite
-    inputs should call with ``assume_finite=True`` (skips the guard and
-    the dead branch) instead of vmapping this dispatcher."""
+    keeps only one result. The dispatcher therefore SHORT-CIRCUITS
+    known-batched callers straight to ``_unexpanded_jit`` (detected
+    via the operands' batching trace, or the explicit ``batched=``
+    kwarg) — this guarded path is only entered unbatched. A batched
+    pipeline that can vouch for finite inputs should instead pass
+    ``assume_finite=True`` (skips the guard AND keeps the kernel)."""
     finite = jnp.isfinite(x).all() & jnp.isfinite(y).all()
     from raft_tpu.ops.unexpanded_pallas import unexpanded_pairwise_tiled
 
@@ -297,7 +325,8 @@ def _unexpanded_guarded(x, y, t: DistanceType, p: float, d_true: int,
 
 
 def _unexpanded(res, x, y, t: DistanceType, p: float,
-                assume_finite: bool = False) -> jax.Array:
+                assume_finite: bool = False,
+                batched: bool = False) -> jax.Array:
     n, d = x.shape
     m = y.shape[0]
     acc_dtype = jnp.promote_types(jnp.promote_types(x.dtype, y.dtype),
@@ -324,5 +353,11 @@ def _unexpanded(res, x, y, t: DistanceType, p: float,
             # caller vouches for the kernel envelope: skip even the
             # in-program finiteness reduction
             return unexpanded_pairwise_tiled(x, y, t, p)
+        if batched:
+            # known-batched caller: the guard's cond would lower to
+            # select under vmap and execute BOTH branches per batch
+            # element — the XLA path alone (inf/NaN-correct) is
+            # strictly cheaper than kernel + XLA with one discarded
+            return _unexpanded_jit(x, y, t, float(p), d, tile, dc=dc)
         return _unexpanded_guarded(x, y, t, float(p), d, tile, dc)
     return _unexpanded_jit(x, y, t, float(p), d, tile, dc=dc)

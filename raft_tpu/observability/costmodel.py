@@ -205,6 +205,91 @@ def roofline(rec: CostRecord, spec: Optional[ChipSpec] = None,
     return est
 
 
+# ------------------------------------------------- fused traffic model
+def fused_traffic_model(Q: int, m: int, d: int, k: int,
+                        T: int, Qb: int, g: int, passes: int,
+                        grid_order: str = "query") -> Dict:
+    """Analytic HBM traffic of the packed fused L2 top-k pipeline for
+    one query batch — the per-variant bytes model the grid-order work
+    is judged by (ISSUE 3): query-major re-fetches the database once
+    per query block (y traffic ``nq·M·d`` bytes), the database-major
+    orders stream it once (``M·d``), trading a bounded amount of x /
+    output revisit traffic. Emitted next to XLA's ``bytes_accessed`` in
+    BENCH artifacts so predicted-vs-measured divergence is visible in
+    the evidence trail, and used by :mod:`raft_tpu.tune` to rank
+    candidates deterministically on CPU.
+
+    Mirrors the real pipeline's geometry: feature padding, row padding
+    to tiles (or whole groups for db orders), query chunking at
+    ``_Q_CHUNK`` (each chunk is a separate kernel launch, so y
+    re-streams per chunk), bf16 (passes=1) vs bf16 hi+lo (passes=3)
+    database bytes, and the 3 packed [Q, G·128] outputs. The model
+    assumes the packed production path — the unpacked fallback's extra
+    id outputs are not priced."""
+    from raft_tpu.distance.knn_fused import (_DC, _D_SINGLE_SHOT,
+                                             _Q_CHUNK)
+
+    lanes = 128
+    d_eff = d + (-d) % (_DC if d > _D_SINGLE_SHOT else lanes)
+    row_mult = g * T if grid_order in ("db", "dbuf") else T
+    M = -(-max(m, 1) // row_mult) * row_mult
+    n_tiles = M // T
+    G = -(-n_tiles // g)
+    y_stream = M * d_eff * 2 * (2 if passes == 3 else 1)
+    yy_stream = 8 * M * 4
+    y_streams = 0.0
+    x_bytes = 0.0
+    out_bytes = 0.0
+    q_left = Q
+    while q_left > 0:
+        qc = min(q_left, _Q_CHUNK)
+        q_left -= qc
+        qb_eff = min(Qb, -(-qc // 8) * 8)
+        qp = -(-qc // qb_eff) * qb_eff
+        nq = qp // qb_eff
+        if grid_order == "query":
+            y_streams += nq                 # y re-fetched per query block
+            x_bytes += qp * d_eff * 4       # x fetched once per block
+        elif grid_order == "db":
+            y_streams += 1                  # super-block resident
+            x_bytes += (M // (g * T)) * qp * d_eff * 4   # x per group
+        else:                               # dbuf: both single-stream
+            y_streams += 1
+            x_bytes += qp * d_eff * 4
+        out_bytes += 3 * qp * G * lanes * 4
+    return {
+        "grid_order": grid_order,
+        "y_bytes": y_streams * y_stream,
+        "y_stream_bytes": float(y_stream),
+        "y_stream_factor": y_streams,
+        "x_bytes": x_bytes,
+        "yy_bytes": y_streams * yy_stream,
+        "out_bytes": out_bytes,
+        "total_bytes": (y_streams * (y_stream + yy_stream)
+                        + x_bytes + out_bytes),
+    }
+
+
+def fused_traffic_record(Q: int, m: int, d: int, k: int,
+                         T: int, Qb: int, g: int, passes: int,
+                         grid_order: str = "query") -> CostRecord:
+    """The traffic model as a :class:`CostRecord` (entry
+    ``fused_traffic_model``) so it can ride the same roofline path as
+    XLA-captured costs — the deterministic ranking key of the
+    :mod:`raft_tpu.tune` CPU fallback."""
+    model = fused_traffic_model(Q, m, d, k, T, Qb, g, passes,
+                                grid_order)
+    lanes = 128
+    d_eff = d + (-d) % lanes if d <= 512 else d + (-d) % 256
+    flops = 2.0 * Q * (-(-m // T) * T) * d_eff * (3 if passes == 3 else 1)
+    return CostRecord(
+        entry="fused_traffic_model",
+        key=f"{grid_order};T={T};Qb={Qb};g={g};p={passes};"
+            f"{Q}x{m}x{d}",
+        flops=flops,
+        bytes_accessed=model["total_bytes"])
+
+
 def _fmt_count(v: float) -> str:
     for unit, scale in (("G", 1e9), ("M", 1e6), ("K", 1e3)):
         if abs(v) >= scale:

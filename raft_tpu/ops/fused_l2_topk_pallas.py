@@ -66,7 +66,8 @@ VMEM_BUDGET = 15 * 2 ** 20
 
 
 def vmem_footprint(T: int, Qb: int, d: int, passes: int,
-                   dchunk: bool = False, kernel: str = "group") -> int:
+                   dchunk: bool = False, kernel: str = "group",
+                   g: int = 16) -> int:
     """Estimated scoped-VMEM bytes of one fused-kernel grid cell.
 
     Calibrated against measured Mosaic compiles/rejections on v5e:
@@ -78,7 +79,36 @@ def vmem_footprint(T: int, Qb: int, d: int, passes: int,
       16.36 MB WITH in-kernel masking; masking is since removed (yy
       carries +inf — two fewer [Qb, T] buffers) but the in-kernel merge
       holds more fold state, so its factors stay higher than the slot
-      kernel's: ~2.2 (p1) / ~3.2 (p3)."""
+      kernel's: ~2.2 (p1) / ~3.2 (p3).
+
+    ``g`` (tiles per group) only enters the database-major models —
+    "stream_db" holds a whole [g·T, d] y super-block resident,
+    "stream_dbuf" holds 2 DMA tile slots but the fold state of the
+    WHOLE query batch (callers pass the padded query count as Qb)."""
+    if kernel == "stream_db":
+        # database-major super-blocked cell: the y group block
+        # [g·T, d] is VMEM-resident (double-buffered by the standard
+        # Pallas pipeline so the next super-block DMA overlaps the
+        # last cell of this one); fold state matches "stream"
+        bytes_ = g * T * d * 2 * 2 * (2 if passes == 3 else 1)
+        bytes_ += Qb * d * 6 + Qb * 8                 # x f32+bf16, xxh
+        bytes_ += 8 * g * T * 4 * 2                   # yyh carrier
+        bytes_ += Qb * _LANES * 4 * 20                # fold state + temps
+        return bytes_
+    if kernel == "stream_dbuf":
+        # explicit double-buffered streaming: y tiles ride a 2-slot
+        # manual-DMA scratch (only 2 tiles resident, whatever g is) but
+        # the cell covers the WHOLE query batch — Qb here is the padded
+        # query count, so the fold-state term dominates. Factor 12 ≈
+        # 3 accumulators + ~6 transient merge temps + pack/cast copies;
+        # UNCALIBRATED estimate (no Mosaic compile/reject measured yet
+        # for this kernel — the first TPU round recalibrates it the way
+        # v5e rejections calibrated the factors above).
+        bytes_ = 2 * T * d * 2 * (2 if passes == 3 else 1)  # 2 DMA slots
+        bytes_ += Qb * d * 6 + Qb * 8                 # x f32+bf16, xxh
+        bytes_ += 8 * g * T * 4 * 2                   # yyh carrier
+        bytes_ += Qb * _LANES * 4 * 12                # fold state + temps
+        return bytes_
     if kernel == "stream":
         # the streamed packed kernel (single-shot only — the d-chunked
         # packed kernel models as "packed") never materializes a
@@ -723,6 +753,169 @@ def _group_kernel_packed_dchunk(m_real_ref, x_ref, yhi_ref, yyh_ref,
                                      xxh_ref=xxh_ref)
 
 
+# --- DATABASE-MAJOR variants: stream y from HBM ~once ----------------
+#
+# The query-major grid (nq, n_tiles) re-fetches EVERY y tile for every
+# query block: y HBM traffic = nq · M · d bytes. At the driver shape
+# (2048×1M×128, Qb=256 ⇒ nq=8) that re-fetch alone accounts for most of
+# the measured 460-vs-820 GB/s roofline gap (round 5). These variants
+# invert the loop so the database streams ~once:
+#
+# - "db" (super-blocked): grid (n_groups, nq) with the WHOLE certificate
+#   group [tpg·T, d] as one resident y block, index (sidx, i) → (sidx,)
+#   — constant across the inner query loop, so Mosaic fetches each
+#   super-block exactly once (y traffic = M·d·2 bytes total) and its
+#   standard pipeline DMAs super-block sidx+1 while the last query block
+#   of sidx computes (one cell ≈ Qb·tpg·T·d·2 MXU flops ≈ 2× the
+#   super-block DMA time at production tiles — the prefetch hides).
+#   Each cell folds the full group in one shot, so the group outputs are
+#   written ONCE per (i, sidx) — no revisited-output accumulation to
+#   keep legal under the inverted order. x blocks are re-fetched once
+#   per super-block (n_groups · Q · d · 4 bytes — the traffic the
+#   autotuner trades against the saved y stream; see
+#   observability.costmodel.fused_traffic_model).
+# - "dbuf" (explicit double-buffered): grid (n_groups,) with y in
+#   ANY/HBM and a manual 2-slot async-copy pipeline: tile jj+1's DMA is
+#   issued before tile jj's fold runs, so the HBM stream overlaps the
+#   MXU/VPU work at TILE granularity and only 2 tiles are VMEM-resident
+#   (the tpg envelope is no longer VMEM-bound). The cell covers the
+#   WHOLE query batch (fold state [Q, 128] — the VMEM cost that
+#   replaces the resident super-block), x is resident and fetched once:
+#   y traffic = M·d·2, x traffic = Q·d·4, both single-stream.
+#
+# Both are packed-only (the production path): same outputs, codes and
+# certificate semantics as fused_l2_group_topk_packed — group sidx maps
+# to output columns [sidx·128, (sidx+1)·128), the within-group code is
+# jj·(T/128) + chunk — so decode_packed_pool and the twin-pool
+# certificate in knn_fused work unchanged. Callers pad the index to a
+# whole number of groups (tpg·T rows); padded columns carry the
+# _PACK_PAD sentinel in yy_half exactly as before.
+
+
+def _fold_tile_packed(acc, x, ythi, ytlo, yyh_t, xxh, jj: int,
+                      *, T: int, Qb: int, pair: bool, pbits: int):
+    """Fold ONE y tile (rows [T, d], half-norms yyh_t [8, T]) into the
+    packed (a1, a2, a3) carriers with within-group tile offset ``jj`` —
+    the per-tile body shared by the database-major kernels. Chunk
+    contractions are emitted individually (the "stream" idiom) so
+    Mosaic co-issues fold(r) with contract(r+1)."""
+    a1, a2, a3 = acc
+    n_chunks = T // _LANES
+    q8 = Qb // 8
+
+    def chunk_score(r):
+        sl = slice(r * _LANES, (r + 1) * _LANES)
+        s_r = _contract(x, ythi[sl, :],
+                        None if ytlo is None else ytlo[sl, :])
+        c = yyh_t[:, sl] - s_r.reshape(q8, 8, _LANES)
+        # c + xx/2 = d2/2 (see _group_fold_and_write_packed)
+        return c if xxh is None else c + xxh
+
+    def pack(c, code):
+        return jax.lax.bitcast_convert_type(
+            (jax.lax.bitcast_convert_type(c, jnp.int32)
+             & ~((1 << pbits) - 1)) | code, jnp.float32)
+
+    if pair:
+        _check_pair_envelope(n_chunks)
+        for r in range(0, n_chunks, 2):
+            c0, c1 = chunk_score(r), chunk_score(r + 1)
+            mn = jnp.minimum(c0, c1)
+            a3 = jnp.minimum(a3, jnp.maximum(c0, c1))
+            base = jj * n_chunks + r                     # even → bit0 free
+            cp = pack(mn, jnp.where(mn == c1, base + 1, base))
+            a1, a2, a3 = _merge_chunk_top2_packed(cp, a1, a2, a3)
+    else:
+        for r in range(n_chunks):
+            cp = pack(chunk_score(r), jj * n_chunks + r)
+            a1, a2, a3 = _merge_chunk_top2_packed(cp, a1, a2, a3)
+    return a1, a2, a3
+
+
+def _group_kernel_packed_db(m_real_ref, x_ref, yhi_ref, yyh_ref,
+                            a1_ref, a2_ref, a3_ref,
+                            *, T: int, Qb: int, tpg: int,
+                            pair: bool = False, pbits: int = _PACK_BITS,
+                            ylo_ref=None, xxh_ref=None):
+    """Database-major super-blocked cell: the resident [tpg·T, d] y
+    block is folded whole (static tile loop), outputs written once."""
+    q8 = Qb // 8
+    big = jnp.full((q8, 8, _LANES), _PACK_PAD, jnp.float32)
+    acc = (big, big, big)
+    x = x_ref[...]
+    yyh = yyh_ref[...]                                   # [8, tpg·T]
+    xxh = (None if xxh_ref is None
+           else xxh_ref[...].reshape(q8, 8, 1))
+    for jj in range(tpg):
+        rs = slice(jj * T, (jj + 1) * T)
+        acc = _fold_tile_packed(
+            acc, x, yhi_ref[rs, :],
+            None if ylo_ref is None else ylo_ref[rs, :],
+            yyh[:, rs], xxh, jj, T=T, Qb=Qb, pair=pair, pbits=pbits)
+    a1_ref[...] = acc[0].reshape(Qb, _LANES)
+    a2_ref[...] = acc[1].reshape(Qb, _LANES)
+    a3_ref[...] = acc[2].reshape(Qb, _LANES)
+
+
+def _group_kernel_packed_dbuf(m_real_ref, x_ref, yhi_ref, yyh_ref,
+                              a1_ref, a2_ref, a3_ref,
+                              *, T: int, Qb: int, tpg: int,
+                              pair: bool = False, pbits: int = _PACK_BITS,
+                              ylo_ref=None, xxh_ref=None):
+    """Explicit double-buffered database streaming: y_hi (and y_lo)
+    stay in ANY/HBM; tiles ride a 2-slot VMEM scratch whose next-tile
+    async copy is issued BEFORE the current tile's fold, so the DMA
+    overlaps the MXU contraction. Grid (n_groups,) — one cell covers
+    the whole query batch (Qb == padded Q)."""
+    sidx = pl.program_id(0)
+    d = yhi_ref.shape[1]
+    q8 = Qb // 8
+
+    def body(scratch_hi, sem_hi, scratch_lo=None, sem_lo=None):
+        def dma(ref, scr, sem, slot, jj):
+            return pltpu.make_async_copy(
+                ref.at[pl.ds((sidx * tpg + jj) * T, T), :],
+                scr.at[slot], sem.at[slot])
+
+        def start(slot, jj):
+            dma(yhi_ref, scratch_hi, sem_hi, slot, jj).start()
+            if scratch_lo is not None:
+                dma(ylo_ref, scratch_lo, sem_lo, slot, jj).start()
+
+        def wait(slot, jj):
+            dma(yhi_ref, scratch_hi, sem_hi, slot, jj).wait()
+            if scratch_lo is not None:
+                dma(ylo_ref, scratch_lo, sem_lo, slot, jj).wait()
+
+        start(0, 0)
+        big = jnp.full((q8, 8, _LANES), _PACK_PAD, jnp.float32)
+        acc = (big, big, big)
+        x = x_ref[...]
+        yyh = yyh_ref[...]                               # [8, tpg·T]
+        xxh = (None if xxh_ref is None
+               else xxh_ref[...].reshape(q8, 8, 1))
+        for jj in range(tpg):
+            slot = jj % 2
+            if jj + 1 < tpg:
+                start((jj + 1) % 2, jj + 1)              # prefetch next
+            wait(slot, jj)
+            acc = _fold_tile_packed(
+                acc, x, scratch_hi[slot],
+                None if scratch_lo is None else scratch_lo[slot],
+                yyh[:, jj * T:(jj + 1) * T], xxh, jj,
+                T=T, Qb=Qb, pair=pair, pbits=pbits)
+        a1_ref[...] = acc[0].reshape(Qb, _LANES)
+        a2_ref[...] = acc[1].reshape(Qb, _LANES)
+        a3_ref[...] = acc[2].reshape(Qb, _LANES)
+
+    scoped = dict(scratch_hi=pltpu.VMEM((2, T, d), jnp.bfloat16),
+                  sem_hi=pltpu.SemaphoreType.DMA((2,)))
+    if ylo_ref is not None:
+        scoped.update(scratch_lo=pltpu.VMEM((2, T, d), jnp.bfloat16),
+                      sem_lo=pltpu.SemaphoreType.DMA((2,)))
+    pl.run_scoped(body, **scoped)
+
+
 def _group_kernel(m_real_ref, x_ref, yhi_ref, yyh_ref,
                   a1_ref, id1_ref, a2_ref, id2_ref, a3_ref,
                   *, T: int, Qb: int, tpg: int, ylo_ref=None):
@@ -953,6 +1146,129 @@ def fused_l2_group_topk_packed_dchunk(x, y_hi, y_lo, yy_half, m_real,
                               y_lo, yy_half, m_real, T=T, Qb=Qb,
                               passes=passes, tpg=tpg, dc=dc, pair=pair,
                               pbits=pbits, xxh=xxh)
+
+
+def _group_pallas_call_db(dbuf: bool, x, y_hi, y_lo, yy_half, m_real,
+                          *, T: int, Qb: int, passes: int, tpg: int,
+                          pair: bool, pbits: int, xxh):
+    """Scaffolding for the database-major packed entry points (specs,
+    grid, pallas_call in ONE place, mirroring _group_pallas_call)."""
+    _check_tiling(T, Qb)
+    _check_pack_envelope(T, tpg, pbits)
+    Q, d = x.shape
+    M = y_hi.shape[0]
+    if M % (tpg * T):
+        raise ValueError(
+            f"database-major fused kernel: index rows M={M} must be a "
+            f"whole number of [tpg·T = {tpg * T}]-row groups — pad the "
+            f"index (knn_fused's _prepare_ops does when grid_order is "
+            f"'db'/'dbuf')")
+    n_groups = M // (tpg * T)
+    if dbuf:
+        # one cell spans the whole query batch (fold state [Q, 128])
+        Qb = Q
+    if Q % Qb:
+        raise ValueError(f"db-major fused kernel: Q={Q} must be a "
+                         f"multiple of Qb={Qb}")
+    nq = Q // Qb
+
+    if dbuf:
+        grid = (n_groups,)
+        x_spec = pl.BlockSpec((Qb, d), lambda s, *_: (0, 0),
+                              memory_space=pltpu.VMEM)
+        y_spec = pl.BlockSpec(memory_space=pltpu.ANY)   # manual DMA
+        yy_spec = pl.BlockSpec((8, tpg * T), lambda s, *_: (0, s),
+                               memory_space=pltpu.VMEM)
+        xx_spec = pl.BlockSpec((Qb, 1), lambda s, *_: (0, 0),
+                               memory_space=pltpu.VMEM)
+        out_spec = pl.BlockSpec((Qb, _LANES), lambda s, *_: (0, s),
+                                memory_space=pltpu.VMEM)
+        base = _group_kernel_packed_dbuf
+    else:
+        grid = (n_groups, nq)
+        x_spec = pl.BlockSpec((Qb, d), lambda s, i, *_: (i, 0),
+                              memory_space=pltpu.VMEM)
+        # the WHOLE group as one resident block: constant over the
+        # inner query loop ⇒ fetched once per group (the stream-once
+        # invariant), double-buffered by the standard pipeline
+        y_spec = pl.BlockSpec((tpg * T, d), lambda s, i, *_: (s, 0),
+                              memory_space=pltpu.VMEM)
+        yy_spec = pl.BlockSpec((8, tpg * T), lambda s, i, *_: (0, s),
+                               memory_space=pltpu.VMEM)
+        xx_spec = pl.BlockSpec((Qb, 1), lambda s, i, *_: (i, 0),
+                               memory_space=pltpu.VMEM)
+        out_spec = pl.BlockSpec((Qb, _LANES), lambda s, i, *_: (i, s),
+                                memory_space=pltpu.VMEM)
+        base = _group_kernel_packed_db
+
+    in_specs = [x_spec, y_spec, yy_spec]
+    operands = [x, y_hi, yy_half]
+    if passes == 3:
+        in_specs.insert(2, y_spec)                      # y_lo
+        operands.insert(2, y_lo)
+    if xxh is not None:
+        in_specs.append(xx_spec)
+        operands.append(xxh)
+    kernel = _make_group_kernel(base, passes, T, Qb, tpg=tpg,
+                                has_xxh=xxh is not None,
+                                pair=pair, pbits=pbits)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[out_spec] * 3,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_packed_out_shape(Q, n_groups * _LANES),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",) * len(grid),
+        ),
+        cost_estimate=_slot_cost(Q, M, d, n_groups * _LANES, passes),
+        interpret=interpret_mode(),
+    )(m_real, *operands)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("T", "Qb", "passes", "tpg", "pair",
+                                    "pbits"))
+def fused_l2_group_topk_packed_db(x, y_hi, y_lo, yy_half, m_real,
+                                  T: int, Qb: int, passes: int,
+                                  tpg: int = 16, pair: bool = False,
+                                  pbits: int = _PACK_BITS, xxh=None):
+    """Database-major super-blocked packed fused kernel (see the
+    DATABASE-MAJOR block comment): same contract and outputs as
+    :func:`fused_l2_group_topk_packed`, but the grid is
+    ``(n_groups, nq)`` with the whole [tpg·T, d] certificate group
+    VMEM-resident — y streams from HBM exactly once instead of
+    ``nq`` times. Requires the index padded to whole groups
+    (``M % (tpg·T) == 0``) and the packed envelope."""
+    return _group_pallas_call_db(False, x, y_hi, y_lo, yy_half, m_real,
+                                 T=T, Qb=Qb, passes=passes, tpg=tpg,
+                                 pair=pair, pbits=pbits, xxh=xxh)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("T", "Qb", "passes", "tpg", "pair",
+                                    "pbits"))
+def fused_l2_group_topk_packed_dbuf(x, y_hi, y_lo, yy_half, m_real,
+                                    T: int, Qb: int, passes: int,
+                                    tpg: int = 16, pair: bool = False,
+                                    pbits: int = _PACK_BITS, xxh=None):
+    """Explicitly double-buffered database-major packed fused kernel
+    (see the DATABASE-MAJOR block comment): y stays in HBM and tiles
+    ride a manual 2-slot async-copy pipeline (tile jj+1's DMA issued
+    before tile jj's fold), so only two tiles are VMEM-resident and the
+    HBM stream overlaps compute at tile granularity. One grid cell
+    covers the whole query batch: ``Qb`` is accepted for interface
+    parity but the effective query block is the padded Q (the VMEM
+    footprint model prices the [Q, 128] fold state — see
+    ``vmem_footprint(kernel="stream_dbuf")``)."""
+    return _group_pallas_call_db(True, x, y_hi, y_lo, yy_half, m_real,
+                                 T=T, Qb=Qb, passes=passes, tpg=tpg,
+                                 pair=pair, pbits=pbits, xxh=xxh)
 
 
 def split_hi_lo(y: jax.Array) -> Tuple[jax.Array, jax.Array]:

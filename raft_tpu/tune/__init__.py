@@ -1,0 +1,19 @@
+"""Evidence-driven autotuning (the fitted-heuristic role of the
+reference's ``cpp/scripts/heuristics/select_k`` fitting pipeline,
+rebuilt on the PR-2 roofline evidence chain: candidates are pruned by
+the scoped-VMEM footprint model, measured through ``benchmark.Fixture``
++ ``res.profiler`` cost capture, and the winner ships as a
+schema-validated, provenance-stamped table the runtime defaults
+consume)."""
+
+from raft_tpu.tune.fused import (TUNE_SCHEMA_VERSION, autotune_fused,
+                                 candidate_space, validate_tune_table,
+                                 write_tune_table)
+
+__all__ = [
+    "TUNE_SCHEMA_VERSION",
+    "autotune_fused",
+    "candidate_space",
+    "validate_tune_table",
+    "write_tune_table",
+]

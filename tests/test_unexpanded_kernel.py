@@ -162,3 +162,39 @@ def test_d_zero_returns_zeros():
         np.zeros((3, 0), np.float32), np.zeros((5, 0), np.float32),
         DT.L1, 2.0))
     assert out.shape == (3, 5) and np.all(out == 0)
+
+
+def test_vmap_caller_short_circuits_guard():
+    # round-5 finding: under vmap the guard's lax.cond lowers to select
+    # and BOTH branches execute per batch element. Known-batched
+    # callers (auto-detected, or batched=True) must route straight to
+    # the XLA path — no cond, no dead Pallas branch — and still match
+    # the unbatched results.
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu import distance
+
+    xs = np.stack([X, X[::-1]])                 # [2, n, d] batch
+
+    def f(a):
+        return distance.pairwise_distance(None, a, Y, metric="l1")
+
+    # the unbatched guarded program carries the cond (baseline for the
+    # assertion below — if this stops holding, the vmap check is moot)
+    assert "cond" in str(jax.make_jaxpr(f)(X))
+
+    jaxpr = str(jax.make_jaxpr(jax.vmap(f))(jnp.asarray(xs)))
+    assert "cond" not in jaxpr, "vmapped caller still pays both branches"
+    assert "pallas_call" not in jaxpr
+
+    out = np.asarray(jax.vmap(f)(jnp.asarray(xs)))
+    for b in range(2):
+        np.testing.assert_allclose(out[b], cdist(xs[b], Y, "cityblock"),
+                                   atol=1e-3, rtol=1e-3)
+
+    # explicit batched=True takes the same route without a vmap trace
+    jaxpr2 = str(jax.make_jaxpr(
+        lambda a: distance.pairwise_distance(None, a, Y, metric="l1",
+                                             batched=True))(X))
+    assert "cond" not in jaxpr2

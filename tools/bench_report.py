@@ -135,15 +135,43 @@ def check_regression(record: Optional[Dict], baseline: Optional[Dict],
             return REGRESS, (
                 f"REGRESSION: {value:g} {unit} < {floor:g} "
                 f"(last good {base_value:g} − {threshold:.0%})")
-        return PASS, (f"ok: {value:g} {unit} vs last good "
-                      f"{base_value:g} (threshold {threshold:.0%})")
+        return _check_roofline(
+            record, baseline, threshold,
+            f"ok: {value:g} {unit} vs last good "
+            f"{base_value:g} (threshold {threshold:.0%})")
     ceil = base_value * (1.0 + threshold)
     if value > ceil:
         return REGRESS, (
             f"REGRESSION: {value:g} {unit} > {ceil:g} "
             f"(last good {base_value:g} + {threshold:.0%})")
-    return PASS, (f"ok: {value:g} {unit} vs last good {base_value:g} "
-                  f"(threshold {threshold:.0%})")
+    return _check_roofline(
+        record, baseline, threshold,
+        f"ok: {value:g} {unit} vs last good {base_value:g} "
+        f"(threshold {threshold:.0%})")
+
+
+def _check_roofline(record: Dict, baseline: Dict, threshold: float,
+                    pass_msg: str) -> Tuple[str, str]:
+    """Second-stage gate on the ROOFLINE-FRACTION trend: a round whose
+    headline GB/s holds can still have lost ground against what the
+    hardware allows (e.g. the cost model's bytes shrank — less work per
+    second at the same rate). Only fires when BOTH records carry a
+    numeric roofline_frac; seconds-only history stays gateable by the
+    headline alone."""
+    rf = record.get("roofline_frac")
+    base_rf = baseline.get("roofline_frac")
+    if (isinstance(rf, (int, float))
+            and isinstance(base_rf, (int, float)) and base_rf > 0):
+        floor = base_rf * (1.0 - threshold)
+        if rf < floor:
+            return REGRESS, (
+                f"ROOFLINE REGRESSION: roofline_frac {rf:.3g} < "
+                f"{floor:.3g} (last good {base_rf:.3g} − "
+                f"{threshold:.0%}) even though the headline holds — "
+                f"the chip allows more than this round achieved")
+        pass_msg += (f"; roofline_frac {rf:.3g} vs last good "
+                     f"{base_rf:.3g}")
+    return PASS, pass_msg
 
 
 def _fmt(v, nd=4) -> str:

@@ -30,7 +30,7 @@ from __future__ import annotations
 import ast
 import os
 import sys
-from typing import Dict, List, Sequence
+from typing import Dict, List, Sequence, Tuple
 
 # module (repo-relative) → functions that must be instrumented
 HOT_PATHS: Dict[str, Sequence[str]] = {
@@ -44,6 +44,7 @@ HOT_PATHS: Dict[str, Sequence[str]] = {
     "raft_tpu/sparse/tiled.py": ("tile_csr", "tile_csr_pairs"),
     "raft_tpu/sparse/sharded.py": ("spmv_sharded", "spmm_sharded"),
     "raft_tpu/solver/linear_assignment.py": ("solve_lap",),
+    "raft_tpu/tune/fused.py": ("autotune_fused",),
 }
 
 # module (repo-relative) → profiler capture methods it must call
@@ -51,6 +52,20 @@ HOT_PATHS: Dict[str, Sequence[str]] = {
 COST_CAPTURE_SITES: Dict[str, Sequence[str]] = {
     "raft_tpu/runtime/entry_points.py": ("capture",),
     "raft_tpu/benchmark.py": ("capture_fn",),
+    "raft_tpu/tune/fused.py": ("capture_fn",),
+}
+
+# defining module → (kernel-variant entry points, consuming module):
+# the grid-order variants must EXIST where the footprint model and the
+# autotuner expect them, and the consumer must actually reference them
+# — deleting a variant (or silently unrouting it) would leave tuned
+# tables naming a kernel production can't run.
+KERNEL_VARIANTS: Dict[str, Tuple[Sequence[str], str]] = {
+    "raft_tpu/ops/fused_l2_topk_pallas.py": (
+        ("fused_l2_group_topk_packed",
+         "fused_l2_group_topk_packed_db",
+         "fused_l2_group_topk_packed_dbuf"),
+        "raft_tpu/distance/knn_fused.py"),
 }
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -110,6 +125,46 @@ def check_cost_capture(root: str = _REPO_ROOT,
     return errors
 
 
+def check_kernel_variants(root: str = _REPO_ROOT,
+                          variants: Dict[str, Tuple[Sequence[str], str]]
+                          = None) -> List[str]:
+    """Violations for :data:`KERNEL_VARIANTS` (empty = clean): each
+    listed entry point must be defined at module level in its defining
+    module AND referenced by name in its consuming module."""
+    variants = KERNEL_VARIANTS if variants is None else variants
+    errors: List[str] = []
+    for rel, (names, consumer_rel) in sorted(variants.items()):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            errors.append(f"{rel}: kernel-variant module missing")
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        defined = {n.name for n in tree.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        consumer_path = os.path.join(root, consumer_rel)
+        if os.path.exists(consumer_path):
+            with open(consumer_path) as f:
+                ctree = ast.parse(f.read(), filename=consumer_rel)
+            referenced = {n.id for n in ast.walk(ctree)
+                          if isinstance(n, ast.Name)}
+        else:
+            errors.append(f"{consumer_rel}: kernel-variant consumer "
+                          f"missing")
+            ctree, referenced = None, set()
+        for name in names:
+            if name not in defined:
+                errors.append(f"{rel}: kernel variant {name!r} not "
+                              f"defined at module level")
+            elif ctree is not None and name not in referenced:
+                errors.append(
+                    f"{consumer_rel}: kernel variant {name!r} is "
+                    f"defined but never referenced — the grid-order "
+                    f"routing would silently drop it")
+    return errors
+
+
 def check(root: str = _REPO_ROOT,
           hot_paths: Dict[str, Sequence[str]] = None) -> List[str]:
     """Returns a list of violation messages (empty = clean)."""
@@ -140,9 +195,11 @@ def check(root: str = _REPO_ROOT,
                 errors.append(f"{rel}: {fn}() is not decorated with "
                               f"@instrument")
     if hot_paths is HOT_PATHS:
-        # the default invocation also gates the cost-capture sites;
-        # callers probing a custom hot_paths table (tests) opt out
+        # the default invocation also gates the cost-capture sites and
+        # the kernel-variant presence/consumption assertions; callers
+        # probing a custom hot_paths table (tests) opt out
         errors.extend(check_cost_capture(root))
+        errors.extend(check_kernel_variants(root))
     return errors
 
 
@@ -155,7 +212,9 @@ def main(argv: Sequence[str] = ()) -> int:
               f"{sum(len(v) for v in HOT_PATHS.values())} functions in "
               f"{len(HOT_PATHS)} modules instrumented; "
               f"{sum(len(v) for v in COST_CAPTURE_SITES.values())} "
-              f"cost-capture sites verified")
+              f"cost-capture sites verified; "
+              f"{sum(len(v[0]) for v in KERNEL_VARIANTS.values())} "
+              f"kernel variants present + consumed")
     return 1 if errors else 0
 
 
