@@ -204,8 +204,11 @@ class MeshComms:
     # -- device p2p ---------------------------------------------------------
     def device_send(self, x, dst: int):
         """Paired send/recv become one ppermute — see device_sendrecv.
-        (ref: comms_iface::device_send)"""
-        return self.device_sendrecv(x, dst, src=None)
+        Counted under its OWN collective label (with payload bytes), so
+        metrics exporters can tell explicit p2p sends apart from the
+        generic sendrecv surface. (ref: comms_iface::device_send)"""
+        _count("device_send", x, self.axis_name)
+        return self._sendrecv_impl(x, dst)
 
     def device_recv(self, x_from_permute):
         return x_from_permute
@@ -215,6 +218,9 @@ class MeshComms:
         dst may be an int (uniform shift pattern) or a list of (src, dst)
         pairs. (ref: comms_iface::device_sendrecv → here ppermute on ICI)"""
         _count("sendrecv", x, self.axis_name)
+        return self._sendrecv_impl(x, dst)
+
+    def _sendrecv_impl(self, x, dst):
         size = self._size
         expects(size is not None,
                 "device_sendrecv needs MeshComms(axis, size=...) for the "
@@ -224,6 +230,17 @@ class MeshComms:
         else:
             perm = list(dst)
         return jax.lax.ppermute(x, self.axis_name, perm)
+
+    def collective_permute(self, x, perm: Sequence[Tuple[int, int]]):
+        """Explicit-permutation exchange — ``jax.lax.ppermute`` with the
+        caller's (src, dst) table, counted (calls + payload bytes) under
+        its own ``collective_permute`` label so the sharded-KNN
+        tournament merge rounds are visible in the metrics exporters.
+        Ranks no pair targets receive ppermute's zero fill.
+        (ref: ncclSend/ncclRecv groups — the reference's p2p rendering
+        of a butterfly exchange.)"""
+        _count("collective_permute", x, self.axis_name)
+        return jax.lax.ppermute(x, self.axis_name, list(perm))
 
     def device_multicast_sendrecv(self, x, dsts: Optional[Sequence[int]] = None):
         """One shard to many ranks: all_gather then select is the XLA-native

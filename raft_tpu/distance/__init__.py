@@ -14,10 +14,16 @@ from raft_tpu.distance.fused_l2nn import (
     prepare_index_sharded,
 )
 from raft_tpu.distance.knn_fused import KnnIndex, prepare_knn_index
+from raft_tpu.distance.knn_sharded import (
+    ShardedFusedIndex,
+    knn_fused_sharded,
+    prepare_knn_index_sharded,
+)
 
 __all__ = [
     "DistanceType", "METRIC_NAMES", "pairwise_distance",
     "fused_l2_nn", "fused_l2_nn_argmin", "knn", "knn_sharded",
     "knn_index_sharded", "ShardedKnnIndex", "prepare_index_sharded",
     "KnnIndex", "prepare_knn_index",
+    "ShardedFusedIndex", "knn_fused_sharded", "prepare_knn_index_sharded",
 ]

@@ -277,7 +277,8 @@ def _pad_rows_to(y, mult: int):
 @functools.partial(jax.jit, static_argnames=("T", "g", "metric",
                                              "pbits", "grid_order"))
 def _prepare_ops(y, T: int, g: int, metric: str,
-                 pbits: int = _PACK_BITS, grid_order: str = "query"):
+                 pbits: int = _PACK_BITS, grid_order: str = "query",
+                 n_valid=None):
     """Index-side operand prep: row padding, bf16 hi/lo split, norms and
     the [8, M] half-norm sentinel carrier. ~3 ms at 1M×128 on v5e —
     hoisted out of the query path so a prepared index (KnnIndex) pays
@@ -286,8 +287,17 @@ def _prepare_ops(y, T: int, g: int, metric: str,
     Database-major grid orders pad the index to WHOLE certificate
     groups (g·T rows — each super-block is one resident y block /
     one DMA group); padded columns carry the same never-wins sentinel
-    either way, so the extra rows are certificate-invisible."""
-    m = y.shape[0]
+    either way, so the extra rows are certificate-invisible.
+
+    ``n_valid`` overrides the real-row count when the caller passes an
+    ALREADY-PADDED matrix (the sharded index prep pads globally to a
+    whole number of equal shards before splitting, so the trailing
+    rows of ``y`` itself are pads that must carry the sentinel). It may
+    be a plain int or a TRACED scalar — inside the sharded prep's
+    shard_map one traced program serves every shard, and each shard's
+    real-row count is a value (a function of its mesh coordinate), not
+    a shape."""
+    m = y.shape[0] if n_valid is None else n_valid
     yp = _pad_rows_to(y, g * T if grid_order in ("db", "dbuf") else T)
     M = yp.shape[0]
     yy_raw = jnp.sum(yp * yp, axis=1)[None, :]                  # [1,M] f32
@@ -316,8 +326,16 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                     metric: str, m: int, rescore: bool = True,
                     pbits: int = _PACK_BITS, certify: str = "kernel",
                     pool_algo: str = "xla", grid_order: str = "query",
-                    _diag: bool = False) -> Tuple[jax.Array, ...]:
+                    _diag: bool = False,
+                    m_valid=None) -> Tuple[jax.Array, ...]:
     """Certified fused KNN on PREPARED operands (see _prepare_ops).
+
+    ``m_valid`` (optional TRACED scalar) overrides the static ``m`` in
+    every real-row mask (kernel column mask, rescore id clamp, fixup
+    column masks). The sharded pipeline (distance.knn_sharded) needs it:
+    one shard_map-traced program serves every shard, but each shard owns
+    a different number of real rows — a value, not a shape. ``m`` keeps
+    sizing the static fixup-tier geometry.
 
     x [Q, d] f32 (Q % Qb == 0, d % 128 == 0 — caller pads), y [m, d] f32
     un-padded rows; returns exact (score [Q, k] ascending, ids [Q, k]).
@@ -352,7 +370,12 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         xx_r = jnp.zeros((Q, 1), jnp.float32)
     else:
         xx_r = xx
-    m_real = jnp.full((1,), m, jnp.int32)
+    # m_eff: the real-row count every mask uses — static m, or the
+    # traced per-shard override (see the m_valid contract above)
+    m_eff = m if m_valid is None else \
+        jnp.asarray(m_valid, jnp.int32).reshape(())
+    m_real = (jnp.full((1,), m, jnp.int32) if m_valid is None
+              else jnp.reshape(m_eff, (1,)))
 
     if packed:
         if d > _D_SINGLE_SHOT:
@@ -467,7 +490,8 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         # contraction; safe_pid is clamped to real rows, so gathering
         # from the row-padded yp returns identical data to the original
         # matrix)
-        safe_pid = jnp.minimum(jnp.maximum(cand_pid, 0), m - 1)
+        safe_pid = jnp.minimum(jnp.maximum(cand_pid, 0),
+                               jnp.maximum(m_eff, 1) - 1)
         yc = jnp.take(yp, safe_pid, axis=0)                     # [Q, C, d]
         if metric == "ip":
             d2c = -jnp.einsum("qd,qcd->qc", x, yc,
@@ -593,7 +617,7 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                       else jnp.sum(yp * yp, axis=1))
             d2 = scores(yp, y_hi, y_lo, yy_all)                 # [F, M]
             col = jnp.arange(M, dtype=jnp.int32)
-            d2 = jnp.where(col[None, :] < m, d2, jnp.inf)
+            d2 = jnp.where(col[None, :] < m_eff, d2, jnp.inf)
             # (A/B MEASURED: routing this top_k through the slotted
             # select — 2.5 vs 3.0 ms standalone at [16, 1M] — showed
             # no e2e win in-composite; the plain top_k stays)
@@ -618,7 +642,7 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                 yy_seg = jax.lax.dynamic_slice_in_dim(yy_raw[0], j * T, T)
             d2 = scores(yt, yth, ytl, yy_seg)
             col = j * T + jnp.arange(T, dtype=jnp.int32)
-            d2 = jnp.where(col[None, :] < m, d2, jnp.inf)
+            d2 = jnp.where(col[None, :] < m_eff, d2, jnp.inf)
             av = jnp.concatenate([bv, d2], axis=1)
             ai = jnp.concatenate(
                 [bi, jnp.broadcast_to(col[None, :], d2.shape)], axis=1)

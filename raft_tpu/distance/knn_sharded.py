@@ -1,0 +1,557 @@
+"""Sharded stream-once KNN — database-parallel fused top-k across the mesh.
+
+(ref: the reference's MNMG brute-force path — each GPU runs the fused
+L2/top-k over its database shard and the per-shard candidate lists meet
+in ``knn_merge_parts`` (spatial/knn/detail/knn_merge_parts.cuh) over the
+comms layer; FAISS's multi-GPU ``IndexShards`` applies the same
+database-sharding pattern. The TPU rendering: the index rows shard over
+a named mesh axis with ``shard_map``, every device runs the PR-3 packed
+db-major fused kernel (:mod:`raft_tpu.distance.knn_fused`) over its
+shard — so each chip streams ITS slice of the database from HBM once —
+and the per-shard candidates merge over ICI.)
+
+Two merge strategies, selected by the ICI cost model
+(:func:`raft_tpu.observability.costmodel.choose_merge_strategy`):
+
+- ``"allgather"``: one ring all-gather of every shard's [nq, k]
+  candidate block (value + global id), then ONE select over the
+  p·k-wide pool. Minimal rounds (one collective + one select); per-
+  device egress grows with p−1.
+- ``"tournament"``: a log₂(p)-round butterfly of ``collective_permute``
+  pair-exchanges; each round every rank merges its k candidates with
+  its partner's via a select over 2k. log₂(p) blocks of wire instead of
+  p−1 — less traffic for p ≥ 4, at the price of serialized rounds.
+  Needs a power-of-two shard count (requests on other counts downgrade
+  to allgather with a logged reason).
+
+Both merges are deterministic and rank-ordered (lower mesh index's
+candidates first), so every shard computes the bit-identical merged
+result — the output is truly replicated, and ties break the same way
+on every device.
+
+**Overlapped merge**: queries split into ``micro_batches`` blocks inside
+ONE traced program. Block i's local fused kernel has no data dependence
+on block i−1's merge collectives, so XLA's latency-hiding scheduler is
+free to overlap the ICI rounds with the next block's MXU work — the
+SPMD analog of the reference's stream-overlapped ``knn_merge_parts``
+copy-in. On CPU (the tier-1 suite) the split is correctness-only.
+
+**Query-sharded mode** (``shard_mode="query"``): the serving shape —
+index replicated (it fits one chip), queries data-parallel over the
+axis, no merge at all. The sharded sibling of
+:func:`raft_tpu.distance.fused_l2nn.knn_sharded` but on the fused
+certified pipeline with a prepared index.
+
+Everything is CPU-testable under
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (interpret-mode
+Pallas inside shard_map) and bit-exact against the single-device
+:func:`knn_fused` oracle — see tests/test_knn_sharded.py.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_tpu.comms import MeshComms
+from raft_tpu.core.error import expects
+from raft_tpu.core.resources import ensure_resources
+from raft_tpu.observability import instrument
+from raft_tpu.observability.costmodel import (MERGE_STRATEGIES,
+                                              choose_merge_strategy)
+from raft_tpu.distance.knn_fused import (
+    _D_SINGLE_SHOT, _DC, _LANES, _PACK_BITS, _PBITS_MAX, _POOL_PAD,
+    _Q_CHUNK, GRID_ORDERS, KnnIndex, _knn_fused_core, _prepare_ops,
+    auto_pack_bits, fit_config, fused_config, pool_select_algo,
+    prepare_knn_index, resolve_grid_order, resolve_pool_algo)
+
+SHARD_MODES = ("db", "query")
+
+# compiled shard_map programs, keyed by the full static geometry — a
+# fresh closure per call would defeat the jit cache (same pattern as
+# fused_l2nn._SHARDED_KNN_CACHE)
+_SHARDED_FUSED_CACHE: dict = {}
+
+
+def resolve_merge_strategy(merge: str, p: int, nq: int, k: int) -> str:
+    """EFFECTIVE merge strategy for a call — decided (and logged) in the
+    non-jitted wrapper like ``resolve_grid_order``, so a downgraded
+    request is visible per call. ``"auto"`` takes the ICI cost-model
+    crossover; a tournament request on a non-power-of-two shard count
+    downgrades to allgather (the butterfly needs a partner every
+    round)."""
+    if merge not in ("auto",) + MERGE_STRATEGIES:
+        raise ValueError(f"merge must be 'auto' or one of "
+                         f"{MERGE_STRATEGIES}, got {merge!r}")
+    if merge == "auto":
+        return choose_merge_strategy(p, nq, k)
+    if merge == "tournament" and (p & (p - 1)):
+        from raft_tpu.core.logger import log_warn
+
+        log_warn("merge='tournament' needs a power-of-two shard count "
+                 "(got p=%d) — using 'allgather' for this call", p)
+        return "allgather"
+    return merge
+
+
+def default_micro_batches(nq: int, Qb: int) -> int:
+    """Micro-batch count when the caller (or a tuned table) doesn't say:
+    enough blocks that merge rounds have a next block to hide behind,
+    but never blocks smaller than one kernel query block. Also bounds
+    each block at ``_Q_CHUNK`` (the fused pipeline's slot-array
+    budget)."""
+    if nq <= max(Qb, 8):
+        nb = 1
+    else:
+        nb = min(4, max(1, nq // max(Qb, 8)))
+    return max(nb, -(-nq // _Q_CHUNK))
+
+
+class ShardedFusedIndex:
+    """A database-sharded fused-KNN index: the :class:`KnnIndex` operand
+    set laid out as row-sharded global arrays over a mesh axis, each
+    shard padded to whole certificate groups. Build once with
+    :func:`prepare_knn_index_sharded`; query with
+    :func:`knn_fused_sharded`. The tiling config, metric and mesh are
+    frozen at build time (the per-shard row padding bakes them in)."""
+
+    def __init__(self, yp_s, y_hi_s, y_lo_s, yyh_s, yy_s, n_rows: int,
+                 rows_per: int, mesh, axis: str, T: int, Qb: int, g: int,
+                 passes: int, metric: str, d_orig: int, pbits: int,
+                 grid_order: str):
+        self.yp_s = yp_s                  # [p·rows_per, d_eff] or None
+        self.y_hi_s, self.y_lo_s = y_hi_s, y_lo_s
+        self.yyh_s, self.yy_s = yyh_s, yy_s
+        self.n_rows = n_rows              # true (unpadded) global rows
+        self.rows_per = rows_per          # rows per shard (padded)
+        self.mesh, self.axis = mesh, axis
+        self.T, self.Qb, self.g = T, Qb, g
+        self.passes, self.metric = passes, metric
+        self.d_orig = d_orig
+        self.pbits = pbits
+        self.grid_order = grid_order
+
+    @property
+    def n_shards(self) -> int:
+        return int(self.mesh.shape[self.axis])
+
+
+def prepare_knn_index_sharded(y, mesh=None, axis: str = "x",
+                              passes: int = 3, metric: str = "l2",
+                              T: Optional[int] = None,
+                              Qb: Optional[int] = None,
+                              g: Optional[int] = None,
+                              store_yp: bool = True,
+                              grid_order: Optional[str] = None,
+                              res=None) -> ShardedFusedIndex:
+    """Build a :class:`ShardedFusedIndex`: rows pad to ``p`` equal
+    shards of whole certificate groups (``g·T`` rows for the
+    database-major orders, ``T`` otherwise) ON HOST, land row-sharded
+    via one ``device_put`` (the full f32 matrix never materializes on
+    one device — the point of the bigger-than-HBM mode), and the
+    index-side operand prep (bf16 hi/lo split, norms, sentinel carrier)
+    runs per shard inside ``shard_map``, with each shard's real-row
+    count threaded as a traced value so global pad rows carry the
+    never-wins sentinel.
+
+    The tiling config resolves against the PER-SHARD shape (pack width
+    from the shard's tile count — a 10M-row index split 8 ways packs
+    like a 1.25M-row one), so per-device kernels run exactly the config
+    a single-chip index of that size would."""
+    res = ensure_resources(res)
+    if mesh is None:
+        mesh = res.mesh
+    expects(mesh is not None,
+            "prepare_knn_index_sharded: pass mesh= or set it on res")
+    expects(axis in mesh.axis_names,
+            "prepare_knn_index_sharded: axis %r not in mesh axes %s",
+            axis, tuple(mesh.axis_names))
+    if metric not in ("l2", "ip"):
+        raise ValueError(f"prepare_knn_index_sharded: metric must be "
+                         f"'l2' or 'ip', got {metric!r}")
+    y = np.asarray(y, np.float32)
+    m, d = y.shape
+    p = int(mesh.shape[axis])
+    dcfg = fused_config(passes)
+    T = dcfg.T if T is None else T
+    Qb = dcfg.Qb if Qb is None else Qb
+    grid_order = dcfg.grid_order if grid_order is None else grid_order
+    if grid_order not in GRID_ORDERS:
+        raise ValueError(f"prepare_knn_index_sharded: grid_order must "
+                         f"be one of {GRID_ORDERS}, got {grid_order!r}")
+    T, Qb = fit_config(T, Qb, d, passes, g or dcfg.g, grid_order)
+    m_shard = -(-m // p)
+    n_tiles_est = max(1, -(-m_shard // T))
+    if g is None:
+        g = max(dcfg.g, (1 << auto_pack_bits(n_tiles_est, T))
+                // (T // _LANES))
+    pbits = min(_PBITS_MAX, max(_PACK_BITS, int(math.ceil(math.log2(
+        max(g * (T // _LANES), 2))))))
+    grid_order = resolve_grid_order(
+        grid_order, d, g * (T // _LANES) <= (1 << pbits))
+    row_mult = g * T if grid_order in ("db", "dbuf") else T
+    rows_per = max(1, -(-m_shard // row_mult)) * row_mult
+    dpad = (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
+    d_eff = d + dpad
+    # host-side global pad: [p·rows_per, d_eff]; pads all trail the real
+    # rows, so shard i owns global rows [i·rows_per, (i+1)·rows_per)
+    yg = np.zeros((p * rows_per, d_eff), np.float32)
+    yg[:m, :d] = y
+    ys = jax.device_put(yg, NamedSharding(mesh, P(axis)))
+
+    def _prep(y_loc):
+        r = jax.lax.axis_index(axis)
+        m_loc = jnp.clip(jnp.int32(m) - r.astype(jnp.int32) * rows_per,
+                         0, rows_per)
+        return _prepare_ops(y_loc, T, g, metric, pbits=pbits,
+                            grid_order=grid_order, n_valid=m_loc)
+
+    fn = jax.jit(jax.shard_map(
+        _prep, mesh=mesh, in_specs=(P(axis),),
+        out_specs=(P(axis), P(axis), P(axis), P(None, axis),
+                   P(None, axis)),
+        check_vma=False))
+    yp_s, y_hi_s, y_lo_s, yyh_s, yy_s = fn(ys)
+    if not store_yp:
+        yp_s = None
+        if passes == 1:
+            y_lo_s = None   # the 1-pass kernel and lite fixup never read it
+    return ShardedFusedIndex(yp_s, y_hi_s, y_lo_s, yyh_s, yy_s, m,
+                             rows_per, mesh, axis, T, Qb, g, passes,
+                             metric, d, pbits, grid_order)
+
+
+def _merge_allgather(comms: MeshComms, p: int, k: int, v, i):
+    """All-gather every shard's [nq, k] candidates and select k of p·k.
+    Pool order is rank-major per query — identical on every shard, so
+    the merged result is replicated bit-for-bit (ties included)."""
+    gv = comms.allgather(v)                                # [p, nq, k]
+    gi = comms.allgather(i)
+    nq = v.shape[0]
+    gv = jnp.moveaxis(gv, 0, 1).reshape(nq, p * k)
+    gi = jnp.moveaxis(gi, 0, 1).reshape(nq, p * k)
+    neg, pos = jax.lax.top_k(-gv, k)
+    return -neg, jnp.take_along_axis(gi, pos, axis=1)
+
+
+def _merge_tournament(comms: MeshComms, p: int, k: int, v, i):
+    """log₂(p) butterfly rounds of collective_permute pair-merges, each
+    a select over 2k. Concatenation order is (lower mesh index first)
+    on BOTH partners, so each round's inputs — and therefore the final
+    top-k, ties included — are identical across the pair; by induction
+    the result is replicated over the whole axis."""
+    rr = comms.get_rank()
+    rounds = int(math.log2(p)) if p > 1 else 0
+    for j in range(rounds):
+        dlt = 1 << j
+        perm = [(s, s ^ dlt) for s in range(p)]
+        ov = comms.collective_permute(v, perm)
+        oi = comms.collective_permute(i, perm)
+        low_first = (rr & dlt) == 0                  # traced scalar bool
+        cat_v = jnp.where(low_first,
+                          jnp.concatenate([v, ov], axis=1),
+                          jnp.concatenate([ov, v], axis=1))
+        cat_i = jnp.where(low_first,
+                          jnp.concatenate([i, oi], axis=1),
+                          jnp.concatenate([oi, i], axis=1))
+        neg, pos = jax.lax.top_k(-cat_v, k)
+        v = -neg
+        i = jnp.take_along_axis(cat_i, pos, axis=1)
+    return v, i
+
+
+@instrument("distance.knn_fused_sharded")
+def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
+                      shard_mode: str = "db", merge: str = "auto",
+                      micro_batches: Optional[int] = None,
+                      passes: int = 3, metric: str = "l2",
+                      T: Optional[int] = None, Qb: Optional[int] = None,
+                      g: Optional[int] = None,
+                      grid_order: Optional[str] = None,
+                      rescore: Optional[bool] = None,
+                      certify: str = "kernel", store_yp: bool = True,
+                      res=None) -> Tuple[jax.Array, jax.Array]:
+    """Certified fused brute-force KNN over a device mesh.
+
+    ``shard_mode="db"`` (default): the INDEX rows shard over
+    ``mesh[axis]`` — the bigger-than-HBM mode. ``y`` may be a raw
+    [m, d] matrix (prepared inline) or a :class:`ShardedFusedIndex`
+    (preferred for repeated query batches; its frozen config wins).
+    Each shard runs the packed fused kernel over its slice (db-major
+    orders stream the shard from HBM once), local ids shift to global
+    by the shard's row offset, and per-shard candidates merge with the
+    strategy picked by ``merge`` ("auto" = the ICI cost-model
+    crossover; see the module doc). ``micro_batches`` splits the query
+    batch so block i's local compute can overlap block i−1's merge
+    collectives (None = :func:`default_micro_batches`, or a tuned
+    table's value via :func:`raft_tpu.tune.sharded.sharded_config`).
+
+    ``shard_mode="query"``: replicated index, data-parallel queries —
+    the serving shape. ``y`` may be a raw matrix or a single-device
+    :class:`KnnIndex`; ``merge``/``micro_batches`` are ignored (no
+    cross-shard candidates exist).
+
+    Returns the same contract as :func:`knn_fused`: (values [nq, k]
+    ascending — IP descending —, global ids [nq, k]), exact under the
+    same certificates, bit-exact vs the single-device oracle.
+    """
+    res = ensure_resources(res)
+    if shard_mode not in SHARD_MODES:
+        raise ValueError(f"knn_fused_sharded: shard_mode must be one "
+                         f"of {SHARD_MODES}, got {shard_mode!r}")
+    if mesh is None:
+        mesh = (y.mesh if isinstance(y, ShardedFusedIndex)
+                else getattr(res, "mesh", None))
+    expects(mesh is not None,
+            "knn_fused_sharded: pass mesh= or set it on res")
+    expects(axis in mesh.axis_names,
+            "knn_fused_sharded: axis %r not in mesh axes %s", axis,
+            tuple(mesh.axis_names))
+    p = int(mesh.shape[axis])
+    x = jnp.asarray(x, jnp.float32)
+    nq = x.shape[0]
+
+    if shard_mode == "query":
+        return _knn_query_sharded(x, y, k, mesh, axis, passes, metric,
+                                  T, Qb, g, grid_order, rescore, certify,
+                                  res)
+
+    if isinstance(y, ShardedFusedIndex):
+        idx = y
+        expects(idx.axis == axis and idx.mesh == mesh,
+                "knn_fused_sharded: index prepared for a different "
+                "mesh/axis — re-prepare or pass its mesh")
+    else:
+        idx = prepare_knn_index_sharded(
+            y, mesh=mesh, axis=axis, passes=passes, metric=metric,
+            T=T, Qb=Qb, g=g, store_yp=store_yp, grid_order=grid_order,
+            res=res)
+    m = idx.n_rows
+    expects(k <= m, "knn_fused_sharded: k=%d > index size %d", k, m)
+    if nq == 0:
+        return (jnp.zeros((0, k), jnp.float32),
+                jnp.zeros((0, k), jnp.int32))
+    # per-shard pool envelope: every shard must be able to yield k local
+    # candidates (the global top-k is a subset of the per-shard unions)
+    n_tiles_loc = idx.rows_per // idx.T
+    pool_loc = 2 * (-(-n_tiles_loc // idx.g)) * _LANES
+    if k > pool_loc:
+        raise NotImplementedError(
+            f"knn_fused_sharded: k={k} too large for the per-shard "
+            f"candidate pool {pool_loc} (fewer shards, or shrink g/T)")
+    if rescore is None:
+        rescore = idx.yp_s is not None
+    if rescore and idx.yp_s is None:
+        raise ValueError("knn_fused_sharded: rescore=True needs a "
+                         "yp-storing index (store_yp=True)")
+    if certify == "f32" and not rescore:
+        raise ValueError("knn_fused_sharded: certify='f32' needs the "
+                         "exact rescore (store_yp=True)")
+
+    # ---- static query-block geometry --------------------------------
+    nb = micro_batches
+    if nb is None:
+        from raft_tpu.tune.sharded import sharded_config
+
+        tuned = sharded_config(p)
+        nb = tuned.get("micro_batches") if tuned else None
+    nb = default_micro_batches(nq, idx.Qb) if nb is None else int(nb)
+    nb = max(1, min(nb, nq))
+    nb = max(nb, -(-nq // _Q_CHUNK))       # keep blocks under _Q_CHUNK
+    qb0 = -(-nq // nb)
+    Qb_eff = min(idx.Qb, ((qb0 + 7) // 8) * 8)
+    qb_len = -(-qb0 // Qb_eff) * Qb_eff
+    nq_pad = nb * qb_len
+    merge = resolve_merge_strategy(merge, p, qb_len, k)
+
+    d_eff = idx.y_hi_s.shape[1]
+    if x.shape[1] != idx.d_orig:
+        raise ValueError(f"knn_fused_sharded: query width {x.shape[1]} "
+                         f"!= index {idx.d_orig}")
+    if d_eff != x.shape[1]:
+        x = jnp.concatenate(
+            [x, jnp.zeros((nq, d_eff - x.shape[1]), jnp.float32)], axis=1)
+    if nq_pad != nq:
+        x = jnp.concatenate(
+            [x, jnp.zeros((nq_pad - nq, d_eff), jnp.float32)])
+
+    S_pool = -(-n_tiles_loc // idx.g) * _LANES
+    packed = idx.g * (idx.T // _LANES) <= (1 << idx.pbits)
+    pool_len = S_pool if packed else 2 * S_pool
+    pool_algo = resolve_pool_algo(pool_select_algo(), pool_len,
+                                  min(k + _POOL_PAD, pool_len))
+
+    has_yp = idx.yp_s is not None
+    has_ylo = idx.y_lo_s is not None
+    key = ("db", mesh, axis, k, idx.T, Qb_eff, idx.g, idx.passes,
+           idx.metric, idx.rows_per, m, nb, qb_len, merge, bool(rescore),
+           idx.pbits, certify, pool_algo, idx.grid_order, has_yp,
+           has_ylo)
+    fn = _SHARDED_FUSED_CACHE.get(key)
+    if fn is None:
+        comms = MeshComms(axis, size=p)
+        merge_fn = (_merge_allgather if merge == "allgather"
+                    else _merge_tournament)
+        rows_per, T_, g_ = idx.rows_per, idx.T, idx.g
+        passes_, metric_, pbits_ = idx.passes, idx.metric, idx.pbits
+        order_ = idx.grid_order
+
+        def shard_fn(*ops_and_x):
+            *ops, xq = ops_and_x
+            it = iter(ops)
+            yp_l = next(it) if has_yp else None
+            yhi_l = next(it)
+            ylo_l = next(it) if has_ylo else None
+            yyh_l = next(it)
+            yy_l = next(it)
+            r = jax.lax.axis_index(axis)
+            m_loc = jnp.clip(
+                jnp.int32(m) - r.astype(jnp.int32) * rows_per,
+                0, rows_per)
+            off = r.astype(jnp.int32) * rows_per
+            out_v, out_i = [], []
+            # micro-batch pipeline: block b's kernel is independent of
+            # block b−1's merge collectives — the scheduler may overlap
+            for b in range(nb):
+                xb = jax.lax.slice_in_dim(xq, b * qb_len,
+                                          (b + 1) * qb_len, axis=0)
+                vals, ids = _knn_fused_core(
+                    xb, yp_l, yhi_l, ylo_l, yyh_l, yy_l,
+                    k=k, T=T_, Qb=Qb_eff, g=g_, passes=passes_,
+                    metric=metric_, m=rows_per, rescore=rescore,
+                    pbits=pbits_, certify=certify, pool_algo=pool_algo,
+                    grid_order=order_, m_valid=m_loc)
+                # local → global ids; pad/sentinel candidates (id -1 or
+                # non-finite value) must lose every merge
+                gid = jnp.where((ids >= 0) & jnp.isfinite(vals),
+                                ids + off, -1)
+                vals = jnp.where(gid >= 0, vals, jnp.inf)
+                mv, mi = merge_fn(comms, p, k, vals, gid)
+                out_v.append(mv)
+                out_i.append(mi)
+            return (jnp.concatenate(out_v, axis=0),
+                    jnp.concatenate(out_i, axis=0))
+
+        row_specs = [P(axis)] * (1 + int(has_yp) + int(has_ylo))
+        in_specs = tuple(row_specs + [P(None, axis), P(None, axis), P()])
+        fn = jax.jit(jax.shard_map(
+            shard_fn, mesh=mesh, in_specs=in_specs,
+            out_specs=(P(), P()), check_vma=False))
+        _SHARDED_FUSED_CACHE[key] = fn
+
+    operands = [o for o in (idx.yp_s, idx.y_hi_s, idx.y_lo_s)
+                if o is not None] + [idx.yyh_s, idx.yy_s]
+    vals, ids = fn(*operands, x)
+    if nq_pad != nq:
+        vals, ids = vals[:nq], ids[:nq]
+    if idx.metric == "ip":
+        return -vals, ids           # internal −x·y ascending → IP desc
+    return vals, ids
+
+
+def _knn_query_sharded(x, y, k, mesh, axis, passes, metric, T, Qb, g,
+                       grid_order, rescore, certify, res):
+    """Query-sharded serving mode: replicated prepared index, queries
+    row-sharded over the axis, per-shard certified fused pipeline —
+    zero cross-shard candidate traffic (each query's top-k depends only
+    on the full index)."""
+    if isinstance(y, KnnIndex):
+        idx = y
+    else:
+        idx = prepare_knn_index(jnp.asarray(y, jnp.float32),
+                                passes=passes, metric=metric, T=T,
+                                Qb=Qb, g=g, grid_order=grid_order)
+    m = idx.n_rows
+    expects(k <= m, "knn_fused_sharded: k=%d > index size %d", k, m)
+    nq = x.shape[0]
+    if nq == 0:
+        return (jnp.zeros((0, k), jnp.float32),
+                jnp.zeros((0, k), jnp.int32))
+    if rescore is None:
+        rescore = idx.yp is not None
+    p = int(mesh.shape[axis])
+    # per-shard query block: a multiple of the kernel block size,
+    # bounded at _Q_CHUNK (the fused pipeline's slot-array budget —
+    # bigger batches chunk BEFORE the shard_map, like knn_fused's own
+    # wrapper)
+    qs0 = -(-nq // p)
+    if qs0 > _Q_CHUNK:
+        step = p * _Q_CHUNK
+        outs = [_knn_query_sharded(x[s:s + step], idx, k, mesh, axis,
+                                   passes, metric, T, Qb, g, grid_order,
+                                   rescore, certify, res)
+                for s in range(0, nq, step)]
+        return (jnp.concatenate([o[0] for o in outs]),
+                jnp.concatenate([o[1] for o in outs]))
+    d_eff = idx.y_hi.shape[1]
+    if x.shape[1] != idx.d_orig:
+        raise ValueError(f"knn_fused_sharded: query width {x.shape[1]} "
+                         f"!= index {idx.d_orig}")
+    if d_eff != x.shape[1]:
+        x = jnp.concatenate(
+            [x, jnp.zeros((nq, d_eff - x.shape[1]), jnp.float32)], axis=1)
+    Qb_eff = min(idx.Qb, ((qs0 + 7) // 8) * 8)
+    qs_len = -(-qs0 // Qb_eff) * Qb_eff
+    nq_pad = p * qs_len
+    if nq_pad != nq:
+        x = jnp.concatenate(
+            [x, jnp.zeros((nq_pad - nq, d_eff), jnp.float32)])
+
+    n_tiles = idx.yyh_k.shape[1] // idx.T
+    S_pool = -(-n_tiles // idx.g) * _LANES
+    packed = idx.g * (idx.T // _LANES) <= (1 << idx.pbits)
+    pool_len = S_pool if packed else 2 * S_pool
+    if k > 2 * S_pool:
+        raise NotImplementedError(
+            f"knn_fused_sharded: k={k} too large for pool {2 * S_pool}")
+    pool_algo = resolve_pool_algo(pool_select_algo(), pool_len,
+                                  min(k + _POOL_PAD, pool_len))
+    has_yp = idx.yp is not None
+    has_ylo = idx.y_lo is not None
+    key = ("query", mesh, axis, k, idx.T, Qb_eff, idx.g, idx.passes,
+           idx.metric, m, qs_len, bool(rescore), idx.pbits, certify,
+           pool_algo, idx.grid_order, has_yp, has_ylo)
+    fn = _SHARDED_FUSED_CACHE.get(key)
+    if fn is None:
+        T_, g_, passes_, metric_ = idx.T, idx.g, idx.passes, idx.metric
+        pbits_, order_ = idx.pbits, idx.grid_order
+
+        def shard_fn(*ops_and_x):
+            *ops, xq = ops_and_x
+            it = iter(ops)
+            yp_l = next(it) if has_yp else None
+            yhi_l = next(it)
+            ylo_l = next(it) if has_ylo else None
+            yyh_l = next(it)
+            yy_l = next(it)
+            return _knn_fused_core(
+                xq, yp_l, yhi_l, ylo_l, yyh_l, yy_l,
+                k=k, T=T_, Qb=Qb_eff, g=g_, passes=passes_,
+                metric=metric_, m=m, rescore=rescore, pbits=pbits_,
+                certify=certify, pool_algo=pool_algo, grid_order=order_)
+
+        n_repl = 1 + int(has_yp) + int(has_ylo) + 2
+        in_specs = tuple([P()] * n_repl + [P(axis)])
+        fn = jax.jit(jax.shard_map(
+            shard_fn, mesh=mesh, in_specs=in_specs,
+            out_specs=(P(axis), P(axis)), check_vma=False))
+        _SHARDED_FUSED_CACHE[key] = fn
+
+    from raft_tpu.parallel import replicated
+
+    operands = [jax.device_put(o, replicated(mesh))
+                for o in (idx.yp, idx.y_hi, idx.y_lo) if o is not None]
+    operands += [jax.device_put(idx.yyh_k, replicated(mesh)),
+                 jax.device_put(idx.yy_raw, replicated(mesh))]
+    xs = jax.device_put(x, NamedSharding(mesh, P(axis)))
+    vals, ids = fn(*operands, xs)
+    if nq_pad != nq:
+        vals, ids = vals[:nq], ids[:nq]
+    if idx.metric == "ip":
+        return -vals, ids
+    return vals, ids

@@ -15,19 +15,64 @@ from raft_tpu.distance.fused_l2nn import knn as _knn
 class NearestNeighbors:
     def __init__(self, n_neighbors: int = 5, metric: str = "sqeuclidean",
                  mesh=None, mesh_axis: str = "x",
+                 n_shards: Optional[int] = None,
+                 merge: str = "auto",
                  res: Optional[Resources] = None):
         """``mesh``: a ``jax.sharding.Mesh`` makes ``kneighbors`` MNMG
         — the INDEX rows shard over ``mesh[mesh_axis]`` (the
         bigger-than-HBM index mode: per-shard local select + one
-        all-gather merge; distance.knn_index_sharded)."""
+        all-gather merge; distance.knn_index_sharded).
+
+        ``n_shards``: shard the index over that many devices through
+        the CERTIFIED sharded fused pipeline
+        (:func:`raft_tpu.distance.knn_fused_sharded` — per-shard
+        stream-once fused kernel + the ``merge`` strategy: "auto" picks
+        the ICI cost-model crossover between the allgather and
+        tournament merges). Falls back to the streamed
+        ``knn_index_sharded`` path for metrics outside the fused
+        envelope. Default (both None) keeps the current single-device
+        behavior."""
         self.res = ensure_resources(res)
         self.n_neighbors = n_neighbors
         self.metric = metric
         self.mesh = mesh
         self.mesh_axis = mesh_axis
+        self.merge = merge
+        if n_shards is not None and mesh is None:
+            import jax
+
+            from raft_tpu.parallel import make_mesh
+
+            devs = jax.devices()
+            if n_shards > len(devs):
+                raise ValueError(
+                    f"NearestNeighbors: n_shards={n_shards} > "
+                    f"{len(devs)} available devices")
+            mesh_axis = "x"
+            self.mesh_axis = mesh_axis
+            self.mesh = make_mesh({mesh_axis: n_shards},
+                                  devices=devs[:n_shards])
+        self.n_shards = n_shards
         self._index = None
 
     def fit(self, X) -> "NearestNeighbors":
+        if self.mesh is not None and self.n_shards is not None:
+            # fused sharded path: build the ShardedFusedIndex once
+            kernel_metric = {"sqeuclidean": "l2", "euclidean": "l2",
+                             "l2": "l2",
+                             "inner_product": "ip"}.get(self.metric)
+            if kernel_metric is not None:
+                from raft_tpu.distance.knn_sharded import \
+                    prepare_knn_index_sharded
+
+                self._index = prepare_knn_index_sharded(
+                    X, mesh=self.mesh, axis=self.mesh_axis,
+                    metric=kernel_metric, res=self.res)
+                self._n_index = self._index.n_rows
+                self._prepared = None
+                return self
+            # metric outside the fused envelope: the streamed sharded
+            # path below still serves it
         if self.mesh is not None:
             # MNMG: pad + shard ONCE, straight from host — the full
             # matrix never materializes on one device (the
@@ -66,6 +111,12 @@ class NearestNeighbors:
 
     @property
     def _index_matrix(self):
+        from raft_tpu.distance.knn_sharded import ShardedFusedIndex
+
+        if isinstance(self._index, ShardedFusedIndex):
+            # sharded fused fit: the true rows of the row-sharded yp
+            return self._index.yp_s[:self._index.n_rows,
+                                    :self._index.d_orig]
         if self.mesh is not None:
             # sharded fit: slice the true rows of the global array
             return self._index.idx_s[:self._index.n]
@@ -77,6 +128,17 @@ class NearestNeighbors:
     def kneighbors(self, queries, n_neighbors: Optional[int] = None
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         k = n_neighbors or self.n_neighbors
+        from raft_tpu.distance.knn_sharded import ShardedFusedIndex
+
+        if isinstance(self._index, ShardedFusedIndex):
+            from raft_tpu.distance.knn_sharded import knn_fused_sharded
+
+            dists, idx = knn_fused_sharded(
+                queries, self._index, k, mesh=self.mesh,
+                axis=self.mesh_axis, merge=self.merge, res=self.res)
+            if self.metric in ("euclidean", "l2"):
+                dists = jnp.sqrt(jnp.maximum(dists, 0.0))
+            return dists, idx
         if self.mesh is not None:
             from raft_tpu.distance.fused_l2nn import knn_index_sharded
 

@@ -74,8 +74,11 @@ from raft_tpu.observability.exporters import (
 from raft_tpu.observability.costmodel import (
     CostRecord,
     RooflineEstimate,
+    choose_merge_strategy,
     classify,
     extract_cost,
+    ici_time_model,
+    ici_traffic_model,
     roofline,
     roofline_report,
 )
@@ -118,6 +121,9 @@ __all__ = [
     "reset",
     "CostRecord",
     "RooflineEstimate",
+    "choose_merge_strategy",
+    "ici_time_model",
+    "ici_traffic_model",
     "classify",
     "extract_cost",
     "roofline",

@@ -290,6 +290,93 @@ def fused_traffic_record(Q: int, m: int, d: int, k: int,
         bytes_accessed=model["total_bytes"])
 
 
+# ------------------------------------------------- ICI traffic model
+MERGE_STRATEGIES = ("allgather", "tournament")
+
+
+def ici_traffic_model(p: int, nq: int, k: int, strategy: str,
+                      cand_bytes: int = 8) -> Dict:
+    """Modeled ICI traffic of ONE sharded-KNN merge over ``p`` shards
+    for a query block of ``nq`` rows selecting ``k`` — the analytic
+    half of the merge-strategy crossover (ISSUE 4) and the bytes every
+    MULTICHIP artifact records next to ``roofline_frac``.
+
+    Per candidate ``cand_bytes`` = 8 (f32 value + int32 global id).
+    Wire bytes are PER-DEVICE EGRESS (the nccl-tests/BUSBW_BENCH
+    convention, so busbw fractions divide by the per-chip ``ici_bw``):
+
+    - ``allgather``: ring all-gather of each shard's [nq, k] candidate
+      block — every rank forwards p−1 chunks, so egress is
+      ``(p−1)·nq·k·cand_bytes``; ONE select over the p·k-wide pool.
+    - ``tournament``: log₂(p) butterfly rounds of collective_permute
+      pair-exchanges, each moving one [nq, k] block
+      (``nq·k·cand_bytes`` egress per round) followed by a select over
+      2k — less wire for p ≥ 4 (log₂(p) < p−1 blocks) at the price of
+      log₂(p) serialized rounds and selects.
+    """
+    if strategy not in MERGE_STRATEGIES:
+        raise ValueError(f"ici_traffic_model: strategy must be one of "
+                         f"{MERGE_STRATEGIES}, got {strategy!r}")
+    block = float(nq) * k * cand_bytes
+    if strategy == "allgather":
+        rounds, wire, width = 1, (p - 1) * block, p * k
+    else:
+        if p & (p - 1):
+            raise ValueError(f"ici_traffic_model: tournament needs a "
+                             f"power-of-two shard count, got p={p}")
+        rounds = max(1, p.bit_length() - 1) if p > 1 else 0
+        wire, width = rounds * block, 2 * k
+    return {
+        "strategy": strategy,
+        "p": p,
+        "rounds": rounds,
+        "wire_bytes_per_device": wire,
+        "bytes_per_round": block if strategy == "tournament"
+        else (p - 1) * block,
+        "select_width": width,
+        # bytes each select pass reads+writes on-device (vals + ids in,
+        # k out — the non-wire cost of a merge round)
+        "select_bytes": float(nq) * (width + k) * cand_bytes,
+    }
+
+
+def ici_time_model(p: int, nq: int, k: int, strategy: str,
+                   spec: Optional[ChipSpec] = None,
+                   cand_bytes: int = 8) -> Dict:
+    """Modeled merge time on ``spec``: wire time (egress ÷ ``ici_bw``)
+    + per-round latency + select time (select_bytes ÷ ``hbm_bw`` per
+    round). Deterministic — the CPU suite ranks strategies with it."""
+    spec = spec if spec is not None else chip_spec()
+    m = ici_traffic_model(p, nq, k, strategy, cand_bytes)
+    ici_bw = spec.ici_bw or spec.hbm_bw   # never divide by zero
+    wire_s = m["wire_bytes_per_device"] / ici_bw
+    select_s = m["rounds"] * (m["select_bytes"] / spec.hbm_bw)
+    lat_s = m["rounds"] * spec.ici_latency
+    m.update({
+        "wire_seconds": wire_s,
+        "select_seconds": select_s,
+        "latency_seconds": lat_s,
+        "merge_seconds": wire_s + select_s + lat_s,
+    })
+    return m
+
+
+def choose_merge_strategy(p: int, nq: int, k: int,
+                          spec: Optional[ChipSpec] = None) -> str:
+    """The modeled-time crossover between the two merge strategies —
+    the ``merge="auto"`` policy of :func:`raft_tpu.distance.
+    knn_sharded.knn_fused_sharded`. Non-power-of-two shard counts can
+    only run the allgather merge (the butterfly needs pairs every
+    round); p ≤ 2 ties on wire bytes, where the single allgather round
+    wins on latency."""
+    if p <= 2 or (p & (p - 1)):
+        return "allgather"
+    spec = spec if spec is not None else chip_spec()
+    t_ag = ici_time_model(p, nq, k, "allgather", spec)["merge_seconds"]
+    t_tr = ici_time_model(p, nq, k, "tournament", spec)["merge_seconds"]
+    return "allgather" if t_ag <= t_tr else "tournament"
+
+
 def _fmt_count(v: float) -> str:
     for unit, scale in (("G", 1e9), ("M", 1e6), ("K", 1e3)):
         if abs(v) >= scale:

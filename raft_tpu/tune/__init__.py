@@ -9,11 +9,18 @@ consume)."""
 from raft_tpu.tune.fused import (TUNE_SCHEMA_VERSION, autotune_fused,
                                  candidate_space, validate_tune_table,
                                  write_tune_table)
+from raft_tpu.tune.sharded import (autotune_sharded, sharded_config,
+                                   sharded_candidate_space,
+                                   sharded_time_model)
 
 __all__ = [
     "TUNE_SCHEMA_VERSION",
     "autotune_fused",
+    "autotune_sharded",
     "candidate_space",
+    "sharded_candidate_space",
+    "sharded_config",
+    "sharded_time_model",
     "validate_tune_table",
     "write_tune_table",
 ]

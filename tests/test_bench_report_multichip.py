@@ -1,0 +1,159 @@
+"""bench_report MULTICHIP aggregation + staleness flags (ISSUE 4
+satellites): the trajectory must absorb both the bare early dryrun
+rounds and the perf-carrying bench_sharded rounds, --check must gate
+the multichip trend, and named single-shot artifacts older than the
+last-good commit must be flagged stale instead of read as current."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _tools_import(name):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def _write(path, obj):
+    with open(path, "w") as f:
+        json.dump(obj, f)
+
+
+def _mc_record(value=40.0, ok=True, measured=True, busbw=0.3,
+               skipped=False):
+    return {
+        "metric": "sharded_knn top-64 2048x10000000x256 over 8 shards",
+        "value": value, "unit": "GB/s", "n_devices": 8, "ok": ok,
+        "skipped": skipped, "measured": measured,
+        "strategies": {
+            "allgather": {"busbw_frac": busbw * 0.8,
+                          "model_ici_bytes_per_device": 1.0e7},
+            "tournament": {"busbw_frac": busbw,
+                           "model_ici_bytes_per_device": 4.0e6},
+        },
+    }
+
+
+def test_collect_multichip_mixes_schemas(tmp_path):
+    br = _tools_import("bench_report")
+    _write(tmp_path / "MULTICHIP_r01.json",
+           {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+            "tail": ""})
+    _write(tmp_path / "MULTICHIP_r02.json",
+           {"n": 2, "parsed": _mc_record()})
+    rounds = br.collect_multichip(str(tmp_path))
+    assert [n for n, _, _ in rounds] == [1, 2]
+    assert rounds[0][2]["ok"] is True
+    assert rounds[1][2]["strategies"]["tournament"]["busbw_frac"] == 0.3
+    out = br.multichip_trajectory(rounds)
+    assert "r01" in out and "r02" in out and "30.00" in out
+
+
+def test_check_multichip_gates_failure_and_trend(tmp_path):
+    br = _tools_import("bench_report")
+    # newest ok=false → regression
+    _write(tmp_path / "MULTICHIP_r01.json", _mc_record())
+    _write(tmp_path / "MULTICHIP_r02.json", _mc_record(ok=False))
+    status, msg = br.check_multichip(br.collect_multichip(str(tmp_path)))
+    assert status == br.REGRESS and "ok=false" in msg
+    # measured value drop beyond threshold → regression
+    _write(tmp_path / "MULTICHIP_r02.json", _mc_record(value=20.0))
+    status, msg = br.check_multichip(br.collect_multichip(str(tmp_path)))
+    assert status == br.REGRESS and "MULTICHIP REGRESSION" in msg
+    # holding value but collapsed busbw fraction → regression
+    _write(tmp_path / "MULTICHIP_r02.json",
+           _mc_record(value=40.0, busbw=0.05))
+    status, msg = br.check_multichip(br.collect_multichip(str(tmp_path)))
+    assert status == br.REGRESS and "BUSBW" in msg
+    # healthy round passes
+    _write(tmp_path / "MULTICHIP_r02.json", _mc_record(value=41.0))
+    status, _ = br.check_multichip(br.collect_multichip(str(tmp_path)))
+    assert status == br.PASS
+
+
+def test_check_multichip_modeled_rounds_not_speed_gated(tmp_path):
+    br = _tools_import("bench_report")
+    _write(tmp_path / "MULTICHIP_r01.json", _mc_record(value=40.0))
+    # a modeled (off-TPU) round with a lower number is NOT a regression
+    _write(tmp_path / "MULTICHIP_r02.json",
+           _mc_record(value=1.0, measured=False))
+    status, msg = br.check_multichip(br.collect_multichip(str(tmp_path)))
+    assert status == br.PASS and "modeled" in msg
+    # skipped rounds are a no-op
+    _write(tmp_path / "MULTICHIP_r03.json",
+           _mc_record(ok=False, skipped=True))
+    status, _ = br.check_multichip(br.collect_multichip(str(tmp_path)))
+    assert status == br.SKIP
+
+
+def test_check_exit_code_combines_bench_and_multichip(tmp_path, capsys):
+    br = _tools_import("bench_report")
+    metric = "fused top-64"
+    _write(tmp_path / "BENCH_r01.json",
+           {"parsed": {"metric": metric, "value": 470.0, "unit": "GB/s"}})
+    _write(tmp_path / "BENCH_LAST_GOOD.json",
+           {"metric": metric, "value": 460.0, "unit": "GB/s"})
+    _write(tmp_path / "MULTICHIP_r01.json", _mc_record())
+    _write(tmp_path / "MULTICHIP_r02.json", _mc_record(ok=False))
+    assert br.main(["--dir", str(tmp_path), "--check"]) == 1
+    out = capsys.readouterr().out
+    assert "[multichip]" in out
+    # fixing the multichip round makes the combined gate pass
+    _write(tmp_path / "MULTICHIP_r02.json", _mc_record(value=45.0))
+    assert br.main(["--dir", str(tmp_path), "--check"]) == 0
+    capsys.readouterr()
+
+
+def test_artifact_staleness_flags(tmp_path):
+    br = _tools_import("bench_report")
+    # no git in tmp_path → unknown, never a crash
+    _write(tmp_path / "SELECT_K_MATRIX.json", {"x": 1})
+    entries = br.artifact_staleness(
+        str(tmp_path), {"git_commit": "deadbeef"})
+    by_name = {e["artifact"]: e["status"] for e in entries}
+    assert by_name["SELECT_K_MATRIX.json"] == "unknown"
+    assert by_name["PALLAS_SMOKE.json"] == "missing"
+    # no baseline at all → unknown for existing files
+    entries = br.artifact_staleness(str(tmp_path), None)
+    assert {e["status"] for e in entries} <= {"unknown", "missing"}
+
+
+def test_repo_staleness_section_renders():
+    """On the real repo the section must render and flag at least the
+    artifacts whose last-touching commit predates the last-good one
+    (PALLAS_SMOKE/BUSBW_BENCH at the time this shipped)."""
+    root = os.path.join(os.path.dirname(__file__), "..")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "bench_report.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert "multichip trajectory" in proc.stdout
+    assert "named artifacts" in proc.stdout
+
+
+def test_bench_sharded_artifact_schema():
+    """The committed MULTICHIP_SHARDED.json (benchmarks/bench_sharded)
+    must carry per-strategy modeled ICI bytes + busbw fraction, and be
+    honestly stamped measured=false when produced off-TPU."""
+    root = os.path.join(os.path.dirname(__file__), "..")
+    path = os.path.join(root, "MULTICHIP_SHARDED.json")
+    if not os.path.exists(path):
+        pytest.skip("no MULTICHIP_SHARDED.json committed")
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["ok"] is True
+    assert isinstance(rec["measured"], bool)
+    for strat in ("allgather", "tournament"):
+        s = rec["strategies"][strat]
+        assert s["model_ici_bytes_per_device"] > 0
+        assert "busbw_frac" in s
+        if not rec["measured"]:
+            assert rec["degraded"] is True
+            assert s.get("parity_vs_oracle") is True

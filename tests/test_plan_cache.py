@@ -1,0 +1,154 @@
+"""Persistent sparse tile-plan cache tests (ISSUE 4 satellite).
+
+The cache must round-trip every host layout BIT-IDENTICALLY across
+"processes" (simulated by a fresh lookup of the same structure), key by
+the sparsity structure (the pairs layout hits across different values),
+honestly miss when a values-baking plan meets different values, and
+degrade to plain recomputation when disabled or corrupt.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.sparse_types import COOMatrix
+from raft_tpu.sparse import plan_cache
+from raft_tpu.sparse.tiled import tile_csr, tile_csr_pairs, tile_pairs
+
+rng = np.random.default_rng(5)
+
+
+def _coo(nnz=3000, m=600, scale=1.0, seed_vals=None):
+    r = rng.integers(0, m, nnz).astype(np.int32)
+    c = rng.integers(0, m, nnz).astype(np.int32)
+    v = (seed_vals if seed_vals is not None
+         else rng.normal(size=nnz).astype(np.float32)) * scale
+    return COOMatrix(r, c, v, (m, m)), r, c, v
+
+
+@pytest.fixture()
+def cache_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_TILE_PLAN_CACHE", str(tmp_path))
+    monkeypatch.setenv("RAFT_TPU_TILE_PLAN_CACHE_MIN_NNZ", "0")
+    return tmp_path
+
+
+def _ell_equal(a, b):
+    assert np.array_equal(np.asarray(a.vals), np.asarray(b.vals))
+    assert np.array_equal(np.asarray(a.col_local), np.asarray(b.col_local))
+    assert np.array_equal(np.asarray(a.row_local), np.asarray(b.row_local))
+    assert np.array_equal(np.asarray(a.perm_rows), np.asarray(b.perm_rows))
+    assert np.array_equal(np.asarray(a.chunk_col_tile),
+                          np.asarray(b.chunk_col_tile))
+    assert np.array_equal(np.asarray(a.chunk_row_tile),
+                          np.asarray(b.chunk_row_tile))
+    assert np.array_equal(np.asarray(a.visited_row_tiles),
+                          np.asarray(b.visited_row_tiles))
+
+
+def test_tile_csr_plan_roundtrip_bit_identical(cache_env):
+    A, *_ = _coo()
+    cold = tile_csr(A, impl="numpy")
+    files = [f for f in os.listdir(cache_env) if f.endswith(".npz")]
+    assert len(files) == 1
+    warm = tile_csr(A, impl="numpy")        # served from disk
+    _ell_equal(cold, warm)
+
+
+def test_tile_csr_values_change_is_honest_miss(cache_env):
+    A, r, c, v = _coo()
+    t1 = tile_csr(A, impl="numpy")
+    A2 = COOMatrix(r, c, v * 2.0, (600, 600))
+    t2 = tile_csr(A2, impl="numpy")         # same structure, new values
+    # layout identical, values correctly re-extracted (not the stale
+    # cached ones)
+    assert np.array_equal(np.asarray(t1.row_local),
+                          np.asarray(t2.row_local))
+    nz1 = np.asarray(t1.vals)[np.asarray(t1.vals) != 0]
+    nz2 = np.asarray(t2.vals)[np.asarray(t2.vals) != 0]
+    np.testing.assert_allclose(np.sort(nz2), np.sort(nz1 * 2.0))
+
+
+def test_tile_pairs_hits_across_values(cache_env):
+    A, r, c, v = _coo()
+    p1 = tile_csr_pairs(A)
+    A2 = COOMatrix(r, c, v * 3.0, (600, 600))
+    p2 = tile_csr_pairs(A2)                 # structure-keyed: plan hit
+    assert np.array_equal(np.asarray(p1.pairs.pos),
+                          np.asarray(p2.pairs.pos))
+    assert np.array_equal(np.asarray(p1.pairs.row_local),
+                          np.asarray(p2.pairs.row_local))
+    # values applied through pos, so they follow the NEW matrix
+    nz1 = np.asarray(p1.vals)[np.asarray(p1.vals) != 0]
+    nz2 = np.asarray(p2.vals)[np.asarray(p2.vals) != 0]
+    np.testing.assert_allclose(np.sort(nz2), np.sort(nz1 * 3.0))
+
+
+def test_spmv_correct_through_cached_plan(cache_env):
+    from raft_tpu.sparse.linalg import spmv
+
+    A, r, c, v = _coo(nnz=2000, m=512)
+    x = rng.normal(size=512).astype(np.float32)
+    dense = np.zeros((512, 512), np.float32)
+    np.add.at(dense, (r, c), v)
+    t_cold = tile_csr(A, impl="numpy")
+    t_warm = tile_csr(A, impl="numpy")
+    for t in (t_cold, t_warm):
+        out = np.asarray(spmv(None, t, x))
+        np.testing.assert_allclose(out, dense @ x, rtol=1e-4, atol=1e-4)
+
+
+def test_disabled_and_threshold(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_TILE_PLAN_CACHE", "0")
+    assert plan_cache.cache_dir() is None
+    assert not plan_cache.enabled_for(10 ** 9)
+    monkeypatch.setenv("RAFT_TPU_TILE_PLAN_CACHE", str(tmp_path))
+    monkeypatch.setenv("RAFT_TPU_TILE_PLAN_CACHE_MIN_NNZ", "5000")
+    assert not plan_cache.enabled_for(4999)
+    assert plan_cache.enabled_for(5000)
+    # below threshold: nothing persists
+    A, *_ = _coo(nnz=100)
+    tile_csr(A, impl="numpy")
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".npz")]
+
+
+def test_corrupt_plan_degrades_to_recompute(cache_env):
+    A, *_ = _coo()
+    t1 = tile_csr(A, impl="numpy")
+    for f in os.listdir(cache_env):
+        if f.endswith(".npz"):
+            (cache_env / f).write_bytes(b"corrupt")
+    t2 = tile_csr(A, impl="numpy")          # miss + rewrite, no raise
+    _ell_equal(t1, t2)
+
+
+def test_fingerprint_sensitivity():
+    r = np.arange(100, dtype=np.int64)
+    c = np.arange(100, dtype=np.int64)
+    fp = plan_cache.structure_fingerprint("pairs", (100, 100),
+                                          (256, 512, 2048), r, c)
+    assert fp == plan_cache.structure_fingerprint(
+        "pairs", (100, 100), (256, 512, 2048), r.copy(), c.copy())
+    assert fp != plan_cache.structure_fingerprint(
+        "pairs", (100, 100), (256, 512, 1024), r, c)     # params
+    assert fp != plan_cache.structure_fingerprint(
+        "pairs", (101, 100), (256, 512, 2048), r, c)     # shape
+    c2 = c.copy()
+    c2[0] += 1
+    assert fp != plan_cache.structure_fingerprint(
+        "pairs", (100, 100), (256, 512, 2048), r, c2)    # ids
+    assert fp != plan_cache.structure_fingerprint(
+        "ell-v2", (100, 100), (256, 512, 2048), r, c)    # kind
+
+
+def test_cache_counters(cache_env):
+    from raft_tpu.observability import get_registry
+
+    A, *_ = _coo(nnz=1500, m=500)
+    tile_pairs(A)
+    tile_pairs(A)
+    vals = {m.name: m.value for m in get_registry().collect()
+            if m.name in (plan_cache.HITS, plan_cache.MISSES)}
+    assert vals.get(plan_cache.HITS, 0) >= 1
+    assert vals.get(plan_cache.MISSES, 0) >= 1

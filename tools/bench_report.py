@@ -43,8 +43,17 @@ from typing import Dict, List, Optional, Sequence, Tuple
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ROUND_GLOB = "BENCH_r*.json"
+MULTICHIP_GLOB = "MULTICHIP_r*.json"
 BASELINE_NAME = "BENCH_LAST_GOOD.json"
 DEFAULT_THRESHOLD = 0.15   # 15% relative drop (or slowdown) fails
+
+# named single-shot artifacts whose numbers predate arbitrary amounts of
+# later work: the report flags the ones whose last-touching commit is
+# older than the last-good measurement's commit instead of silently
+# presenting them as current (SELECT_K_MATRIX / PALLAS_SMOKE / TPU_FUZZ
+# all predate multiple perf rounds at the time this gate shipped)
+NAMED_ARTIFACTS = ("SELECT_K_MATRIX.json", "PALLAS_SMOKE.json",
+                   "TPU_FUZZ.json", "BUSBW_BENCH.json")
 
 # cost-model fields Fixture.run emits into BENCH artifacts (PR 2+)
 COST_FIELDS = ("flops", "bytes_accessed", "arithmetic_intensity",
@@ -96,6 +105,164 @@ def collect_rounds(directory: str) -> List[Tuple[int, str, Optional[Dict]]]:
             continue
         out.append((int(m.group(1)), path, load_record(path)))
     out.sort(key=lambda t: t[0])
+    return out
+
+
+def load_multichip(path: str) -> Optional[Dict]:
+    """Flat multichip record: unwraps the driver's envelope like
+    :func:`load_record`, but multichip rounds are NOT required to carry
+    a perf metric — the early rounds are bare ``{n_devices, rc, ok}``
+    dryrun verdicts and must stay visible in the trajectory."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict):
+        return None
+    rec = data.get("parsed")
+    if isinstance(rec, dict) and ("ok" in rec or "strategies" in rec):
+        merged = dict(data)
+        merged.update(rec)
+        return merged
+    if "ok" in data or "n_devices" in data or "strategies" in data:
+        return data
+    return None
+
+
+def collect_multichip(directory: str
+                      ) -> List[Tuple[int, str, Optional[Dict]]]:
+    out = []
+    for path in glob.glob(os.path.join(directory, MULTICHIP_GLOB)):
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        out.append((int(m.group(1)), path, load_multichip(path)))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def _best_busbw(rec: Dict) -> Optional[float]:
+    strategies = rec.get("strategies")
+    if not isinstance(strategies, dict):
+        return None
+    fracs = [s.get("busbw_frac") for s in strategies.values()
+             if isinstance(s, dict)
+             and isinstance(s.get("busbw_frac"), (int, float))]
+    return max(fracs) if fracs else None
+
+
+def check_multichip(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
+                    threshold: float = DEFAULT_THRESHOLD
+                    ) -> Tuple[str, str]:
+    """Gate the MULTICHIP trend: the newest parseable round must be
+    ``ok`` (a failed distributed dryrun/bench is a regression, not a
+    footnote), and when the newest AND a previous round both carry
+    MEASURED sharded-KNN throughput, the newest must hold the value
+    within ``threshold`` (modeled off-TPU rounds are evidence of model
+    shape, not chip speed — never gated against measured history)."""
+    newest = None
+    for _, _, rec in reversed(rounds):
+        if rec is not None:
+            newest = rec
+            break
+    if newest is None:
+        return SKIP, "no MULTICHIP artifact to gate"
+    if newest.get("skipped"):
+        return SKIP, "latest MULTICHIP round skipped (no devices)"
+    if not newest.get("ok", True):
+        return REGRESS, ("latest MULTICHIP round failed (ok=false) — "
+                         "the distributed path regressed")
+    value = newest.get("value")
+    if not newest.get("measured") or not isinstance(value, (int, float)):
+        return PASS, ("latest MULTICHIP round ok"
+                      + ("" if newest.get("measured")
+                         else " (modeled — not gated on speed)"))
+    prev = None
+    for _, _, rec in reversed(rounds[:-1]):
+        if (rec is not None and rec.get("measured")
+                and isinstance(rec.get("value"), (int, float))
+                and rec.get("unit", "GB/s") == newest.get("unit",
+                                                          "GB/s")):
+            prev = rec
+            break
+    if prev is None:
+        return PASS, (f"multichip ok: {value:g} "
+                      f"{newest.get('unit', 'GB/s')} (first measured "
+                      f"round — nothing to trend against)")
+    floor = prev["value"] * (1.0 - threshold)
+    if value < floor:
+        return REGRESS, (
+            f"MULTICHIP REGRESSION: {value:g} < {floor:g} "
+            f"(previous measured {prev['value']:g} − {threshold:.0%})")
+    msg = (f"multichip ok: {value:g} {newest.get('unit', 'GB/s')} vs "
+           f"previous {prev['value']:g}")
+    bw, pbw = _best_busbw(newest), _best_busbw(prev)
+    if bw is not None and pbw is not None and pbw > 0:
+        if bw < pbw * (1.0 - threshold):
+            return REGRESS, (
+                f"MULTICHIP BUSBW REGRESSION: busbw_frac {bw:.3g} < "
+                f"{pbw * (1.0 - threshold):.3g} (previous {pbw:.3g} − "
+                f"{threshold:.0%}) — the merge lost ICI ground even "
+                f"though the headline holds")
+        msg += f"; busbw_frac {bw:.3g} vs {pbw:.3g}"
+    return PASS, msg
+
+
+def _git_commit_time(directory: str, ref: str) -> Optional[int]:
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            ["git", "-C", directory, "show", "-s", "--format=%ct", ref],
+            capture_output=True, text=True, timeout=10)
+        return int(r.stdout.strip().splitlines()[-1]) \
+            if r.returncode == 0 and r.stdout.strip() else None
+    except Exception:
+        return None
+
+
+def _git_last_touched(directory: str, name: str) -> Optional[int]:
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            ["git", "-C", directory, "log", "-1", "--format=%ct", "--",
+             name], capture_output=True, text=True, timeout=10)
+        return int(r.stdout.strip()) \
+            if r.returncode == 0 and r.stdout.strip() else None
+    except Exception:
+        return None
+
+
+def artifact_staleness(directory: str,
+                       baseline: Optional[Dict]) -> List[Dict]:
+    """Freshness verdict for each :data:`NAMED_ARTIFACTS` file: STALE
+    when its last-touching commit predates the commit the last-good
+    measurement was taken at — those numbers describe an older code
+    state and must not be read as current evidence. Degrades to
+    ``unknown`` without git/baseline (never raises)."""
+    ref = (baseline or {}).get("git_commit", "")
+    ref = str(ref).replace("-dirty", "")
+    ref_time = _git_commit_time(directory, ref) if ref else None
+    out = []
+    for name in NAMED_ARTIFACTS:
+        path = os.path.join(directory, name)
+        if not os.path.exists(path):
+            out.append({"artifact": name, "status": "missing"})
+            continue
+        touched = _git_last_touched(directory, name)
+        if touched is None or ref_time is None:
+            out.append({"artifact": name, "status": "unknown"})
+            continue
+        stale = touched < ref_time
+        out.append({
+            "artifact": name,
+            "status": "STALE" if stale else "current",
+            "age_rounds_note": (
+                "last touched before the last-good commit — numbers "
+                "describe an older code state" if stale else ""),
+        })
     return out
 
 
@@ -233,6 +400,51 @@ def trajectory(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
     return "\n".join(lines) + "\n"
 
 
+def multichip_trajectory(rounds: Sequence[Tuple[int, str,
+                                                Optional[Dict]]]) -> str:
+    """Multichip series: dryrun verdicts for the bare early rounds,
+    sharded-KNN throughput + best busbw fraction once artifacts carry
+    them (benchmarks/bench_sharded.py)."""
+    lines = ["multichip trajectory (MULTICHIP_r*.json)",
+             "========================================="]
+    if not rounds:
+        return "\n".join(lines + ["(no MULTICHIP_r*.json artifacts "
+                                  "found)"]) + "\n"
+    cols = ("round", "devices", "ok", "value", "unit", "busbw%",
+            "measured", "metric")
+    rows = []
+    for n, path, rec in rounds:
+        if rec is None:
+            rows.append((f"r{n:02d}", "-", "-", "-", "-", "-", "-",
+                         f"<unparseable: {os.path.basename(path)}>"))
+            continue
+        bw = _best_busbw(rec)
+        rows.append((
+            f"r{n:02d}", _fmt(rec.get("n_devices")),
+            _fmt(bool(rec.get("ok"))), _fmt(rec.get("value")),
+            rec.get("unit", "-"),
+            f"{bw * 100:.2f}" if isinstance(bw, (int, float)) else "-",
+            _fmt(rec.get("measured")) if "measured" in rec else "-",
+            normalize_metric(rec.get("metric", "dryrun"))))
+    widths = [max(len(c), *(len(str(r[i])) for r in rows))
+              for i, c in enumerate(cols)]
+    lines.append("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    lines.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        lines.append("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+    return "\n".join(lines) + "\n"
+
+
+def staleness_section(entries: List[Dict]) -> str:
+    lines = ["named artifacts (freshness vs the last-good commit)",
+             "---------------------------------------------------"]
+    for e in entries:
+        note = e.get("age_rounds_note") or ""
+        lines.append(f"{e['artifact']:<24} {e['status']}"
+                     + (f" — {note}" if note else ""))
+    return "\n".join(lines) + "\n"
+
+
 def main(argv: Sequence[str] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--dir", default=_REPO_ROOT,
@@ -250,8 +462,10 @@ def main(argv: Sequence[str] = None) -> int:
     args = p.parse_args(argv)
 
     rounds = collect_rounds(args.dir)
+    mrounds = collect_multichip(args.dir)
     baseline_path = args.baseline or os.path.join(args.dir, BASELINE_NAME)
     baseline = load_record(baseline_path)
+    stale = artifact_staleness(args.dir, baseline)
 
     if args.check:
         # newest round wins; older rounds are history, not candidates
@@ -262,18 +476,37 @@ def main(argv: Sequence[str] = None) -> int:
                 break
         status, msg = check_regression(candidate, baseline, args.threshold)
         print(f"bench_report --check: {status}: {msg}")
-        return {PASS: 0, SKIP: 0, REGRESS: 1, MISSING_BASELINE: 2}[status]
+        mstatus, mmsg = check_multichip(mrounds, args.threshold)
+        print(f"bench_report --check [multichip]: {mstatus}: {mmsg}")
+        for e in stale:
+            if e.get("status") == "STALE":
+                print(f"bench_report --check: note: {e['artifact']} is "
+                      f"STALE ({e['age_rounds_note']})")
+        codes = {PASS: 0, SKIP: 0, REGRESS: 1, MISSING_BASELINE: 2}
+        # regression in EITHER trend fails; missing baseline only when
+        # nothing regressed
+        rc = codes[status]
+        mrc = codes[mstatus]
+        return 1 if 1 in (rc, mrc) else max(rc, mrc)
 
     if args.json:
         payload = {
             "rounds": [{"round": n, "path": os.path.basename(path),
                         "record": rec} for n, path, rec in rounds],
+            "multichip_rounds": [
+                {"round": n, "path": os.path.basename(path),
+                 "record": rec} for n, path, rec in mrounds],
+            "named_artifacts": stale,
             "baseline": baseline,
         }
         print(json.dumps(payload, indent=1, sort_keys=True, default=str))
         return 0
 
     sys.stdout.write(trajectory(rounds, baseline))
+    sys.stdout.write("\n")
+    sys.stdout.write(multichip_trajectory(mrounds))
+    sys.stdout.write("\n")
+    sys.stdout.write(staleness_section(stale))
     return 0
 
 

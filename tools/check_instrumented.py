@@ -45,6 +45,8 @@ HOT_PATHS: Dict[str, Sequence[str]] = {
     "raft_tpu/sparse/sharded.py": ("spmv_sharded", "spmm_sharded"),
     "raft_tpu/solver/linear_assignment.py": ("solve_lap",),
     "raft_tpu/tune/fused.py": ("autotune_fused",),
+    "raft_tpu/tune/sharded.py": ("autotune_sharded",),
+    "raft_tpu/distance/knn_sharded.py": ("knn_fused_sharded",),
 }
 
 # module (repo-relative) → profiler capture methods it must call
@@ -53,7 +55,22 @@ COST_CAPTURE_SITES: Dict[str, Sequence[str]] = {
     "raft_tpu/runtime/entry_points.py": ("capture",),
     "raft_tpu/benchmark.py": ("capture_fn",),
     "raft_tpu/tune/fused.py": ("capture_fn",),
+    "raft_tpu/tune/sharded.py": ("capture_fn",),
 }
+
+# sharded-merge observability sites: the merge rounds must flow through
+# the COUNTED comms surface (MeshComms methods that call _count), and
+# comms.py must count the p2p/permute collectives under their own
+# labels. A merge round rewritten onto raw jax.lax collectives would
+# silently vanish from the metrics exporters — exactly the regression
+# this table catches.
+# module → attribute-call names it must contain
+SHARDED_MERGE_SITES: Dict[str, Sequence[str]] = {
+    "raft_tpu/distance/knn_sharded.py": ("collective_permute",
+                                         "allgather"),
+}
+# comms.py must register these collective labels with _count(...)
+COUNTED_COLLECTIVES = ("collective_permute", "device_send")
 
 # defining module → (kernel-variant entry points, consuming module):
 # the grid-order variants must EXIST where the footprint model and the
@@ -165,6 +182,48 @@ def check_kernel_variants(root: str = _REPO_ROOT,
     return errors
 
 
+def check_sharded_merge(root: str = _REPO_ROOT,
+                        sites: Dict[str, Sequence[str]] = None,
+                        counted: Sequence[str] = None) -> List[str]:
+    """Violations for :data:`SHARDED_MERGE_SITES` +
+    :data:`COUNTED_COLLECTIVES` (empty = clean)."""
+    sites = SHARDED_MERGE_SITES if sites is None else sites
+    counted = COUNTED_COLLECTIVES if counted is None else counted
+    errors: List[str] = []
+    for rel, methods in sorted(sites.items()):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            errors.append(f"{rel}: sharded-merge module missing")
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        for m in methods:
+            if not _calls_attribute(tree, m):
+                errors.append(
+                    f"{rel}: no call to comms .{m}(...) — the sharded "
+                    f"merge rounds would stop flowing through the "
+                    f"collective counters")
+    comms_rel = "raft_tpu/comms/comms.py"
+    comms_path = os.path.join(root, comms_rel)
+    if not os.path.exists(comms_path):
+        errors.append(f"{comms_rel}: comms module missing")
+        return errors
+    with open(comms_path) as f:
+        ctree = ast.parse(f.read(), filename=comms_rel)
+    counted_labels = {
+        node.args[0].value for node in ast.walk(ctree)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+        and node.func.id == "_count" and node.args
+        and isinstance(node.args[0], ast.Constant)}
+    for label in counted:
+        if label not in counted_labels:
+            errors.append(
+                f"{comms_rel}: collective {label!r} is not reported "
+                f"through _count(...) — its calls/bytes would be "
+                f"invisible to the metrics exporters")
+    return errors
+
+
 def check(root: str = _REPO_ROOT,
           hot_paths: Dict[str, Sequence[str]] = None) -> List[str]:
     """Returns a list of violation messages (empty = clean)."""
@@ -195,11 +254,13 @@ def check(root: str = _REPO_ROOT,
                 errors.append(f"{rel}: {fn}() is not decorated with "
                               f"@instrument")
     if hot_paths is HOT_PATHS:
-        # the default invocation also gates the cost-capture sites and
-        # the kernel-variant presence/consumption assertions; callers
-        # probing a custom hot_paths table (tests) opt out
+        # the default invocation also gates the cost-capture sites, the
+        # kernel-variant presence/consumption assertions, and the
+        # sharded-merge collective counting; callers probing a custom
+        # hot_paths table (tests) opt out
         errors.extend(check_cost_capture(root))
         errors.extend(check_kernel_variants(root))
+        errors.extend(check_sharded_merge(root))
     return errors
 
 
@@ -214,7 +275,10 @@ def main(argv: Sequence[str] = ()) -> int:
               f"{sum(len(v) for v in COST_CAPTURE_SITES.values())} "
               f"cost-capture sites verified; "
               f"{sum(len(v[0]) for v in KERNEL_VARIANTS.values())} "
-              f"kernel variants present + consumed")
+              f"kernel variants present + consumed; "
+              f"{sum(len(v) for v in SHARDED_MERGE_SITES.values())} "
+              f"sharded-merge sites + "
+              f"{len(COUNTED_COLLECTIVES)} counted collectives")
     return 1 if errors else 0
 
 
