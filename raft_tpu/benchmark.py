@@ -115,6 +115,19 @@ class Fixture:
         bench_name = name or getattr(fn, "__name__", repr(fn))
         result.update(self._cost_fields(bench_name, fn, args,
                                         result["seconds"]))
+        # resilience provenance: a nonzero degradation counter means
+        # some hot path ran a ladder fallback this process — stamp it
+        # so bench_report --check can refuse to gate (or baseline)
+        # degraded evidence. Omitted when zero, keeping clean artifacts
+        # byte-identical to the pre-resilience schema.
+        try:
+            from raft_tpu.resilience import degradation_count
+
+            dc = degradation_count()
+            if dc:
+                result["resilience_degradations"] = dc
+        except Exception:
+            pass
         if model:
             result.update({
                 (k if str(k).startswith("model_") else f"model_{k}"): v

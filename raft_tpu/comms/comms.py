@@ -64,6 +64,22 @@ class Status(enum.Enum):
     ABORT = 2
 
 
+def status_from_exception(exc: BaseException) -> Status:
+    """Map a failure observed while waiting on collective work to the
+    reference's ``status_t`` vocabulary — the NCCL abort/timeout
+    semantics table (docs/MIGRATION.md): a cancellation or expired
+    deadline is ``ABORT`` (the communicator was torn down on purpose,
+    like ``ncclCommAbort``); any classified device failure is
+    ``ERROR`` (the reference's ``commStatus_t`` error path). Used by
+    ``HostComms.sync_stream(nothrow=True)``."""
+    from raft_tpu.core.error import DeadlineExceededError
+    from raft_tpu.core.interruptible import InterruptedException
+
+    if isinstance(exc, (DeadlineExceededError, InterruptedException)):
+        return Status.ABORT
+    return Status.ERROR
+
+
 def _count(collective: str, x, axis_name) -> None:
     """Report one collective to the metrics registry (lazy import keeps
     the comms module importable without observability and vice versa).

@@ -23,8 +23,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from raft_tpu.core.error import expects
-from raft_tpu.comms.comms import MeshComms, Op, Status
+from raft_tpu.core.error import device_errors, expects
+from raft_tpu.comms.comms import (MeshComms, Op, Status,
+                                  status_from_exception)
+from raft_tpu.resilience import fault_point
 
 
 class HostComms:
@@ -51,20 +53,35 @@ class HostComms:
         """(ref: comm_split → sub-mesh axis; requires a multi-axis mesh)"""
         return HostComms(self.mesh, other_axis)
 
-    def sync_stream(self, *arrays) -> Status:
+    def sync_stream(self, *arrays, nothrow: bool = False) -> Status:
         """Block on dispatched work with cancellation polling — the host-side
         sync_stream (ref: std_comms::sync_stream →
-        interruptible::synchronize)."""
+        interruptible::synchronize). Honors an armed
+        :func:`raft_tpu.resilience.deadline` scope (the polling wait is
+        a cancellation point). ``nothrow=True`` returns the reference's
+        status vocabulary instead of raising: ABORT for a cancelled/
+        deadline-expired wait, ERROR for a classified device failure —
+        the ``comms_iface::sync_stream → status_t`` contract."""
         from raft_tpu.core import interruptible
 
-        if arrays:
-            interruptible.synchronize(*arrays)
+        try:
+            fault_point("host_sync")
+            if arrays:
+                with device_errors("host_comms.sync_stream"):
+                    interruptible.synchronize(*arrays)
+        except Exception as e:
+            if nothrow:
+                return status_from_exception(e)
+            raise
         return Status.SUCCESS
 
     def barrier(self) -> None:
         """(ref: comms_iface::barrier; multi-host: sync_global_devices).
         A multi-host sync failure propagates — silently degrading to a
-        local barrier would turn a distributed failure into a race."""
+        local barrier would turn a distributed failure into a race.
+        The local wait polls the interruptible token, so an armed
+        deadline converts a hung barrier into DeadlineExceededError."""
+        fault_point("host_barrier")
         try:
             from jax.experimental import multihost_utils
         except ImportError:
@@ -72,8 +89,12 @@ class HostComms:
         if multihost_utils is not None and jax.process_count() > 1:
             multihost_utils.sync_global_devices("raft_tpu_barrier")
             return
-        jax.block_until_ready(
-            self._run(lambda c, x: c.barrier(x), jnp.zeros((self.size,), jnp.int32)))
+        from raft_tpu.core import interruptible
+
+        with device_errors("host_comms.barrier"):
+            interruptible.synchronize(self._run(
+                lambda c, x: c.barrier(x),
+                jnp.zeros((self.size,), jnp.int32)))
 
     # -- machinery ---------------------------------------------------------
     def _sharding(self, rest_ndim: int):
@@ -83,7 +104,10 @@ class HostComms:
     def _run(self, fn, x, out_extra_rank: int = 0):
         """shard_map ``fn(MeshComms, shard)`` over the rank axis. The
         per-shard output rank is (x.ndim − 1) + out_extra_rank (collectives
-        like allgather add one axis)."""
+        like allgather add one axis). Carries the ``host_collective``
+        fault site — one injection hook covers every host-driven
+        collective."""
+        fault_point("host_collective")
         x = jnp.asarray(x)
         expects(x.shape[0] == self.size,
                 "HostComms: axis 0 (=%d) must equal comm size %d",

@@ -11,6 +11,9 @@ capture is needed.
 
 from __future__ import annotations
 
+import contextlib
+from typing import Iterator, List, Optional
+
 
 class RaftException(Exception):
     """Base exception. (ref: core/error.hpp ``raft::exception``)"""
@@ -28,6 +31,95 @@ class DeviceError(RaftException):
 
 class OutOfMemoryError(DeviceError):
     """HBM exhaustion. (ref: rmm::bad_alloc path)"""
+
+
+class DeadlineExceededError(RaftException):
+    """A :func:`raft_tpu.resilience.deadline` scope expired before the
+    guarded work completed — the TPU rendering of an NCCL collective
+    timeout / watchdog abort. Carries the deadline budget and the
+    active span stack of the cancelled thread at raise time, so a hang
+    converted into this error names WHERE the program was stuck.
+    (ref: ncclCommAbort + the reference's interruptible::synchronize
+    raising out of a spinning stream wait.)"""
+
+    def __init__(self, message: str, seconds: Optional[float] = None,
+                 span_stack: Optional[List[str]] = None):
+        super().__init__(message)
+        self.seconds = seconds
+        self.span_stack = list(span_stack or [])
+
+
+# substrings of XLA / runtime status messages, checked upper-cased.
+# RESOURCE_EXHAUSTED is the status code jaxlib surfaces for HBM/host
+# allocation failure; the rest cover the prose variants seen in practice.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED",
+                "OUT OF MEMORY", "FAILED TO ALLOCATE", "ALLOCATION FAIL",
+                "SCOPED-VMEM", "EXCEEDED MEMORY")
+_DEADLINE_MARKERS = ("DEADLINE_EXCEEDED", "DEADLINE EXCEEDED",
+                     "TIMED OUT", "TIMEOUT")
+_DEVICE_MARKERS = ("INTERNAL:", "ABORTED:", "UNAVAILABLE:",
+                   "DATA CORRUPTION", "HALT")
+
+
+def _is_xla_error(exc: BaseException) -> bool:
+    """jaxlib-layer exception, duck-typed by class name/module so the
+    classifier needs no jaxlib import (and unit tests can use stubs)."""
+    for klass in type(exc).__mro__:
+        if klass.__name__ in ("XlaRuntimeError", "JaxRuntimeError"):
+            return True
+        if klass.__module__.split(".")[0] in ("jaxlib", "jax"):
+            return True
+    return False
+
+
+def classify_xla_error(exc: BaseException) -> Optional[RaftException]:
+    """Map a raw runtime exception onto the raft taxonomy, or None.
+
+    (ref: core/error.hpp's per-status ``RAFT_CUDA_TRY`` expansion — each
+    vendor status code became a typed raft exception. On TPU the vendor
+    surface is jaxlib's ``XlaRuntimeError`` whose *message* carries the
+    absl status code.) Mapping: RESOURCE_EXHAUSTED/OOM →
+    :class:`OutOfMemoryError`; DEADLINE_EXCEEDED/timeout →
+    :class:`DeadlineExceededError`; INTERNAL/ABORTED (or any other
+    jaxlib-layer failure) → :class:`DeviceError`. Exceptions already in
+    the taxonomy pass through unchanged; exceptions that are neither
+    (``ValueError`` from user input, ``KeyboardInterrupt``…) return
+    None — the caller re-raises them unwrapped."""
+    if isinstance(exc, RaftException):
+        return exc
+    if not isinstance(exc, Exception):
+        return None          # KeyboardInterrupt/SystemExit are not ours
+    msg = str(exc)
+    upper = msg.upper()
+    is_xla = _is_xla_error(exc)
+    label = f"[{type(exc).__name__}] {msg}"
+    if any(m in upper for m in _OOM_MARKERS):
+        return OutOfMemoryError(label)
+    if is_xla and any(m in upper for m in _DEADLINE_MARKERS):
+        return DeadlineExceededError(label)
+    if is_xla or any(m in upper for m in _DEVICE_MARKERS):
+        return DeviceError(label)
+    return None
+
+
+@contextlib.contextmanager
+def device_errors(context: str = "") -> Iterator[None]:
+    """Scope that re-raises device-layer failures classified into the
+    raft taxonomy (chained via ``raise ... from``), so callers of the
+    runtime entry points never see raw jaxlib exceptions. Non-device
+    exceptions propagate unwrapped. (ref: the RAFT_CUDA_TRY macro
+    bracket around every launch.)"""
+    try:
+        yield
+    except RaftException:
+        raise
+    except Exception as e:
+        classified = classify_xla_error(e)
+        if classified is not None:
+            if context:
+                classified.args = (f"{context}: {classified.args[0]}",)
+            raise classified from e
+        raise
 
 
 def expects(condition: bool, fmt: str, *args) -> None:

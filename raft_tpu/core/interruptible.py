@@ -34,10 +34,14 @@ class InterruptedException(RaftException):
 
 
 class _Token:
-    __slots__ = ("cancelled",)
+    __slots__ = ("cancelled", "fired_deadline")
 
     def __init__(self):
         self.cancelled = False
+        # set (before ``cancelled``) by a deadline watchdog so the
+        # cancellation point can raise DeadlineExceededError instead of
+        # the plain InterruptedException — see resilience/deadline.py
+        self.fired_deadline = None
 
 
 _registry: Dict[int, _Token] = {}
@@ -67,15 +71,45 @@ def yield_no_throw() -> bool:
     tok = get_token()
     if tok.cancelled:
         tok.cancelled = False
+        tok.fired_deadline = None
         return True
     return False
 
 
 def yield_() -> None:
-    """Cancellation point: raises :class:`InterruptedException` if cancelled.
-    (ref: interruptible.hpp ``yield``)"""
-    if yield_no_throw():
-        raise InterruptedException("interruptible: cancelled")
+    """Cancellation point: raises :class:`InterruptedException` if
+    cancelled — or :class:`raft_tpu.core.error.DeadlineExceededError`
+    when the cancellation was armed by an expired
+    :func:`raft_tpu.resilience.deadline` scope, carrying that scope's
+    budget and this thread's active span stack (the nvtx range stack)
+    for diagnosis. (ref: interruptible.hpp ``yield``)"""
+    tok = get_token()
+    if not tok.cancelled:
+        return
+    tok.cancelled = False
+    fired = tok.fired_deadline
+    tok.fired_deadline = None
+    if fired is not None:
+        from raft_tpu.core import nvtx
+        from raft_tpu.core.error import DeadlineExceededError
+
+        spans = nvtx.range_stack()
+        label = fired.get("label") or "deadline"
+        seconds = fired.get("seconds")
+        try:
+            from raft_tpu.observability import get_registry
+
+            get_registry().counter(
+                "raft_tpu_deadline_exceeded_total", {"scope": label},
+                help="Deadline scopes that expired and cancelled their "
+                     "thread").inc()
+        except Exception:
+            pass
+        raise DeadlineExceededError(
+            f"deadline {label!r} of {seconds}s exceeded"
+            + (f" (active spans: {' > '.join(spans)})" if spans else ""),
+            seconds=seconds, span_stack=spans)
+    raise InterruptedException("interruptible: cancelled")
 
 
 def synchronize(*arrays, poll_interval_s: float = 0.001):

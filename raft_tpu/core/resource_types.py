@@ -56,5 +56,10 @@ class ResourceType(enum.Enum):
     # Profiler, like METRICS)
     PROFILER = enum.auto()
 
+    # recovery-policy table (retry budgets + degradation ladders per
+    # site — see raft_tpu.resilience.policy; defaults to the
+    # process-global PolicyTable, like METRICS/PROFILER)
+    RESILIENCE = enum.auto()
+
     # user-defined (ref: CUSTOM)
     CUSTOM = enum.auto()

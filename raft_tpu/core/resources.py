@@ -240,6 +240,26 @@ class Resources:
         to a non-default device, or isolate records per tenant)."""
         self.set_resource(ResourceType.PROFILER, profiler)
 
+    # recovery policies (retry budgets + degradation ladders — see
+    # raft_tpu.resilience.policy)
+    @property
+    def resilience(self):
+        """The handle's recovery-policy table. Falls back to the
+        process-global :func:`raft_tpu.resilience.get_policy_table`
+        when no factory is registered — the same default contract as
+        ``metrics``/``profiler``."""
+        if not self.has_resource_factory(ResourceType.RESILIENCE):
+            from raft_tpu.resilience.policy import get_policy_table
+
+            return get_policy_table()
+        return self.get_resource(ResourceType.RESILIENCE)
+
+    def set_resilience(self, table) -> None:
+        """Install a handle-scoped PolicyTable (e.g. to disable retries
+        for one tenant, or tighten the ladder for a latency-bound
+        caller)."""
+        self.set_resource(ResourceType.RESILIENCE, table)
+
     @property
     def workspace(self) -> WorkspaceResource:
         return self.get_resource(ResourceType.WORKSPACE_RESOURCE)
@@ -299,6 +319,14 @@ def _default_metrics_factory(res: Resources):
     from raft_tpu.observability import get_registry
 
     return get_registry()
+
+
+def _default_resilience_factory(res: Resources):
+    """Default RESILIENCE slot: the process-global recovery-policy
+    table (override per handle with ``set_resilience``)."""
+    from raft_tpu.resilience.policy import get_policy_table
+
+    return get_policy_table()
 
 
 def _default_profiler_factory(res: Resources):
@@ -361,6 +389,8 @@ class DeviceResources(Resources):
         self.add_resource_factory(ResourceType.HOST_MEMORY_KIND, lambda r: "pinned_host")
         self.add_resource_factory(ResourceType.METRICS, _default_metrics_factory)
         self.add_resource_factory(ResourceType.PROFILER, _default_profiler_factory)
+        self.add_resource_factory(ResourceType.RESILIENCE,
+                                  _default_resilience_factory)
 
 
 def _device_resources_reduce(self):

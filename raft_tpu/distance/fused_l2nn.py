@@ -26,6 +26,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.kvp import KeyValuePair
 from raft_tpu.core.resources import ensure_resources
 from raft_tpu.observability import instrument
+from raft_tpu.resilience import fault_point
 
 
 def _pad_rows(y, tile):
@@ -73,6 +74,7 @@ def fused_l2_nn_argmin(res, x, y, sqrt: bool = False,
     """For each row of x, the nearest row of y under (squared) L2.
     Returns (min_dist [n], argmin [n]). (ref: pre-cuVS fusedL2NN /
     pylibraft.distance.fused_l2_nn_argmin)"""
+    fault_point("fused_l2nn")
     res = ensure_resources(res)
     x = jnp.asarray(x, jnp.float32)
     y = jnp.asarray(y, jnp.float32)

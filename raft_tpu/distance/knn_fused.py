@@ -69,13 +69,14 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.observability import instrument
+from raft_tpu.resilience import fault_point
 
 from raft_tpu.ops.fused_l2_topk_pallas import (
-    _LANES, _PACK_BITS, _PACK_MASK, _PACK_PAD, _PBITS_MAX, VMEM_BUDGET,
+    _LANES, _PACK_BITS, _PACK_MASK, _PACK_PAD, _PBITS_MAX,
     fused_l2_group_topk, fused_l2_group_topk_dchunk,
     fused_l2_group_topk_packed, fused_l2_group_topk_packed_db,
     fused_l2_group_topk_packed_dbuf, fused_l2_group_topk_packed_dchunk,
-    split_hi_lo, vmem_footprint)
+    split_hi_lo, vmem_budget, vmem_footprint)
 
 # grid iteration orders for the packed fused kernel (see the
 # DATABASE-MAJOR block comment in ops.fused_l2_topk_pallas):
@@ -702,10 +703,11 @@ def fit_config(T: int, Qb: int, d: int, passes: int,
     would silently shrink. (For grid_order="dbuf" the Qb loop is a
     no-op — its footprint prices the whole query batch — so the T loop
     carries the shrink.)"""
-    while (footprint_for(T, Qb, d, passes, g, grid_order) > VMEM_BUDGET
+    budget = vmem_budget()
+    while (footprint_for(T, Qb, d, passes, g, grid_order) > budget
            and Qb > 8):
         Qb = max(8, (Qb // 2) // 8 * 8)
-    while (footprint_for(T, Qb, d, passes, g, grid_order) > VMEM_BUDGET
+    while (footprint_for(T, Qb, d, passes, g, grid_order) > budget
            and T > 2 * _LANES):
         T = max(2 * _LANES, (T // 2) // _LANES * _LANES)
     return T, Qb
@@ -811,11 +813,13 @@ def _row_config(row, d: Optional[int], passes: int) -> Optional[FusedConfig]:
         return None
     if d is not None and fit_config(cfg.T, cfg.Qb, d, passes, cfg.g,
                                     cfg.grid_order) != (cfg.T, cfg.Qb):
-        from raft_tpu.core.logger import log_warn
+        from raft_tpu.tune.fused import table_degraded
 
-        log_warn("TUNE_FUSED row (T=%d, Qb=%d, g=%d, %s, passes=%d) "
-                 "fails the scoped-VMEM fit at d=%d — rejected",
-                 cfg.T, cfg.Qb, cfg.g, cfg.grid_order, passes, d)
+        table_degraded(
+            "fused", "row_rejected",
+            f"row (T={cfg.T}, Qb={cfg.Qb}, g={cfg.g}, "
+            f"{cfg.grid_order}, passes={passes}) fails the scoped-VMEM "
+            f"fit at d={d}")
         return None
     return cfg
 
@@ -823,32 +827,51 @@ def _row_config(row, d: Optional[int], passes: int) -> Optional[FusedConfig]:
 def _load_tuned() -> dict:
     """Parse + validate the tune table → {passes: FusedConfig}. Any
     corrupt, stale or future-schema table degrades to {} (built-in
-    defaults) with a logged reason — it must never break knn."""
+    defaults) with a logged reason — it must never break knn. Every
+    degraded load is counted under ``tune.table_degraded{table=fused,
+    reason=...}`` (WARN once per process — see
+    :func:`raft_tpu.tune.fused.table_degraded`); the read carries the
+    ``tune_table_read`` fault site so a torn/corrupt table is
+    injectable."""
     import json
     import os
 
-    from raft_tpu.core.logger import log_info, log_warn
+    from raft_tpu.core.logger import log_info
     from raft_tpu.native import _REPO_ROOT
+    from raft_tpu.tune.fused import (TUNE_SCHEMA_VERSION, table_degraded,
+                                     validate_tune_table)
 
-    path = os.environ.get("RAFT_TPU_TUNE_FUSED") or os.path.join(
-        _REPO_ROOT, "TUNE_FUSED.json")
+    path_env = os.environ.get("RAFT_TPU_TUNE_FUSED")
+    path = path_env or os.path.join(_REPO_ROOT, "TUNE_FUSED.json")
+    if fault_point("tune_table_read") == "corrupt":
+        table_degraded("fused", "unreadable",
+                       f"{path}: injected corrupt table read")
+        return {}
     tuned: dict = {}
     try:
         with open(path) as f:
             tbl = json.load(f)
-        from raft_tpu.tune.fused import TUNE_SCHEMA_VERSION, \
-            validate_tune_table
-
+    except FileNotFoundError:
+        if path_env:   # an explicitly-named table that is absent IS
+            #            a degradation; the default path missing is
+            #            just the untuned state
+            table_degraded("fused", "missing", path)
+        return {}
+    except Exception as e:
+        table_degraded("fused", "unreadable",
+                       f"{path}: {type(e).__name__}: {e}")
+        return {}
+    try:
         errors = validate_tune_table(tbl)
         if errors:
-            log_warn("TUNE_FUSED table %s rejected (%s) — using "
-                     "built-in fused defaults", path, "; ".join(errors))
+            table_degraded("fused", "invalid",
+                           f"{path}: " + "; ".join(errors))
             return {}
         if int(tbl.get("schema", 1)) > TUNE_SCHEMA_VERSION:
-            log_warn("TUNE_FUSED table %s has future schema %s (this "
-                     "build understands ≤ %d) — using built-in fused "
-                     "defaults", path, tbl.get("schema"),
-                     TUNE_SCHEMA_VERSION)
+            table_degraded(
+                "fused", "future_schema",
+                f"{path}: schema {tbl.get('schema')} (this build "
+                f"understands ≤ {TUNE_SCHEMA_VERSION})")
             return {}
         shape = tbl.get("shape")
         d = (int(shape[2]) if isinstance(shape, (list, tuple))
@@ -1067,6 +1090,7 @@ def knn_fused(x, y, k: int, passes: int = 3,
     "query" with a logged reason. A :class:`KnnIndex` freezes the
     order at build time.
     """
+    fault_point("knn_fused")
     idx: Optional[KnnIndex] = y if isinstance(y, KnnIndex) else None
     if idx is not None:
         T, Qb, g = idx.T, idx.Qb, idx.g

@@ -51,6 +51,7 @@ Pallas inside shard_map) and bit-exact against the single-device
 from __future__ import annotations
 
 import math
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -59,11 +60,16 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raft_tpu.comms import MeshComms
-from raft_tpu.core.error import expects
+from raft_tpu.core.error import (DeviceError, OutOfMemoryError,
+                                 device_errors, expects)
 from raft_tpu.core.resources import ensure_resources
 from raft_tpu.observability import instrument
 from raft_tpu.observability.costmodel import (MERGE_STRATEGIES,
                                               choose_merge_strategy)
+from raft_tpu.resilience import (PoisonedOutputError, degrade_merge,
+                                 fault_point, faults_active,
+                                 record_degradation, record_exhausted,
+                                 record_retry)
 from raft_tpu.distance.knn_fused import (
     _D_SINGLE_SHOT, _DC, _LANES, _PACK_BITS, _PBITS_MAX, _POOL_PAD,
     _Q_CHUNK, GRID_ORDERS, KnnIndex, _knn_fused_core, _prepare_ops,
@@ -84,10 +90,14 @@ def resolve_merge_strategy(merge: str, p: int, nq: int, k: int) -> str:
     request is visible per call. ``"auto"`` takes the ICI cost-model
     crossover; a tournament request on a non-power-of-two shard count
     downgrades to allgather (the butterfly needs a partner every
-    round)."""
-    if merge not in ("auto",) + MERGE_STRATEGIES:
-        raise ValueError(f"merge must be 'auto' or one of "
+    round). ``"host"`` — the bottom rung of the collective-failure
+    ladder — is also requestable directly: no merge collective at all,
+    per-shard candidates gathered and selected on the host."""
+    if merge not in ("auto", "host") + MERGE_STRATEGIES:
+        raise ValueError(f"merge must be 'auto', 'host' or one of "
                          f"{MERGE_STRATEGIES}, got {merge!r}")
+    if merge == "host":
+        return merge
     if merge == "auto":
         return choose_merge_strategy(p, nq, k)
     if merge == "tournament" and (p & (p - 1)):
@@ -265,6 +275,21 @@ def _merge_tournament(comms: MeshComms, p: int, k: int, v, i):
     return v, i
 
 
+def _merge_host_pool(gv, gi, k: int):
+    """Host-side merge — the bottom rung of the collective-failure
+    ladder: the shard_map program returns each shard's LOCAL candidates
+    (out_specs sharded over the axis → [p, nq, k] on host), and the
+    final select runs outside the SPMD program, with no merge
+    collective in the compiled graph at all. Pool order is rank-major
+    per query — the exact pool :func:`_merge_allgather` builds — so the
+    result is bit-identical to the collective merges, ties included."""
+    p, nqp, kk = gv.shape
+    pool_v = jnp.moveaxis(gv, 0, 1).reshape(nqp, p * kk)
+    pool_i = jnp.moveaxis(gi, 0, 1).reshape(nqp, p * kk)
+    neg, pos = jax.lax.top_k(-pool_v, k)
+    return -neg, jnp.take_along_axis(pool_i, pos, axis=1)
+
+
 @instrument("distance.knn_fused_sharded")
 def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
                       shard_mode: str = "db", merge: str = "auto",
@@ -317,9 +342,11 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
     nq = x.shape[0]
 
     if shard_mode == "query":
-        return _knn_query_sharded(x, y, k, mesh, axis, passes, metric,
-                                  T, Qb, g, grid_order, rescore, certify,
-                                  res)
+        fault_point("sharded_dispatch")
+        with device_errors("distance.knn_fused_sharded[query]"):
+            return _knn_query_sharded(x, y, k, mesh, axis, passes,
+                                      metric, T, Qb, g, grid_order,
+                                      rescore, certify, res)
 
     if isinstance(y, ShardedFusedIndex):
         idx = y
@@ -353,21 +380,13 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
         raise ValueError("knn_fused_sharded: certify='f32' needs the "
                          "exact rescore (store_yp=True)")
 
-    # ---- static query-block geometry --------------------------------
-    nb = micro_batches
-    if nb is None:
+    # ---- micro-batch request (caller / tuned table / default) -------
+    nb_req = micro_batches
+    if nb_req is None:
         from raft_tpu.tune.sharded import sharded_config
 
         tuned = sharded_config(p)
-        nb = tuned.get("micro_batches") if tuned else None
-    nb = default_micro_batches(nq, idx.Qb) if nb is None else int(nb)
-    nb = max(1, min(nb, nq))
-    nb = max(nb, -(-nq // _Q_CHUNK))       # keep blocks under _Q_CHUNK
-    qb0 = -(-nq // nb)
-    Qb_eff = min(idx.Qb, ((qb0 + 7) // 8) * 8)
-    qb_len = -(-qb0 // Qb_eff) * Qb_eff
-    nq_pad = nb * qb_len
-    merge = resolve_merge_strategy(merge, p, qb_len, k)
+        nb_req = tuned.get("micro_batches") if tuned else None
 
     d_eff = idx.y_hi_s.shape[1]
     if x.shape[1] != idx.d_orig:
@@ -376,9 +395,6 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
     if d_eff != x.shape[1]:
         x = jnp.concatenate(
             [x, jnp.zeros((nq, d_eff - x.shape[1]), jnp.float32)], axis=1)
-    if nq_pad != nq:
-        x = jnp.concatenate(
-            [x, jnp.zeros((nq_pad - nq, d_eff), jnp.float32)])
 
     S_pool = -(-n_tiles_loc // idx.g) * _LANES
     packed = idx.g * (idx.T // _LANES) <= (1 << idx.pbits)
@@ -388,67 +404,170 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
 
     has_yp = idx.yp_s is not None
     has_ylo = idx.y_lo_s is not None
-    key = ("db", mesh, axis, k, idx.T, Qb_eff, idx.g, idx.passes,
-           idx.metric, idx.rows_per, m, nb, qb_len, merge, bool(rescore),
-           idx.pbits, certify, pool_algo, idx.grid_order, has_yp,
-           has_ylo)
-    fn = _SHARDED_FUSED_CACHE.get(key)
-    if fn is None:
-        comms = MeshComms(axis, size=p)
-        merge_fn = (_merge_allgather if merge == "allgather"
-                    else _merge_tournament)
-        rows_per, T_, g_ = idx.rows_per, idx.T, idx.g
-        passes_, metric_, pbits_ = idx.passes, idx.metric, idx.pbits
-        order_ = idx.grid_order
 
-        def shard_fn(*ops_and_x):
-            *ops, xq = ops_and_x
-            it = iter(ops)
-            yp_l = next(it) if has_yp else None
-            yhi_l = next(it)
-            ylo_l = next(it) if has_ylo else None
-            yyh_l = next(it)
-            yy_l = next(it)
-            r = jax.lax.axis_index(axis)
-            m_loc = jnp.clip(
-                jnp.int32(m) - r.astype(jnp.int32) * rows_per,
-                0, rows_per)
-            off = r.astype(jnp.int32) * rows_per
-            out_v, out_i = [], []
-            # micro-batch pipeline: block b's kernel is independent of
-            # block b−1's merge collectives — the scheduler may overlap
-            for b in range(nb):
-                xb = jax.lax.slice_in_dim(xq, b * qb_len,
-                                          (b + 1) * qb_len, axis=0)
-                vals, ids = _knn_fused_core(
-                    xb, yp_l, yhi_l, ylo_l, yyh_l, yy_l,
-                    k=k, T=T_, Qb=Qb_eff, g=g_, passes=passes_,
-                    metric=metric_, m=rows_per, rescore=rescore,
-                    pbits=pbits_, certify=certify, pool_algo=pool_algo,
-                    grid_order=order_, m_valid=m_loc)
-                # local → global ids; pad/sentinel candidates (id -1 or
-                # non-finite value) must lose every merge
-                gid = jnp.where((ids >= 0) & jnp.isfinite(vals),
-                                ids + off, -1)
-                vals = jnp.where(gid >= 0, vals, jnp.inf)
-                mv, mi = merge_fn(comms, p, k, vals, gid)
-                out_v.append(mv)
-                out_i.append(mi)
-            return (jnp.concatenate(out_v, axis=0),
-                    jnp.concatenate(out_i, axis=0))
+    def _geometry(nb, Qb_base):
+        """Static query-block geometry for one (micro-batch, Qb)
+        attempt — recomputed per ladder rung."""
+        nb = (default_micro_batches(nq, Qb_base) if nb is None
+              else int(nb))
+        nb = max(1, min(nb, nq))
+        nb = max(nb, -(-nq // _Q_CHUNK))   # keep blocks under _Q_CHUNK
+        qb0 = -(-nq // nb)
+        Qb_eff = min(Qb_base, ((qb0 + 7) // 8) * 8)
+        qb_len = -(-qb0 // Qb_eff) * Qb_eff
+        return nb, Qb_eff, qb_len, nb * qb_len
 
-        row_specs = [P(axis)] * (1 + int(has_yp) + int(has_ylo))
-        in_specs = tuple(row_specs + [P(None, axis), P(None, axis), P()])
-        fn = jax.jit(jax.shard_map(
-            shard_fn, mesh=mesh, in_specs=in_specs,
-            out_specs=(P(), P()), check_vma=False))
-        _SHARDED_FUSED_CACHE[key] = fn
+    def _dispatch(merge_eff, nb_in, Qb_base):
+        """Build (or reuse) and run the compiled SPMD program for one
+        (merge strategy, micro-batches, Qb) point — the unit the
+        degradation ladder retries with different arguments."""
+        nb, Qb_eff, qb_len, nq_pad = _geometry(nb_in, Qb_base)
+        xq = x
+        if nq_pad != nq:
+            xq = jnp.concatenate(
+                [x, jnp.zeros((nq_pad - nq, d_eff), jnp.float32)])
+        key = ("db", mesh, axis, k, idx.T, Qb_eff, idx.g, idx.passes,
+               idx.metric, idx.rows_per, m, nb, qb_len, merge_eff,
+               bool(rescore), idx.pbits, certify, pool_algo,
+               idx.grid_order, has_yp, has_ylo)
+        fn = _SHARDED_FUSED_CACHE.get(key)
+        if fn is None:
+            comms = MeshComms(axis, size=p)
+            merge_fn = {"allgather": _merge_allgather,
+                        "tournament": _merge_tournament,
+                        "host": None}[merge_eff]
+            rows_per, T_, g_ = idx.rows_per, idx.T, idx.g
+            passes_, metric_, pbits_ = idx.passes, idx.metric, idx.pbits
+            order_ = idx.grid_order
 
-    operands = [o for o in (idx.yp_s, idx.y_hi_s, idx.y_lo_s)
-                if o is not None] + [idx.yyh_s, idx.yy_s]
-    vals, ids = fn(*operands, x)
-    if nq_pad != nq:
-        vals, ids = vals[:nq], ids[:nq]
+            def shard_fn(*ops_and_x):
+                *ops, xq_l = ops_and_x
+                it = iter(ops)
+                yp_l = next(it) if has_yp else None
+                yhi_l = next(it)
+                ylo_l = next(it) if has_ylo else None
+                yyh_l = next(it)
+                yy_l = next(it)
+                r = jax.lax.axis_index(axis)
+                m_loc = jnp.clip(
+                    jnp.int32(m) - r.astype(jnp.int32) * rows_per,
+                    0, rows_per)
+                off = r.astype(jnp.int32) * rows_per
+                out_v, out_i = [], []
+                # micro-batch pipeline: block b's kernel is independent
+                # of block b−1's merge collectives — the scheduler may
+                # overlap
+                for b in range(nb):
+                    xb = jax.lax.slice_in_dim(xq_l, b * qb_len,
+                                              (b + 1) * qb_len, axis=0)
+                    vals, ids = _knn_fused_core(
+                        xb, yp_l, yhi_l, ylo_l, yyh_l, yy_l,
+                        k=k, T=T_, Qb=Qb_eff, g=g_, passes=passes_,
+                        metric=metric_, m=rows_per, rescore=rescore,
+                        pbits=pbits_, certify=certify,
+                        pool_algo=pool_algo, grid_order=order_,
+                        m_valid=m_loc)
+                    # local → global ids; pad/sentinel candidates (id -1
+                    # or non-finite value) must lose every merge
+                    gid = jnp.where((ids >= 0) & jnp.isfinite(vals),
+                                    ids + off, -1)
+                    vals = jnp.where(gid >= 0, vals, jnp.inf)
+                    if merge_fn is not None:
+                        vals, gid = merge_fn(comms, p, k, vals, gid)
+                    out_v.append(vals)
+                    out_i.append(gid)
+                cat_v = jnp.concatenate(out_v, axis=0)
+                cat_i = jnp.concatenate(out_i, axis=0)
+                if merge_fn is None:   # host merge: per-shard locals out
+                    return cat_v[None], cat_i[None]
+                return cat_v, cat_i
+
+            row_specs = [P(axis)] * (1 + int(has_yp) + int(has_ylo))
+            in_specs = tuple(row_specs
+                             + [P(None, axis), P(None, axis), P()])
+            out_specs = ((P(axis), P(axis)) if merge_eff == "host"
+                         else (P(), P()))
+            fn = jax.jit(jax.shard_map(
+                shard_fn, mesh=mesh, in_specs=in_specs,
+                out_specs=out_specs, check_vma=False))
+            _SHARDED_FUSED_CACHE[key] = fn
+
+        operands = [o for o in (idx.yp_s, idx.y_hi_s, idx.y_lo_s)
+                    if o is not None] + [idx.yyh_s, idx.yy_s]
+        vals, ids = fn(*operands, xq)
+        if merge_eff == "host":
+            vals, ids = _merge_host_pool(vals, ids, k)
+        if nq_pad != nq:
+            vals, ids = vals[:nq], ids[:nq]
+        return vals, ids
+
+    # ---- resilience driver ------------------------------------------
+    # The fast path is one trip through the loop body with zero extra
+    # dispatches; the except arms walk the graceful-degradation ladder
+    # (see raft_tpu.resilience.policy): classified OOM → halve Qb,
+    # then grow micro-batches; collective failure (device error or
+    # injected timeout at the merge) → tournament → allgather → host
+    # merge. DeadlineExceededError is never caught here — a deadline
+    # is the caller's global budget. Every rung is bit-identical in
+    # ids to the undegraded oracle (tests/test_resilience.py).
+    _, _, qb_len0, _ = _geometry(nb_req, idx.Qb)
+    merge_eff = resolve_merge_strategy(merge, p, qb_len0, k)
+    validate = (faults_active()
+                or bool(os.environ.get("RAFT_TPU_VALIDATE_OUTPUTS")))
+    site = "distance.knn_fused_sharded"
+    Qb_base, nb_cur, retries = idx.Qb, nb_req, 0
+    while True:
+        try:
+            poison = fault_point("sharded_dispatch")
+            if merge_eff == "tournament":
+                fault_point("merge_permute")
+            elif merge_eff == "allgather":
+                fault_point("merge_allgather")
+            with device_errors(site):
+                vals, ids = _dispatch(merge_eff, nb_cur, Qb_base)
+            if poison == "nan":   # simulated kernel-output poisoning
+                vals = jnp.full_like(vals, jnp.nan)
+            if validate and not bool(jnp.isfinite(vals).all()):
+                try:
+                    from raft_tpu.resilience import POISONED
+
+                    res.metrics.counter(
+                        POISONED, {"site": site},
+                        help="Outputs that failed the finiteness "
+                             "guard").inc()
+                except Exception:
+                    pass
+                raise PoisonedOutputError(
+                    f"{site}: non-finite values in merged top-k")
+            break
+        except PoisonedOutputError as e:
+            retries += 1
+            pol = res.resilience.policy_for(site)
+            if retries > pol.max_retries:
+                record_exhausted(site)
+                raise
+            record_retry(site, e, retries)
+        except OutOfMemoryError:
+            nb_now = _geometry(nb_cur, Qb_base)[0]
+            if Qb_base > 8:
+                new_Qb = max(8, (Qb_base // 2) // 8 * 8)
+                record_degradation(site, f"fit:Qb:{Qb_base}->{new_Qb}")
+                Qb_base = new_Qb
+            elif nb_now < min(nq, 64):
+                record_degradation(
+                    site,
+                    f"fit:micro_batches:{nb_now}->{2 * nb_now}")
+                nb_cur = min(nq, 2 * nb_now)
+            else:
+                record_exhausted(site)
+                raise
+        except DeviceError as e:
+            nxt = degrade_merge(merge_eff)
+            if nxt is None:
+                record_exhausted(site)
+                raise
+            record_degradation(site, f"merge:{merge_eff}->{nxt}")
+            merge_eff = nxt
     if idx.metric == "ip":
         return -vals, ids           # internal −x·y ascending → IP desc
     return vals, ids

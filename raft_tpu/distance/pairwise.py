@@ -30,6 +30,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.resources import ensure_resources
 from raft_tpu.distance.types import METRIC_NAMES, DistanceType
 from raft_tpu.observability import instrument
+from raft_tpu.resilience import fault_point
 
 
 def _as_type(metric: Union[str, DistanceType]) -> DistanceType:
@@ -112,6 +113,7 @@ def pairwise_distance(res, x, y=None, metric: Union[str, DistanceType] = "euclid
     >>> np.asarray(pairwise_distance(None, x, metric="euclidean")).round(1).tolist()
     [[0.0, 5.0], [5.0, 0.0]]
     """
+    fault_point("pairwise_distance")
     x = jnp.asarray(x)
     y = x if y is None else jnp.asarray(y)
     expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1],

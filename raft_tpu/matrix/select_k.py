@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from raft_tpu.core.error import expects
 from raft_tpu.matrix.select_k_types import SelectAlgo
 from raft_tpu.observability import instrument
+from raft_tpu.resilience import fault_point
 
 
 def _load_select_k_table():
@@ -172,6 +173,7 @@ def select_k(
     >>> np.asarray(vals).tolist(), np.asarray(idx).tolist()
     ([[1.0, 2.0]], [[1, 2]])
     """
+    fault_point("select_k")
     in_val = jnp.asarray(in_val)
     expects(in_val.ndim == 2, "select_k: in_val must be [batch, len]")
     batch, length = in_val.shape

@@ -20,6 +20,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from raft_tpu.observability import instrument
+from raft_tpu.resilience import fault_point
 
 
 @partial(jax.jit, static_argnames=("k", "nc"))
@@ -63,6 +64,7 @@ def select_k_chunked(in_val, in_idx, k: int, select_min: bool,
     length)."""
     from raft_tpu.matrix.select_k_types import f32_comparable_keys
 
+    fault_point("select_k_chunked")
     in_val = jnp.asarray(in_val)
     if not f32_comparable_keys(in_val.dtype):
         raise NotImplementedError(

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from raft_tpu.ops.folds import fold_group_top2
 from raft_tpu.observability import instrument
+from raft_tpu.resilience import fault_point
 
 _POOL_PAD = 32
 
@@ -215,6 +216,7 @@ def select_k_slotted(in_val, in_idx, k: int, select_min: bool
     Returned values are GATHERED from the input, preserving its dtype."""
     from raft_tpu.matrix.select_k_types import f32_comparable_keys
 
+    fault_point("select_k_slotted")
     in_val = jnp.asarray(in_val)
     if not f32_comparable_keys(in_val.dtype):
         raise NotImplementedError(

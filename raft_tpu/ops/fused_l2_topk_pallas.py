@@ -34,6 +34,7 @@ the real row count); padded rows therefore never pollute slots.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -63,6 +64,25 @@ _PACK_PAD = float(2.0 ** 125)    # finite "never wins" sentinel
 # headroom for temporaries the estimator can't see.
 VMEM_LIMIT = 16 * 2 ** 20
 VMEM_BUDGET = 15 * 2 ** 20
+
+
+def vmem_budget() -> int:
+    """The scoped-VMEM fit budget ``fit_config``/``footprint_for``
+    validate against. ``RAFT_TPU_VMEM_BUDGET_MB`` (env) overrides the
+    built-in :data:`VMEM_BUDGET` — the derate knob for a generation
+    whose Mosaic limit differs from the calibrated v5e one, or for
+    operators who keep hitting real compile OOMs at configs the model
+    passes (the footprint factors are estimates; shrinking the budget
+    makes every fit predicate — production routing, the tune sweeps'
+    pruning, and the resilience degradation ladder's rung validation —
+    conservative in one place)."""
+    raw = os.environ.get("RAFT_TPU_VMEM_BUDGET_MB")
+    if raw:
+        try:
+            return int(float(raw) * (1 << 20))
+        except ValueError:
+            pass
+    return VMEM_BUDGET
 
 
 def vmem_footprint(T: int, Qb: int, d: int, passes: int,

@@ -1,0 +1,84 @@
+"""raft_tpu.resilience — fault injection, recovery policies, deadlines.
+
+The robustness layer the reference expresses as ``raft::interruptible``
++ ``RAFT_EXPECTS``/``RAFT_CUDA_TRY`` + NCCL abort/timeout handling,
+grown into a testable subsystem:
+
+- :mod:`~raft_tpu.resilience.faults` — named injection sites armed via
+  ``RAFT_TPU_FAULTS`` (deterministic nth-call / seeded-probabilistic
+  triggers), so OOM, device errors, collective timeout/hang, corrupt
+  persistent reads and NaN poisoning are all simulable at every hot
+  path. Statically gated by ``tools/check_instrumented.py``.
+- :mod:`~raft_tpu.resilience.policy` — bounded retry with backoff
+  (:func:`run_with_policy`, per-site :class:`RetryPolicy` via the
+  ``res.resilience`` slot) and the graceful-degradation ladders
+  (:func:`fused_degradation_ladder` for OOM,
+  :func:`degrade_merge` for collective failure), every step counted in
+  the metrics registry.
+- :mod:`~raft_tpu.resilience.deadline` — :func:`deadline` scopes that
+  convert hangs into :class:`~raft_tpu.core.error.DeadlineExceededError`
+  (with the active span stack) via the interruptible token.
+
+With ``RAFT_TPU_FAULTS`` unset and no deadline armed, the whole layer
+is null-object pass-through: one boolean check per fault site, zero
+extra dispatches, identical compile-cache behavior.
+"""
+
+from raft_tpu.core.error import (DeadlineExceededError, classify_xla_error,
+                                 device_errors)
+from raft_tpu.resilience.deadline import deadline
+from raft_tpu.resilience.faults import (DATA_KINDS, FAULT_KINDS,
+                                        INJECTIONS, KNOWN_SITES, FaultSpec,
+                                        InjectedDeviceError, InjectedFault,
+                                        InjectedOutOfMemory, InjectedTimeout,
+                                        clear as clear_faults,
+                                        configure as configure_faults,
+                                        active as faults_active,
+                                        fault_point, parse_faults)
+from raft_tpu.resilience.policy import (DEGRADATIONS, EXHAUSTED,
+                                        MERGE_LADDER, POISONED, RETRIES,
+                                        FusedRung, PoisonedOutputError,
+                                        PolicyTable, RetryPolicy,
+                                        degradation_count, degrade_merge,
+                                        fused_degradation_ladder,
+                                        get_policy_table, record_degradation,
+                                        record_exhausted, record_retry,
+                                        run_with_policy)
+
+__all__ = [
+    "DATA_KINDS",
+    "FAULT_KINDS",
+    "INJECTIONS",
+    "KNOWN_SITES",
+    "FaultSpec",
+    "InjectedDeviceError",
+    "InjectedFault",
+    "InjectedOutOfMemory",
+    "InjectedTimeout",
+    "clear_faults",
+    "configure_faults",
+    "faults_active",
+    "fault_point",
+    "parse_faults",
+    "DeadlineExceededError",
+    "classify_xla_error",
+    "device_errors",
+    "deadline",
+    "DEGRADATIONS",
+    "EXHAUSTED",
+    "MERGE_LADDER",
+    "POISONED",
+    "RETRIES",
+    "FusedRung",
+    "PoisonedOutputError",
+    "PolicyTable",
+    "RetryPolicy",
+    "degradation_count",
+    "degrade_merge",
+    "fused_degradation_ladder",
+    "get_policy_table",
+    "record_degradation",
+    "record_exhausted",
+    "record_retry",
+    "run_with_policy",
+]

@@ -1,0 +1,81 @@
+"""Deadline scopes: convert hangs into typed, diagnosable errors.
+
+(ref: core/interruptible.hpp — the reference converts a stuck stream
+wait into ``interrupted_exception`` only when someone calls ``cancel``
+from another thread; production NCCL deployments layer a watchdog on
+top. :func:`deadline` IS that watchdog, packaged: a scope that arms the
+calling thread's cancellation token from a timer thread, so every
+cooperative cancellation point inside the scope —
+``interruptible.synchronize``, ``interruptible.yield_``,
+``HostComms.sync_stream``, ``HostComms.barrier``, an injected ``hang``
+fault — raises :class:`~raft_tpu.core.error.DeadlineExceededError`
+within one poll interval of expiry, carrying the thread's active span
+stack for diagnosis.)
+
+Usage::
+
+    from raft_tpu.resilience import deadline
+
+    with deadline(30.0, label="sharded-merge"):
+        vals, ids = knn_fused_sharded(x, idx, k=64, mesh=mesh)
+        res.sync(vals, ids)          # polling wait — cancellable
+
+Scope semantics:
+
+- The deadline binds to the CALLING thread's token; work dispatched to
+  other threads is not covered (arm a scope per worker thread).
+- Only cooperative cancellation points convert: a non-polling blocking
+  call (``jax.block_until_ready``) cannot be interrupted mid-wait —
+  use ``res.sync`` / ``interruptible.synchronize``, which poll.
+- If the deadline fires while work is still running, the next
+  cancellation point raises; if the body completes first the scope
+  still raises at exit when the deadline has already expired (the
+  budget WAS exceeded — honest semantics for SLO accounting). A scope
+  that exits before expiry disarms its timer and is free.
+- Scopes nest; the innermost-to-expire wins. Exiting a scope restores
+  the token state it found (an outer deadline stays armed).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, Optional
+
+from raft_tpu.core import interruptible
+from raft_tpu.core.error import expects
+
+
+@contextlib.contextmanager
+def deadline(seconds: float, label: Optional[str] = None) -> Iterator[None]:
+    """Arm a watchdog that cancels this thread ``seconds`` from now.
+    See the module doc for the exact scope semantics."""
+    expects(seconds > 0, "deadline: seconds must be > 0 (got %s)",
+            seconds)
+    tok = interruptible.get_token()
+    info = {"seconds": float(seconds), "label": label or "deadline"}
+    fired = threading.Event()
+
+    def _fire():
+        # order matters: the info must be visible before the flag flips
+        # (yield_ reads the flag first, then the info)
+        tok.fired_deadline = info
+        fired.set()
+        tok.cancelled = True
+
+    timer = threading.Timer(float(seconds), _fire)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+        # consume a deadline that fired after the last cancellation
+        # point but before scope exit — the budget was exceeded
+        interruptible.yield_()
+    finally:
+        timer.cancel()
+        # un-poison the token if OUR deadline fired but was not
+        # consumed (e.g. a different exception is propagating) — a
+        # stale cancellation must not ambush the thread's next wait
+        if fired.is_set() and tok.fired_deadline is info:
+            tok.fired_deadline = None
+            tok.cancelled = False

@@ -1,0 +1,307 @@
+"""Fault injection: named sites, deterministic triggers, one env knob.
+
+Every hot path in the port carries a named *injection site* — a
+``fault_point("<site>")`` call in its non-jitted wrapper — so compile
+OOM, dispatch ``RESOURCE_EXHAUSTED``, collective timeout/hang, corrupt
+cache/tune-table reads, and NaN poisoning can all be simulated
+deterministically, without touching the code under test. (ref: the
+reference frames robustness as core vocabulary — ``RAFT_EXPECTS`` /
+``RAFT_CUDA_TRY`` / ``raft::interruptible``; fault *injection* is the
+missing half that makes those paths testable, the role nccl-tests'
+abort harness plays for NCCL.)
+
+DSL (env ``RAFT_TPU_FAULTS``, or :func:`configure` from tests)::
+
+    site:kind[@call=N][:p=F] [; site:kind ...]
+
+    RAFT_TPU_FAULTS="aot_compile:oom@call=2;merge_permute:timeout:p=1.0"
+
+- ``kind`` ∈ :data:`FAULT_KINDS`:
+  ``oom``      → raises :class:`InjectedOutOfMemory` (classifies like a
+                 RESOURCE_EXHAUSTED XlaRuntimeError);
+  ``error``    → raises :class:`InjectedDeviceError` (INTERNAL analog);
+  ``timeout``  → raises :class:`InjectedTimeout` (collective timeout —
+                 a recoverable DeviceError, NOT a deadline);
+  ``hang``     → blocks in an interruptible poll loop until cancelled —
+                 a :func:`raft_tpu.resilience.deadline` scope converts
+                 it into ``DeadlineExceededError``; a safety cap
+                 (``RAFT_TPU_FAULT_HANG_MAX_S``, default 30 s) raises
+                 InjectedTimeout so an unguarded test can't hang CI;
+  ``corrupt``/``nan`` → do NOT raise: ``fault_point`` returns the kind
+                 string and the site applies it (treat a cache read as
+                 torn, poison kernel output) — the site owns the data
+                 plane, the registry owns the trigger.
+- triggers: bare kind = every call; ``@call=N`` = exactly the Nth call
+  to that site (1-based — the deterministic inject-then-recover
+  pattern); ``p=F`` = per-call Bernoulli, derandomized by hashing
+  (site, kind, call index, ``RAFT_TPU_FAULTS_SEED``) — the same seed
+  replays the same fault schedule.
+
+With no faults configured the whole layer is a single module-global
+boolean check per site — the zero-overhead null-object contract the
+no-fault parity tests pin down.
+
+Injections are counted (``raft_tpu_fault_injections_total{site,kind}``)
+and emitted as ``fault`` events through the observability registry.
+``tools/check_instrumented.py``'s ``FAULT_SITES`` gate statically
+asserts every hot-path module keeps its sites — a new hot path cannot
+ship uninjectable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from raft_tpu.core.error import DeviceError, OutOfMemoryError
+
+FAULT_KINDS = ("oom", "error", "timeout", "hang", "corrupt", "nan")
+#: kinds fault_point RETURNS (site applies them) instead of raising
+DATA_KINDS = ("corrupt", "nan")
+
+INJECTIONS = "raft_tpu_fault_injections_total"
+
+#: site name → kinds that are meaningful there (advisory — the matrix
+#: test iterates this; ``fault_point`` accepts any registered name).
+#: tools/check_instrumented.py's FAULT_SITES table is the STATIC mirror
+#: of this registry (per defining module); a test pins them consistent.
+KNOWN_SITES: Dict[str, Tuple[str, ...]] = {
+    # runtime entry points (_aot_call)
+    "aot_compile": ("oom", "error"),
+    "aot_dispatch": ("oom", "error", "nan"),
+    # fused KNN, single-device and sharded
+    "knn_fused": ("oom", "error"),
+    "sharded_dispatch": ("oom", "error", "nan"),
+    "merge_permute": ("oom", "error", "timeout", "hang"),
+    "merge_allgather": ("oom", "error", "timeout", "hang"),
+    # select / distance / sparse / solver hot paths
+    "select_k": ("oom", "error"),
+    "select_k_chunked": ("oom", "error"),
+    "select_k_slotted": ("oom", "error"),
+    "pairwise_distance": ("oom", "error"),
+    "fused_l2nn": ("oom", "error"),
+    "tile_csr": ("oom", "error"),
+    "spmv_sharded": ("oom", "error"),
+    "solve_lap": ("oom", "error"),
+    # tuners + persistent stores
+    "autotune_fused": ("error",),
+    "autotune_sharded": ("error",),
+    "tune_table_read": ("corrupt",),
+    "plan_cache_read": ("corrupt",),
+    # host-side comms
+    "host_collective": ("oom", "error", "timeout", "hang"),
+    "host_barrier": ("error", "timeout", "hang"),
+    "host_sync": ("error", "hang"),
+}
+
+
+class InjectedFault:
+    """Marker mixin: tells an injected failure apart from a real one
+    (tests assert on it; recovery code must NOT — recovery treats
+    injected and real failures identically, that is the point)."""
+
+
+class InjectedOutOfMemory(OutOfMemoryError, InjectedFault):
+    """Injected RESOURCE_EXHAUSTED."""
+
+
+class InjectedDeviceError(DeviceError, InjectedFault):
+    """Injected INTERNAL/ABORTED-class device failure."""
+
+
+class InjectedTimeout(DeviceError, InjectedFault):
+    """Injected collective timeout — recoverable (merge-ladder) device
+    failure, deliberately NOT a DeadlineExceededError: a deadline is
+    the caller's global budget and is never retried."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One armed fault: site + kind + trigger (+ mutable call state)."""
+
+    site: str
+    kind: str
+    nth_call: Optional[int] = None    # fire exactly on this call (1-based)
+    probability: Optional[float] = None
+    calls: int = 0
+    fired: int = 0
+
+    def should_fire(self, seed: int) -> bool:
+        self.calls += 1
+        if self.nth_call is not None:
+            return self.calls == self.nth_call
+        if self.probability is not None:
+            h = hashlib.sha256(
+                f"{self.site}|{self.kind}|{self.calls}|{seed}".encode()
+            ).digest()
+            draw = int.from_bytes(h[:8], "big") / float(1 << 64)
+            return draw < self.probability
+        return True
+
+
+def parse_faults(spec: str) -> List[FaultSpec]:
+    """Parse the fault DSL (see module doc). Raises ``ValueError`` on a
+    malformed entry — callers that must not raise (the env loader)
+    catch and log instead."""
+    out: List[FaultSpec] = []
+    for raw in spec.split(";"):
+        entry = raw.strip()
+        if not entry:
+            continue
+        tokens = [t.strip() for t in entry.split(":")]
+        if len(tokens) < 2:
+            raise ValueError(f"fault entry {entry!r}: want site:kind[...]")
+        site = tokens[0]
+        kind_tok = tokens[1]
+        nth = None
+        if "@" in kind_tok:
+            kind_tok, _, mod = kind_tok.partition("@")
+            if not mod.startswith("call="):
+                raise ValueError(f"fault entry {entry!r}: unknown "
+                                 f"modifier {mod!r} (want @call=N)")
+            nth = int(mod[len("call="):])
+            if nth < 1:
+                raise ValueError(f"fault entry {entry!r}: call index "
+                                 f"must be ≥ 1")
+        kind = kind_tok.strip().lower()
+        if kind not in FAULT_KINDS:
+            raise ValueError(f"fault entry {entry!r}: kind {kind!r} not "
+                             f"in {FAULT_KINDS}")
+        prob = None
+        for extra in tokens[2:]:
+            if extra.startswith("p="):
+                prob = float(extra[2:])
+                if not (0.0 <= prob <= 1.0):
+                    raise ValueError(f"fault entry {entry!r}: p must be "
+                                     f"in [0, 1]")
+            elif extra.startswith("call="):
+                nth = int(extra[len("call="):])
+            elif extra:
+                raise ValueError(f"fault entry {entry!r}: unknown "
+                                 f"modifier {extra!r}")
+        out.append(FaultSpec(site=site, kind=kind, nth_call=nth,
+                             probability=prob))
+    return out
+
+
+_lock = threading.Lock()
+_active: Dict[str, List[FaultSpec]] = {}
+_armed = False          # module-global fast flag — THE no-fault fast path
+_seed = 0
+
+
+def _install(specs: List[FaultSpec], seed: Optional[int]) -> None:
+    global _armed, _seed
+    with _lock:
+        _active.clear()
+        for s in specs:
+            _active.setdefault(s.site, []).append(s)
+        if seed is not None:
+            _seed = int(seed)
+        _armed = bool(_active)
+
+
+def configure(spec: str, seed: Optional[int] = None) -> List[FaultSpec]:
+    """Arm faults programmatically (tests). Replaces the current set;
+    raises on a malformed spec. Returns the installed specs (their
+    mutable call state is live — tests can inspect ``fired``)."""
+    specs = parse_faults(spec)
+    _install(specs, seed)
+    return specs
+
+
+def clear() -> None:
+    """Disarm all faults (back to the zero-overhead null-object mode)."""
+    _install([], None)
+
+
+def active() -> bool:
+    """True when any fault is armed."""
+    return _armed
+
+
+def _load_env() -> None:
+    spec = os.environ.get("RAFT_TPU_FAULTS", "")
+    seed = os.environ.get("RAFT_TPU_FAULTS_SEED")
+    if not spec.strip():
+        return
+    try:
+        _install(parse_faults(spec), int(seed) if seed else None)
+    except (ValueError, TypeError) as e:
+        from raft_tpu.core.logger import log_error
+
+        log_error("RAFT_TPU_FAULTS=%r is malformed (%s) — NO faults "
+                  "armed", spec, e)
+
+
+_load_env()
+
+
+def _count_injection(site: str, kind: str) -> None:
+    try:
+        from raft_tpu.observability import get_registry
+
+        reg = get_registry()
+        reg.counter(INJECTIONS, {"site": site, "kind": kind},
+                    help="Injected faults, by site and kind").inc()
+        reg.emit({"type": "fault", "site": site, "kind": kind})
+    except Exception:
+        pass
+
+
+def _hang(site: str) -> None:
+    """Block until cancelled (deadline/cancel) — the injectable
+    collective hang. ``yield_`` raises out of the loop; the safety cap
+    keeps an unguarded hang from freezing a suite forever."""
+    from raft_tpu.core import interruptible
+
+    max_s = float(os.environ.get("RAFT_TPU_FAULT_HANG_MAX_S", "30"))
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < max_s:
+        interruptible.yield_()
+        time.sleep(0.001)
+    raise InjectedTimeout(
+        f"injected hang at {site!r} gave up after {max_s}s with no "
+        f"cancellation — guard it with resilience.deadline(...)")
+
+
+def fault_point(site: str) -> Optional[str]:
+    """The per-site injection hook. Returns None on the (overwhelmingly
+    common) pass-through; raises for ``oom``/``error``/``timeout``
+    (and ``hang``, via cancellation); returns ``"corrupt"``/``"nan"``
+    for the data-plane kinds so the site applies the corruption
+    itself. Thread-safe; call/fire state is per armed spec."""
+    if not _armed:
+        return None
+    with _lock:
+        specs = _active.get(site)
+        if not specs:
+            return None
+        firing = None
+        for s in specs:
+            if s.should_fire(_seed):
+                s.fired += 1
+                firing = s
+                break
+        if firing is None:
+            return None
+    kind = firing.kind
+    _count_injection(site, kind)
+    from raft_tpu.core.logger import log_warn
+
+    log_warn("fault injected: site=%s kind=%s (call %d)", site, kind,
+             firing.calls)
+    if kind == "oom":
+        raise InjectedOutOfMemory(
+            f"injected RESOURCE_EXHAUSTED at {site!r}")
+    if kind == "error":
+        raise InjectedDeviceError(f"injected INTERNAL error at {site!r}")
+    if kind == "timeout":
+        raise InjectedTimeout(f"injected collective timeout at {site!r}")
+    if kind == "hang":
+        _hang(site)
+    return kind          # corrupt / nan — the site applies it

@@ -12,8 +12,10 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.error import device_errors
 from raft_tpu.core.resources import ensure_resources
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+from raft_tpu.resilience import fault_point, run_with_policy
 
 
 def _aot_call(res, name: str, statics: tuple, fn, *args):
@@ -27,7 +29,18 @@ def _aot_call(res, name: str, statics: tuple, fn, *args):
     ``cost_analysis`` FLOPs/bytes and ``memory_analysis`` peak HBM — into
     ``res.profiler``, keyed by the same (entry, statics, shapes, sharding)
     signature as the cache, so roofline attribution covers every runtime
-    entry without a second lowering (cache hits reuse the stored record)."""
+    entry without a second lowering (cache hits reuse the stored record).
+
+    Resilience contract: compile AND dispatch run inside
+    ``device_errors`` — callers never see raw jaxlib exceptions, only
+    the classified taxonomy (OutOfMemoryError / DeviceError /
+    DeadlineExceededError) — and the whole attempt is retried under the
+    handle's ``runtime`` RetryPolicy (a failed compile is NOT cached,
+    so a retry recompiles). Fault sites: ``aot_compile`` (inside the
+    compile miss) and ``aot_dispatch`` (before every execution).
+    Dispatch is async — an OOM XLA reports at completion time surfaces
+    at the caller's sync point, already classified if the caller syncs
+    through ``res.sync``/``device_errors``."""
     args = tuple(jnp.asarray(a) for a in args)
     # sharding/placement is part of the compiled executable's signature —
     # a cache hit with differently-committed args would raise at dispatch
@@ -36,15 +49,23 @@ def _aot_call(res, name: str, statics: tuple, fn, *args):
                   str(getattr(a, "sharding", None))) for a in args))
 
     def _compile():
-        compiled = jax.jit(fn).lower(*args).compile()
+        fault_point("aot_compile")
+        with device_errors(f"{name} [compile]"):
+            compiled = jax.jit(fn).lower(*args).compile()
         try:
             res.profiler.capture(name, compiled, key=str(key[1:]))
         except Exception:
             pass  # cost capture must never fail the entry point
         return compiled
 
-    compiled = res.compile_cache.get_or_compile(key, _compile)
-    return compiled(*args)
+    def _attempt(attempt):
+        compiled = res.compile_cache.get_or_compile(key, _compile)
+        fault_point("aot_dispatch")
+        with device_errors(name):
+            return compiled(*args)
+
+    return run_with_policy(f"runtime.{name}", _attempt,
+                           policy=res.resilience.policy_for("runtime"))
 
 
 def lanczos_solver(res, rows, cols, vals, n: int, n_components: int,

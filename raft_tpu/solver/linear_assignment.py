@@ -24,6 +24,7 @@ import numpy as np
 
 from raft_tpu.core.error import expects
 from raft_tpu.observability import instrument
+from raft_tpu.resilience import fault_point
 
 
 @partial(jax.jit, static_argnames=("n",))
@@ -352,6 +353,7 @@ class LinearAssignmentProblem:
 def solve_lap(res, cost, tol: float = None):
     """Functional convenience wrapper. See
     :meth:`LinearAssignmentProblem.solve` for the ``tol`` contract."""
+    fault_point("solve_lap")
     cost = jnp.asarray(cost)
     n = cost.shape[-1]
     lap = LinearAssignmentProblem(res, n)

@@ -30,9 +30,18 @@ Config (env):
   (default 200000): tiny conversions are cheaper than the disk round
   trip and would litter the cache (the tier-1 suite's matrices stay
   below it unless a test opts in).
+- ``RAFT_TPU_TILE_PLAN_CACHE_MAX_MB`` — total on-disk size cap
+  (default 2048 MB; ``0``/negative = unbounded). Enforced after every
+  save with least-recently-USED eviction: a hit touches its file's
+  mtime, so long-lived structures survive and one-off fingerprints age
+  out — without the cap the cache grows without bound across
+  processes. Evictions are counted
+  (``raft_tpu_tile_plan_cache_evictions_total``).
 
 Hits/misses are counted in the observability registry
-(``raft_tpu_tile_plan_cache_{hits,misses}_total``).
+(``raft_tpu_tile_plan_cache_{hits,misses}_total``). Reads carry the
+``plan_cache_read`` fault-injection site: an injected ``corrupt`` read
+degrades to a miss (recompute), exactly like a real torn file.
 """
 
 from __future__ import annotations
@@ -46,9 +55,11 @@ import numpy as np
 
 PLAN_VERSION = 1
 _DEFAULT_MIN_NNZ = 200_000
+_DEFAULT_MAX_MB = 2048
 
 HITS = "raft_tpu_tile_plan_cache_hits_total"
 MISSES = "raft_tpu_tile_plan_cache_misses_total"
+EVICTIONS = "raft_tpu_tile_plan_cache_evictions_total"
 
 
 def cache_dir() -> Optional[str]:
@@ -72,6 +83,20 @@ def min_nnz() -> int:
 
 def enabled_for(nnz: int) -> bool:
     return cache_dir() is not None and nnz >= min_nnz()
+
+
+def max_cache_bytes() -> Optional[int]:
+    """Size cap in bytes, or None (unbounded) for a non-positive /
+    unparseable ``RAFT_TPU_TILE_PLAN_CACHE_MAX_MB``... 0 disables the
+    cap, not the cache."""
+    raw = os.environ.get("RAFT_TPU_TILE_PLAN_CACHE_MAX_MB")
+    try:
+        mb = float(raw) if raw is not None else float(_DEFAULT_MAX_MB)
+    except ValueError:
+        mb = float(_DEFAULT_MAX_MB)
+    if mb <= 0:
+        return None
+    return int(mb * (1 << 20))
 
 
 def _digest(*parts) -> str:
@@ -124,11 +149,20 @@ def load_plan(fingerprint: str,
               vals_digest: Optional[str] = None) -> Optional[Dict]:
     """The cached plan arrays for ``fingerprint``, or None (miss). When
     ``vals_digest`` is given, a stored plan with a different values
-    digest is a miss (the plan's arrays bake those values in)."""
+    digest is a miss (the plan's arrays bake those values in). A hit
+    touches the file's mtime (the LRU clock for the size cap)."""
     d = cache_dir()
     if d is None:
         return None
     path = os.path.join(d, f"{fingerprint}.npz")
+    try:
+        from raft_tpu.resilience import fault_point
+
+        if fault_point("plan_cache_read") == "corrupt":
+            _count(False)
+            return None     # injected torn read → honest miss
+    except ImportError:
+        pass
     try:
         with np.load(path, allow_pickle=False) as z:
             meta_ver = int(z["__version__"])
@@ -146,6 +180,10 @@ def load_plan(fingerprint: str,
         _count(False)
         return None
     _count(True)
+    try:
+        os.utime(path)          # LRU touch: a hit keeps the plan young
+    except OSError:
+        pass
     return out
 
 
@@ -170,6 +208,7 @@ def save_plan(fingerprint: str, arrays: Dict[str, np.ndarray],
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)
+        _enforce_cap(d)
         return True
     except Exception as e:
         try:
@@ -180,3 +219,53 @@ def save_plan(fingerprint: str, arrays: Dict[str, np.ndarray],
         except Exception:
             pass
         return False
+
+
+def _enforce_cap(d: str) -> int:
+    """Evict least-recently-used plans until the directory fits the
+    size cap; returns the number evicted. Never raises — a racing
+    process deleting a file concurrently is fine."""
+    cap = max_cache_bytes()
+    if cap is None:
+        return 0
+    evicted = 0
+    try:
+        entries = []
+        with os.scandir(d) as it:
+            for e in it:
+                if not e.name.endswith(".npz"):
+                    continue
+                try:
+                    st = e.stat()
+                    entries.append((st.st_mtime, st.st_size, e.path))
+                except OSError:
+                    continue
+        total = sum(size for _, size, _ in entries)
+        entries.sort()               # oldest mtime (least recently used) first
+        for _, size, path in entries:
+            if total <= cap:
+                break
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            total -= size
+            evicted += 1
+        if evicted:
+            try:
+                from raft_tpu.observability import get_registry
+
+                reg = get_registry()
+                if reg.enabled:
+                    reg.counter(EVICTIONS,
+                                help="Tile plans evicted by the LRU "
+                                     "size cap").inc(evicted)
+            except Exception:
+                pass
+            from raft_tpu.core.logger import log_info
+
+            log_info("tile-plan cache: evicted %d LRU plan(s) to fit "
+                     "the %d-byte cap", evicted, cap)
+    except Exception:
+        pass
+    return evicted

@@ -45,6 +45,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
 from raft_tpu.sparse.tiled import TiledELL, tile_csr
 from raft_tpu.observability import instrument
+from raft_tpu.resilience import fault_point
 
 
 @jax.tree_util.register_dataclass
@@ -213,6 +214,7 @@ def spmv_sharded(S: ShardedTiledELL, x) -> jax.Array:
     the jitted Lanczos loop (GSPMD all-gathers y where needed)."""
     from raft_tpu.ops.spmv_pallas import spmv_tiled
 
+    fault_point("spmv_sharded")
     x = jnp.asarray(x, jnp.float32)
     y = _shard_map_blocks(S, lambda t, xr: spmv_tiled(t, xr)[None, :], x)
     return y.reshape(-1)[:S.shape[0]]

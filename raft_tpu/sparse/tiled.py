@@ -46,6 +46,7 @@ import numpy as np
 
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
 from raft_tpu.observability import instrument
+from raft_tpu.resilience import fault_point
 
 
 @jax.tree_util.register_pytree_node_class
@@ -568,6 +569,7 @@ def tile_csr(A, C: int = 512, R: int = 256, E: int = 2048,
     "native" forces the LEGACY scalar-perm C++ layout (kept for
     comparison/compat). All layouts produce identical SpMV results
     (tested)."""
+    fault_point("tile_csr")
     if impl not in ("auto", "device", "numpy", "native"):
         raise ValueError(f"tile_csr: impl must be 'auto', 'device', "
                          f"'numpy' or 'native', got {impl!r}")

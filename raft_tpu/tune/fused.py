@@ -41,8 +41,51 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from raft_tpu.observability import instrument
+from raft_tpu.resilience import fault_point
 
 TUNE_SCHEMA_VERSION = 3
+
+# counter: tuned-table loads that degraded to built-in defaults, with a
+# reason label ("tune.table_degraded" in the metrics docs) — the silent
+# half of the degrade-to-defaults contract made loud. Reasons:
+# unreadable / invalid / future_schema / row_rejected / shard_mismatch /
+# missing (explicit env path only — an absent default table is the
+# normal state, not a degradation).
+TABLE_DEGRADED = "raft_tpu_tune_table_degraded_total"
+
+_degraded_warned: set = set()
+
+
+def table_degraded(table: str, reason: str, detail: str = "") -> None:
+    """Count one degraded tuned-table load under
+    :data:`TABLE_DEGRADED` ``{table, reason}`` and log at WARN once per
+    (table, reason) per process — every later occurrence stays counted
+    but quiet (a serving loop hitting a stale table must not spam)."""
+    try:
+        from raft_tpu.observability import get_registry
+
+        reg = get_registry()
+        reg.counter(TABLE_DEGRADED, {"table": table, "reason": reason},
+                    help="Tuned-table loads degraded to built-in "
+                         "defaults, by reason").inc()
+        reg.emit({"type": "tune_table_degraded", "table": table,
+                  "reason": reason, "detail": detail[:200]})
+    except Exception:
+        pass
+    key = (table, reason)
+    if key not in _degraded_warned:
+        _degraded_warned.add(key)
+        from raft_tpu.core.logger import log_warn
+
+        log_warn("tune table %r degraded to built-ins (%s)%s — this "
+                 "WARN fires once per process; the "
+                 "tune.table_degraded counter keeps counting", table,
+                 reason, f": {detail}" if detail else "")
+
+
+def _reset_degraded_warnings() -> None:
+    """Test hook: re-arm the once-per-process WARN."""
+    _degraded_warned.clear()
 
 # the driver benchmark shape (bench.py / BASELINE config 2, one-chip)
 DRIVER_SHAPE = (2048, 1_000_000, 128, 64)
@@ -245,6 +288,7 @@ def autotune_fused(res=None, shape: Sequence[int] = DRIVER_SHAPE,
     from raft_tpu.core.resources import ensure_resources
     from raft_tpu.observability import costmodel
 
+    fault_point("autotune_fused")
     res = ensure_resources(res)
     nq, m, d, k = (int(v) for v in shape[:4])
     if measure is None:

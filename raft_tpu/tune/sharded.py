@@ -43,8 +43,10 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from raft_tpu.observability import instrument
+from raft_tpu.resilience import fault_point
 from raft_tpu.tune.fused import (TUNE_SCHEMA_VERSION, provenance,
-                                 validate_tune_table, write_tune_table)
+                                 table_degraded, validate_tune_table,
+                                 write_tune_table)
 
 # the north-star workload (BENCH_NORTHSTAR.json) — the shape that is at
 # the one-chip capacity wall and exists to be sharded
@@ -178,30 +180,43 @@ def sharded_config(p: Optional[int] = None) -> Dict:
     if not tbl:
         return {}
     if p is not None and tbl.get("n_shards") not in (None, int(p)):
+        table_degraded("sharded", "shard_mismatch",
+                       f"table tuned for p={tbl.get('n_shards')}, "
+                       f"call wants p={p}")
         return {}
     best = tbl.get("best")
     return dict(best) if isinstance(best, dict) else {}
 
 
 def _load_sharded_table() -> Optional[Dict]:
-    from raft_tpu.core.logger import log_info, log_warn
+    from raft_tpu.core.logger import log_info
     from raft_tpu.native import _REPO_ROOT
 
-    path = os.environ.get("RAFT_TPU_TUNE_SHARDED") or os.path.join(
-        _REPO_ROOT, "TUNE_SHARDED.json")
+    path_env = os.environ.get("RAFT_TPU_TUNE_SHARDED")
+    path = path_env or os.path.join(_REPO_ROOT, "TUNE_SHARDED.json")
+    if fault_point("tune_table_read") == "corrupt":
+        table_degraded("sharded", "unreadable",
+                       f"{path}: injected corrupt table read")
+        return None
     try:
         with open(path) as f:
             tbl = json.load(f)
-    except Exception:
+    except FileNotFoundError:
+        if path_env:
+            table_degraded("sharded", "missing", path)
+        return None
+    except Exception as e:
+        table_degraded("sharded", "unreadable",
+                       f"{path}: {type(e).__name__}: {e}")
         return None
     errors = validate_tune_table(tbl)
     if errors:
-        log_warn("TUNE_SHARDED table %s rejected (%s) — using built-in "
-                 "sharded defaults", path, "; ".join(errors))
+        table_degraded("sharded", "invalid",
+                       f"{path}: " + "; ".join(errors))
         return None
     if int(tbl.get("schema", 1)) > TUNE_SCHEMA_VERSION:
-        log_warn("TUNE_SHARDED table %s has future schema %s — using "
-                 "built-in sharded defaults", path, tbl.get("schema"))
+        table_degraded("sharded", "future_schema",
+                       f"{path}: schema {tbl.get('schema')}")
         return None
     prov = tbl.get("provenance", {})
     log_info("sharded_config: loaded %s (schema %s, chip=%s, "
@@ -235,6 +250,7 @@ def autotune_sharded(res=None, shape: Sequence[int] = NORTHSTAR_SHAPE,
 
     from raft_tpu.core.resources import ensure_resources
 
+    fault_point("autotune_sharded")
     res = ensure_resources(res)
     nq, m, d, k = (int(v) for v in shape[:4])
     if p is None:

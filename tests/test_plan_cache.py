@@ -142,6 +142,69 @@ def test_fingerprint_sensitivity():
         "ell-v2", (100, 100), (256, 512, 2048), r, c)    # kind
 
 
+@pytest.mark.parametrize("mutate", [
+    lambda b: b"",                                   # empty file
+    lambda b: b[: max(1, len(b) // 3)],              # truncated
+    lambda b: b"\x00" * len(b),                      # zeroed
+    lambda b: b'{"json": "not an npz at all"}',      # garbage JSON
+    lambda b: b[:-7] + b"garbage",                   # torn tail
+])
+def test_corrupt_entry_fuzz_never_raises(cache_env, mutate):
+    """ISSUE 5 satellite: every flavor of on-disk corruption degrades
+    to a recompute-and-rewrite — the conversion path NEVER sees the
+    exception."""
+    A, *_ = _coo()
+    t1 = tile_csr(A, impl="numpy")
+    [f] = [f for f in os.listdir(cache_env) if f.endswith(".npz")]
+    raw = (cache_env / f).read_bytes()
+    (cache_env / f).write_bytes(mutate(raw))
+    t2 = tile_csr(A, impl="numpy")          # miss + rewrite, no raise
+    _ell_equal(t1, t2)
+
+
+def test_lru_size_cap_evicts_oldest(cache_env, monkeypatch):
+    """The size cap evicts least-recently-USED plans (a hit refreshes
+    its file's mtime) and counts evictions."""
+    from raft_tpu.observability import get_registry
+
+    def fp(i):
+        return f"{i:032x}"
+
+    payload = {"a": np.zeros(1 << 14, np.float32)}   # ~64 KiB each
+    # generous cap first: everything fits
+    monkeypatch.setenv("RAFT_TPU_TILE_PLAN_CACHE_MAX_MB", "10")
+    for i in range(3):
+        assert plan_cache.save_plan(fp(i), payload)
+    assert len(list(cache_env.glob("*.npz"))) == 3
+    # age plan 0 and 1, then touch 0 via a HIT so 1 is the LRU victim
+    for i in (0, 1):
+        os.utime(cache_env / f"{fp(i)}.npz", (1, 1))
+    assert plan_cache.load_plan(fp(0)) is not None
+    before = sum(m.value for m in get_registry().collect()
+                 if m.name == plan_cache.EVICTIONS)
+    # cap that holds ~2 plans: the next save must evict the LRU (1)
+    monkeypatch.setenv("RAFT_TPU_TILE_PLAN_CACHE_MAX_MB", "0.15")
+    assert plan_cache.save_plan(fp(3), payload)
+    remaining = {p.name for p in cache_env.glob("*.npz")}
+    assert f"{fp(1)}.npz" not in remaining      # LRU victim gone
+    assert f"{fp(3)}.npz" in remaining          # newest survives
+    assert f"{fp(0)}.npz" in remaining          # recently-hit survives
+    after = sum(m.value for m in get_registry().collect()
+                if m.name == plan_cache.EVICTIONS)
+    assert after > before
+
+
+def test_size_cap_env_parsing(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_TILE_PLAN_CACHE_MAX_MB", raising=False)
+    assert plan_cache.max_cache_bytes() == 2048 << 20
+    monkeypatch.setenv("RAFT_TPU_TILE_PLAN_CACHE_MAX_MB", "1.5")
+    assert plan_cache.max_cache_bytes() == int(1.5 * (1 << 20))
+    monkeypatch.setenv("RAFT_TPU_TILE_PLAN_CACHE_MAX_MB", "0")
+    assert plan_cache.max_cache_bytes() is None      # cap disabled
+    monkeypatch.setenv("RAFT_TPU_TILE_PLAN_CACHE_MAX_MB", "junk")
+    assert plan_cache.max_cache_bytes() == 2048 << 20
+
+
 def test_cache_counters(cache_env):
     from raft_tpu.observability import get_registry
 

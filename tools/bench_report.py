@@ -170,6 +170,12 @@ def check_multichip(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
         return SKIP, "no MULTICHIP artifact to gate"
     if newest.get("skipped"):
         return SKIP, "latest MULTICHIP round skipped (no devices)"
+    mrd = newest.get("resilience_degradations")
+    if isinstance(mrd, (int, float)) and mrd > 0:
+        return SKIP, (
+            f"latest MULTICHIP round recorded {mrd:g} resilience "
+            f"degradation ladder step(s) — a degraded run is history, "
+            f"never gated and never baseline material")
     if not newest.get("ok", True):
         return REGRESS, ("latest MULTICHIP round failed (ok=false) — "
                          "the distributed path regressed")
@@ -280,6 +286,12 @@ def check_regression(record: Optional[Dict], baseline: Optional[Dict],
     if record.get("degraded"):
         return SKIP, ("latest artifact is degraded (outage/CPU fallback)"
                       " — not gated")
+    rd = record.get("resilience_degradations")
+    if isinstance(rd, (int, float)) and rd > 0:
+        return SKIP, (
+            f"latest artifact recorded {rd:g} resilience degradation "
+            f"ladder step(s) — numbers from a degraded run are "
+            f"history, never gated and never baseline material")
     value = record.get("value")
     if not isinstance(value, (int, float)):
         return SKIP, "latest artifact has no numeric value"

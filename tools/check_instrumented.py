@@ -72,6 +72,35 @@ SHARDED_MERGE_SITES: Dict[str, Sequence[str]] = {
 # comms.py must register these collective labels with _count(...)
 COUNTED_COLLECTIVES = ("collective_permute", "device_send")
 
+# module (repo-relative) → fault-injection sites it must carry: a call
+# ``fault_point("<site>")`` with the literal site name (see
+# raft_tpu/resilience/faults.py). EVERY module in HOT_PATHS must appear
+# here with ≥ 1 site — a hot path that cannot be fault-injected cannot
+# be tested under failure, which is exactly the regression this gate
+# exists to catch. Site names must also exist in faults.KNOWN_SITES
+# (pinned by tests/test_resilience.py).
+FAULT_SITES: Dict[str, Sequence[str]] = {
+    "raft_tpu/runtime/entry_points.py": ("aot_compile", "aot_dispatch"),
+    "raft_tpu/distance/knn_sharded.py": ("sharded_dispatch",
+                                         "merge_permute",
+                                         "merge_allgather"),
+    "raft_tpu/distance/knn_fused.py": ("knn_fused", "tune_table_read"),
+    "raft_tpu/matrix/select_k.py": ("select_k",),
+    "raft_tpu/matrix/select_k_chunked.py": ("select_k_chunked",),
+    "raft_tpu/matrix/select_k_slotted.py": ("select_k_slotted",),
+    "raft_tpu/distance/pairwise.py": ("pairwise_distance",),
+    "raft_tpu/distance/fused_l2nn.py": ("fused_l2nn",),
+    "raft_tpu/sparse/tiled.py": ("tile_csr",),
+    "raft_tpu/sparse/sharded.py": ("spmv_sharded",),
+    "raft_tpu/solver/linear_assignment.py": ("solve_lap",),
+    "raft_tpu/tune/fused.py": ("autotune_fused",),
+    "raft_tpu/tune/sharded.py": ("autotune_sharded",
+                                 "tune_table_read"),
+    "raft_tpu/sparse/plan_cache.py": ("plan_cache_read",),
+    "raft_tpu/comms/host_comms.py": ("host_collective", "host_barrier",
+                                     "host_sync"),
+}
+
 # defining module → (kernel-variant entry points, consuming module):
 # the grid-order variants must EXIST where the footprint model and the
 # autotuner expect them, and the consumer must actually reference them
@@ -182,6 +211,55 @@ def check_kernel_variants(root: str = _REPO_ROOT,
     return errors
 
 
+def _fault_point_sites(tree: ast.Module) -> set:
+    """Literal site names passed to ``fault_point(...)`` calls (plain
+    name or attribute spelling)."""
+    sites = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        func = node.func
+        name = (func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else None)
+        if name == "fault_point" and isinstance(node.args[0],
+                                                ast.Constant):
+            sites.add(node.args[0].value)
+    return sites
+
+
+def check_fault_sites(root: str = _REPO_ROOT,
+                      sites: Dict[str, Sequence[str]] = None,
+                      hot_paths: Dict[str, Sequence[str]] = None
+                      ) -> List[str]:
+    """Violations for :data:`FAULT_SITES` (empty = clean): every listed
+    module carries every listed ``fault_point("<site>")`` call, and
+    every HOT_PATHS module is covered by at least one site — a new hot
+    path cannot ship uninjectable."""
+    sites = FAULT_SITES if sites is None else sites
+    hot_paths = HOT_PATHS if hot_paths is None else hot_paths
+    errors: List[str] = []
+    for rel in sorted(hot_paths):
+        if rel not in sites:
+            errors.append(
+                f"{rel}: hot-path module has no FAULT_SITES entry — "
+                f"every hot path must register a fault-injection site")
+    for rel, names in sorted(sites.items()):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            errors.append(f"{rel}: fault-site module missing")
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        found = _fault_point_sites(tree)
+        for site in names:
+            if site not in found:
+                errors.append(
+                    f"{rel}: no fault_point({site!r}) call — the hot "
+                    f"path would ship uninjectable (see "
+                    f"raft_tpu/resilience/faults.py)")
+    return errors
+
+
 def check_sharded_merge(root: str = _REPO_ROOT,
                         sites: Dict[str, Sequence[str]] = None,
                         counted: Sequence[str] = None) -> List[str]:
@@ -261,6 +339,7 @@ def check(root: str = _REPO_ROOT,
         errors.extend(check_cost_capture(root))
         errors.extend(check_kernel_variants(root))
         errors.extend(check_sharded_merge(root))
+        errors.extend(check_fault_sites(root))
     return errors
 
 
@@ -278,7 +357,9 @@ def main(argv: Sequence[str] = ()) -> int:
               f"kernel variants present + consumed; "
               f"{sum(len(v) for v in SHARDED_MERGE_SITES.values())} "
               f"sharded-merge sites + "
-              f"{len(COUNTED_COLLECTIVES)} counted collectives")
+              f"{len(COUNTED_COLLECTIVES)} counted collectives; "
+              f"{sum(len(v) for v in FAULT_SITES.values())} fault-"
+              f"injection sites in {len(FAULT_SITES)} modules")
     return 1 if errors else 0
 
 
