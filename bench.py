@@ -37,8 +37,10 @@ import time
 
 import numpy as np
 
-_LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "BENCH_LAST_GOOD.json")
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+_LAST_GOOD = os.path.join(_REPO_DIR, "BENCH_LAST_GOOD.json")
+_TRACE_PATH = os.path.join(_REPO_DIR, "BENCH_TRACE.json")
+_DRIFT_PATH = os.path.join(_REPO_DIR, "DRIFT_LEDGER.json")
 SCHEMA = 2  # bumped when the headline metric's meaning changes
 #             (v2: headline = certified-bf16 p1 since round 3; p3 extras)
 
@@ -165,6 +167,32 @@ def _load_last_good():
     except Exception:
         pass
     return None
+
+
+def _write_flight_artifacts(drift_checked: bool) -> None:
+    """Perfetto trace of the run (BENCH_TRACE.json — micro-batch
+    overlap and compile/dispatch timing become visually verifiable at
+    https://ui.perfetto.dev) + the durable drift ledger (this process's
+    model-vs-measured entries merged into DRIFT_LEDGER.json, which
+    ``bench_report --check`` gates). Must never fail the bench."""
+    try:
+        from raft_tpu.observability import export_perfetto
+        from raft_tpu.observability.timeline import (DriftLedger,
+                                                     get_drift_ledger)
+
+        trace = export_perfetto()
+        trace["raft_tpu"] = {"artifact": "bench.py",
+                             "drift_checked": drift_checked}
+        with open(_TRACE_PATH, "w") as f:
+            json.dump(trace, f, indent=1, default=str)
+            f.write("\n")
+        if len(get_drift_ledger()):
+            disk = DriftLedger.load(_DRIFT_PATH)
+            disk.merge(get_drift_ledger())
+            disk.save(_DRIFT_PATH)
+    except Exception as e:
+        print(f"bench: flight/drift artifact write failed: {e}",
+              file=sys.stderr)
 
 
 def _save_last_good(result: dict) -> None:
@@ -353,6 +381,12 @@ def main():
         if isinstance(measured_bytes, (int, float)) and measured_bytes > 0:
             result["model_vs_measured_bytes"] = round(
                 traffic_model["total_bytes"] / measured_bytes, 4)
+
+    # drift_checked: True only when this round's MEASURED numbers fed
+    # the drift ledger (a real-hardware run of the fused path), so
+    # bench_report can tell calibrated rounds from modeled ones
+    result["drift_checked"] = platform == "tpu" and not fused_failed
+    _write_flight_artifacts(result["drift_checked"])
 
     if platform == "tpu" and not fused_failed:
         _save_last_good(result)

@@ -38,6 +38,8 @@ import numpy as np
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 OUT_PATH = os.path.join(_REPO, "MULTICHIP_SHARDED.json")
+TRACE_PATH = os.path.join(_REPO, "MULTICHIP_SHARDED_TRACE.json")
+DRIFT_PATH = os.path.join(_REPO, "DRIFT_LEDGER.json")
 SCHEMA = 1
 
 # per-platform shapes: the TPU shape is the north-star workload scaled
@@ -151,6 +153,16 @@ def main() -> int:
                 entry["predicted_seconds"] = secs
                 entry["model_merge_seconds"] = ici_time_model(
                     p, nq, k, strat, spec)["merge_seconds"]
+                # prediction side of the drift ledger: the modeled
+                # ranking this site trusts until a measured TPU round
+                # recalibrates it (measured=False — never drift-gated)
+                from raft_tpu.observability.timeline import record_drift
+
+                record_drift(f"bench_sharded.{strat}",
+                             predicted_seconds=secs,
+                             predicted_bytes=wire[
+                                 "wire_bytes_per_device"],
+                             measured=False, platform="cpu")
             entry["gbps"] = round(eff_bytes / secs / 1e9, 2) if secs \
                 else None
             # busbw fraction: achieved ICI rate over the generation's
@@ -176,6 +188,9 @@ def main() -> int:
         "ok": ok,
         "skipped": False,
         "measured": measured,
+        # calibrated-vs-modeled provenance: measured rounds feed the
+        # drift ledger; modeled rounds never drift-gate
+        "drift_checked": measured,
         "degraded": not measured,
         "chip": spec.name,
         "ici_bw": spec.ici_bw,
@@ -187,6 +202,28 @@ def main() -> int:
     with open(OUT_PATH, "w") as f:
         json.dump(result, f, indent=1)
         f.write("\n")
+    # Perfetto trace artifact: the flight-recorder ring of this run —
+    # micro-batch kernel vs merge-collective overlap becomes VISUALLY
+    # verifiable (open at https://ui.perfetto.dev) — plus the durable
+    # drift ledger. Neither may fail the benchmark.
+    try:
+        from raft_tpu.observability import export_perfetto
+        from raft_tpu.observability.timeline import (DriftLedger,
+                                                     get_drift_ledger)
+
+        trace = export_perfetto()
+        trace["raft_tpu"] = {"artifact": "bench_sharded.py",
+                             "drift_checked": measured}
+        with open(TRACE_PATH, "w") as f:
+            json.dump(trace, f, indent=1, default=str)
+            f.write("\n")
+        if len(get_drift_ledger()):
+            disk = DriftLedger.load(DRIFT_PATH)
+            disk.merge(get_drift_ledger())
+            disk.save(DRIFT_PATH)
+    except Exception as e:
+        print(f"bench_sharded: flight/drift artifact write failed: {e}",
+              file=sys.stderr)
     print(json.dumps(result))
     return 0 if ok else 1
 

@@ -132,6 +132,28 @@ class Fixture:
             result.update({
                 (k if str(k).startswith("model_") else f"model_{k}"): v
                 for k, v in model.items()})
+        # drift ledger: the cost model's prediction vs THIS measurement,
+        # per site. predicted_seconds is the roofline-perfect time the
+        # model says this executable needs (roofline_frac · measured);
+        # ``measured`` is True only on real TPU hardware — CPU-suite
+        # entries are model-shape evidence and are never drift-gated
+        # (tools/bench_report.py --check gates the measured ones).
+        try:
+            from raft_tpu.observability.timeline import record_drift
+
+            rf = result.get("roofline_frac")
+            if isinstance(rf, (int, float)) and rf > 0:
+                record_drift(
+                    bench_name,
+                    predicted_seconds=rf * result["seconds"],
+                    predicted_bytes=result.get(
+                        "model_total_bytes", result.get("bytes_accessed")),
+                    measured_seconds=result["seconds"],
+                    measured_bytes=result.get("bytes_accessed"),
+                    measured=jax.default_backend() == "tpu",
+                    platform=jax.default_backend())
+        except Exception:
+            pass
         from raft_tpu.observability import record_benchmark
 
         record_benchmark(bench_name, result)

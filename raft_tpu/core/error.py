@@ -24,9 +24,28 @@ class LogicError(RaftException):
     (ref: core/error.hpp ``raft::logic_error``)"""
 
 
+def _flight_tail() -> List[dict]:
+    """Last ~64 flight-recorder events at error-construction time —
+    attached to device/deadline errors the way the span stack is, so a
+    failure carries its own timeline. [] when tracing is disabled (no
+    allocation); NEVER raises (an error constructor must not fail)."""
+    try:
+        from raft_tpu.observability.flight import error_tail
+
+        return error_tail()
+    except Exception:
+        return []
+
+
 class DeviceError(RaftException):
     """Accelerator-side failure (XLA compile/runtime error surfaced to the
-    host). (ref: core/error.hpp ``raft::cuda_error``)"""
+    host). Carries ``flight_tail`` — the last ~64 timeline events at
+    construction time (see :mod:`raft_tpu.observability.flight`).
+    (ref: core/error.hpp ``raft::cuda_error``)"""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.flight_tail = _flight_tail()
 
 
 class OutOfMemoryError(DeviceError):
@@ -36,9 +55,10 @@ class OutOfMemoryError(DeviceError):
 class DeadlineExceededError(RaftException):
     """A :func:`raft_tpu.resilience.deadline` scope expired before the
     guarded work completed — the TPU rendering of an NCCL collective
-    timeout / watchdog abort. Carries the deadline budget and the
-    active span stack of the cancelled thread at raise time, so a hang
-    converted into this error names WHERE the program was stuck.
+    timeout / watchdog abort. Carries the deadline budget, the active
+    span stack of the cancelled thread at raise time, and the
+    flight-recorder tail (``flight_tail``), so a hang converted into
+    this error names WHERE the program was stuck and what led up to it.
     (ref: ncclCommAbort + the reference's interruptible::synchronize
     raising out of a spinning stream wait.)"""
 
@@ -47,6 +67,7 @@ class DeadlineExceededError(RaftException):
         super().__init__(message)
         self.seconds = seconds
         self.span_stack = list(span_stack or [])
+        self.flight_tail = _flight_tail()
 
 
 # substrings of XLA / runtime status messages, checked upper-cased.
@@ -84,8 +105,15 @@ def classify_xla_error(exc: BaseException) -> Optional[RaftException]:
     jaxlib-layer failure) → :class:`DeviceError`. Exceptions already in
     the taxonomy pass through unchanged; exceptions that are neither
     (``ValueError`` from user input, ``KeyboardInterrupt``…) return
-    None — the caller re-raises them unwrapped."""
+    None — the caller re-raises them unwrapped.
+
+    Every classification is also a flight-recorder trigger: an
+    ``error`` timeline event is emitted and, when
+    ``RAFT_TPU_FLIGHT_DIR`` is set, the ring is dumped as Perfetto
+    JSON for post-mortem — once per exception instance, so an error
+    bubbling through nested ``device_errors`` scopes dumps once."""
     if isinstance(exc, RaftException):
+        _flight_on_classify(exc)
         return exc
     if not isinstance(exc, Exception):
         return None          # KeyboardInterrupt/SystemExit are not ours
@@ -93,13 +121,33 @@ def classify_xla_error(exc: BaseException) -> Optional[RaftException]:
     upper = msg.upper()
     is_xla = _is_xla_error(exc)
     label = f"[{type(exc).__name__}] {msg}"
+    classified: Optional[RaftException] = None
     if any(m in upper for m in _OOM_MARKERS):
-        return OutOfMemoryError(label)
-    if is_xla and any(m in upper for m in _DEADLINE_MARKERS):
-        return DeadlineExceededError(label)
-    if is_xla or any(m in upper for m in _DEVICE_MARKERS):
-        return DeviceError(label)
-    return None
+        classified = OutOfMemoryError(label)
+    elif is_xla and any(m in upper for m in _DEADLINE_MARKERS):
+        classified = DeadlineExceededError(label)
+    elif is_xla or any(m in upper for m in _DEVICE_MARKERS):
+        classified = DeviceError(label)
+    if classified is not None:
+        _flight_on_classify(classified)
+    return classified
+
+
+def _flight_on_classify(error: RaftException) -> None:
+    """Timeline event + post-mortem dump for one classified device
+    failure — once per exception instance; never raises."""
+    if getattr(error, "_flight_dumped", False):
+        return
+    try:
+        error._flight_dumped = True
+        from raft_tpu.observability import flight
+        from raft_tpu.observability.timeline import emit_error
+
+        emit_error(type(error).__name__, str(error))
+        flight.post_mortem(f"classify-{type(error).__name__}",
+                           error=error)
+    except Exception:
+        pass
 
 
 @contextlib.contextmanager

@@ -105,10 +105,22 @@ def yield_() -> None:
                      "thread").inc()
         except Exception:
             pass
-        raise DeadlineExceededError(
+        err = DeadlineExceededError(
             f"deadline {label!r} of {seconds}s exceeded"
             + (f" (active spans: {' > '.join(spans)})" if spans else ""),
             seconds=seconds, span_stack=spans)
+        # a fired deadline is a flight-recorder trigger: emit the
+        # ``deadline`` timeline event and dump the ring for post-mortem
+        # (RAFT_TPU_FLIGHT_DIR) — the error already carries the tail
+        try:
+            from raft_tpu.observability import flight
+            from raft_tpu.observability.timeline import emit_deadline
+
+            emit_deadline(label, seconds, fired=True, stack=spans)
+            flight.post_mortem(f"deadline-{label}", error=err)
+        except Exception:
+            pass
+        raise err
     raise InterruptedException("interruptible: cancelled")
 
 

@@ -15,8 +15,16 @@ TPU-native port's equivalents behind ONE substrate:
 - hooks — comms collectives, ``CompileCache`` hit/miss, ``MemoryTracker``
   allocations and ``benchmark.Fixture`` results all report in
   (:mod:`raft_tpu.observability.hooks`).
-- exporters — Prometheus text exposition, JSON lines, and a human
-  summary table (:mod:`raft_tpu.observability.exporters`).
+- exporters — Prometheus text exposition, JSON lines, a human
+  summary table, and the Perfetto/Chrome trace-event view of the
+  flight recorder (:mod:`raft_tpu.observability.exporters`).
+- flight recorder — a process-wide lock-guarded ring buffer of typed
+  timeline events (spans, collectives, compiles, faults, retries,
+  degradation rungs, deadlines), Perfetto-exportable, with automatic
+  post-mortem dumps to ``RAFT_TPU_FLIGHT_DIR`` on classified device
+  errors and fired deadlines (:mod:`raft_tpu.observability.flight` +
+  :mod:`raft_tpu.observability.timeline`), plus the model-vs-measured
+  :class:`DriftLedger` gated by ``tools/bench_report.py --check``.
 - cost model — static XLA ``cost_analysis``/``memory_analysis`` capture
   per compiled executable plus roofline attribution against the
   per-TPU-generation peaks in :mod:`raft_tpu.utils.arch`
@@ -47,11 +55,31 @@ from raft_tpu.observability.metrics import (
     MetricsRegistry,
     NULL_METRIC,
     DEFAULT_TIME_BUCKETS,
+    COMPILE_TIME_BUCKETS,
     get_registry,
     set_registry,
     enable,
     disable,
     tracing_enabled,
+)
+from raft_tpu.observability.flight import (
+    FlightRecorder,
+    KNOWN_EVENT_KINDS,
+    NULL_FLIGHT,
+    disable_flight,
+    enable_flight,
+    flight_enabled,
+    get_flight_recorder,
+    post_mortem,
+    set_flight_recorder,
+)
+from raft_tpu.observability.timeline import (
+    DRIFT_BAND,
+    DriftLedger,
+    emit_marker,
+    get_drift_ledger,
+    record_drift,
+    set_drift_ledger,
 )
 from raft_tpu.observability.spans import (
     instrument,
@@ -68,6 +96,7 @@ from raft_tpu.observability.hooks import (
 from raft_tpu.observability.exporters import (
     bench_results,
     export_jsonl,
+    export_perfetto,
     export_prometheus,
     summary_table,
 )
@@ -90,8 +119,10 @@ from raft_tpu.observability.profiler import (
 
 
 def reset() -> None:
-    """Clear the process-global registry (metrics AND events)."""
+    """Clear the process-global registry (metrics AND events) and the
+    flight-recorder ring."""
     get_registry().reset()
+    get_flight_recorder().clear()
 
 
 __all__ = [
@@ -101,6 +132,23 @@ __all__ = [
     "MetricsRegistry",
     "NULL_METRIC",
     "DEFAULT_TIME_BUCKETS",
+    "COMPILE_TIME_BUCKETS",
+    "FlightRecorder",
+    "KNOWN_EVENT_KINDS",
+    "NULL_FLIGHT",
+    "get_flight_recorder",
+    "set_flight_recorder",
+    "enable_flight",
+    "disable_flight",
+    "flight_enabled",
+    "post_mortem",
+    "DRIFT_BAND",
+    "DriftLedger",
+    "emit_marker",
+    "get_drift_ledger",
+    "set_drift_ledger",
+    "record_drift",
+    "export_perfetto",
     "get_registry",
     "set_registry",
     "enable",

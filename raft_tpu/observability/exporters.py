@@ -1,13 +1,25 @@
-"""Registry exporters: Prometheus text exposition, JSON lines, summary table.
+"""Exporters: Prometheus text, JSON lines, summary table, Perfetto trace.
 
-Three views of one :class:`~raft_tpu.observability.metrics.MetricsRegistry`:
+Three views of one :class:`~raft_tpu.observability.metrics.MetricsRegistry`
+plus one of the flight recorder:
 
 - :func:`export_prometheus` — text exposition format (the shape
   ``prometheus_client.generate_latest()`` emits), scrapeable as-is.
+  Histograms always carry the explicit cumulative ``le="+Inf"`` bucket
+  (== ``_count``) required by the exposition format; note
+  ``DEFAULT_TIME_BUCKETS`` tops out at 30 s, so anything slower (a cold
+  north-star compile can exceed it) lands only in ``+Inf`` — compile
+  timings use :data:`~raft_tpu.observability.metrics.
+  COMPILE_TIME_BUCKETS` (reaching 300 s) to keep resolution there.
 - :func:`export_jsonl` — one JSON object per line: first the buffered
   event stream (span ends, benchmark results), then a snapshot line per
   metric. The substrate future ``BENCH_*.json`` trajectories are cut from.
 - :func:`summary_table` — human-readable aligned table for terminals.
+- :func:`export_perfetto` — the flight-recorder ring as a Chrome
+  trace-event object (open at https://ui.perfetto.dev or
+  chrome://tracing): spans as complete slices, faults/retries/
+  degradation rungs as instants, lanes (threads / mesh axes / shards)
+  as named tracks.
 """
 
 from __future__ import annotations
@@ -15,6 +27,7 @@ from __future__ import annotations
 import io
 import json
 import math
+import os
 from typing import Dict, Optional
 
 from raft_tpu.observability.metrics import (
@@ -136,6 +149,58 @@ def summary_table(registry: Optional[MetricsRegistry] = None) -> str:
     for name, label_s, val in rows:
         out.write(f"{name.ljust(w0)}  {label_s.ljust(w1)}  {val}\n")
     return out.getvalue()
+
+
+#: event fields consumed by the trace-event envelope itself; everything
+#: else a flight event carries rides in Perfetto's ``args`` pane.
+_PERFETTO_ENVELOPE = ("kind", "name", "ph", "ts", "dur", "lane")
+
+
+def export_perfetto(recorder=None) -> Dict:
+    """Flight-recorder ring → Chrome trace-event JSON object.
+
+    Every flight event becomes one trace event with the REQUIRED keys
+    ``ph``/``ts``/``pid``/``tid``/``name`` (+ ``dur`` for complete
+    slices); ``kind`` becomes the category (``cat``), the remaining
+    fields the ``args`` dict. Timestamps are the recorder's monotonic
+    seconds converted to microseconds (Perfetto's unit). Lanes (thread
+    names, ``comms:<axis>``, shards) map to stable ``tid``s with a
+    ``thread_name`` metadata event each, so Perfetto renders one named
+    track per lane. Serializable as-is with ``json.dump``.
+    """
+    from raft_tpu.observability.flight import get_flight_recorder
+
+    rec = recorder if recorder is not None else get_flight_recorder()
+    pid = os.getpid()
+    lanes: Dict[str, int] = {}
+    out = []
+    for ev in rec.events():
+        lane = str(ev.get("lane") or "main")
+        tid = lanes.get(lane)
+        if tid is None:
+            tid = lanes[lane] = len(lanes) + 1
+        ph = ev.get("ph", "i")
+        te: Dict = {
+            "name": str(ev.get("name", ev.get("kind", "?"))),
+            "cat": str(ev.get("kind", "event")),
+            "ph": ph,
+            "ts": float(ev.get("ts", 0.0)) * 1e6,
+            "pid": pid,
+            "tid": tid,
+        }
+        if ph == "X":
+            te["dur"] = max(float(ev.get("dur", 0.0)), 0.0) * 1e6
+        elif ph == "i":
+            te["s"] = "t"          # instant scoped to its thread track
+        args = {k: v for k, v in ev.items()
+                if k not in _PERFETTO_ENVELOPE and v is not None}
+        if args:
+            te["args"] = args
+        out.append(te)
+    for lane, tid in sorted(lanes.items(), key=lambda kv: kv[1]):
+        out.append({"name": "thread_name", "ph": "M", "ts": 0.0,
+                    "pid": pid, "tid": tid, "args": {"name": lane}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
 def bench_results(registry: Optional[MetricsRegistry] = None) -> Dict[str, Dict]:

@@ -18,6 +18,9 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from raft_tpu.observability.metrics import get_registry
+from raft_tpu.observability.timeline import (emit_benchmark,
+                                             emit_collective,
+                                             emit_compile)
 
 COMMS_CALLS = "raft_tpu_comms_calls_total"
 COMMS_BYTES = "raft_tpu_comms_bytes_total"
@@ -53,6 +56,8 @@ def record_collective(collective: str, x, axis_name: str = "") -> None:
         reg.counter(COMMS_BYTES, labels,
                     help="Per-shard payload bytes entering collectives"
                     ).inc(n)
+    emit_collective(collective, n if isinstance(n, int) else 0,
+                    str(axis_name))
 
 
 def record_cache(hit: bool) -> None:
@@ -66,6 +71,7 @@ def record_cache(hit: bool) -> None:
     else:
         reg.counter(CACHE_MISSES, help="CompileCache lookups that paid a "
                                        "compilation").inc()
+    emit_compile("compile_cache", hit=hit)
 
 
 def record_alloc(nbytes: int, current_bytes: int, peak_bytes: int) -> None:
@@ -113,3 +119,4 @@ def record_benchmark(name: str, result: Dict[str, float],
     if nbytes is not None:
         event["nbytes"] = nbytes
     reg.emit(event)
+    emit_benchmark(name, float(result.get("seconds", 0.0)))

@@ -34,10 +34,21 @@ from typing import Dict, Iterable, List, Optional, Tuple
 LabelDict = Optional[Dict[str, str]]
 _LabelKey = Tuple[Tuple[str, str], ...]
 
-# Fixed default buckets for wall-time histograms: 1 µs .. 30 s, the span
-# from a cached-dispatch no-op to a cold north-star compile.
+# Fixed default buckets for wall-time histograms: 1 µs .. 30 s. NOTE the
+# 30 s ceiling: anything slower lands only in the (always-emitted)
+# cumulative ``le="+Inf"`` bucket, losing resolution — and a cold
+# north-star compile has been observed to exceed 30 s. Compile-time
+# histograms must use COMPILE_TIME_BUCKETS instead.
 DEFAULT_TIME_BUCKETS: Tuple[float, ...] = (
     1e-6, 1e-5, 1e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 3e-1, 1.0, 3.0, 10.0, 30.0,
+)
+
+# Compile-time preset: same decade ladder, extended to 300 s so cold
+# AOT/north-star compiles (minutes, not seconds) keep bucket resolution
+# instead of piling into +Inf. Used by runtime.entry_points._aot_call's
+# raft_tpu_compile_seconds histogram.
+COMPILE_TIME_BUCKETS: Tuple[float, ...] = (
+    1e-3, 1e-2, 1e-1, 3e-1, 1.0, 3.0, 10.0, 30.0, 100.0, 300.0,
 )
 
 

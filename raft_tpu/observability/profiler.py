@@ -111,7 +111,23 @@ class Profiler:
             compiled = target.lower(*args, **kwargs).compile()
         except Exception:
             return None
-        return self.capture(entry, compiled, key=key)
+        rec = self.capture(entry, compiled, key=key)
+        # prediction side of the drift ledger: the model's roofline-
+        # perfect seconds/bytes for this entry, once per (entry, shape
+        # signature). measured=False — never drift-gated; the measured
+        # half arrives when benchmark.Fixture.run times the same site.
+        if rec is not None:
+            try:
+                from raft_tpu.observability.timeline import record_drift
+
+                est = costmodel.roofline(rec, self.spec)
+                record_drift(entry,
+                             predicted_seconds=est.roof_seconds,
+                             predicted_bytes=rec.bytes_accessed,
+                             measured=False)
+            except Exception:
+                pass
+        return rec
 
     # -- queries ----------------------------------------------------------
     def records(self) -> Dict[str, CostRecord]:

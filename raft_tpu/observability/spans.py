@@ -36,6 +36,7 @@ import numpy as np
 
 from raft_tpu.core import nvtx
 from raft_tpu.observability.metrics import ENV_DISABLED, get_registry
+from raft_tpu.observability.timeline import emit_span
 
 SPAN_CALLS = "raft_tpu_span_calls_total"
 SPAN_ERRORS = "raft_tpu_span_errors_total"
@@ -58,6 +59,8 @@ def tree_nbytes(tree) -> int:
 
 def _record(name: str, parent: str, seconds: float, bytes_in: int,
             bytes_out: int, error: bool) -> None:
+    emit_span(name, parent, seconds, bytes_in, bytes_out, error,
+              stack=nvtx.range_stack())
     reg = get_registry()
     labels = {"span": name, "range": parent}
     reg.counter(SPAN_CALLS, labels,

@@ -1,0 +1,365 @@
+"""Typed timeline emitters + the model-vs-measured drift ledger.
+
+The one place the flight-recorder event vocabulary is spelled out: every
+subsystem that participates in the timeline calls ONE helper here (the
+way metric naming lives in :mod:`raft_tpu.observability.hooks`), so
+event shapes stay consistent and the disabled fast path stays a single
+boolean test — no helper computes an argument before checking
+``recorder.enabled``.
+
+Emitters → :data:`raft_tpu.observability.flight.KNOWN_EVENT_KINDS`:
+
+- :func:`emit_span` (``span``) — from ``spans._record``: complete
+  events carrying begin+duration, bytes in/out and the nvtx stack.
+- :func:`emit_collective` (``collective``) — from
+  ``hooks.record_collective``: per-shard payload bytes and axis, fired
+  at TRACE time (the honest countable event on an XLA runtime).
+- :func:`emit_compile` / :func:`emit_dispatch` (``compile`` /
+  ``dispatch``) — from ``runtime.entry_points._aot_call`` and the
+  CompileCache bridge.
+- :func:`emit_fault` / :func:`emit_retry` / :func:`emit_degradation`
+  (``fault`` / ``retry`` / ``degradation``) — from
+  :mod:`raft_tpu.resilience`; ladder walks become visible in Perfetto,
+  not just counters.
+- :func:`emit_deadline` (``deadline``) — scope armed / scope fired.
+- :func:`emit_error` (``error``) — every ``classify_xla_error``
+  classification.
+- :func:`emit_benchmark` (``benchmark``), :func:`emit_marker`
+  (``marker``).
+
+Drift ledger
+------------
+:class:`DriftLedger` is the durable record of *cost-model prediction
+vs. measurement* per site: every ``benchmark.Fixture.run`` (and the
+prediction side of ``Profiler.capture_fn``) appends one entry with the
+model's seconds/bytes, the measured wall time, and a ``measured`` flag
+(True only on real TPU hardware — CPU-suite entries are model-shape
+evidence, never calibration evidence). ``tools/bench_report.py
+--check`` gates the latest MEASURED entry per site against
+:data:`DRIFT_BAND` — so the first measured TPU round automatically
+*recalibrates* the modeled rankings (``choose_merge_strategy``, the
+``measured: false`` tune tables) instead of just replacing them.
+Persistence is opt-in: in-memory always; written to
+``RAFT_TPU_DRIFT_LEDGER`` (path) when set, or via :meth:`DriftLedger.
+save` (the benchmarks write ``DRIFT_LEDGER.json`` at the repo root).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from raft_tpu.observability.flight import get_flight_recorder
+
+#: flag a site when predicted and measured disagree by more than this
+#: factor (either direction). Mirrored in tools/bench_report.py (which
+#: stays raft_tpu-import-free); tests/test_flight.py pins them equal.
+DRIFT_BAND = 3.0
+
+DRIFT_SCHEMA = 1
+DRIFT_RECORDS = "raft_tpu_drift_records_total"
+DRIFT_RATIO = "raft_tpu_drift_seconds_ratio"
+
+
+def _now() -> float:
+    return time.perf_counter()
+
+
+# ------------------------------------------------------------- emitters
+def emit_span(name: str, parent: str, seconds: float, bytes_in: int,
+              bytes_out: int, error: bool,
+              stack: Optional[List[str]] = None) -> None:
+    """One completed instrumented span (ph=X, begin = now − seconds)."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("span", name, ts=_now() - seconds, dur=seconds, ph="X",
+               stack=stack, range=parent, bytes_in=bytes_in,
+               bytes_out=bytes_out, error=error)
+
+
+def emit_collective(collective: str, nbytes: int, axis: str) -> None:
+    """One comms collective (trace-time; lane = the mesh axis)."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("collective", collective, lane=f"comms:{axis or '?'}",
+               bytes=nbytes, axis=axis)
+
+
+def emit_compile(entry: str, seconds: float = 0.0,
+                 hit: Optional[bool] = None) -> None:
+    """A CompileCache hit/miss or a timed AOT compile (ph=X when a
+    duration is known)."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    if seconds:
+        rec.record("compile", entry, ts=_now() - seconds, dur=seconds,
+                   ph="X", hit=bool(hit) if hit is not None else False)
+    else:
+        rec.record("compile", entry,
+                   hit=bool(hit) if hit is not None else None)
+
+
+def emit_dispatch(entry: str) -> None:
+    """One AOT executable dispatch."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("dispatch", entry)
+
+
+def emit_fault(site: str, kind: str) -> None:
+    """One injected fault firing at ``site``."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("fault", site, fault_kind=kind)
+
+
+def emit_retry(site: str, attempt: int, error: str) -> None:
+    """One bounded-retry attempt."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("retry", site, attempt=attempt, error=error[:200])
+
+
+def emit_degradation(site: str, action: str) -> None:
+    """One graceful-degradation ladder rung (policy.record_degradation)."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("degradation", site, action=action)
+
+
+def emit_deadline(label: str, seconds: Optional[float], fired: bool,
+                  stack: Optional[List[str]] = None) -> None:
+    """A deadline scope armed (``fired=False``) or converting a hang
+    into DeadlineExceededError (``fired=True``)."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("deadline", label, stack=stack, budget_seconds=seconds,
+               fired=fired)
+
+
+def emit_error(error_type: str, message: str,
+               context: str = "") -> None:
+    """One classified device error."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("error", error_type, message=message[:300],
+               context=context)
+
+
+def emit_benchmark(name: str, seconds: float) -> None:
+    """One Fixture.run result (ph=X spanning the measured time)."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("benchmark", name, ts=_now() - seconds, dur=seconds,
+               ph="X")
+
+
+def emit_marker(name: str, **args) -> None:
+    """Free-form instant (benchmark phase boundaries etc.)."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("marker", name, **args)
+
+
+# --------------------------------------------------------- drift ledger
+class DriftLedger:
+    """Per-site history of (predicted, measured) pairs.
+
+    Thread-safe; bounded to ``max_entries`` per site (newest kept).
+    ``record()`` computes ``drift_seconds_ratio`` =
+    ``max(pred/meas, meas/pred)`` when both sides are present, emits a
+    ``drift`` flight event + registry gauge, and persists when the
+    ledger has a ``path`` (atomic tmp+rename — a torn write must not
+    corrupt the evidence trail)."""
+
+    def __init__(self, path: Optional[str] = None,
+                 max_entries: int = 20):
+        self.path = path
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: Dict[str, List[Dict]] = {}
+
+    # -- record -----------------------------------------------------------
+    def record(self, site: str,
+               predicted_seconds: Optional[float] = None,
+               predicted_bytes: Optional[float] = None,
+               measured_seconds: Optional[float] = None,
+               measured_bytes: Optional[float] = None,
+               measured: bool = False, **extra) -> Dict:
+        """Append one entry for ``site``; returns it. Never raises into
+        the caller's hot path (persistence failures are logged once)."""
+        entry: Dict = {
+            "predicted_seconds": predicted_seconds,
+            "predicted_bytes": predicted_bytes,
+            "measured_seconds": measured_seconds,
+            "measured_bytes": measured_bytes,
+            "measured": bool(measured),
+            "ts": time.time(),
+        }
+        if extra:
+            entry.update(extra)
+        if (isinstance(predicted_seconds, (int, float))
+                and isinstance(measured_seconds, (int, float))
+                and predicted_seconds > 0 and measured_seconds > 0):
+            r = predicted_seconds / measured_seconds
+            entry["drift_seconds_ratio"] = max(r, 1.0 / r)
+        with self._lock:
+            hist = self._entries.setdefault(site, [])
+            hist.append(entry)
+            del hist[:-self.max_entries]
+        try:
+            from raft_tpu.observability.metrics import get_registry
+
+            reg = get_registry()
+            reg.counter(DRIFT_RECORDS, {"site": site},
+                        help="Drift-ledger entries recorded").inc()
+            ratio = entry.get("drift_seconds_ratio")
+            if isinstance(ratio, (int, float)):
+                reg.gauge(DRIFT_RATIO, {"site": site},
+                          help="Latest |model/measured| seconds ratio "
+                               "(1.0 = perfect model)").set(ratio)
+        except Exception:
+            pass
+        rec = get_flight_recorder()
+        if rec.enabled:
+            rec.record("drift", site, measured=bool(measured),
+                       predicted_seconds=predicted_seconds,
+                       measured_seconds=measured_seconds,
+                       ratio=entry.get("drift_seconds_ratio"))
+        if self.path:
+            self.save()
+        return entry
+
+    # -- queries ----------------------------------------------------------
+    def entries(self) -> Dict[str, List[Dict]]:
+        with self._lock:
+            return {k: [dict(e) for e in v]
+                    for k, v in self._entries.items()}
+
+    def latest(self, site: str) -> Optional[Dict]:
+        with self._lock:
+            hist = self._entries.get(site)
+            return dict(hist[-1]) if hist else None
+
+    def sites(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._entries.values())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def merge(self, other: "DriftLedger") -> None:
+        """Append ``other``'s per-site histories after this ledger's
+        (bounded per site, newest kept) — how a benchmark process folds
+        its in-memory entries into the durable repo-root ledger."""
+        for site, hist in other.entries().items():
+            with self._lock:
+                dest = self._entries.setdefault(site, [])
+                dest.extend(hist)
+                del dest[:-self.max_entries]
+
+    # -- persistence ------------------------------------------------------
+    def to_dict(self) -> Dict:
+        with self._lock:
+            return {"schema": DRIFT_SCHEMA, "band": DRIFT_BAND,
+                    "entries": {k: [dict(e) for e in v]
+                                for k, v in self._entries.items()}}
+
+    def save(self, path: Optional[str] = None) -> Optional[str]:
+        """Atomic write; returns the path or None on failure (a ledger
+        write must never fail a benchmark)."""
+        target = path or self.path
+        if not target:
+            return None
+        try:
+            payload = self.to_dict()
+            tmp = target + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=1, sort_keys=True,
+                          default=str)
+                f.write("\n")
+            os.replace(tmp, target)
+            return target
+        except Exception as e:
+            from raft_tpu.core.logger import log_warn
+
+            log_warn("drift ledger: could not write %s: %s", target, e)
+            return None
+
+    @staticmethod
+    def load(path: str, max_entries: int = 20) -> "DriftLedger":
+        """Read a ledger file; corrupt/missing degrades to empty (the
+        plan-cache contract: a torn evidence file recomputes, never
+        raises)."""
+        led = DriftLedger(path=path, max_entries=max_entries)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            entries = data.get("entries")
+            if isinstance(entries, dict):
+                with led._lock:
+                    for site, hist in entries.items():
+                        if isinstance(hist, list):
+                            led._entries[str(site)] = [
+                                dict(e) for e in hist
+                                if isinstance(e, dict)
+                            ][-max_entries:]
+        except Exception:
+            pass
+        return led
+
+
+_global_ledger: Optional[DriftLedger] = None
+_ledger_lock = threading.Lock()
+
+
+def get_drift_ledger() -> DriftLedger:
+    """Process-global ledger, created lazily; persists automatically
+    when env ``RAFT_TPU_DRIFT_LEDGER`` names a path."""
+    global _global_ledger
+    with _ledger_lock:
+        if _global_ledger is None:
+            path = os.environ.get("RAFT_TPU_DRIFT_LEDGER", "").strip()
+            _global_ledger = DriftLedger(path=path or None)
+        return _global_ledger
+
+
+def set_drift_ledger(ledger: DriftLedger) -> Optional[DriftLedger]:
+    """Swap the process-global ledger (tests). Returns the previous."""
+    global _global_ledger
+    with _ledger_lock:
+        prev, _global_ledger = _global_ledger, ledger
+        return prev
+
+
+def record_drift(site: str, **kw) -> Optional[Dict]:
+    """Module-level convenience over :meth:`DriftLedger.record` on the
+    process-global ledger; respects the tracing kill switch and never
+    raises into the measurement path."""
+    try:
+        from raft_tpu.observability.metrics import tracing_enabled
+
+        if not tracing_enabled():
+            return None
+        return get_drift_ledger().record(site, **kw)
+    except Exception:
+        return None

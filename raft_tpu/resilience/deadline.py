@@ -55,6 +55,12 @@ def deadline(seconds: float, label: Optional[str] = None) -> Iterator[None]:
     tok = interruptible.get_token()
     info = {"seconds": float(seconds), "label": label or "deadline"}
     fired = threading.Event()
+    try:
+        from raft_tpu.observability.timeline import emit_deadline
+
+        emit_deadline(info["label"], info["seconds"], fired=False)
+    except Exception:
+        pass
 
     def _fire():
         # order matters: the info must be visible before the flag flips

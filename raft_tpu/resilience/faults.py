@@ -244,11 +244,13 @@ _load_env()
 def _count_injection(site: str, kind: str) -> None:
     try:
         from raft_tpu.observability import get_registry
+        from raft_tpu.observability.timeline import emit_fault
 
         reg = get_registry()
         reg.counter(INJECTIONS, {"site": site, "kind": kind},
                     help="Injected faults, by site and kind").inc()
         reg.emit({"type": "fault", "site": site, "kind": kind})
+        emit_fault(site, kind)
     except Exception:
         pass
 

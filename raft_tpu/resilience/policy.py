@@ -133,11 +133,14 @@ def _registry():
 def record_retry(site: str, error: BaseException,
                  attempt: int = 0) -> None:
     try:
+        from raft_tpu.observability.timeline import emit_retry
+
         reg = _registry()
         reg.counter(RETRIES, {"site": site},
                     help="Recovery retries, by site").inc()
         reg.emit({"type": "retry", "site": site, "attempt": attempt,
                   "error": f"{type(error).__name__}: {error}"[:200]})
+        emit_retry(site, attempt, f"{type(error).__name__}: {error}")
     except Exception:
         pass
 
@@ -153,12 +156,17 @@ def record_exhausted(site: str) -> None:
 
 def record_degradation(site: str, action: str) -> None:
     """Count one ladder step. ``action`` is a stable machine-readable
-    label like ``merge:tournament->allgather`` or ``fit:Qb:256->128``."""
+    label like ``merge:tournament->allgather`` or ``fit:Qb:256->128``.
+    Also emitted as a ``degradation`` timeline event, so ladder walks
+    are visible in a Perfetto trace — not just counters."""
     try:
+        from raft_tpu.observability.timeline import emit_degradation
+
         reg = _registry()
         reg.counter(DEGRADATIONS, {"site": site, "action": action},
                     help="Graceful-degradation ladder steps taken").inc()
         reg.emit({"type": "degradation", "site": site, "action": action})
+        emit_degradation(site, action)
     except Exception:
         pass
     from raft_tpu.core.logger import log_warn

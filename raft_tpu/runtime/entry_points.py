@@ -49,9 +49,28 @@ def _aot_call(res, name: str, statics: tuple, fn, *args):
                   str(getattr(a, "sharding", None))) for a in args))
 
     def _compile():
+        import time
+
         fault_point("aot_compile")
+        t0 = time.perf_counter()
         with device_errors(f"{name} [compile]"):
             compiled = jax.jit(fn).lower(*args).compile()
+        # compile wall time: timeline event + histogram on the COMPILE
+        # bucket preset (DEFAULT_TIME_BUCKETS tops out at 30 s — a cold
+        # north-star compile can exceed it; the preset reaches 300 s)
+        try:
+            from raft_tpu.observability.metrics import (
+                COMPILE_TIME_BUCKETS, get_registry)
+            from raft_tpu.observability.timeline import emit_compile
+
+            dt = time.perf_counter() - t0
+            emit_compile(name, seconds=dt, hit=False)
+            get_registry().histogram(
+                "raft_tpu_compile_seconds", {"entry": name},
+                help="AOT compile wall time (compile bucket preset)",
+                buckets=COMPILE_TIME_BUCKETS).observe(dt)
+        except Exception:
+            pass
         try:
             res.profiler.capture(name, compiled, key=str(key[1:]))
         except Exception:
@@ -61,6 +80,12 @@ def _aot_call(res, name: str, statics: tuple, fn, *args):
     def _attempt(attempt):
         compiled = res.compile_cache.get_or_compile(key, _compile)
         fault_point("aot_dispatch")
+        try:
+            from raft_tpu.observability.timeline import emit_dispatch
+
+            emit_dispatch(name)
+        except Exception:
+            pass
         with device_errors(name):
             return compiled(*args)
 

@@ -16,7 +16,15 @@ flat record. This tool turns that pile of disconnected artifacts into:
    newest round is a no-op — outage artifacts are history, not gates).
    Exit 0 = pass or nothing to gate (no new comparable artifact — the
    tier-1 no-op), exit 1 = regression, exit 2 = a gateable artifact
-   exists but the baseline is missing.
+   exists but the baseline is missing;
+3. a **drift gate** (part of ``--check``): DRIFT_LEDGER.json — the
+   model-vs-measured ledger ``benchmark.Fixture.run`` records — is
+   scanned per site; a site whose MEASURED entry has the cost model's
+   predicted seconds off by more than ``--drift-band`` (default 3x
+   either way) fails the gate. Modeled-only entries (``measured:
+   false`` — the CPU suite) are never drift-gated, and artifacts carry
+   ``drift_checked`` so calibrated rounds are tellable from modeled
+   ones.
 
 Degraded rounds (tunnel down, CPU fallback, cached re-emission) are
 shown in the trajectory but never gated — gating an outage artifact
@@ -45,7 +53,13 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ROUND_GLOB = "BENCH_r*.json"
 MULTICHIP_GLOB = "MULTICHIP_r*.json"
 BASELINE_NAME = "BENCH_LAST_GOOD.json"
+DRIFT_LEDGER_NAME = "DRIFT_LEDGER.json"
 DEFAULT_THRESHOLD = 0.15   # 15% relative drop (or slowdown) fails
+# flag a site when the cost model's predicted seconds and the MEASURED
+# seconds disagree by more than this factor either way. Mirror of
+# raft_tpu.observability.timeline.DRIFT_BAND (this tool stays
+# raft_tpu-import-free); tests/test_flight.py pins the two equal.
+DRIFT_BAND = 3.0
 
 # named single-shot artifacts whose numbers predate arbitrary amounts of
 # later work: the report flags the ones whose last-touching commit is
@@ -213,6 +227,72 @@ def check_multichip(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
                 f"though the headline holds")
         msg += f"; busbw_frac {bw:.3g} vs {pbw:.3g}"
     return PASS, msg
+
+
+def load_drift_ledger(path: str) -> Optional[Dict]:
+    """DRIFT_LEDGER.json → {site: [entries...]}; None for a missing or
+    unreadable ledger (the no-op case — the gate must not fail repos
+    that have never run a drift-recording benchmark)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    entries = data.get("entries") if isinstance(data, dict) else None
+    if not isinstance(entries, dict):
+        return None
+    return {str(k): v for k, v in entries.items() if isinstance(v, list)}
+
+
+def check_drift(entries: Optional[Dict], band: float = DRIFT_BAND
+                ) -> Tuple[str, str]:
+    """Gate the model-vs-measured drift ledger.
+
+    Per site, the NEWEST entry wins. Only entries with ``measured:
+    true`` (real-hardware measurements) and both ``predicted_seconds``
+    and ``measured_seconds`` are gated — modeled-only sites (the CPU
+    suite, prediction-side capture_fn records) are evidence of model
+    shape, never calibration failures. A gated site whose
+    predicted/measured seconds ratio (either direction) exceeds
+    ``band`` is flagged: the cost model that ranks tune tables and
+    merge strategies is out of calibration there, and the measured
+    round must recalibrate it, not just outvote it."""
+    if not entries:
+        return SKIP, "no drift ledger to gate"
+    flagged, gated, modeled_only = [], 0, 0
+    for site in sorted(entries):
+        hist = [e for e in entries[site] if isinstance(e, dict)]
+        if not hist:
+            continue
+        latest = hist[-1]
+        if not latest.get("measured"):
+            modeled_only += 1
+            continue
+        pred = latest.get("predicted_seconds")
+        meas = latest.get("measured_seconds")
+        if not (isinstance(pred, (int, float))
+                and isinstance(meas, (int, float))
+                and pred > 0 and meas > 0):
+            modeled_only += 1
+            continue
+        gated += 1
+        ratio = max(pred / meas, meas / pred)
+        if ratio > band:
+            flagged.append(f"{site} ({ratio:.2g}x)")
+    if flagged:
+        return REGRESS, (
+            f"MODEL DRIFT: {len(flagged)} site(s) outside the "
+            f"{band:g}x band: {', '.join(flagged)} — the cost model "
+            f"is out of calibration; re-tune before trusting modeled "
+            f"rankings")
+    if gated == 0:
+        return PASS, (f"drift ledger has no measured entries "
+                      f"({modeled_only} modeled-only site(s) — never "
+                      f"drift-gated)")
+    return PASS, (f"drift ok: {gated} measured site(s) within the "
+                  f"{band:g}x band"
+                  + (f"; {modeled_only} modeled-only skipped"
+                     if modeled_only else ""))
 
 
 def _git_commit_time(directory: str, ref: str) -> Optional[int]:
@@ -469,6 +549,14 @@ def main(argv: Sequence[str] = None) -> int:
                    help="gate the newest non-degraded round against the "
                         "baseline; exit 1 on regression, 2 on missing "
                         "baseline, 0 otherwise")
+    p.add_argument("--drift-ledger", default=None,
+                   help=f"drift ledger file (default: "
+                        f"<dir>/{DRIFT_LEDGER_NAME})")
+    p.add_argument("--drift-band", type=float, default=DRIFT_BAND,
+                   help="flag sites whose predicted/measured seconds "
+                        "ratio exceeds this factor either way "
+                        f"(default {DRIFT_BAND:g}; measured entries "
+                        "only — modeled rounds are never drift-gated)")
     p.add_argument("--json", action="store_true",
                    help="emit the trajectory as JSON instead of a table")
     args = p.parse_args(argv)
@@ -487,19 +575,26 @@ def main(argv: Sequence[str] = None) -> int:
                 candidate = rec
                 break
         status, msg = check_regression(candidate, baseline, args.threshold)
+        if candidate is not None and "drift_checked" in candidate:
+            msg += (" [drift-checked round]" if candidate["drift_checked"]
+                    else " [modeled round — not drift-calibrated]")
         print(f"bench_report --check: {status}: {msg}")
         mstatus, mmsg = check_multichip(mrounds, args.threshold)
         print(f"bench_report --check [multichip]: {mstatus}: {mmsg}")
+        ledger_path = args.drift_ledger or os.path.join(
+            args.dir, DRIFT_LEDGER_NAME)
+        dstatus, dmsg = check_drift(load_drift_ledger(ledger_path),
+                                    args.drift_band)
+        print(f"bench_report --check [drift]: {dstatus}: {dmsg}")
         for e in stale:
             if e.get("status") == "STALE":
                 print(f"bench_report --check: note: {e['artifact']} is "
                       f"STALE ({e['age_rounds_note']})")
         codes = {PASS: 0, SKIP: 0, REGRESS: 1, MISSING_BASELINE: 2}
-        # regression in EITHER trend fails; missing baseline only when
+        # regression in ANY trend fails; missing baseline only when
         # nothing regressed
-        rc = codes[status]
-        mrc = codes[mstatus]
-        return 1 if 1 in (rc, mrc) else max(rc, mrc)
+        rcs = (codes[status], codes[mstatus], codes[dstatus])
+        return 1 if 1 in rcs else max(rcs)
 
     if args.json:
         payload = {
@@ -510,6 +605,9 @@ def main(argv: Sequence[str] = None) -> int:
                  "record": rec} for n, path, rec in mrounds],
             "named_artifacts": stale,
             "baseline": baseline,
+            "drift_ledger": load_drift_ledger(
+                args.drift_ledger
+                or os.path.join(args.dir, DRIFT_LEDGER_NAME)),
         }
         print(json.dumps(payload, indent=1, sort_keys=True, default=str))
         return 0

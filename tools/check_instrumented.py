@@ -30,7 +30,7 @@ from __future__ import annotations
 import ast
 import os
 import sys
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 # module (repo-relative) → functions that must be instrumented
 HOT_PATHS: Dict[str, Sequence[str]] = {
@@ -100,6 +100,60 @@ FAULT_SITES: Dict[str, Sequence[str]] = {
     "raft_tpu/comms/host_comms.py": ("host_collective", "host_barrier",
                                      "host_sync"),
 }
+
+# timeline-event gate: every hot-path module and every fault-site
+# module must emit flight-recorder events — a hot path invisible in a
+# Perfetto trace cannot be reconstructed post-mortem, which is exactly
+# the regression this gate catches. A module "emits" by referencing at
+# least the listed emitter callables (``@instrument``/``fault_point``
+# route through the flight recorder; the ``emit_*`` helpers live in
+# raft_tpu/observability/timeline.py). EMITTER_KINDS maps each emitter
+# to the flight event kind it produces; the checker statically asserts
+# every kind exists in flight.KNOWN_EVENT_KINDS (parsed from the
+# source), and tests/test_flight.py pins the same fact at runtime.
+EMITTER_KINDS: Dict[str, str] = {
+    "instrument": "span",
+    "span": "span",
+    "emit_span": "span",
+    "fault_point": "fault",
+    "emit_fault": "fault",
+    "record_collective": "collective",
+    "emit_collective": "collective",
+    "emit_compile": "compile",
+    "emit_dispatch": "dispatch",
+    "emit_retry": "retry",
+    "emit_degradation": "degradation",
+    "emit_deadline": "deadline",
+    "emit_error": "error",
+    "emit_benchmark": "benchmark",
+    "record_drift": "drift",
+    "emit_marker": "marker",
+}
+
+EVENT_SITES: Dict[str, Sequence[str]] = {
+    # every HOT_PATHS module: spans via @instrument + fault events
+    **{rel: ("instrument", "fault_point") for rel in HOT_PATHS},
+    # fault-site modules outside HOT_PATHS
+    "raft_tpu/runtime/entry_points.py": (
+        "fault_point", "emit_compile", "emit_dispatch"),
+    "raft_tpu/sparse/plan_cache.py": ("fault_point",),
+    "raft_tpu/comms/host_comms.py": ("fault_point",),
+    # the emit wiring itself — deleting a bridge silently empties the
+    # timeline even though every call site still "emits"
+    "raft_tpu/comms/comms.py": ("record_collective",),
+    "raft_tpu/resilience/faults.py": ("emit_fault",),
+    "raft_tpu/resilience/policy.py": ("emit_retry",
+                                      "emit_degradation"),
+    "raft_tpu/resilience/deadline.py": ("emit_deadline",),
+    "raft_tpu/core/interruptible.py": ("emit_deadline",),
+    "raft_tpu/observability/spans.py": ("emit_span",),
+    "raft_tpu/observability/hooks.py": ("emit_collective",
+                                        "emit_compile",
+                                        "emit_benchmark"),
+    "raft_tpu/benchmark.py": ("record_drift",),
+}
+
+_FLIGHT_MODULE = "raft_tpu/observability/flight.py"
 
 # defining module → (kernel-variant entry points, consuming module):
 # the grid-order variants must EXIST where the footprint model and the
@@ -260,6 +314,100 @@ def check_fault_sites(root: str = _REPO_ROOT,
     return errors
 
 
+def _known_event_kinds(root: str) -> Optional[set]:
+    """The KNOWN_EVENT_KINDS tuple literal parsed out of flight.py (the
+    same static-scan pattern as the other gates — no raft_tpu import).
+    None when the module/assignment is missing (reported separately)."""
+    path = os.path.join(root, _FLIGHT_MODULE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=_FLIGHT_MODULE)
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                            ast.Name):
+            targets = [node.target.id]
+        if "KNOWN_EVENT_KINDS" in targets and node.value is not None:
+            try:
+                val = ast.literal_eval(node.value)
+            except ValueError:
+                return None
+            return {str(v) for v in val}
+    return None
+
+
+def _referenced_names(tree: ast.Module) -> set:
+    """Every plain name and attribute name referenced in the module —
+    covers calls, decorators (@instrument(...)), and from-imports."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.add(node.attr)
+        elif isinstance(node, ast.ImportFrom):
+            names.update(a.name for a in node.names)
+    return names
+
+
+def check_event_sites(root: str = _REPO_ROOT,
+                      sites: Dict[str, Sequence[str]] = None,
+                      emitters: Dict[str, str] = None,
+                      hot_paths: Dict[str, Sequence[str]] = None,
+                      fault_sites: Dict[str, Sequence[str]] = None
+                      ) -> List[str]:
+    """Violations for :data:`EVENT_SITES` (empty = clean): every module
+    in HOT_PATHS and every FAULT_SITES module must have an EVENT_SITES
+    entry; each listed emitter must be referenced in the module and
+    must map (via :data:`EMITTER_KINDS`) to a kind present in
+    ``flight.KNOWN_EVENT_KINDS`` — a hot path that emits no timeline
+    events cannot be reconstructed from a post-mortem dump."""
+    sites = EVENT_SITES if sites is None else sites
+    emitters = EMITTER_KINDS if emitters is None else emitters
+    hot_paths = HOT_PATHS if hot_paths is None else hot_paths
+    fault_sites = FAULT_SITES if fault_sites is None else fault_sites
+    errors: List[str] = []
+    kinds = _known_event_kinds(root)
+    if kinds is None:
+        errors.append(f"{_FLIGHT_MODULE}: KNOWN_EVENT_KINDS tuple not "
+                      f"found — the flight-recorder vocabulary is gone")
+        kinds = set()
+    for emitter, kind in sorted(emitters.items()):
+        if kinds and kind not in kinds:
+            errors.append(
+                f"EMITTER_KINDS[{emitter!r}] = {kind!r} is not a "
+                f"flight.KNOWN_EVENT_KINDS kind — the gate table and "
+                f"the event vocabulary have diverged")
+    for rel in sorted(set(hot_paths) | set(fault_sites)):
+        if rel not in sites:
+            errors.append(
+                f"{rel}: hot-path/fault-site module has no EVENT_SITES "
+                f"entry — it would be invisible in the flight timeline")
+    for rel, names in sorted(sites.items()):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            errors.append(f"{rel}: event-site module missing")
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        referenced = _referenced_names(tree)
+        for name in names:
+            if name not in emitters:
+                errors.append(
+                    f"{rel}: EVENT_SITES emitter {name!r} is not in "
+                    f"EMITTER_KINDS — unknown timeline emitter")
+            if name not in referenced:
+                errors.append(
+                    f"{rel}: no reference to timeline emitter "
+                    f"{name!r} — the module would stop emitting "
+                    f"flight-recorder events")
+    return errors
+
+
 def check_sharded_merge(root: str = _REPO_ROOT,
                         sites: Dict[str, Sequence[str]] = None,
                         counted: Sequence[str] = None) -> List[str]:
@@ -340,6 +488,7 @@ def check(root: str = _REPO_ROOT,
         errors.extend(check_kernel_variants(root))
         errors.extend(check_sharded_merge(root))
         errors.extend(check_fault_sites(root))
+        errors.extend(check_event_sites(root))
     return errors
 
 
@@ -359,7 +508,8 @@ def main(argv: Sequence[str] = ()) -> int:
               f"sharded-merge sites + "
               f"{len(COUNTED_COLLECTIVES)} counted collectives; "
               f"{sum(len(v) for v in FAULT_SITES.values())} fault-"
-              f"injection sites in {len(FAULT_SITES)} modules")
+              f"injection sites in {len(FAULT_SITES)} modules; "
+              f"{len(EVENT_SITES)} timeline-event-emitting modules")
     return 1 if errors else 0
 
 
