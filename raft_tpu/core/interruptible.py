@@ -15,6 +15,22 @@ observed with ``block_until_ready``. The same vocabulary is preserved:
   host-orchestrated solver loops (Lanczos etc.), which is where cancellation
   is actually actionable on TPU.
 - :func:`cancel` — flip another thread's token.
+
+Thread-safety contract (the serving engine's concurrency shape — many
+request threads each arming their own :func:`raft_tpu.resilience.deadline`
+scope — is what pinned this down):
+
+- A thread's OWN token is found through ``threading.local`` storage, so a
+  recycled OS thread ident can never hand a new thread a stale (possibly
+  poisoned) token left behind by a dead one. The ident-keyed registry is
+  kept only so :func:`cancel` can reach *another* thread's token, and a
+  thread's first ``get_token()`` overwrites any stale registry entry for
+  its ident.
+- Every token mutation (cancel, deadline arm/fire/consume) holds the
+  token's own lock, so a watchdog timer firing on its timer thread cannot
+  race the owning thread's check-and-clear.
+- Deadline state is re-entrant: nested/overlapping scopes each own their
+  arm record and only ever clear their own (see resilience/deadline.py).
 """
 
 from __future__ import annotations
@@ -34,46 +50,64 @@ class InterruptedException(RaftException):
 
 
 class _Token:
-    __slots__ = ("cancelled", "fired_deadline")
+    __slots__ = ("lock", "cancelled", "fired_deadlines")
 
     def __init__(self):
+        self.lock = threading.Lock()
         self.cancelled = False
-        # set (before ``cancelled``) by a deadline watchdog so the
+        # appended to (under ``lock``) by deadline watchdogs so the
         # cancellation point can raise DeadlineExceededError instead of
-        # the plain InterruptedException — see resilience/deadline.py
-        self.fired_deadline = None
+        # the plain InterruptedException. A LIST, in firing order,
+        # because nested scopes can both expire before either is
+        # consumed — each scope removes only its own record at exit —
+        # see resilience/deadline.py
+        self.fired_deadlines = []
 
 
 _registry: Dict[int, _Token] = {}
 _registry_lock = threading.Lock()
+_tls = threading.local()
 
 
 def get_token(thread_id: int | None = None) -> _Token:
-    """Token for a thread (default: calling thread), creating it on first use.
-    (ref: interruptible.hpp ``get_token``)"""
-    tid = thread_id if thread_id is not None else threading.get_ident()
-    with _registry_lock:
-        tok = _registry.get(tid)
+    """Token for a thread (default: calling thread), creating it on first
+    use. The calling thread's token lives in thread-local storage (an OS
+    ident recycled onto a new thread gets a FRESH token, never a dead
+    thread's leftovers); the ident registry exists so ``cancel(tid)`` can
+    reach another live thread. (ref: interruptible.hpp ``get_token``)"""
+    if thread_id is None:
+        tok = getattr(_tls, "token", None)
         if tok is None:
             tok = _Token()
-            _registry[tid] = tok
+            _tls.token = tok
+            with _registry_lock:
+                _registry[threading.get_ident()] = tok
+        return tok
+    with _registry_lock:
+        tok = _registry.get(thread_id)
+        if tok is None:
+            tok = _Token()
+            _registry[thread_id] = tok
         return tok
 
 
 def cancel(thread_id: int | None = None) -> None:
     """Request cancellation of a thread's next interruptible wait.
     (ref: interruptible.hpp ``cancel``)"""
-    get_token(thread_id).cancelled = True
+    tok = get_token(thread_id)
+    with tok.lock:
+        tok.cancelled = True
 
 
 def yield_no_throw() -> bool:
     """Check-and-clear this thread's token; returns True if cancelled."""
     tok = get_token()
-    if tok.cancelled:
-        tok.cancelled = False
-        tok.fired_deadline = None
-        return True
-    return False
+    with tok.lock:
+        if tok.cancelled:
+            tok.cancelled = False
+            tok.fired_deadlines.clear()
+            return True
+        return False
 
 
 def yield_() -> None:
@@ -84,11 +118,16 @@ def yield_() -> None:
     budget and this thread's active span stack (the nvtx range stack)
     for diagnosis. (ref: interruptible.hpp ``yield``)"""
     tok = get_token()
-    if not tok.cancelled:
-        return
-    tok.cancelled = False
-    fired = tok.fired_deadline
-    tok.fired_deadline = None
+    with tok.lock:
+        if not tok.cancelled:
+            return
+        # consume the EARLIEST pending expiry (firing order); further
+        # pending expiries keep the token cancelled so each converts at
+        # a later cancellation point (or is cleared by its own scope's
+        # exit while the first error propagates through it)
+        fired = (tok.fired_deadlines.pop(0)
+                 if tok.fired_deadlines else None)
+        tok.cancelled = bool(tok.fired_deadlines)
     if fired is not None:
         from raft_tpu.core import nvtx
         from raft_tpu.core.error import DeadlineExceededError

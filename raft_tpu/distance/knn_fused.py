@@ -275,6 +275,27 @@ def _pad_rows_to(y, mult: int):
     return _pad_rows(y, mult)[0]
 
 
+def pad_query_rows(x, rows: int):
+    """Pad a RAGGED query batch up to a fixed ``rows`` count with zero
+    rows — the serving engine's bucket shapes (raft_tpu.serving) and the
+    AOT ``knn_query`` runtime entry both route ragged request batches
+    through this so every dispatch hits a pre-compiled shape. Zero-row
+    queries are inert through the whole pipeline (their top-k is
+    computed and discarded — the certificate and fixup maths are
+    per-query, so pads cannot perturb real rows); callers slice the
+    first ``n`` result rows back out. Raises when the batch is LARGER
+    than the bucket: silently truncating requests is exactly the
+    failure mode the serving ladder's reject path exists to prevent."""
+    n = x.shape[0]
+    if n > rows:
+        raise ValueError(f"pad_query_rows: batch of {n} rows does not "
+                         f"fit the {rows}-row bucket")
+    if n == rows:
+        return x
+    return jnp.concatenate(
+        [x, jnp.zeros((rows - n, x.shape[1]), x.dtype)], axis=0)
+
+
 @functools.partial(jax.jit, static_argnames=("T", "g", "metric",
                                              "pbits", "grid_order"))
 def _prepare_ops(y, T: int, g: int, metric: str,

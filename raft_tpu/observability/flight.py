@@ -68,6 +68,7 @@ KNOWN_EVENT_KINDS = (
     "benchmark",     # Fixture.run results
     "drift",         # model-vs-measured drift ledger records
     "marker",        # free-form instants (benchmark phases etc.)
+    "serving",       # serving engine: enqueue/flush/shed/swap/warmup
 )
 
 #: events attached to DeviceError/DeadlineExceededError payloads

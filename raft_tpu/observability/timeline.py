@@ -175,6 +175,21 @@ def emit_marker(name: str, **args) -> None:
     rec.record("marker", name, **args)
 
 
+def emit_serving(event: str, **args) -> None:
+    """One serving-engine lifecycle event (``serving`` kind). ``event``
+    names the step — ``enqueue`` (request admitted, with queue depth),
+    ``flush`` (a coalesced micro-batch dispatched, with bucket/rows),
+    ``shed`` (overload admission rejection), ``swap`` (index snapshot
+    generation change), ``warmup`` (bucket pre-compile at engine
+    start), ``reject`` (request larger than the bucket ladder) — so a
+    Perfetto trace shows the queue → batch → dispatch pipeline next to
+    the compile/dispatch/deadline events it feeds."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("serving", event, lane="serving", **args)
+
+
 # --------------------------------------------------------- drift ledger
 class DriftLedger:
     """Per-site history of (predicted, measured) pairs.

@@ -32,8 +32,15 @@ Scope semantics:
   still raises at exit when the deadline has already expired (the
   budget WAS exceeded — honest semantics for SLO accounting). A scope
   that exits before expiry disarms its timer and is free.
-- Scopes nest; the innermost-to-expire wins. Exiting a scope restores
-  the token state it found (an outer deadline stays armed).
+- Scopes are RE-ENTRANT and thread-safe: they nest on one thread (the
+  first-to-expire wins; a fired inner scope never clobbers an armed
+  outer one, and exiting a scope only ever clears ITS OWN pending
+  cancellation), and scopes on different threads are fully independent
+  — tokens are thread-local, and every arm/fire/consume holds the
+  token's lock, so concurrent request threads (the serving engine's
+  batcher + client threads) cannot trample each other's watchdogs.
+  Pinned by tests/test_resilience.py's concurrent-scope regression
+  test.
 """
 
 from __future__ import annotations
@@ -63,11 +70,14 @@ def deadline(seconds: float, label: Optional[str] = None) -> Iterator[None]:
         pass
 
     def _fire():
-        # order matters: the info must be visible before the flag flips
-        # (yield_ reads the flag first, then the info)
-        tok.fired_deadline = info
-        fired.set()
-        tok.cancelled = True
+        # all under the token lock so the owning thread's check-and-
+        # clear cannot interleave. Expiries queue in firing order —
+        # the cancellation point reports the earliest, and each scope
+        # removes only its own record at exit
+        with tok.lock:
+            tok.fired_deadlines.append(info)
+            fired.set()
+            tok.cancelled = True
 
     timer = threading.Timer(float(seconds), _fire)
     timer.daemon = True
@@ -81,7 +91,15 @@ def deadline(seconds: float, label: Optional[str] = None) -> Iterator[None]:
         timer.cancel()
         # un-poison the token if OUR deadline fired but was not
         # consumed (e.g. a different exception is propagating) — a
-        # stale cancellation must not ambush the thread's next wait
-        if fired.is_set() and tok.fired_deadline is info:
-            tok.fired_deadline = None
-            tok.cancelled = False
+        # stale cancellation must not ambush the thread's next wait.
+        # Only OUR arm record is removed: another scope's pending
+        # expiry stays queued (and keeps the token cancelled).
+        if fired.is_set():
+            with tok.lock:
+                try:
+                    tok.fired_deadlines.remove(info)
+                except ValueError:
+                    pass        # already consumed by a yield_
+                else:
+                    if not tok.fired_deadlines:
+                        tok.cancelled = False

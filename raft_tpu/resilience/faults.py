@@ -96,6 +96,12 @@ KNOWN_SITES: Dict[str, Tuple[str, ...]] = {
     "host_collective": ("oom", "error", "timeout", "hang"),
     "host_barrier": ("error", "timeout", "hang"),
     "host_sync": ("error", "hang"),
+    # serving engine (raft_tpu.serving): admission at enqueue, the
+    # batch flush (dispatch of a coalesced micro-batch), and the
+    # background snapshot rebuild
+    "serving_enqueue": ("error",),
+    "serving_flush": ("oom", "error", "timeout", "hang"),
+    "serving_snapshot": ("error",),
 }
 
 

@@ -93,6 +93,109 @@ def _aot_call(res, name: str, statics: tuple, fn, *args):
                            policy=res.resilience.policy_for("runtime"))
 
 
+def knn_query(res, index, x, k: int, rescore: Optional[bool] = None,
+              certify: str = "kernel") -> Tuple[jax.Array, jax.Array]:
+    """AOT serving entry: certified fused KNN against a PREPARED
+    :class:`~raft_tpu.distance.knn_fused.KnnIndex`, compiled once per
+    (index geometry, query-batch shape) and served from the handle's
+    CompileCache — the data plane of the serving engine
+    (:mod:`raft_tpu.serving`).
+
+    Unlike :func:`raft_tpu.distance.knn_fused.knn_fused` (which jits
+    lazily on first call), this entry lowers+compiles through
+    :func:`_aot_call`, so the serving engine can PRE-WARM every bucket
+    shape of its ladder at start-up and no live request ever pays a
+    trace/compile: the cache key covers the query shape, so each bucket
+    owns exactly one executable, and an index-snapshot swap of the same
+    geometry re-uses them all (the index operands are ARGUMENTS, not
+    baked-in constants). Feature/row padding to the kernel's block
+    geometry happens INSIDE the compiled program — the key is the raw
+    bucket shape the engine dispatches.
+    """
+    from raft_tpu.distance.knn_fused import (_LANES, _POOL_PAD, KnnIndex,
+                                             _knn_fused_core,
+                                             pool_select_algo,
+                                             resolve_pool_algo)
+    from raft_tpu.core.error import expects
+
+    res = ensure_resources(res)
+    expects(isinstance(index, KnnIndex),
+            "knn_query: index must be a prepared KnnIndex (see "
+            "distance.prepare_knn_index)")
+    idx = index
+    if certify not in ("kernel", "f32"):
+        raise ValueError(f"knn_query: certify must be 'kernel' or "
+                         f"'f32', got {certify!r}")
+    x = jnp.asarray(x, jnp.float32)
+    Q, d_x = x.shape
+    expects(d_x == idx.d_orig, "knn_query: query width %d != index %d",
+            d_x, idx.d_orig)
+    expects(k <= idx.n_rows, "knn_query: k=%d > index size %d", k,
+            idx.n_rows)
+    if Q == 0:
+        return (jnp.zeros((0, k), jnp.float32),
+                jnp.zeros((0, k), jnp.int32))
+    if rescore is None:
+        rescore = idx.yp is not None
+    if rescore and idx.yp is None:
+        raise ValueError("knn_query: rescore=True needs a yp-storing "
+                         "index (store_yp=True)")
+    if idx.passes == 3:
+        certify = "kernel"      # p3 is already f32-certified
+    if certify == "f32" and not rescore:
+        raise ValueError("knn_query: certify='f32' needs the exact "
+                         "rescore (store_yp=True)")
+    # pool geometry + effective selection algo, resolved per call like
+    # knn_fused's own wrapper (the non-jitted decision point)
+    n_tiles = idx.yyh_k.shape[1] // idx.T
+    S_pool = -(-n_tiles // idx.g) * _LANES
+    packed = idx.g * (idx.T // _LANES) <= (1 << idx.pbits)
+    pool_len = S_pool if packed else 2 * S_pool
+    if k > 2 * S_pool:
+        raise NotImplementedError(
+            f"knn_query: k={k} too large for pool {2 * S_pool}")
+    pool_algo = resolve_pool_algo(pool_select_algo(), pool_len,
+                                  min(k + _POOL_PAD, pool_len))
+    Qb_eff = min(idx.Qb, ((Q + 7) // 8) * 8)
+    has_yp = idx.yp is not None
+    has_ylo = idx.y_lo is not None
+    T_, g_, passes_ = idx.T, idx.g, idx.passes
+    metric_, m_, pbits_ = idx.metric, idx.n_rows, idx.pbits
+    order_ = idx.grid_order
+
+    def run(xq, *ops):
+        it = iter(ops)
+        yp = next(it) if has_yp else None
+        y_hi = next(it)
+        y_lo = next(it) if has_ylo else None
+        yyh_k = next(it)
+        yy_raw = next(it)
+        dpad = y_hi.shape[1] - xq.shape[1]
+        if dpad:
+            xq = jnp.concatenate(
+                [xq, jnp.zeros((xq.shape[0], dpad), jnp.float32)], axis=1)
+        qpad = (-Q) % Qb_eff
+        if qpad:
+            xq = jnp.concatenate(
+                [xq, jnp.zeros((qpad, xq.shape[1]), jnp.float32)])
+        vals, ids = _knn_fused_core(
+            xq, yp, y_hi, y_lo, yyh_k, yy_raw,
+            k=k, T=T_, Qb=Qb_eff, g=g_, passes=passes_, metric=metric_,
+            m=m_, rescore=rescore, pbits=pbits_, certify=certify,
+            pool_algo=pool_algo, grid_order=order_)
+        if qpad:
+            vals, ids = vals[:Q], ids[:Q]
+        if metric_ == "ip":
+            vals = -vals        # internal −x·y ascending → IP descending
+        return vals, ids
+
+    statics = (k, T_, Qb_eff, g_, passes_, metric_, m_, bool(rescore),
+               pbits_, certify, pool_algo, order_, has_yp, has_ylo, Q)
+    ops = [o for o in (idx.yp, idx.y_hi, idx.y_lo) if o is not None]
+    ops += [idx.yyh_k, idx.yy_raw]
+    return _aot_call(res, "knn_query", statics, run, x, *ops)
+
+
 def lanczos_solver(res, rows, cols, vals, n: int, n_components: int,
                    max_iterations: int = 1000, ncv: Optional[int] = None,
                    tolerance: float = 1e-6, which: str = "SA", seed: int = 42,

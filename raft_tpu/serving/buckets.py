@@ -1,0 +1,108 @@
+"""The bucket ladder: the small fixed set of pre-compiled batch shapes.
+
+Every request batch the serving engine dispatches is padded up to one
+of a few fixed row counts — the *bucket ladder* — so after the engine's
+start-up warm-up each dispatch hits an ALREADY-COMPILED executable
+(per-bucket compile-cache keys in ``runtime.entry_points.knn_query``;
+per-bucket jit-cache keys in the query-sharded mesh plane). Dynamic
+shapes would re-trace per distinct batch size — the one latency cliff a
+serving path cannot afford.
+
+Ladder shape: ascending multiples of 8 (the fused kernel's query-block
+sublane quantum), topped by the autotuner's ``Qb`` sweet spot by
+default — the batch size the measured-best fused config was tuned at,
+so a full bucket runs the kernel exactly at its tuned operating point.
+Smaller rungs exist so a near-empty queue is not taxed with a full
+``Qb`` pad (pad rows cost real kernel time).
+
+Env knobs:
+
+- ``RAFT_TPU_SERVING_BUCKETS`` — comma-separated row counts (each
+  rounded UP to a multiple of 8, sorted, deduplicated; at most
+  :data:`MAX_BUCKETS` rungs). An unparseable spec degrades to the
+  default ladder with a logged reason and a ``marker`` timeline event
+  (the tune-table loader contract: corrupt config must never break
+  serving).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+#: quantum every bucket rounds up to (the fused kernel's query sublanes)
+ROW_QUANTUM = 8
+#: ladder length cap — each rung is one warmed executable per geometry
+MAX_BUCKETS = 8
+
+BUCKETS_ENV = "RAFT_TPU_SERVING_BUCKETS"
+
+
+def default_bucket_ladder(qb: int) -> Tuple[int, ...]:
+    """The built-in ladder for a tuned query-block sweet spot ``qb``:
+    geometric rungs qb/16 → qb/4 → qb (each rounded up to the row
+    quantum, deduplicated) — small enough that a trickle of traffic
+    pays little padding, topped at the tuned batch size."""
+    qb = max(ROW_QUANTUM, int(qb))
+    raw = (qb // 16, qb // 4, qb)
+    out = []
+    for b in raw:
+        b = max(ROW_QUANTUM, -(-b // ROW_QUANTUM) * ROW_QUANTUM)
+        if b not in out:
+            out.append(b)
+    return tuple(sorted(out))
+
+
+def _degrade(spec: str, reason: str, qb: int) -> Tuple[int, ...]:
+    from raft_tpu.core.logger import log_warn
+
+    log_warn("%s=%r is invalid (%s) — using the default bucket ladder",
+             BUCKETS_ENV, spec, reason)
+    try:
+        from raft_tpu.observability.timeline import emit_marker
+
+        emit_marker("serving.buckets.degraded", spec=spec[:100],
+                    reason=reason)
+    except Exception:
+        pass
+    return default_bucket_ladder(qb)
+
+
+def bucket_ladder(qb: int, spec: Optional[str] = None) -> Tuple[int, ...]:
+    """Resolve the bucket ladder: explicit ``spec`` (or the
+    ``RAFT_TPU_SERVING_BUCKETS`` env), validated and normalized —
+    ascending, multiples of :data:`ROW_QUANTUM`, ≤ :data:`MAX_BUCKETS`
+    rungs — falling back to :func:`default_bucket_ladder` on anything
+    unusable."""
+    spec = os.environ.get(BUCKETS_ENV, "") if spec is None else spec
+    spec = spec.strip()
+    if not spec:
+        return default_bucket_ladder(qb)
+    try:
+        raw = [int(tok) for tok in spec.replace(";", ",").split(",")
+               if tok.strip()]
+    except ValueError as e:
+        return _degrade(spec, f"not integers: {e}", qb)
+    if not raw:
+        return _degrade(spec, "empty ladder", qb)
+    if any(b <= 0 for b in raw):
+        return _degrade(spec, "buckets must be positive", qb)
+    out = []
+    for b in raw:
+        b = -(-b // ROW_QUANTUM) * ROW_QUANTUM   # round UP to the quantum
+        if b not in out:
+            out.append(b)
+    out.sort()
+    if len(out) > MAX_BUCKETS:
+        return _degrade(spec, f"more than {MAX_BUCKETS} rungs", qb)
+    return tuple(out)
+
+
+def bucket_for(n_rows: int, ladder: Sequence[int]) -> Optional[int]:
+    """Smallest bucket that fits ``n_rows``, or None when the batch is
+    larger than the top rung (the caller splits — or, for one oversized
+    REQUEST, rejects with a classified error)."""
+    for b in ladder:
+        if n_rows <= b:
+            return b
+    return None

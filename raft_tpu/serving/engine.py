@@ -1,0 +1,673 @@
+"""The closed-loop micro-batching query engine (ISSUE 7 tentpole).
+
+The repo's front door so far is a library call — one caller, one batch.
+This module is the millions-of-users shape: a thread-safe request queue
+that COALESCES arriving queries into dynamic micro-batches, pads each
+batch up to the bucket ladder (:mod:`raft_tpu.serving.buckets` — a
+small fixed set of pre-AOT-compiled shapes, warmed at engine start via
+``runtime.entry_points.knn_query``, so no live request ever pays a
+trace/compile), and dispatches them against an immutable
+:class:`~raft_tpu.serving.snapshot.IndexSnapshot` (background
+rebuild-and-swap for updates — readers never block on a swap).
+
+Resilience is the PR-5 runtime, reused:
+
+- per-request **admission control**: an oversized request (> the top
+  bucket) is rejected with a classified :class:`RequestTooLargeError`
+  (never silently truncated); a full queue **sheds** the request with
+  :class:`OverloadShedError` — recorded as a NEW degradation-ladder
+  rung (``shed:overload``) rather than letting latency grow into a
+  hang; a request whose deadline expires while still queued is failed
+  with ``DeadlineExceededError`` at batch-assembly time instead of
+  wasting a dispatch.
+- per-batch :func:`raft_tpu.resilience.deadline` scopes: the batcher
+  thread arms the MINIMUM remaining budget across the batch, so a hung
+  dispatch converts into a typed error within one poll interval. The
+  thread-safe re-entrant token rework (this PR) is what makes per-batch
+  scopes on a worker thread safe next to callers' own scopes.
+- fault sites ``serving_enqueue`` / ``serving_flush`` make both halves
+  of the pipe injectable (``RAFT_TPU_FAULTS``).
+
+Observability: every admitted request, flush, shed and swap emits a
+``serving`` flight-recorder event (:func:`raft_tpu.observability.
+timeline.emit_serving`); queue depth is a live gauge, request latency a
+p50/p99-capable histogram, and every batch/bucket/shed transition a
+labeled counter through the MetricsRegistry — the evidence surface
+``benchmarks/bench_serving.py`` turns into the ``BENCH_SERVING.json``
+SLO artifact.
+
+Env knobs (see README "Serving & SLO workflow"):
+
+- ``RAFT_TPU_SERVING_BUCKETS``   — bucket ladder (buckets.py)
+- ``RAFT_TPU_SERVING_FLUSH_MS``  — flush window for a partial batch
+  (default 2 ms: the oldest queued request never waits longer than
+  this for co-riders before dispatching)
+- ``RAFT_TPU_SERVING_QUEUE_CAP`` — max queued QUERY ROWS before
+  admission sheds (default 4096)
+- ``RAFT_TPU_SERVING_DEADLINE_S`` — default per-request deadline
+  budget (unset = requests carry no deadline unless submitted with one)
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from raft_tpu.core import interruptible
+from raft_tpu.core.error import (DeadlineExceededError, LogicError,
+                                 RaftException, expects)
+from raft_tpu.core.resources import ensure_resources
+from raft_tpu.observability import instrument
+from raft_tpu.observability.timeline import emit_serving
+from raft_tpu.resilience import deadline, fault_point, record_degradation
+from raft_tpu.serving.buckets import bucket_for, bucket_ladder
+from raft_tpu.serving.snapshot import IndexSnapshot, SnapshotStore
+
+# metric names (the serving slice of the registry vocabulary)
+REQUESTS = "raft_tpu_serving_requests_total"
+LATENCY = "raft_tpu_serving_latency_seconds"
+QUEUE_DEPTH = "raft_tpu_serving_queue_rows"
+BATCHES = "raft_tpu_serving_batches_total"
+BATCH_PAD_ROWS = "raft_tpu_serving_batch_pad_rows_total"
+SHED = "raft_tpu_serving_shed_total"
+
+FLUSH_MS_ENV = "RAFT_TPU_SERVING_FLUSH_MS"
+QUEUE_CAP_ENV = "RAFT_TPU_SERVING_QUEUE_CAP"
+DEADLINE_ENV = "RAFT_TPU_SERVING_DEADLINE_S"
+
+#: bounded retries for requests bumped out of a batch by a NEIGHBOR's
+#: deadline firing (the request itself still has budget) — one requeue,
+#: then honest failure
+_MAX_REQUEUES = 1
+
+
+class RequestTooLargeError(LogicError):
+    """Request exceeds the largest bucket of the serving ladder —
+    rejected at admission (classified, never silently truncated; split
+    client-side or raise the ladder via RAFT_TPU_SERVING_BUCKETS)."""
+
+
+class OverloadShedError(RaftException):
+    """Admission control shed this request: the queue is at its row
+    cap. Shedding is the engine's overload degradation rung — callers
+    back off / retry; the engine never converts overload into unbounded
+    queueing latency."""
+
+
+class ServingFuture:
+    """Completion handle for one submitted request."""
+
+    __slots__ = ("_event", "_vals", "_ids", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._vals = None
+        self._ids = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def _complete(self, vals, ids) -> None:
+        self._vals, self._ids = vals, ids
+        self._event.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self._error = error
+        self._event.set()
+
+    def exception(self, timeout: Optional[float] = None
+                  ) -> Optional[BaseException]:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request still pending")
+        return self._error
+
+    def result(self, timeout: Optional[float] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Block for this request's (values [n, k], ids [n, k]);
+        re-raises the request's classified failure."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._vals, self._ids
+
+
+class _Request:
+    __slots__ = ("x", "n", "enqueued_at", "deadline_at", "future",
+                 "requeues")
+
+    def __init__(self, x, n, enqueued_at, deadline_at, future):
+        self.x = x
+        self.n = n
+        self.enqueued_at = enqueued_at
+        self.deadline_at = deadline_at
+        self.future = future
+        self.requeues = 0
+
+
+@instrument("serving.execute_batch")
+def execute_batch(plane, snap: IndexSnapshot, x: np.ndarray, bucket: int,
+                  n_valid: int, budget_s: Optional[float] = None
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Dispatch ONE coalesced micro-batch against one snapshot.
+
+    ``x`` [n_valid, d] is the concatenated request rows; it is padded
+    up to ``bucket`` (a pre-warmed shape — see the module doc) and run
+    through the engine's data ``plane``. ``budget_s`` (the minimum
+    remaining request budget) arms a :func:`deadline` scope on THIS
+    thread; the completion wait polls the cancellation token, so a hung
+    dispatch converts instead of blocking the batcher forever. Carries
+    the ``serving_flush`` fault site — OOM/error/timeout/hang at the
+    flush are all injectable without touching the engine."""
+    emit_serving("flush", bucket=bucket, rows=n_valid,
+                 generation=snap.generation,
+                 budget_s=budget_s)
+    from raft_tpu.distance.knn_fused import pad_query_rows
+
+    xp = pad_query_rows(x, bucket)
+
+    def _dispatch():
+        # the fault site sits INSIDE the deadline scope: an injected
+        # hang here must be cancellable exactly like a real stuck
+        # dispatch (the scope converts it within one poll interval)
+        fault_point("serving_flush")
+        vals, ids = plane(snap, xp)
+        interruptible.synchronize(vals, ids)
+        return vals, ids
+
+    if budget_s is not None:
+        with deadline(budget_s, label="serving_flush"):
+            vals, ids = _dispatch()
+    else:
+        vals, ids = _dispatch()
+    return np.asarray(vals)[:n_valid], np.asarray(ids)[:n_valid]
+
+
+class ServingEngine:
+    """Dynamic micro-batching KNN serving engine.
+
+    ``index`` may be a prepared :class:`~raft_tpu.distance.knn_fused.
+    KnnIndex` or a raw [m, d] matrix (prepared at construction).
+    ``mesh`` switches the data plane from the single-device AOT entry
+    (``runtime.knn_query``) to the PR-4 query-sharded replicated-index
+    mode (``knn_fused_sharded(shard_mode="query")``) — data-parallel
+    queries over the mesh axis, zero cross-shard merge traffic.
+
+    Lifecycle::
+
+        eng = ServingEngine(index, k=64)
+        eng.start()                      # warms every bucket (AOT)
+        fut = eng.submit(q, deadline_s=0.05)
+        vals, ids = fut.result()
+        eng.update_index(new_y)          # background rebuild-and-swap
+        eng.stop()
+
+    ``clock`` is injectable (tests/benchmarks pin a deterministic
+    clock for deadline/ageing accounting; the batcher's waits stay
+    real-time ticks).
+    """
+
+    def __init__(self, index, k: int, *, res=None, mesh=None,
+                 axis: str = "x",
+                 buckets: Union[str, Sequence[int], None] = None,
+                 flush_interval_s: Optional[float] = None,
+                 max_queue_rows: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 passes: int = 3, metric: str = "l2",
+                 T: Optional[int] = None, Qb: Optional[int] = None,
+                 g: Optional[int] = None,
+                 grid_order: Optional[str] = None,
+                 store_yp: bool = True,
+                 rescore: Optional[bool] = None,
+                 certify: str = "kernel",
+                 clock=time.monotonic):
+        from raft_tpu.distance.knn_fused import KnnIndex
+
+        self.res = ensure_resources(res)
+        self.k = int(k)
+        self._mesh, self._axis = mesh, axis
+        self._rescore, self._certify = rescore, certify
+        self._clock = clock
+        self._build_kw = dict(passes=passes, metric=metric, T=T, Qb=Qb,
+                              g=g, grid_order=grid_order,
+                              store_yp=store_yp)
+        if isinstance(index, KnnIndex):
+            initial = index
+        else:
+            initial = self._build_index(np.asarray(index, np.float32))
+        expects(self.k <= initial.n_rows,
+                "ServingEngine: k=%d > index size %d", self.k,
+                initial.n_rows)
+        self.d = initial.d_orig
+        self._store = SnapshotStore(self._build_index,
+                                    initial_index=initial)
+        if buckets is None or isinstance(buckets, str):
+            self._ladder = bucket_ladder(initial.Qb, buckets)
+        else:
+            self._ladder = bucket_ladder(
+                initial.Qb, ",".join(str(int(b)) for b in buckets))
+        if flush_interval_s is None:
+            try:
+                flush_interval_s = float(
+                    os.environ.get(FLUSH_MS_ENV, "2")) / 1e3
+            except (TypeError, ValueError):
+                flush_interval_s = 2e-3
+        self._flush_interval_s = max(1e-4, float(flush_interval_s))
+        if max_queue_rows is None:
+            try:
+                max_queue_rows = int(os.environ.get(QUEUE_CAP_ENV,
+                                                    "4096"))
+            except (TypeError, ValueError):
+                max_queue_rows = 4096
+        self._max_queue_rows = max(self._ladder[-1], int(max_queue_rows))
+        if default_deadline_s is None:
+            env = os.environ.get(DEADLINE_ENV, "").strip()
+            if env:
+                try:
+                    default_deadline_s = float(env)
+                except (TypeError, ValueError):
+                    default_deadline_s = None
+        self._default_deadline_s = default_deadline_s
+
+        self._cond = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._depth_rows = 0
+        self._stop = False
+        self._busy = False
+        self._force_flush = False
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+        self._latencies: collections.deque = collections.deque(
+            maxlen=4096)
+        self._stats = collections.Counter()
+
+    # -- construction helpers --------------------------------------------
+    def _build_index(self, y):
+        from raft_tpu.distance.knn_fused import prepare_knn_index
+
+        return prepare_knn_index(y, **self._build_kw)
+
+    def _plane(self, snap: IndexSnapshot, xb):
+        """The data plane for one padded bucket batch: the AOT runtime
+        entry on one device, or the PR-4 query-sharded replicated-index
+        mode over the mesh."""
+        if self._mesh is not None:
+            from raft_tpu.distance.knn_sharded import knn_fused_sharded
+
+            return knn_fused_sharded(
+                xb, snap.index, self.k, mesh=self._mesh,
+                axis=self._axis, shard_mode="query",
+                rescore=self._rescore, certify=self._certify,
+                res=self.res)
+        from raft_tpu.runtime.entry_points import knn_query
+
+        return knn_query(self.res, snap.index, xb, self.k,
+                         rescore=self._rescore, certify=self._certify)
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        return self._ladder
+
+    @property
+    def started(self) -> bool:
+        return self._started
+
+    def start(self) -> "ServingEngine":
+        """Warm every bucket shape (AOT compile through the runtime
+        entry — live requests then always hit the compile cache) and
+        start the batcher thread. Idempotent."""
+        with self._cond:
+            if self._started:
+                return self
+            self._started = True
+            self._stop = False
+        self._warm_snapshot(self._store.current())
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serving-batcher",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Drain the queue, then stop the batcher."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        self._thread = None
+        with self._cond:
+            self._started = False
+
+    def _warm_snapshot(self, snap: IndexSnapshot) -> None:
+        """Pre-compile every bucket shape against ``snap`` — run at
+        start-up AND against a freshly rebuilt snapshot BEFORE it is
+        swapped in, so a geometry-changing update cannot push a compile
+        onto the request path."""
+        misses0 = self.res.compile_cache.misses
+        for b in self._ladder:
+            x0 = np.zeros((b, self.d), np.float32)
+            vals, ids = self._plane(snap, x0)
+            interruptible.synchronize(vals, ids)
+            emit_serving("warmup", bucket=b, generation=snap.generation)
+        self._stats["warmed_buckets"] = len(self._ladder)
+        self._stats["warmup_compiles"] += (
+            self.res.compile_cache.misses - misses0)
+
+    # -- admission --------------------------------------------------------
+    def submit(self, x, deadline_s: Optional[float] = None
+               ) -> ServingFuture:
+        """Enqueue one request of [n, d] (or [d]) query rows; returns a
+        :class:`ServingFuture`. Admission control happens HERE:
+        oversized requests raise :class:`RequestTooLargeError`, a full
+        queue raises :class:`OverloadShedError` (counted as the
+        ``shed:overload`` degradation rung). Carries the
+        ``serving_enqueue`` fault site."""
+        fault_point("serving_enqueue")
+        x = np.asarray(x, np.float32)
+        if x.ndim == 1:
+            x = x[None]
+        expects(x.ndim == 2 and x.shape[1] == self.d,
+                "serving: request must be [n, %d] query rows (got %s)",
+                self.d, x.shape)
+        n = x.shape[0]
+        if n == 0:
+            fut = ServingFuture()
+            fut._complete(np.zeros((0, self.k), np.float32),
+                          np.zeros((0, self.k), np.int32))
+            return fut
+        if n > self._ladder[-1]:
+            self._count_request("rejected")
+            emit_serving("reject", rows=n, top_bucket=self._ladder[-1])
+            raise RequestTooLargeError(
+                f"serving: request of {n} rows exceeds the largest "
+                f"bucket {self._ladder[-1]} — split it client-side or "
+                f"raise the ladder (RAFT_TPU_SERVING_BUCKETS)")
+        now = self._clock()
+        budget = (deadline_s if deadline_s is not None
+                  else self._default_deadline_s)
+        req = _Request(x, n, now,
+                       now + budget if budget else None,
+                       ServingFuture())
+        with self._cond:
+            if self._depth_rows + n > self._max_queue_rows:
+                self._count_request("shed")
+                self._stats["shed"] += 1
+                try:
+                    self.res.metrics.counter(
+                        SHED, help="Requests shed by admission control "
+                                   "(queue at its row cap)").inc()
+                except Exception:
+                    pass
+                record_degradation("serving.engine", "shed:overload")
+                emit_serving("shed", rows=n,
+                             queue_rows=self._depth_rows)
+                raise OverloadShedError(
+                    f"serving: queue at capacity "
+                    f"({self._depth_rows}/{self._max_queue_rows} rows)"
+                    f" — request shed; back off and retry")
+            self._queue.append(req)
+            self._depth_rows += n
+            self._gauge_depth()
+            emit_serving("enqueue", rows=n,
+                         queue_rows=self._depth_rows,
+                         deadline_s=budget)
+            self._cond.notify_all()
+        return req.future
+
+    def query(self, x, deadline_s: Optional[float] = None,
+              timeout: Optional[float] = 60.0
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        """Blocking convenience: submit + wait."""
+        return self.submit(x, deadline_s=deadline_s).result(timeout)
+
+    # -- index updates ----------------------------------------------------
+    def update_index(self, y, block: bool = False):
+        """Rebuild the index from ``y`` and swap it in — in the
+        background by default; queries keep hitting the current
+        snapshot until the new one is built AND pre-warmed (every
+        bucket compiled against the new geometry before the swap), so
+        readers never block and never pay a compile."""
+        y = np.asarray(y, np.float32)
+        expects(y.ndim == 2 and y.shape[1] == self.d,
+                "serving: replacement index must be [m, %d] (got %s)",
+                self.d, y.shape)
+        expects(self.k <= y.shape[0],
+                "serving: k=%d > replacement index size %d", self.k,
+                y.shape[0])
+        store = self._store
+
+        def _builder(yy, **kw):
+            idx = self._build_index(yy)
+            if self._started:
+                # pre-swap warm on a TEMP snapshot (generation stamped
+                # by the store when it swaps)
+                self._warm_snapshot(IndexSnapshot(idx, -1))
+            return idx
+
+        prev_builder = store._builder
+        store._builder = _builder
+        try:
+            return store.update(y, block=block)
+        finally:
+            if block:
+                store._builder = prev_builder
+
+    @property
+    def snapshot(self) -> IndexSnapshot:
+        return self._store.current()
+
+    # -- metrics helpers --------------------------------------------------
+    def _count_request(self, status: str) -> None:
+        self._stats[f"requests_{status}"] += 1
+        try:
+            self.res.metrics.counter(
+                REQUESTS, {"status": status},
+                help="Serving requests by terminal status").inc()
+        except Exception:
+            pass
+
+    def _gauge_depth(self) -> None:
+        try:
+            self.res.metrics.gauge(
+                QUEUE_DEPTH, help="Query rows currently queued"
+            ).set(self._depth_rows)
+        except Exception:
+            pass
+
+    def _observe_latency(self, seconds: float) -> None:
+        self._latencies.append(seconds)
+        try:
+            self.res.metrics.histogram(
+                LATENCY, help="End-to-end request latency (enqueue → "
+                              "completion)").observe(seconds)
+        except Exception:
+            pass
+
+    def stats(self) -> dict:
+        """Live counters + latency percentiles (engine-side; the
+        BENCH_SERVING artifact measures client-side)."""
+        with self._cond:
+            out = dict(self._stats)
+            out["queue_rows"] = self._depth_rows
+        lat = sorted(self._latencies)
+        if lat:
+            out["p50_ms"] = 1e3 * lat[len(lat) // 2]
+            out["p99_ms"] = 1e3 * lat[min(len(lat) - 1,
+                                          int(len(lat) * 0.99))]
+        out["generation"] = self._store.generation
+        out["compile_misses"] = self.res.compile_cache.misses
+        out["buckets"] = self._ladder
+        return out
+
+    # -- the batcher ------------------------------------------------------
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Force-drain the queue; returns True once empty and idle.
+        The deterministic lever tests and benchmarks use instead of
+        sleeping through flush windows."""
+        t_end = time.monotonic() + timeout
+        with self._cond:
+            self._force_flush = True
+            self._cond.notify_all()
+            while ((self._queue or self._busy)
+                   and time.monotonic() < t_end):
+                self._cond.wait(0.01)
+            drained = not self._queue and not self._busy
+            self._force_flush = False
+            return drained
+
+    def _pop_batch_locked(self):
+        """Assemble the next batch under the lock: greedy pops up to
+        the top bucket, failing queue-expired requests on the way (the
+        admission half of the deadline contract — an expired request
+        never wastes a dispatch)."""
+        now = self._clock()
+        batch = []
+        total = 0
+        expired = []
+        while self._queue:
+            req = self._queue[0]
+            if req.deadline_at is not None and req.deadline_at <= now:
+                self._queue.popleft()
+                self._depth_rows -= req.n
+                expired.append(req)
+                continue
+            if total + req.n > self._ladder[-1]:
+                break
+            self._queue.popleft()
+            self._depth_rows -= req.n
+            batch.append(req)
+            total += req.n
+        self._gauge_depth()
+        return batch, total, expired
+
+    def _fail_expired(self, expired) -> None:
+        for req in expired:
+            self._count_request("deadline")
+            self._stats["expired_in_queue"] += 1
+            req.future._fail(DeadlineExceededError(
+                "serving: request deadline expired while queued",
+                seconds=(req.deadline_at - req.enqueued_at
+                         if req.deadline_at else None)))
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    if self._stop:
+                        break
+                    if self._queue:
+                        now = self._clock()
+                        total = sum(r.n for r in self._queue)
+                        oldest = self._queue[0].enqueued_at
+                        if (self._force_flush
+                                or total >= self._ladder[-1]
+                                or now - oldest
+                                >= self._flush_interval_s):
+                            break
+                        self._cond.wait(self._flush_interval_s / 2)
+                    else:
+                        # empty-queue flush timer tick: nothing to
+                        # dispatch — the timer is a no-op, not a batch
+                        self._cond.wait(self._flush_interval_s)
+                if self._stop and not self._queue:
+                    self._busy = False
+                    self._cond.notify_all()
+                    return
+                batch, total, expired = self._pop_batch_locked()
+                self._busy = bool(batch)
+            self._fail_expired(expired)
+            if batch:
+                try:
+                    self._run_batch(batch, total)
+                finally:
+                    with self._cond:
+                        self._busy = False
+                        self._cond.notify_all()
+
+    def _run_batch(self, batch, total: int) -> None:
+        snap = self._store.current()       # ONE snapshot per batch —
+        #                                    every rider sees one index
+        bucket = bucket_for(total, self._ladder)
+        x = (batch[0].x if len(batch) == 1
+             else np.concatenate([r.x for r in batch], axis=0))
+        now = self._clock()
+        budgets = [r.deadline_at - now for r in batch
+                   if r.deadline_at is not None]
+        budget = min(budgets) if budgets else None
+        if budget is not None and budget <= 0:
+            # raced to expiry between assembly and dispatch
+            self._fail_expired([r for r in batch
+                                if r.deadline_at is not None
+                                and r.deadline_at <= now])
+            batch = [r for r in batch
+                     if r.deadline_at is None or r.deadline_at > now]
+            if not batch:
+                return
+            return self._run_batch(batch, sum(r.n for r in batch))
+        self._stats["batches"] += 1
+        self._stats["padded_rows"] += bucket - total
+        try:
+            self.res.metrics.counter(
+                BATCHES, {"bucket": str(bucket)},
+                help="Dispatched micro-batches by bucket").inc()
+            self.res.metrics.counter(
+                BATCH_PAD_ROWS,
+                help="Pad rows dispatched (bucket − real rows)"
+            ).inc(bucket - total)
+        except Exception:
+            pass
+        try:
+            vals, ids = execute_batch(self._plane, snap, x, bucket,
+                                      total, budget)
+        except DeadlineExceededError as e:
+            self._on_batch_deadline(batch, e)
+            return
+        except Exception as e:
+            for req in batch:
+                self._count_request("error")
+                req.future._fail(e)
+            return
+        off = 0
+        done = self._clock()
+        for req in batch:
+            req.future._complete(vals[off:off + req.n],
+                                 ids[off:off + req.n])
+            off += req.n
+            self._count_request("ok")
+            self._observe_latency(max(0.0, done - req.enqueued_at))
+
+    def _on_batch_deadline(self, batch, err: DeadlineExceededError
+                           ) -> None:
+        """A batch deadline fired: requests whose OWN budget expired
+        fail with the deadline error; riders that still have budget are
+        re-queued once (at the head — they have waited longest) and
+        fail honestly on a second strike."""
+        now = self._clock()
+        requeue = []
+        for req in batch:
+            if req.deadline_at is not None and req.deadline_at <= now:
+                self._count_request("deadline")
+                req.future._fail(err)
+            elif req.requeues >= _MAX_REQUEUES:
+                self._count_request("error")
+                req.future._fail(err)
+            else:
+                req.requeues += 1
+                requeue.append(req)
+        if requeue:
+            self._stats["requeued"] += len(requeue)
+            with self._cond:
+                for req in reversed(requeue):
+                    self._queue.appendleft(req)
+                    self._depth_rows += req.n
+                self._gauge_depth()
+                self._cond.notify_all()

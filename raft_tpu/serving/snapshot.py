@@ -1,0 +1,174 @@
+"""Immutable index snapshots with background rebuild-and-swap.
+
+The serving engine never queries a mutable index: it queries an
+:class:`IndexSnapshot` — a frozen (prepared index, generation) pair —
+taken ONCE per micro-batch, so every request coalesced into a batch
+sees one consistent index even while an update is in flight (the
+snapshot-swap-mid-batch consistency contract pinned by
+tests/test_serving.py).
+
+Updates go through :class:`SnapshotStore`:
+
+- ``current()`` is a lock-free attribute read — readers NEVER block on
+  a swap (the reference ecosystem's index objects get the same
+  copy-on-write treatment in cuVS serving deployments).
+- ``update(y)`` rebuilds the index on a background thread (operand prep
+  is the expensive part — ~3 ms at 1M×128, arbitrarily long at scale)
+  and atomically swaps the new snapshot in when done; queries keep
+  hitting the OLD snapshot until the swap, then new batches pick up the
+  new generation. A failed build leaves the old snapshot untouched
+  (counted + logged, never propagated into the query path).
+- generation numbers are monotonic; ids returned for one request are
+  always consistent with exactly one generation.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from raft_tpu.observability import instrument
+from raft_tpu.resilience import fault_point
+
+SNAPSHOT_SWAPS = "raft_tpu_serving_snapshot_swaps_total"
+SNAPSHOT_FAILURES = "raft_tpu_serving_snapshot_failures_total"
+
+
+class IndexSnapshot:
+    """One frozen (index, generation) pair. The ``index`` is a prepared
+    :class:`~raft_tpu.distance.knn_fused.KnnIndex` (or sharded sibling)
+    whose operands are immutable jax arrays — nothing here is ever
+    mutated after construction."""
+
+    __slots__ = ("index", "generation", "n_rows")
+
+    def __init__(self, index, generation: int):
+        self.index = index
+        self.generation = generation
+        self.n_rows = int(getattr(index, "n_rows", 0))
+
+    def __repr__(self):
+        return (f"IndexSnapshot(gen={self.generation}, "
+                f"n_rows={self.n_rows})")
+
+
+@instrument("serving.build_snapshot")
+def build_snapshot(y, builder: Callable, generation: int,
+                   **build_kw) -> IndexSnapshot:
+    """Build one snapshot: run the index ``builder`` (default:
+    ``distance.prepare_knn_index`` — the engine passes the bound
+    builder for its data plane) over the new matrix. Carries the
+    ``serving_snapshot`` fault site so a failing rebuild is injectable;
+    a failure here must leave the store's current snapshot untouched
+    (SnapshotStore.update guarantees that)."""
+    fault_point("serving_snapshot")
+    return IndexSnapshot(builder(y, **build_kw), generation)
+
+
+class SnapshotStore:
+    """Holder of the current :class:`IndexSnapshot` + the background
+    rebuild machinery. ``current()`` is one attribute read; ``swap()``
+    and generation accounting hold a small lock; at most one background
+    rebuild runs at a time (a second ``update`` while one is in flight
+    queues behind it on the builder thread's completion)."""
+
+    def __init__(self, builder: Callable, initial_index=None):
+        self._builder = builder
+        self._lock = threading.Lock()
+        self._generation = 0
+        self._current: Optional[IndexSnapshot] = None
+        self._build_thread: Optional[threading.Thread] = None
+        self._last_error: Optional[BaseException] = None
+        if initial_index is not None:
+            self._current = IndexSnapshot(initial_index, 0)
+
+    # -- readers (lock-free) ---------------------------------------------
+    def current(self) -> Optional[IndexSnapshot]:
+        """The live snapshot — a bare attribute read, never blocking on
+        an in-flight rebuild/swap."""
+        return self._current
+
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    @property
+    def last_error(self) -> Optional[BaseException]:
+        """The most recent FAILED rebuild's error (diagnostic only —
+        failures never surface into the query path)."""
+        return self._last_error
+
+    # -- writers ----------------------------------------------------------
+    def swap(self, snapshot: IndexSnapshot) -> IndexSnapshot:
+        """Atomically install ``snapshot`` as current; returns the
+        previous one. Counted + emitted so swaps are visible in the
+        flight timeline next to the batches they interleave with."""
+        with self._lock:
+            prev, self._current = self._current, snapshot
+        try:
+            from raft_tpu.observability import get_registry
+            from raft_tpu.observability.timeline import emit_serving
+
+            get_registry().counter(
+                SNAPSHOT_SWAPS,
+                help="Index snapshot swaps installed").inc()
+            emit_serving("swap", generation=snapshot.generation,
+                         n_rows=snapshot.n_rows)
+        except Exception:
+            pass
+        return prev
+
+    def update(self, y, block: bool = False, **build_kw):
+        """Rebuild from ``y`` and swap when ready. ``block=False``
+        (default) runs the build on a background thread and returns it
+        immediately — readers keep the old snapshot until the swap;
+        ``block=True`` builds inline (tests, cold start). A failed
+        build counts + records the error and leaves the current
+        snapshot in place."""
+        with self._lock:
+            self._generation += 1
+            gen = self._generation
+
+        def _build():
+            try:
+                snap = build_snapshot(y, self._builder, gen, **build_kw)
+            except Exception as e:
+                self._last_error = e
+                try:
+                    from raft_tpu.observability import get_registry
+
+                    get_registry().counter(
+                        SNAPSHOT_FAILURES,
+                        help="Index snapshot rebuilds that failed "
+                             "(old snapshot kept serving)").inc()
+                except Exception:
+                    pass
+                from raft_tpu.core.logger import log_warn
+
+                log_warn("serving: snapshot rebuild (gen %d) failed "
+                         "(%s: %s) — keeping the current snapshot",
+                         gen, type(e).__name__, str(e)[:200])
+                return
+            with self._lock:
+                # a swap is installed only if no NEWER generation beat
+                # us to it (two racing updates: last requested wins)
+                cur = self._current
+                if cur is not None and cur.generation > gen:
+                    return
+            self.swap(snap)
+
+        if block:
+            _build()
+            return None
+        t = threading.Thread(target=_build, name=f"snapshot-build-{gen}",
+                             daemon=True)
+        with self._lock:
+            self._build_thread = t
+        t.start()
+        return t
+
+    def wait_for_builds(self, timeout: Optional[float] = None) -> None:
+        """Join the most recent background build (tests/shutdown)."""
+        t = self._build_thread
+        if t is not None:
+            t.join(timeout)

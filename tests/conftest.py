@@ -32,6 +32,15 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` (ROADMAP): long wall-clock load tests
+    # (the Poisson serving soak) carry this marker; each slow test must
+    # have a fast deterministic sibling that stays in tier-1
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running wall-clock tests excluded from tier-1")
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()

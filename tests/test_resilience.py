@@ -214,6 +214,25 @@ def _coo_small():
     return COOMatrix(r, c, v, (64, 64))
 
 
+_serving_engine = None
+
+
+def _drive_serving_enqueue():
+    """Cheap route through the serving_enqueue fault site: the fault
+    fires at admission, before the engine needs a batcher thread."""
+    global _serving_engine
+    from raft_tpu.serving import ServingEngine
+
+    if _serving_engine is None:
+        from raft_tpu.distance.knn_fused import prepare_knn_index
+
+        idx = prepare_knn_index(
+            rng.normal(size=(64, 8)).astype(np.float32),
+            passes=3, T=256, Qb=32, g=2)
+        _serving_engine = ServingEngine(idx, k=2, buckets=(8,))
+    return _serving_engine.submit(np.ones((2, 8), np.float32))
+
+
 def _always_raise_drivers():
     """site → cheap call routing through that site (the fault fires at
     the site before real work starts, so dummy-sized args are fine)."""
@@ -258,11 +277,16 @@ def _always_raise_drivers():
         "host_sync": lambda: hc.sync_stream(jnp.ones(2)),
         "aot_compile": _drive_aot,
         "aot_dispatch": _drive_aot,
+        "serving_enqueue": _drive_serving_enqueue,
         "sharded_dispatch": None,      # dedicated ladder tests below
         "merge_permute": None,
         "merge_allgather": None,
         "tune_table_read": None,       # corrupt-kind tests below
         "plan_cache_read": None,
+        # serving flush/snapshot: dedicated batch/swap injection tests
+        # in tests/test_serving.py (the engine needs a running batcher)
+        "serving_flush": None,
+        "serving_snapshot": None,
     }
 
 
@@ -525,6 +549,112 @@ def test_deadline_scope_exits_clean():
         with deadline(0.05):
             time.sleep(0.15)
     interruptible.yield_()          # and the token is clean afterwards
+
+
+def test_deadline_scopes_thread_isolated():
+    """ISSUE 7 satellite regression: two CONCURRENT deadline scopes on
+    different threads — the short one fires on its own thread only; the
+    long one's work is never cancelled by it (tokens are thread-local,
+    arms are lock-guarded)."""
+    import threading
+
+    outcomes = {}
+    barrier = threading.Barrier(2)
+
+    def short_lived():
+        barrier.wait()
+        try:
+            with deadline(0.15, label="short"):
+                while True:
+                    interruptible.yield_()
+                    time.sleep(0.002)
+        except DeadlineExceededError as e:
+            outcomes["short"] = e
+
+    def long_lived():
+        barrier.wait()
+        try:
+            with deadline(30.0, label="long"):
+                t0 = time.monotonic()
+                # polls well past the short scope's expiry
+                while time.monotonic() - t0 < 0.4:
+                    interruptible.yield_()
+                    time.sleep(0.002)
+            outcomes["long"] = "ok"
+        except DeadlineExceededError as e:     # pragma: no cover
+            outcomes["long"] = e
+
+    ts = [threading.Thread(target=short_lived),
+          threading.Thread(target=long_lived)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert isinstance(outcomes.get("short"), DeadlineExceededError)
+    assert outcomes.get("long") == "ok"
+
+
+def test_deadline_scopes_reentrant_nested():
+    """Nested scopes on ONE thread: the inner (first-to-expire) scope
+    raises with ITS label; the outer scope stays armed and exits clean
+    — and the token is unpoisoned afterwards."""
+    with deadline(30.0, label="outer"):
+        with pytest.raises(DeadlineExceededError) as ei:
+            with deadline(0.1, label="inner"):
+                while True:
+                    interruptible.yield_()
+                    time.sleep(0.002)
+        assert "inner" in str(ei.value)
+        # the outer scope's watchdog has not fired — the thread's next
+        # cancellation point must NOT raise
+        interruptible.yield_()
+    interruptible.yield_()          # token clean after both scopes
+
+
+def test_deadline_both_scopes_expired_report_earliest():
+    """Both nested scopes expire before any cancellation point: the
+    earliest expiry (the inner scope's) is reported, each scope clears
+    only its own record, and nothing leaks onto the token."""
+    with pytest.raises(DeadlineExceededError) as ei:
+        with deadline(0.05, label="outer-short"):
+            with deadline(0.1, label="inner-late"):
+                time.sleep(0.25)        # no polls: both timers fire
+                interruptible.yield_()
+    assert "outer-short" in str(ei.value)
+    interruptible.yield_()              # token clean afterwards
+
+
+def test_interruptible_token_is_thread_local_not_ident_keyed():
+    """A recycled thread ident must never inherit a dead thread's
+    poisoned token: each new thread's first get_token() yields a fresh,
+    uncancelled token even when the registry holds a stale entry for
+    the same ident."""
+    import threading
+
+    idents = []
+
+    def poison():
+        idents.append(threading.get_ident())
+        interruptible.cancel()          # own token, left poisoned
+
+    t = threading.Thread(target=poison)
+    t.start()
+    t.join()
+    # the dead thread's registry entry is still poisoned...
+    stale = interruptible.get_token(idents[0])
+    assert stale.cancelled
+    # ...but any NEW thread's own token is created clean (thread-local
+    # lookup, never the ident registry), even if its ident collides
+    out = {}
+
+    def check():
+        tok = interruptible.get_token()
+        out["cancelled"] = tok.cancelled
+
+    t3 = threading.Thread(target=check)
+    t3.start()
+    t3.join()
+    assert out["cancelled"] is False
 
 
 def test_hostcomms_sync_stream_nothrow_abort_status():

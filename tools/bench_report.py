@@ -52,6 +52,8 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ROUND_GLOB = "BENCH_r*.json"
 MULTICHIP_GLOB = "MULTICHIP_r*.json"
+SERVING_GLOB = "SERVING_r*.json"
+SERVING_NAME = "BENCH_SERVING.json"
 BASELINE_NAME = "BENCH_LAST_GOOD.json"
 DRIFT_LEDGER_NAME = "DRIFT_LEDGER.json"
 DEFAULT_THRESHOLD = 0.15   # 15% relative drop (or slowdown) fails
@@ -67,7 +69,8 @@ DRIFT_BAND = 3.0
 # presenting them as current (SELECT_K_MATRIX / PALLAS_SMOKE / TPU_FUZZ
 # all predate multiple perf rounds at the time this gate shipped)
 NAMED_ARTIFACTS = ("SELECT_K_MATRIX.json", "PALLAS_SMOKE.json",
-                   "TPU_FUZZ.json", "BUSBW_BENCH.json")
+                   "TPU_FUZZ.json", "BUSBW_BENCH.json",
+                   "BENCH_SERVING.json")
 
 # cost-model fields Fixture.run emits into BENCH artifacts (PR 2+)
 COST_FIELDS = ("flops", "bytes_accessed", "arithmetic_intensity",
@@ -227,6 +230,158 @@ def check_multichip(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
                 f"though the headline holds")
         msg += f"; busbw_frac {bw:.3g} vs {pbw:.3g}"
     return PASS, msg
+
+
+def load_serving(path: str) -> Optional[Dict]:
+    """Flat serving-SLO record (benchmarks/bench_serving.py): unwraps
+    the driver's envelope like :func:`load_multichip`. A record must
+    carry at least an ``ok`` verdict or a latency/throughput field to
+    count."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict):
+        return None
+    rec = data.get("parsed")
+    if isinstance(rec, dict) and ("ok" in rec or "p99_ms" in rec
+                                  or "throughput_qps" in rec):
+        merged = dict(data)
+        merged.update(rec)
+        return merged
+    if "ok" in data or "p99_ms" in data or "throughput_qps" in data:
+        return data
+    return None
+
+
+def collect_serving(directory: str
+                    ) -> List[Tuple[int, str, Optional[Dict]]]:
+    """(round, path, record) for every SERVING_r*.json, in round order,
+    plus the bare BENCH_SERVING.json (when present) as the NEWEST
+    entry — the current run's artifact gates even before a driver wraps
+    it into a numbered round."""
+    out = []
+    for path in glob.glob(os.path.join(directory, SERVING_GLOB)):
+        m = re.search(r"SERVING_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        out.append((int(m.group(1)), path, load_serving(path)))
+    out.sort(key=lambda t: t[0])
+    bare = os.path.join(directory, SERVING_NAME)
+    if os.path.exists(bare):
+        n = (out[-1][0] + 1) if out else 1
+        out.append((n, bare, load_serving(bare)))
+    return out
+
+
+def check_serving(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
+                  threshold: float = DEFAULT_THRESHOLD
+                  ) -> Tuple[str, str]:
+    """Gate the serving-SLO trend (BENCH_SERVING / SERVING_r*):
+
+    - the newest parseable round must be ``ok`` (correctness parity +
+      no compile miss after warm-up — a broken serving path is a
+      regression, not a footnote);
+    - degraded rounds (nonzero resilience degradations — sheds, ladder
+      walks) are SKIPped: outage evidence is history, never a gate;
+    - only MEASURED rounds are speed-gated: when the newest and a
+      previous measured round both carry p99 latency / throughput, p99
+      must not grow past ``threshold`` and throughput must not drop
+      past it. Modeled (off-TPU) rounds pass on ``ok`` alone."""
+    newest = None
+    for _, _, rec in reversed(rounds):
+        if rec is not None:
+            newest = rec
+            break
+    if newest is None:
+        return SKIP, "no serving artifact to gate"
+    if newest.get("skipped"):
+        return SKIP, "latest serving round skipped"
+    rd = newest.get("resilience_degradations")
+    if isinstance(rd, (int, float)) and rd > 0:
+        return SKIP, (
+            f"latest serving round recorded {rd:g} degradation "
+            f"step(s) (sheds/ladder walks) — a degraded run is "
+            f"history, never gated and never baseline material")
+    if not newest.get("ok", True):
+        return REGRESS, ("latest serving round failed (ok=false) — "
+                         "the serving path regressed")
+    misses = newest.get("compile_misses_after_warmup")
+    if isinstance(misses, (int, float)) and misses > 0:
+        return REGRESS, (
+            f"latest serving round paid {misses:g} AOT compile "
+            f"miss(es) AFTER warm-up — a live request traced/compiled, "
+            f"the exact latency cliff the bucket ladder exists to "
+            f"prevent")
+    p99 = newest.get("p99_ms")
+    qps = newest.get("throughput_qps")
+    if not newest.get("measured"):
+        return PASS, ("latest serving round ok (modeled — not gated "
+                      "on speed)")
+    prev = None
+    for _, _, rec in reversed(rounds[:-1]):
+        if (rec is not None and rec.get("measured")
+                and not rec.get("skipped")
+                and isinstance(rec.get("p99_ms"), (int, float))):
+            prev = rec
+            break
+    if prev is None:
+        return PASS, (f"serving ok: p99 {p99} ms, {qps} req/s (first "
+                      f"measured round — nothing to trend against)")
+    msgs = []
+    if isinstance(p99, (int, float)) and \
+            isinstance(prev.get("p99_ms"), (int, float)):
+        ceil = prev["p99_ms"] * (1.0 + threshold)
+        if p99 > ceil:
+            return REGRESS, (
+                f"SERVING P99 REGRESSION: {p99:g} ms > {ceil:g} "
+                f"(previous measured {prev['p99_ms']:g} + "
+                f"{threshold:.0%})")
+        msgs.append(f"p99 {p99:g} vs {prev['p99_ms']:g} ms")
+    if isinstance(qps, (int, float)) and \
+            isinstance(prev.get("throughput_qps"), (int, float)) \
+            and prev["throughput_qps"] > 0:
+        floor = prev["throughput_qps"] * (1.0 - threshold)
+        if qps < floor:
+            return REGRESS, (
+                f"SERVING THROUGHPUT REGRESSION: {qps:g} req/s < "
+                f"{floor:g} (previous measured "
+                f"{prev['throughput_qps']:g} − {threshold:.0%})")
+        msgs.append(f"{qps:g} vs {prev['throughput_qps']:g} req/s")
+    return PASS, "serving ok: " + "; ".join(msgs or ["no SLO fields"])
+
+
+def serving_trajectory(rounds: Sequence[Tuple[int, str,
+                                              Optional[Dict]]]) -> str:
+    """Serving-SLO series: p50/p99/throughput per round, shed and
+    compile-miss evidence next to the ok verdict."""
+    lines = ["serving trajectory (SERVING_r*.json + BENCH_SERVING.json)",
+             "========================================================="]
+    if not rounds:
+        return "\n".join(lines + ["(no serving artifacts found)"]) + "\n"
+    cols = ("round", "ok", "p50 ms", "p99 ms", "req/s", "shed",
+            "miss>warm", "measured", "metric")
+    rows = []
+    for n, path, rec in rounds:
+        if rec is None:
+            rows.append((f"r{n:02d}", "-", "-", "-", "-", "-", "-", "-",
+                         f"<unparseable: {os.path.basename(path)}>"))
+            continue
+        rows.append((
+            f"r{n:02d}", _fmt(bool(rec.get("ok"))),
+            _fmt(rec.get("p50_ms")), _fmt(rec.get("p99_ms")),
+            _fmt(rec.get("throughput_qps")), _fmt(rec.get("shed")),
+            _fmt(rec.get("compile_misses_after_warmup")),
+            _fmt(rec.get("measured")) if "measured" in rec else "-",
+            normalize_metric(rec.get("metric", "serving"))))
+    widths = [max(len(c), *(len(str(r[i])) for r in rows))
+              for i, c in enumerate(cols)]
+    lines.append("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    lines.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        lines.append("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+    return "\n".join(lines) + "\n"
 
 
 def load_drift_ledger(path: str) -> Optional[Dict]:
@@ -563,6 +718,7 @@ def main(argv: Sequence[str] = None) -> int:
 
     rounds = collect_rounds(args.dir)
     mrounds = collect_multichip(args.dir)
+    srounds = collect_serving(args.dir)
     baseline_path = args.baseline or os.path.join(args.dir, BASELINE_NAME)
     baseline = load_record(baseline_path)
     stale = artifact_staleness(args.dir, baseline)
@@ -581,6 +737,8 @@ def main(argv: Sequence[str] = None) -> int:
         print(f"bench_report --check: {status}: {msg}")
         mstatus, mmsg = check_multichip(mrounds, args.threshold)
         print(f"bench_report --check [multichip]: {mstatus}: {mmsg}")
+        sstatus, smsg = check_serving(srounds, args.threshold)
+        print(f"bench_report --check [serving]: {sstatus}: {smsg}")
         ledger_path = args.drift_ledger or os.path.join(
             args.dir, DRIFT_LEDGER_NAME)
         dstatus, dmsg = check_drift(load_drift_ledger(ledger_path),
@@ -593,7 +751,8 @@ def main(argv: Sequence[str] = None) -> int:
         codes = {PASS: 0, SKIP: 0, REGRESS: 1, MISSING_BASELINE: 2}
         # regression in ANY trend fails; missing baseline only when
         # nothing regressed
-        rcs = (codes[status], codes[mstatus], codes[dstatus])
+        rcs = (codes[status], codes[mstatus], codes[sstatus],
+               codes[dstatus])
         return 1 if 1 in rcs else max(rcs)
 
     if args.json:
@@ -603,6 +762,9 @@ def main(argv: Sequence[str] = None) -> int:
             "multichip_rounds": [
                 {"round": n, "path": os.path.basename(path),
                  "record": rec} for n, path, rec in mrounds],
+            "serving_rounds": [
+                {"round": n, "path": os.path.basename(path),
+                 "record": rec} for n, path, rec in srounds],
             "named_artifacts": stale,
             "baseline": baseline,
             "drift_ledger": load_drift_ledger(
@@ -615,6 +777,8 @@ def main(argv: Sequence[str] = None) -> int:
     sys.stdout.write(trajectory(rounds, baseline))
     sys.stdout.write("\n")
     sys.stdout.write(multichip_trajectory(mrounds))
+    sys.stdout.write("\n")
+    sys.stdout.write(serving_trajectory(srounds))
     sys.stdout.write("\n")
     sys.stdout.write(staleness_section(stale))
     return 0

@@ -47,6 +47,8 @@ HOT_PATHS: Dict[str, Sequence[str]] = {
     "raft_tpu/tune/fused.py": ("autotune_fused",),
     "raft_tpu/tune/sharded.py": ("autotune_sharded",),
     "raft_tpu/distance/knn_sharded.py": ("knn_fused_sharded",),
+    "raft_tpu/serving/engine.py": ("execute_batch",),
+    "raft_tpu/serving/snapshot.py": ("build_snapshot",),
 }
 
 # module (repo-relative) → profiler capture methods it must call
@@ -99,6 +101,8 @@ FAULT_SITES: Dict[str, Sequence[str]] = {
     "raft_tpu/sparse/plan_cache.py": ("plan_cache_read",),
     "raft_tpu/comms/host_comms.py": ("host_collective", "host_barrier",
                                      "host_sync"),
+    "raft_tpu/serving/engine.py": ("serving_enqueue", "serving_flush"),
+    "raft_tpu/serving/snapshot.py": ("serving_snapshot",),
 }
 
 # timeline-event gate: every hot-path module and every fault-site
@@ -128,6 +132,7 @@ EMITTER_KINDS: Dict[str, str] = {
     "emit_benchmark": "benchmark",
     "record_drift": "drift",
     "emit_marker": "marker",
+    "emit_serving": "serving",
 }
 
 EVENT_SITES: Dict[str, Sequence[str]] = {
@@ -151,6 +156,14 @@ EVENT_SITES: Dict[str, Sequence[str]] = {
                                         "emit_compile",
                                         "emit_benchmark"),
     "raft_tpu/benchmark.py": ("record_drift",),
+    # the serving engine: every module under raft_tpu/serving/ must
+    # appear here (enforced structurally by check_serving_coverage) —
+    # enqueue/flush/shed/swap/warmup all flow through emit_serving
+    "raft_tpu/serving/engine.py": ("instrument", "fault_point",
+                                   "emit_serving"),
+    "raft_tpu/serving/snapshot.py": ("instrument", "fault_point",
+                                     "emit_serving"),
+    "raft_tpu/serving/buckets.py": ("emit_marker",),
 }
 
 _FLIGHT_MODULE = "raft_tpu/observability/flight.py"
@@ -450,6 +463,35 @@ def check_sharded_merge(root: str = _REPO_ROOT,
     return errors
 
 
+_SERVING_DIR = "raft_tpu/serving"
+
+
+def check_serving_coverage(root: str = _REPO_ROOT,
+                           sites: Dict[str, Sequence[str]] = None
+                           ) -> List[str]:
+    """EVERY module under raft_tpu/serving/ (package __init__ excluded)
+    must have an EVENT_SITES entry — a serving module invisible in the
+    flight timeline cannot be reconstructed from a steady-state trace,
+    and the ISSUE-7 gates promise full serving coverage. Structural,
+    so a NEW serving module cannot ship unobserved by forgetting the
+    table."""
+    sites = EVENT_SITES if sites is None else sites
+    errors: List[str] = []
+    serving_dir = os.path.join(root, _SERVING_DIR)
+    if not os.path.isdir(serving_dir):
+        return [f"{_SERVING_DIR}/: serving package missing"]
+    for name in sorted(os.listdir(serving_dir)):
+        if not name.endswith(".py") or name == "__init__.py":
+            continue
+        rel = f"{_SERVING_DIR}/{name}"
+        if rel not in sites:
+            errors.append(
+                f"{rel}: serving module has no EVENT_SITES entry — "
+                f"every raft_tpu/serving/ module must emit timeline "
+                f"events")
+    return errors
+
+
 def check(root: str = _REPO_ROOT,
           hot_paths: Dict[str, Sequence[str]] = None) -> List[str]:
     """Returns a list of violation messages (empty = clean)."""
@@ -489,6 +531,7 @@ def check(root: str = _REPO_ROOT,
         errors.extend(check_sharded_merge(root))
         errors.extend(check_fault_sites(root))
         errors.extend(check_event_sites(root))
+        errors.extend(check_serving_coverage(root))
     return errors
 
 
