@@ -1,0 +1,25 @@
+"""raft_tpu.ann — approximate nearest neighbors (the IVF tier).
+
+(ref: the reference's historical headline capability — the ANN stack
+(ivf_flat.cuh / ivf_flat_types.hpp, neighbors/detail/ivf_flat_*) that
+migrated to cuVS. Brute force at the 2048×10M×256 north star is
+permanently HBM-bandwidth-bound; the only way past the streamed-HBM
+wall is to read LESS of the database per query. IVF-Flat is the first
+rung: a balanced k-means coarse quantizer (raft_tpu.cluster) buckets
+the database into inverted lists, a query probes ``n_probes`` of them,
+and recall@k vs the bit-exact brute-force oracle becomes a tracked
+artifact next to GB/s (BENCH_ANN.json).)
+"""
+
+from raft_tpu.ann.ivf_flat import (DEFAULT_ROW_QUANTUM, IvfFlatIndex,
+                                   ShardedIvfIndex, build_ivf_flat,
+                                   search_ivf_flat, shard_ivf_lists)
+
+__all__ = [
+    "DEFAULT_ROW_QUANTUM",
+    "IvfFlatIndex",
+    "ShardedIvfIndex",
+    "build_ivf_flat",
+    "search_ivf_flat",
+    "shard_ivf_lists",
+]

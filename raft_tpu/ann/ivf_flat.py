@@ -1,0 +1,623 @@
+"""IVF-Flat: inverted-list ANN over the fused KNN primitives.
+
+(ref: neighbors/ivf_flat.cuh + detail/ivf_flat_build.cuh /
+ivf_flat_search.cuh — the reference's interleaved-list IVF index, the
+headline ANN capability that migrated to cuVS. BASELINE's "critical
+scoping fact": past the streamed-HBM roofline the only speedup left is
+reading LESS of the database; IVF-Flat reads ``n_probes/n_lists`` of
+it, trading tracked recall.)
+
+Index layout — the **padded ragged slab** (build_ivf_flat):
+
+- database rows are bucketed by nearest coarse centroid (balanced
+  k-means, :mod:`raft_tpu.cluster` — balance keeps per-probe cost
+  uniform and pad waste bounded);
+- each inverted list is padded up to a multiple of the **row quantum**
+  (default 8 — the fused pipeline's sublane multiple), then the lists
+  are laid back-to-back in ONE [R, d] slab: ``offsets [L+1]`` row
+  offsets, ``sizes [L]`` real lengths, global ids carried alongside in
+  ``ids [R]`` (−1 on pad rows). Memory is Σ padded sizes — ragged, not
+  L·max;
+- the slab's pad rows are exactly the ragged ``rows_valid`` layout
+  ``distance.knn_fused._prepare_ops`` now takes: the degenerate exact
+  path runs the CERTIFIED packed fused kernel over the whole slab with
+  interspersed pads carried as never-wins sentinels.
+
+Search (search_ivf_flat):
+
+1. **coarse probe**: top-``n_probes`` nearest centroids per query via
+   the existing fused-L2 top-k machinery
+   (:func:`raft_tpu.distance.fused_l2nn.knn`, streamed sweep — the
+   fusedL2NN lineage);
+2. **fine scan**: the probed lists' slab windows are gathered per
+   query and scored with the exact expanded-L2 form (f32 HIGHEST — the
+   same score the fused pipeline's rescore evaluates, so the
+   ``n_probes = n_lists`` result is id-for-id the brute-force oracle),
+   then one top-k over the ``n_probes·window`` candidates;
+3. ``n_probes ≥ n_lists`` (or ``k`` beyond the probed capacity)
+   **degrades to exact search** with a logged reason — the certified
+   fused pipeline over the ragged slab — so the speed/recall knob can
+   never silently return worse-than-exact results at exact cost.
+
+``shard="lists"`` (shard_ivf_lists + the sharded search path): WHOLE
+lists distribute over a mesh axis via shard_map — each shard scans the
+probed lists it owns and the per-shard top-k candidates (global ids)
+merge with the PR-4 rank-ordered machinery
+(:func:`raft_tpu.distance.knn_sharded._merge_allgather` /
+``_merge_tournament``, strategy picked by the ICI cost model).
+
+Observability: build and search are ``@instrument``-ed, carry the
+``ivf_build`` / ``ivf_search`` fault sites, emit ``marker`` flight
+events (probed-bytes fraction rides the search event), and the fine
+scan's XLA cost is captured through ``res.profiler.capture_fn``.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.resources import ensure_resources
+from raft_tpu.observability import instrument
+from raft_tpu.observability.flight import get_flight_recorder
+from raft_tpu.observability.timeline import emit_marker
+from raft_tpu.resilience import fault_point
+
+#: inverted-list row quantum: every list pads to a multiple of this
+#: (the fused pipeline's 8-row sublane multiple — a slab built at this
+#: quantum stays gatherable in whole sublanes). Env override:
+#: ``RAFT_TPU_IVF_ROW_QUANTUM``.
+DEFAULT_ROW_QUANTUM = 8
+
+
+def _env_int(name: str, default: int, lo: int = 1) -> int:
+    import os
+
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return max(lo, int(raw))
+    except (TypeError, ValueError):
+        from raft_tpu.core.logger import log_warn
+
+        log_warn("%s=%r is not an int — using %d", name, raw, default)
+        return default
+
+#: fine-scan gather budget: queries chunk so the [nq, P·W, d] candidate
+#: tile stays under ~256 MB f32
+_FINE_TILE = 1 << 26
+
+# compiled sharded-search programs, keyed by full static geometry
+# (same pattern as knn_sharded._SHARDED_FUSED_CACHE)
+_SHARDED_IVF_CACHE: dict = {}
+
+
+class IvfFlatIndex:
+    """The padded ragged IVF-Flat index (see the module doc). Built by
+    :func:`build_ivf_flat`; queried by :func:`search_ivf_flat`. The
+    coarse centroids, slab geometry and metric are frozen at build.
+
+    ``Qb`` is the serving-bucket hint (the fused pipeline's tuned query
+    block) so the serving engine's bucket ladder derives the same way
+    it does for a brute-force :class:`~raft_tpu.distance.knn_fused.
+    KnnIndex` snapshot."""
+
+    def __init__(self, centroids, slab, ids, yy_slab, offsets, sizes,
+                 padded_sizes, n_rows: int, d_orig: int,
+                 row_quantum: int, n_probes_default: int, Qb: int,
+                 kmeans_iters: int = 0, balanced: bool = True):
+        self.centroids = centroids          # [L, d] f32
+        self.slab = slab                    # [R, d] f32 (pad rows zero)
+        self.ids = ids                      # [R] int32 global ids, -1 pads
+        self.yy_slab = yy_slab              # [R] f32 row norms (pads 0)
+        self.offsets = offsets              # [L+1] int32 slab row offsets
+        self.sizes = sizes                  # [L] int32 real list lengths
+        self.padded_sizes = padded_sizes    # [L] int32 quantum-padded
+        self.n_rows = n_rows
+        self.d_orig = d_orig
+        self.row_quantum = row_quantum
+        self.n_probes_default = n_probes_default
+        self.Qb = Qb
+        self.kmeans_iters = kmeans_iters
+        self.balanced = balanced
+        self.metric = "l2"
+        # host copies of the geometry (numpy — search wrappers index
+        # them without device sync) + the lazy ragged fused operands
+        self._np_offsets = np.asarray(offsets)
+        self._np_sizes = np.asarray(sizes)
+        self._np_padded = np.asarray(padded_sizes)
+        self._fused_ops = None
+
+    @property
+    def n_lists(self) -> int:
+        return int(self.sizes.shape[0])
+
+    @property
+    def probe_window(self) -> int:
+        """Static per-probe gather window: the largest padded list."""
+        return max(int(self._np_padded.max()), self.row_quantum)
+
+    @property
+    def slab_rows(self) -> int:
+        return int(self.slab.shape[0])
+
+    def __repr__(self):
+        return (f"IvfFlatIndex(n_rows={self.n_rows}, "
+                f"n_lists={self.n_lists}, d={self.d_orig}, "
+                f"slab_rows={self.slab_rows}, "
+                f"window={self.probe_window})")
+
+
+@instrument("ann.build_ivf_flat")
+def build_ivf_flat(res, y, n_lists: int, n_probes: Optional[int] = None,
+                   max_iter: int = 10, seed: int = 0,
+                   balanced: bool = True,
+                   row_quantum: Optional[int] = None,
+                   max_train_rows: Optional[int] = None
+                   ) -> IvfFlatIndex:
+    """Build an :class:`IvfFlatIndex` over ``y`` [m, d].
+
+    (ref: ivf_flat::build — coarse-train on a sub-sample, assign every
+    row, bucket into interleaved lists.) Coarse training runs balanced
+    k-means (:func:`raft_tpu.cluster.kmeans_fit`) on at most
+    ``max_train_rows`` rows (default ``max(32·n_lists, 4096)`` — the
+    trainset_fraction idea), full assignment runs the fusedL2NN argmin
+    sweep, and the host lays the lists out as the padded ragged slab
+    described in the module doc."""
+    from raft_tpu.cluster import kmeans_fit, kmeans_predict
+
+    fault_point("ivf_build")
+    res = ensure_resources(res)
+    if row_quantum is None:
+        row_quantum = _env_int("RAFT_TPU_IVF_ROW_QUANTUM",
+                               DEFAULT_ROW_QUANTUM)
+    y = np.asarray(y, np.float32)
+    m, d = y.shape
+    L = int(n_lists)
+    expects(L >= 1, "build_ivf_flat: n_lists must be >= 1, got %d", L)
+    expects(L <= m, "build_ivf_flat: n_lists=%d > %d rows", L, m)
+    expects(row_quantum >= 1,
+            "build_ivf_flat: row_quantum must be >= 1")
+    cap = max_train_rows or max(32 * L, 4096)
+    if m > cap:
+        rng = np.random.default_rng(seed)
+        train = y[rng.choice(m, cap, replace=False)]
+    else:
+        train = y
+    km = kmeans_fit(res, train, L, max_iter=max_iter, seed=seed,
+                    balanced=balanced)
+    labels = np.asarray(kmeans_predict(res, km.centroids, y))
+
+    # ---- host-side ragged layout ------------------------------------
+    sizes = np.bincount(labels, minlength=L).astype(np.int32)
+    padded = ((sizes + row_quantum - 1) // row_quantum
+              * row_quantum).astype(np.int32)
+    padded[sizes == 0] = 0                     # empty lists cost nothing
+    offsets = np.concatenate(
+        [[0], np.cumsum(padded, dtype=np.int64)]).astype(np.int32)
+    R = int(offsets[-1])
+    slab = np.zeros((R, d), np.float32)
+    ids = np.full(R, -1, np.int32)
+    order = np.argsort(labels, kind="stable")
+    sorted_labels = labels[order]
+    # rank of each row within its list (order is label-sorted, so the
+    # rank is position minus the first position of that label)
+    first = np.concatenate([[0], np.cumsum(sizes, dtype=np.int64)[:-1]])
+    rank = np.arange(m) - first[sorted_labels]
+    dest = offsets[sorted_labels] + rank
+    slab[dest] = y[order]
+    ids[dest] = order.astype(np.int32)
+
+    from raft_tpu.distance.knn_fused import fused_config
+
+    n_probes_default = int(n_probes) if n_probes else max(
+        1, min(L, 1 + L // 8))
+    idx = IvfFlatIndex(
+        centroids=km.centroids,
+        slab=jnp.asarray(slab),
+        ids=jnp.asarray(ids),
+        yy_slab=jnp.sum(jnp.asarray(slab) ** 2, axis=1),
+        offsets=jnp.asarray(offsets),
+        sizes=jnp.asarray(sizes),
+        padded_sizes=jnp.asarray(padded),
+        n_rows=m, d_orig=d, row_quantum=int(row_quantum),
+        n_probes_default=n_probes_default,
+        Qb=fused_config(3).Qb,
+        kmeans_iters=km.n_iter, balanced=balanced)
+    emit_marker("ivf_build", n_rows=m, n_lists=L, slab_rows=R,
+                window=idx.probe_window,
+                pad_frac=round(float(R - m) / max(m, 1), 4),
+                size_min=int(sizes.min()), size_max=int(sizes.max()),
+                kmeans_iters=km.n_iter, balanced=bool(balanced))
+    return idx
+
+
+# --------------------------------------------------------- fine scan
+@partial(jax.jit, static_argnames=("k", "P", "W"))
+def _fine_scan(x, slab, ids, yy_slab, starts, psizes,
+               k: int, P: int, W: int):
+    """Score the probed slab windows and select top-k.
+
+    ``starts [nq, P]`` are slab row offsets of the probed lists,
+    ``psizes [nq, P]`` their padded lengths (0 = unowned/empty probe).
+    The expanded-L2 score is evaluated in f32 HIGHEST — the same form
+    (and therefore bitwise the same candidate values) the fused
+    pipeline's exact rescore computes, which is what makes the
+    ``n_probes = n_lists`` id sets match the oracle exactly."""
+    nq = x.shape[0]
+    ar = jnp.arange(W, dtype=jnp.int32)
+    rows = starts[:, :, None] + ar[None, None, :]          # [nq, P, W]
+    within = ar[None, None, :] < psizes[:, :, None]
+    rows = jnp.clip(rows, 0, slab.shape[0] - 1).reshape(nq, P * W)
+    within = within.reshape(nq, P * W)
+    cid = jnp.take(ids, rows)
+    valid = within & (cid >= 0)
+    yc = jnp.take(slab, rows, axis=0)                      # [nq, PW, d]
+    xx = jnp.sum(x * x, axis=1, keepdims=True)
+    d2 = (xx + jnp.take(yy_slab, rows)
+          - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
+                             precision=jax.lax.Precision.HIGHEST))
+    d2 = jnp.where(valid, jnp.maximum(d2, 0.0), jnp.inf)
+    neg, pos = jax.lax.top_k(-d2, k)
+    vals = -neg
+    out_ids = jnp.take_along_axis(cid, pos, axis=1)
+    return vals, jnp.where(jnp.isfinite(vals), out_ids, -1)
+
+
+def _coarse_probe(res, centroids, x, n_probes: int):
+    """Top-``n_probes`` nearest coarse centroids per query through the
+    existing fused-L2 top-k machinery (the streamed sweep — centroid
+    counts are small, so the threshold-gated merge path is the right
+    tool on every backend)."""
+    from raft_tpu.distance.fused_l2nn import knn as _knn
+
+    _, lists = _knn(res, centroids, x, n_probes, metric="sqeuclidean",
+                    algo="streamed")
+    return lists
+
+
+# ------------------------------------------------- exact degradation
+def _slab_fused_geometry(index: IvfFlatIndex):
+    """Lazy certified-fused operands for the WHOLE slab with the ragged
+    ``rows_valid`` mask — the degenerate-exact data plane (and the one
+    consumer that exercises the ragged ``_prepare_ops`` path end to
+    end). Mirrors ``prepare_knn_index`` but forces the packed
+    query-major envelope the ragged mask requires."""
+    if index._fused_ops is not None:
+        return index._fused_ops
+    from raft_tpu.distance.knn_fused import (_LANES, _PACK_BITS,
+                                             _PBITS_MAX, _prepare_ops,
+                                             auto_pack_bits, fit_config,
+                                             fused_config)
+
+    R, d = index.slab.shape
+    cfg = fused_config(3)
+    T, Qb = fit_config(cfg.T, cfg.Qb, d, 3, cfg.g, "query")
+    n_tiles_est = max(1, -(-R // T))
+    g = max(cfg.g, (1 << auto_pack_bits(n_tiles_est, T)) // (T // _LANES))
+    n_ch = T // _LANES
+    pbits = min(_PBITS_MAX, max(_PACK_BITS, int(math.ceil(math.log2(
+        max(g * n_ch, 2))))))
+    if g * n_ch > (1 << pbits):
+        g = max(1, (1 << pbits) // n_ch)   # ragged mask is packed-only
+    dpad = (-d) % _LANES
+    slab = index.slab
+    if dpad:
+        slab = jnp.concatenate(
+            [slab, jnp.zeros((R, dpad), jnp.float32)], axis=1)
+    valid = index.ids >= 0
+    ops = _prepare_ops(slab, T, g, "l2", pbits=pbits,
+                       grid_order="query", rows_valid=valid)
+    M = ops[0].shape[0]
+    rv = jnp.concatenate(
+        [valid, jnp.zeros((M - R,), jnp.bool_)]) if M > R else valid
+    index._fused_ops = (ops, rv, T, Qb, g, pbits)
+    return index._fused_ops
+
+
+def _exact_search(res, index: IvfFlatIndex, x, k: int):
+    """Exact top-k over the ragged slab through the certified packed
+    fused pipeline (``rows_valid`` mask), slab positions mapped back to
+    global ids — bitwise the oracle's values (same exact-f32 rescore
+    score function over the same rows)."""
+    from raft_tpu.distance.knn_fused import (_LANES, _POOL_PAD,
+                                             _Q_CHUNK, _knn_fused_core)
+
+    ops, rv, T, Qb, g, pbits = _slab_fused_geometry(index)
+    yp, y_hi, y_lo, yyh_k, yy_raw = ops
+    M = yp.shape[0]
+    n_tiles = M // T
+    S_pool = -(-n_tiles // g) * _LANES
+    expects(k <= 2 * S_pool,
+            "search_ivf_flat: k=%d too large for the exact-path pool "
+            "%d (shrink k or grow the index)", k, 2 * S_pool)
+    x = jnp.asarray(x, jnp.float32)
+    nq = x.shape[0]
+    if nq > _Q_CHUNK:
+        outs = [_exact_search(res, index, x[s:s + _Q_CHUNK], k)
+                for s in range(0, nq, _Q_CHUNK)]
+        return (jnp.concatenate([o[0] for o in outs]),
+                jnp.concatenate([o[1] for o in outs]))
+    dpad = y_hi.shape[1] - x.shape[1]
+    if dpad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((nq, dpad), jnp.float32)], axis=1)
+    Qb_eff = min(Qb, ((nq + 7) // 8) * 8)
+    qpad = (-nq) % Qb_eff
+    if qpad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((qpad, x.shape[1]), jnp.float32)])
+    vals, pos = _knn_fused_core(
+        x, yp, y_hi, y_lo, yyh_k, yy_raw, k=k, T=T, Qb=Qb_eff, g=g,
+        passes=3, metric="l2", m=M, rescore=True, pbits=pbits,
+        rows_valid=rv)
+    vals, pos = vals[:nq], pos[:nq]
+    gids = jnp.where(pos >= 0,
+                     jnp.take(index.ids, jnp.maximum(pos, 0)), -1)
+    return vals, gids
+
+
+# ------------------------------------------------------------ search
+@instrument("ann.search_ivf_flat")
+def search_ivf_flat(res, index, queries, k: int,
+                    n_probes: Optional[int] = None,
+                    merge: str = "auto"
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Approximate top-k against an IVF-Flat index.
+
+    (ref: ivf_flat::search — coarse probe, gather the probed lists,
+    list-local select, merge.) Returns (d2 [nq, k] ascending, global
+    ids [nq, k]); entries beyond the probed candidates carry
+    (+inf, −1) — recall vs the exact oracle is the tracked artifact
+    (benchmarks/bench_ann.py → BENCH_ANN.json).
+
+    ``index`` is an :class:`IvfFlatIndex` or a :class:`ShardedIvfIndex`
+    (:func:`shard_ivf_lists` — whole lists over the mesh, per-shard
+    local top-k + the PR-4 rank-ordered merge picked by ``merge``).
+
+    ``n_probes ≥ n_lists`` (or ``k`` beyond the probed capacity)
+    degrades to EXACT search with a logged reason — the certified
+    fused pipeline over the ragged slab; the returned id set then
+    matches the brute-force oracle exactly (the degenerate-exact
+    invariant the tests pin)."""
+    fault_point("ivf_search")
+    res = ensure_resources(res)
+    sharded = isinstance(index, ShardedIvfIndex)
+    base = index.base if sharded else index
+    x = jnp.asarray(queries, jnp.float32)
+    expects(x.ndim == 2 and x.shape[1] == base.d_orig,
+            "search_ivf_flat: query width %s != index %d",
+            x.shape[1:], base.d_orig)
+    expects(k >= 1, "search_ivf_flat: k must be >= 1")
+    expects(k <= base.n_rows,
+            "search_ivf_flat: k=%d > index size %d", k, base.n_rows)
+    nq = x.shape[0]
+    if nq == 0:
+        return (jnp.zeros((0, k), jnp.float32),
+                jnp.zeros((0, k), jnp.int32))
+    L = base.n_lists
+    if n_probes is None:
+        # fleet-wide recall knob: RAFT_TPU_ANN_NPROBES retunes every
+        # default-probes caller (serving planes included) without a
+        # rebuild — read per call, like the pool-select env
+        P = _env_int("RAFT_TPU_ANN_NPROBES", base.n_probes_default)
+    else:
+        P = int(n_probes)
+    expects(P >= 1, "search_ivf_flat: n_probes must be >= 1, got %d", P)
+    W = index.probe_window
+    reason = None
+    if P >= L:
+        reason = f"n_probes={P} >= n_lists={L}"
+    elif k > P * W:
+        reason = (f"k={k} exceeds the probed candidate capacity "
+                  f"{P}x{W}={P * W}")
+    if reason is not None:
+        from raft_tpu.core.logger import log_warn
+
+        log_warn("search_ivf_flat: %s — degrading to exact search "
+                 "over the full index for this call", reason)
+        emit_marker("ivf_exact_degrade", reason=reason, k=k,
+                    n_probes=P, n_lists=L)
+        return _exact_search(res, base, x, k)
+
+    probes = _coarse_probe(res, base.centroids, x, P)       # [nq, P]
+
+    rec = get_flight_recorder()
+    if rec.enabled:
+        probed_rows = float(jnp.sum(jnp.take(base.sizes, probes)))
+        emit_marker("ivf_search", nq=nq, k=k, n_probes=P, n_lists=L,
+                    probed_frac=round(
+                        probed_rows / max(1, nq * base.n_rows), 6),
+                    sharded=bool(sharded))
+
+    if sharded:
+        return _search_sharded(res, index, x, probes, k, P, W, merge)
+
+    starts = jnp.take(index.offsets[:-1], probes)
+    psizes = jnp.take(index.padded_sizes, probes)
+    d = x.shape[1]
+    chunk = max(8, _FINE_TILE // max(1, P * W * max(d, 1)))
+    try:
+        res.profiler.capture_fn(
+            "ann.ivf_fine_scan", _fine_scan,
+            x[:min(nq, chunk)], index.slab, index.ids, index.yy_slab,
+            starts[:min(nq, chunk)], psizes[:min(nq, chunk)],
+            k=k, P=P, W=W)
+    except Exception:
+        pass
+    if nq <= chunk:
+        return _fine_scan(x, index.slab, index.ids, index.yy_slab,
+                          starts, psizes, k=k, P=P, W=W)
+    outs = [_fine_scan(x[s:s + chunk], index.slab, index.ids,
+                       index.yy_slab, starts[s:s + chunk],
+                       psizes[s:s + chunk], k=k, P=P, W=W)
+            for s in range(0, nq, chunk)]
+    return (jnp.concatenate([o[0] for o in outs]),
+            jnp.concatenate([o[1] for o in outs]))
+
+
+# ----------------------------------------------------------- sharded
+class ShardedIvfIndex:
+    """Whole inverted lists distributed over a mesh axis (the
+    ``shard="lists"`` layout): shard ``r`` owns the contiguous list
+    block [r·Ll, (r+1)·Ll) laid out in its own local slab; list→shard
+    routing is pure arithmetic. Build with :func:`shard_ivf_lists`;
+    query through :func:`search_ivf_flat` (type-dispatched)."""
+
+    def __init__(self, base: IvfFlatIndex, mesh, axis: str,
+                 slab_s, ids_s, yy_s, starts_g, psizes_g,
+                 lists_per: int, rows_per: int):
+        self.base = base
+        self.mesh, self.axis = mesh, axis
+        self.slab_s = slab_s        # [p·rows_per, d] sharded P(axis)
+        self.ids_s = ids_s          # [p·rows_per] global ids, -1 pads
+        self.yy_s = yy_s            # [p·rows_per] row norms
+        self.starts_g = starts_g    # [Lg] LOCAL start row per list
+        self.psizes_g = psizes_g    # [Lg] padded sizes (0 = empty)
+        self.lists_per = lists_per
+        self.rows_per = rows_per
+
+    @property
+    def n_shards(self) -> int:
+        return int(self.mesh.shape[self.axis])
+
+    @property
+    def probe_window(self) -> int:
+        return self.base.probe_window
+
+
+def shard_ivf_lists(index: IvfFlatIndex, mesh, axis: str = "x"
+                    ) -> ShardedIvfIndex:
+    """Lay an :class:`IvfFlatIndex` out list-sharded over
+    ``mesh[axis]``: lists pad to ``p`` equal blocks (virtual empty
+    lists), every shard's local slab pads to the max shard row count
+    (shard_map needs equal shards), and the shards land via ONE
+    sharded ``device_put`` — the slab never materializes replicated on
+    any device. Global ids ride inside each local slab, so the merged
+    results need no offset arithmetic."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    expects(axis in mesh.axis_names,
+            "shard_ivf_lists: axis %r not in mesh axes %s", axis,
+            tuple(mesh.axis_names))
+    p = int(mesh.shape[axis])
+    L = index.n_lists
+    Lg = -(-L // p) * p
+    Ll = Lg // p
+    offsets, padded = index._np_offsets, index._np_padded
+    slab = np.asarray(index.slab)
+    ids = np.asarray(index.ids)
+    # yy is GATHERED from the base index, not recomputed — the sharded
+    # and unsharded fine scans must score bitwise-identical d2 per
+    # candidate, and a host-side re-summation could round differently
+    yy = np.asarray(index.yy_slab)
+    d = slab.shape[1]
+    # per-shard row counts (sum of its lists' padded sizes)
+    shard_rows = [int(padded[r * Ll:min((r + 1) * Ll, L)].sum())
+                  for r in range(p)]
+    S = max(max(shard_rows), index.row_quantum)
+    slab_g = np.zeros((p * S, d), np.float32)
+    ids_g = np.full(p * S, -1, np.int32)
+    yy_g = np.zeros(p * S, np.float32)
+    starts_g = np.zeros(Lg, np.int32)
+    psizes_g = np.zeros(Lg, np.int32)
+    psizes_g[:L] = padded
+    for r in range(p):
+        cursor = 0
+        for gl in range(r * Ll, min((r + 1) * Ll, L)):
+            w = int(padded[gl])
+            starts_g[gl] = cursor
+            if w:
+                src = int(offsets[gl])
+                dst = r * S + cursor
+                slab_g[dst:dst + w] = slab[src:src + w]
+                ids_g[dst:dst + w] = ids[src:src + w]
+                yy_g[dst:dst + w] = yy[src:src + w]
+            cursor += w
+    sh = NamedSharding(mesh, P(axis))
+    return ShardedIvfIndex(
+        index, mesh, axis,
+        slab_s=jax.device_put(slab_g, sh),
+        ids_s=jax.device_put(ids_g, sh),
+        yy_s=jax.device_put(yy_g, sh),
+        starts_g=jnp.asarray(starts_g),
+        psizes_g=jnp.asarray(psizes_g),
+        lists_per=Ll, rows_per=S)
+
+
+def _search_sharded(res, index: ShardedIvfIndex, x, probes, k: int,
+                    P: int, W: int, merge: str):
+    """List-sharded fine scan + rank-ordered merge. Every shard scans
+    the probed lists IT owns (unowned probes masked), selects its local
+    top-k with global ids, and the per-shard candidates merge with the
+    PR-4 machinery — deterministic rank-major pools, so the result is
+    replicated bit-for-bit and matches the unsharded scan's id set."""
+    from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+    from raft_tpu.comms import MeshComms
+    from raft_tpu.distance.knn_sharded import (_merge_allgather,
+                                               _merge_tournament,
+                                               resolve_merge_strategy)
+    from raft_tpu.parallel import replicated
+
+    mesh, axis = index.mesh, index.axis
+    p = index.n_shards
+    expects(merge in ("auto", "allgather", "tournament"),
+            "search_ivf_flat: merge must be 'auto', 'allgather' or "
+            "'tournament', got %r", merge)
+    nq = x.shape[0]
+    merge_eff = resolve_merge_strategy(merge, p, nq, k)
+    if merge_eff == "host":     # not a rung here — auto never picks it
+        merge_eff = "allgather"
+    # fault sites fire in the WRAPPER (per call), like knn_sharded's
+    # resilience driver — a trace-time site inside shard_map would fire
+    # once per compile and lie for every cached dispatch after
+    if merge_eff == "tournament":
+        fault_point("merge_permute")
+    else:
+        fault_point("merge_allgather")
+    d = x.shape[1]
+    chunk = max(8, _FINE_TILE // max(1, P * W * max(d, 1)))
+    if nq > chunk:
+        outs = [_search_sharded(res, index, x[s:s + chunk],
+                                probes[s:s + chunk], k, P, W, merge)
+                for s in range(0, nq, chunk)]
+        return (jnp.concatenate([o[0] for o in outs]),
+                jnp.concatenate([o[1] for o in outs]))
+
+    Ll, S = index.lists_per, index.rows_per
+    key = (mesh, axis, k, P, W, S, Ll, merge_eff, d, nq)
+    fn = _SHARDED_IVF_CACHE.get(key)
+    if fn is None:
+        comms = MeshComms(axis, size=p)
+        merge_fn = {"allgather": _merge_allgather,
+                    "tournament": _merge_tournament}[merge_eff]
+
+        def shard_fn(slab_l, ids_l, yy_l, xq, pr, starts_g, psz_g):
+            r = jax.lax.axis_index(axis).astype(jnp.int32)
+            owned = (pr >= r * Ll) & (pr < (r + 1) * Ll)
+            starts = jnp.take(starts_g, pr)
+            psz = jnp.where(owned, jnp.take(psz_g, pr), 0)
+            vals, gids = _fine_scan(xq, slab_l, ids_l, yy_l, starts,
+                                    psz, k=k, P=P, W=W)
+            return merge_fn(comms, p, k, vals, gids)
+
+        fn = jax.jit(jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(Pspec(axis), Pspec(axis), Pspec(axis),
+                      Pspec(), Pspec(), Pspec(), Pspec()),
+            out_specs=(Pspec(), Pspec()), check_vma=False))
+        _SHARDED_IVF_CACHE[key] = fn
+
+    repl = replicated(mesh)
+    return fn(index.slab_s, index.ids_s, index.yy_s,
+              jax.device_put(x, repl), jax.device_put(probes, repl),
+              jax.device_put(index.starts_g, repl),
+              jax.device_put(index.psizes_g, repl))

@@ -1,0 +1,15 @@
+"""raft_tpu.cluster — balanced k-means on the fused primitives. (ref:
+cpp/include/raft/cluster — kmeans.cuh / kmeans_balanced.cuh, the coarse
+trainers behind the reference's ANN stack.)"""
+
+from raft_tpu.cluster.kmeans import (DEFAULT_BALANCE_ALPHA, KMeansResult,
+                                     kmeans_fit, kmeans_inertia,
+                                     kmeans_predict)
+
+__all__ = [
+    "DEFAULT_BALANCE_ALPHA",
+    "KMeansResult",
+    "kmeans_fit",
+    "kmeans_inertia",
+    "kmeans_predict",
+]

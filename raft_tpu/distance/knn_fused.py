@@ -300,7 +300,7 @@ def pad_query_rows(x, rows: int):
                                              "pbits", "grid_order"))
 def _prepare_ops(y, T: int, g: int, metric: str,
                  pbits: int = _PACK_BITS, grid_order: str = "query",
-                 n_valid=None):
+                 n_valid=None, rows_valid=None):
     """Index-side operand prep: row padding, bf16 hi/lo split, norms and
     the [8, M] half-norm sentinel carrier. ~3 ms at 1M×128 on v5e —
     hoisted out of the query path so a prepared index (KnnIndex) pays
@@ -318,15 +318,35 @@ def _prepare_ops(y, T: int, g: int, metric: str,
     be a plain int or a TRACED scalar — inside the sharded prep's
     shard_map one traced program serves every shard, and each shard's
     real-row count is a value (a function of its mesh coordinate), not
-    a shape."""
-    m = y.shape[0] if n_valid is None else n_valid
+    a shape.
+
+    ``rows_valid`` is the RAGGED generalization of ``n_valid``: a [m]
+    bool mask over the INPUT rows marking which are real — pads may be
+    interspersed anywhere, not just trailing. This is the layout the
+    IVF-Flat inverted lists (raft_tpu.ann — each list padded to a row
+    quantum, so pads sit at every list tail) and the serving engine's
+    bucket padding share. Masked-out rows carry the same never-wins
+    sentinel trailing pads do, so they are invisible to the fold and
+    the certificate; rows appended here to reach the tile multiple are
+    masked too. Mutually exclusive with ``n_valid``."""
+    if rows_valid is not None:
+        m = y.shape[0]       # geometric row count; masking is per-row
+    else:
+        m = y.shape[0] if n_valid is None else n_valid
     yp = _pad_rows_to(y, g * T if grid_order in ("db", "dbuf") else T)
     M = yp.shape[0]
     yy_raw = jnp.sum(yp * yp, axis=1)[None, :]                  # [1,M] f32
     n_ch = T // _LANES
     packed = g * n_ch <= (1 << pbits)
     pad_sentinel = _PACK_PAD if packed else jnp.inf
-    valid = (jnp.arange(M, dtype=jnp.int32) < m)[None, :]
+    if rows_valid is not None:
+        rv = jnp.asarray(rows_valid, jnp.bool_).reshape(-1)
+        pad = M - rv.shape[0]
+        if pad:
+            rv = jnp.concatenate([rv, jnp.zeros((pad,), jnp.bool_)])
+        valid = rv[None, :]
+    else:
+        valid = (jnp.arange(M, dtype=jnp.int32) < m)[None, :]
     if metric == "ip":
         # r = 0/2 − x·(y/2) = −x·y/2 → score −x·y = 2·r (+ xx_r = 0)
         y_hi, y_lo = split_hi_lo(yp * 0.5)
@@ -349,7 +369,7 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                     pbits: int = _PACK_BITS, certify: str = "kernel",
                     pool_algo: str = "xla", grid_order: str = "query",
                     _diag: bool = False,
-                    m_valid=None) -> Tuple[jax.Array, ...]:
+                    m_valid=None, rows_valid=None) -> Tuple[jax.Array, ...]:
     """Certified fused KNN on PREPARED operands (see _prepare_ops).
 
     ``m_valid`` (optional TRACED scalar) overrides the static ``m`` in
@@ -358,6 +378,16 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
     one shard_map-traced program serves every shard, but each shard owns
     a different number of real rows — a value, not a shape. ``m`` keeps
     sizing the static fixup-tier geometry.
+
+    ``rows_valid`` (optional TRACED [M] bool, M = the PREPARED row
+    count) is the RAGGED mask: real rows may be interspersed with pads
+    (the IVF-Flat slab layout — every inverted list tail is padding).
+    The operands must have been prepared with the SAME mask (the
+    sentinel carrier is what hides pads from the kernel fold); here it
+    only replaces the prefix column masks in the fixup sweeps and
+    widens the rescore clamp to the whole slab. Packed-path only: the
+    unpacked kernels prefix-mask in-kernel by ``m_real`` and cannot
+    honor an arbitrary mask.
 
     x [Q, d] f32 (Q % Qb == 0, d % 128 == 0 — caller pads), y [m, d] f32
     un-padded rows; returns exact (score [Q, k] ascending, ids [Q, k]).
@@ -393,11 +423,25 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
     else:
         xx_r = xx
     # m_eff: the real-row count every mask uses — static m, or the
-    # traced per-shard override (see the m_valid contract above)
-    m_eff = m if m_valid is None else \
-        jnp.asarray(m_valid, jnp.int32).reshape(())
-    m_real = (jnp.full((1,), m, jnp.int32) if m_valid is None
-              else jnp.reshape(m_eff, (1,)))
+    # traced per-shard override (see the m_valid contract above). The
+    # ragged rows_valid mode has no prefix count: m_eff covers the whole
+    # slab (pads are hidden by the sentinel carrier + the mask gathers
+    # below), and the unpacked kernels — which prefix-mask in-kernel —
+    # are out of envelope.
+    if rows_valid is not None:
+        if not packed:
+            raise ValueError(
+                "_knn_fused_core: rows_valid (ragged mask) needs the "
+                "packed kernel envelope (g·(T/128) ≤ 2^pbits) — the "
+                "unpacked kernels mask by prefix count in-kernel")
+        rows_valid = jnp.asarray(rows_valid, jnp.bool_).reshape(-1)
+        m_eff = jnp.int32(M)
+        m_real = jnp.full((1,), M, jnp.int32)
+    else:
+        m_eff = m if m_valid is None else \
+            jnp.asarray(m_valid, jnp.int32).reshape(())
+        m_real = (jnp.full((1,), m, jnp.int32) if m_valid is None
+                  else jnp.reshape(m_eff, (1,)))
 
     if packed:
         if d > _D_SINGLE_SHOT:
@@ -639,7 +683,9 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                       else jnp.sum(yp * yp, axis=1))
             d2 = scores(yp, y_hi, y_lo, yy_all)                 # [F, M]
             col = jnp.arange(M, dtype=jnp.int32)
-            d2 = jnp.where(col[None, :] < m_eff, d2, jnp.inf)
+            col_ok = (rows_valid[None, :] if rows_valid is not None
+                      else col[None, :] < m_eff)
+            d2 = jnp.where(col_ok, d2, jnp.inf)
             # (A/B MEASURED: routing this top_k through the slotted
             # select — 2.5 vs 3.0 ms standalone at [16, 1M] — showed
             # no e2e win in-composite; the plain top_k stays)
@@ -664,7 +710,10 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                 yy_seg = jax.lax.dynamic_slice_in_dim(yy_raw[0], j * T, T)
             d2 = scores(yt, yth, ytl, yy_seg)
             col = j * T + jnp.arange(T, dtype=jnp.int32)
-            d2 = jnp.where(col[None, :] < m_eff, d2, jnp.inf)
+            col_ok = (jax.lax.dynamic_slice_in_dim(
+                rows_valid, j * T, T)[None, :]
+                if rows_valid is not None else col[None, :] < m_eff)
+            d2 = jnp.where(col_ok, d2, jnp.inf)
             av = jnp.concatenate([bv, d2], axis=1)
             ai = jnp.concatenate(
                 [bi, jnp.broadcast_to(col[None, :], d2.shape)], axis=1)

@@ -11,5 +11,7 @@ from raft_tpu.models.pca import PCA
 from raft_tpu.models.tsvd import TruncatedSVD
 from raft_tpu.models.spectral_embedding import SpectralEmbedding
 from raft_tpu.models.knn import NearestNeighbors
+from raft_tpu.models.kmeans import KMeans
 
-__all__ = ["PCA", "TruncatedSVD", "SpectralEmbedding", "NearestNeighbors"]
+__all__ = ["PCA", "TruncatedSVD", "SpectralEmbedding",
+           "NearestNeighbors", "KMeans"]

@@ -1,0 +1,71 @@
+"""KMeans estimator — the sklearn-shaped wrapper over
+:mod:`raft_tpu.cluster`. (ref: the reference's kmeans.cuh fit/predict
+surface as consumed by cuML's KMeans.)"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources, ensure_resources
+
+
+class KMeans:
+    """scikit-learn-compatible k-means.
+
+    ``balanced=True`` routes through the balanced variant (the
+    per-iteration cluster-size penalty à la ``kmeans_balanced`` — the
+    coarse trainer the IVF tier uses). Attributes after ``fit``:
+    ``cluster_centers_``, ``labels_``, ``inertia_``, ``n_iter_``."""
+
+    def __init__(self, n_clusters: int = 8, max_iter: int = 300,
+                 tol: float = 1e-4, random_state: int = 0,
+                 balanced: bool = False, init: str = "kmeans++",
+                 n_init: int = 3,
+                 res: Optional[Resources] = None):
+        self.res = ensure_resources(res)
+        self.n_clusters = int(n_clusters)
+        self.max_iter = int(max_iter)
+        self.tol = float(tol)
+        self.random_state = int(random_state)
+        self.balanced = bool(balanced)
+        self.init = init
+        self.n_init = int(n_init)
+        self.cluster_centers_ = None
+        self.labels_ = None
+        self.inertia_ = None
+        self.n_iter_ = None
+
+    def fit(self, X) -> "KMeans":
+        from raft_tpu.cluster import kmeans_fit
+
+        r = kmeans_fit(self.res, X, self.n_clusters,
+                       max_iter=self.max_iter, tol=self.tol,
+                       seed=self.random_state, balanced=self.balanced,
+                       init=self.init, n_init=self.n_init)
+        self.cluster_centers_ = r.centroids
+        self.labels_ = r.labels
+        self.inertia_ = float(r.inertia)
+        self.n_iter_ = int(r.n_iter)
+        return self
+
+    def predict(self, X):
+        from raft_tpu.cluster import kmeans_predict
+
+        if self.cluster_centers_ is None:
+            raise RuntimeError("KMeans: call fit() before predict()")
+        return kmeans_predict(self.res, self.cluster_centers_, X)
+
+    def fit_predict(self, X):
+        return self.fit(X).labels_
+
+    def transform(self, X):
+        """Distances (euclidean, sklearn convention) to each center."""
+        if self.cluster_centers_ is None:
+            raise RuntimeError("KMeans: call fit() before transform()")
+        from raft_tpu.distance.pairwise import pairwise_distance
+
+        return pairwise_distance(self.res, jnp.asarray(X, jnp.float32),
+                                 self.cluster_centers_,
+                                 metric="euclidean")

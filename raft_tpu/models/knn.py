@@ -17,6 +17,9 @@ class NearestNeighbors:
                  mesh=None, mesh_axis: str = "x",
                  n_shards: Optional[int] = None,
                  merge: str = "auto",
+                 algorithm: str = "brute",
+                 n_lists: Optional[int] = None,
+                 n_probes: Optional[int] = None,
                  res: Optional[Resources] = None):
         """``mesh``: a ``jax.sharding.Mesh`` makes ``kneighbors`` MNMG
         — the INDEX rows shard over ``mesh[mesh_axis]`` (the
@@ -31,13 +34,36 @@ class NearestNeighbors:
         tournament merges). Falls back to the streamed
         ``knn_index_sharded`` path for metrics outside the fused
         envelope. Default (both None) keeps the current single-device
-        behavior."""
+        behavior.
+
+        ``algorithm="ivf_flat"`` switches ``fit`` to building an
+        IVF-Flat index (:func:`raft_tpu.ann.build_ivf_flat` — balanced
+        k-means coarse quantizer + padded ragged inverted lists) and
+        ``kneighbors`` to the approximate probe search with
+        ``n_probes`` lists per query (``n_probes = n_lists`` degrades
+        to exact — the degenerate-exact invariant). L2-family metrics
+        only; the default ``"brute"`` keeps every existing path
+        unchanged. With ``n_shards``, the lists distribute over the
+        mesh (:func:`raft_tpu.ann.shard_ivf_lists`) and per-shard
+        top-k candidates merge with the ``merge`` strategy."""
+        if algorithm not in ("brute", "ivf_flat"):
+            raise ValueError(
+                f"NearestNeighbors: algorithm must be 'brute' or "
+                f"'ivf_flat', got {algorithm!r}")
+        if algorithm == "ivf_flat" and metric not in (
+                "sqeuclidean", "euclidean", "l2"):
+            raise ValueError(
+                f"NearestNeighbors: algorithm='ivf_flat' serves the "
+                f"L2 family only, got metric={metric!r}")
         self.res = ensure_resources(res)
         self.n_neighbors = n_neighbors
         self.metric = metric
         self.mesh = mesh
         self.mesh_axis = mesh_axis
         self.merge = merge
+        self.algorithm = algorithm
+        self.n_lists = n_lists
+        self.n_probes = n_probes
         if n_shards is not None and mesh is None:
             import jax
 
@@ -56,6 +82,20 @@ class NearestNeighbors:
         self._index = None
 
     def fit(self, X) -> "NearestNeighbors":
+        if self.algorithm == "ivf_flat":
+            from raft_tpu.ann import build_ivf_flat, shard_ivf_lists
+
+            X = jnp.asarray(X, jnp.float32)
+            n_lists = self.n_lists or max(
+                1, min(1024, int(round(X.shape[0] ** 0.5))))
+            self._index = build_ivf_flat(self.res, X, n_lists=n_lists,
+                                         n_probes=self.n_probes)
+            self._n_index = self._index.n_rows
+            self._prepared = None
+            if self.mesh is not None:
+                self._index = shard_ivf_lists(self._index, self.mesh,
+                                              self.mesh_axis)
+            return self
         if self.mesh is not None and self.n_shards is not None:
             # fused sharded path: build the ShardedFusedIndex once
             kernel_metric = {"sqeuclidean": "l2", "euclidean": "l2",
@@ -128,6 +168,15 @@ class NearestNeighbors:
     def kneighbors(self, queries, n_neighbors: Optional[int] = None
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         k = n_neighbors or self.n_neighbors
+        if self.algorithm == "ivf_flat":
+            from raft_tpu.ann import search_ivf_flat
+
+            dists, idx = search_ivf_flat(
+                self.res, self._index, queries, k,
+                n_probes=self.n_probes, merge=self.merge)
+            if self.metric in ("euclidean", "l2"):
+                dists = jnp.sqrt(jnp.maximum(dists, 0.0))
+            return dists, idx
         from raft_tpu.distance.knn_sharded import ShardedFusedIndex
 
         if isinstance(self._index, ShardedFusedIndex):

@@ -290,6 +290,67 @@ def fused_traffic_record(Q: int, m: int, d: int, k: int,
         bytes_accessed=model["total_bytes"])
 
 
+def ivf_traffic_model(nq: int, m: int, d: int, k: int, n_lists: int,
+                      n_probes: int, probe_window: int,
+                      slab_rows: int) -> Dict:
+    """Analytic HBM traffic of one IVF-Flat search batch
+    (:mod:`raft_tpu.ann`) next to the brute-force bytes it displaces —
+    the model behind BENCH_ANN.json's speed/recall frontier.
+
+    - ``coarse_bytes``: the [L, d] centroid sweep (+ query rows);
+    - ``probed_frac``: probed slab rows / total slab rows — the
+      fraction of database bytes a query touches (the knob recall is
+      traded against);
+    - ``fine_stream_bytes``: the LIST-MAJOR schedule — every probed
+      list streams from HBM once per query batch (the IVF analog of
+      PR-3's db-major grid re-order), so database-side traffic is
+      ``probed_frac`` of the slab. This is the bytes model the
+      frontier is ranked by;
+    - ``fine_gather_bytes``: what the CURRENT query-major XLA gather
+      path reads — each query re-fetches its own probe windows, the
+      exact nq× re-read pathology the PR-3 work removed from brute
+      force (the committed frontier carries both numbers so the gap
+      IS the named follow-up: a list-major fine-scan kernel);
+    - ``brute_bytes``: the stream-once fused pipeline's y traffic for
+      the same batch (database streamed ONCE per _Q_CHUNK query chunk,
+      bf16 hi+lo — the baseline this tier must beat);
+    - ``modeled_speedup``: brute_bytes / stream total — both pipelines
+      are HBM-bound, so the bytes ratio IS the modeled speedup, and
+      ``hbm_bw · speedup`` is the effective database-scan rate a
+      roofline-perfect chip would sustain.
+    """
+    from raft_tpu.distance.knn_fused import _Q_CHUNK
+
+    lanes = 128
+    d_eff = d + (-d) % lanes
+    coarse_bytes = float(n_lists * d_eff * 4 + nq * d_eff * 4
+                         + nq * n_lists * 4)
+    per_row = d_eff * 4 + 4 + 4              # row + norm + id
+    probed_frac = min(1.0, float(n_probes) * probe_window
+                      / max(1, slab_rows))
+    out_bytes = float(nq) * k * 8
+    chunks = max(1, -(-nq // _Q_CHUNK))
+    fine_stream_bytes = (float(chunks) * probed_frac
+                         * max(slab_rows, 1) * per_row)
+    fine_gather_bytes = float(nq) * n_probes * probe_window * per_row
+    total_stream = coarse_bytes + fine_stream_bytes + out_bytes
+    total_gather = coarse_bytes + fine_gather_bytes + out_bytes
+    brute_bytes = float(chunks) * max(m, 1) * d_eff * 2 * 2 \
+        + float(nq) * d_eff * 4
+    return {
+        "coarse_bytes": coarse_bytes,
+        "fine_stream_bytes": fine_stream_bytes,
+        "fine_gather_bytes": fine_gather_bytes,
+        "out_bytes": out_bytes,
+        "total_bytes": total_stream,
+        "total_gather_bytes": total_gather,
+        "brute_bytes": brute_bytes,
+        "probed_frac": probed_frac,
+        "modeled_speedup": brute_bytes / max(total_stream, 1.0),
+        "gather_overread": total_gather / max(total_stream, 1.0),
+    }
+
+
 # ------------------------------------------------- ICI traffic model
 MERGE_STRATEGIES = ("allgather", "tournament")
 

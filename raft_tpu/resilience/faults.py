@@ -87,6 +87,13 @@ KNOWN_SITES: Dict[str, Tuple[str, ...]] = {
     "tile_csr": ("oom", "error"),
     "spmv_sharded": ("oom", "error"),
     "solve_lap": ("oom", "error"),
+    # clustering + ANN tier (raft_tpu.cluster / raft_tpu.ann): the
+    # fit entry + the per-Lloyd-iteration site, and the IVF index
+    # build/search pair
+    "kmeans_fit": ("oom", "error"),
+    "kmeans_iteration": ("error",),
+    "ivf_build": ("oom", "error"),
+    "ivf_search": ("oom", "error"),
     # tuners + persistent stores
     "autotune_fused": ("error",),
     "autotune_sharded": ("error",),

@@ -226,9 +226,31 @@ class ServingEngine:
                  store_yp: bool = True,
                  rescore: Optional[bool] = None,
                  certify: str = "kernel",
+                 algorithm: str = "brute",
+                 n_lists: Optional[int] = None,
+                 n_probes: Optional[int] = None,
                  clock=time.monotonic):
+        from raft_tpu.ann import IvfFlatIndex
         from raft_tpu.distance.knn_fused import KnnIndex
 
+        # algorithm="ivf_flat": the SnapshotStore holds an IVF snapshot
+        # (built via ann.build_ivf_flat, swapped like any other) and
+        # the data plane serves APPROXIMATE queries through
+        # ann.search_ivf_flat behind the exact same bucket ladder —
+        # the speed/recall knob (n_probes) rides the serving tier.
+        if algorithm not in ("brute", "ivf_flat"):
+            raise ValueError(f"ServingEngine: algorithm must be "
+                             f"'brute' or 'ivf_flat', got {algorithm!r}")
+        if algorithm == "ivf_flat":
+            expects(mesh is None,
+                    "ServingEngine: algorithm='ivf_flat' serves "
+                    "single-device planes (shard the lists via "
+                    "ann.shard_ivf_lists outside the engine)")
+            expects(metric == "l2",
+                    "ServingEngine: algorithm='ivf_flat' serves "
+                    "metric='l2' only")
+        self._algorithm = algorithm
+        self._n_lists, self._n_probes = n_lists, n_probes
         self.res = ensure_resources(res)
         self.k = int(k)
         self._mesh, self._axis = mesh, axis
@@ -237,7 +259,12 @@ class ServingEngine:
         self._build_kw = dict(passes=passes, metric=metric, T=T, Qb=Qb,
                               g=g, grid_order=grid_order,
                               store_yp=store_yp)
-        if isinstance(index, KnnIndex):
+        if isinstance(index, (KnnIndex, IvfFlatIndex)):
+            if isinstance(index, IvfFlatIndex) != (
+                    algorithm == "ivf_flat"):
+                raise ValueError(
+                    "ServingEngine: prepared index type does not match "
+                    "algorithm=%r" % (algorithm,))
             initial = index
         else:
             initial = self._build_index(np.asarray(index, np.float32))
@@ -289,14 +316,27 @@ class ServingEngine:
 
     # -- construction helpers --------------------------------------------
     def _build_index(self, y):
+        if self._algorithm == "ivf_flat":
+            from raft_tpu.ann import build_ivf_flat
+
+            n_lists = self._n_lists or max(
+                1, min(1024, int(round(y.shape[0] ** 0.5))))
+            return build_ivf_flat(self.res, y, n_lists=n_lists,
+                                  n_probes=self._n_probes)
         from raft_tpu.distance.knn_fused import prepare_knn_index
 
         return prepare_knn_index(y, **self._build_kw)
 
     def _plane(self, snap: IndexSnapshot, xb):
         """The data plane for one padded bucket batch: the AOT runtime
-        entry on one device, or the PR-4 query-sharded replicated-index
-        mode over the mesh."""
+        entry on one device, the PR-4 query-sharded replicated-index
+        mode over the mesh, or the ANN tier's IVF probe search
+        (``algorithm="ivf_flat"``)."""
+        if self._algorithm == "ivf_flat":
+            from raft_tpu.ann import search_ivf_flat
+
+            return search_ivf_flat(self.res, snap.index, xb, self.k,
+                                   n_probes=self._n_probes)
         if self._mesh is not None:
             from raft_tpu.distance.knn_sharded import knn_fused_sharded
 

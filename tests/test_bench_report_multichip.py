@@ -157,3 +157,96 @@ def test_bench_sharded_artifact_schema():
         if not rec["measured"]:
             assert rec["degraded"] is True
             assert s.get("parity_vs_oracle") is True
+
+
+# ------------------------------------------------------------------
+# the ANN frontier gate (ISSUE 8)
+# ------------------------------------------------------------------
+
+def _ann_record(best=0.97, ok=True, degen=True, measured=False,
+                search_ms=300.0, k=10, degr=0):
+    rec = {
+        "metric": "ivf_flat recall@10 frontier 256x20000x32",
+        "value": best, "unit": f"recall@{k}", "ok": ok, "k": k,
+        "skipped": False, "measured": measured,
+        "recall_floor": 0.95, "degenerate_exact": degen,
+        "search_ms": search_ms,
+        "frontier": [
+            {"n_lists": 16, "n_probes": 1, "recall_at_k": best - 0.02,
+             "probed_frac": 0.06, "search_ms": search_ms},
+            {"n_lists": 16, "n_probes": 2, "recall_at_k": best,
+             "probed_frac": 0.12, "search_ms": search_ms * 1.5},
+        ],
+    }
+    if degr:
+        rec["resilience_degradations"] = degr
+    return rec
+
+
+def test_check_ann_gates_floor_and_degenerate(tmp_path):
+    br = _tools_import("bench_report")
+    # recall floor violated → regress even on a modeled round
+    _write(tmp_path / "BENCH_ANN.json", _ann_record(best=0.80))
+    status, msg = br.check_ann(br.collect_ann(str(tmp_path)))
+    assert status == br.REGRESS and "RECALL" in msg
+    # degenerate-exact violated → regress
+    _write(tmp_path / "BENCH_ANN.json", _ann_record(degen=False))
+    status, msg = br.check_ann(br.collect_ann(str(tmp_path)))
+    assert status == br.REGRESS and "DEGENERATE" in msg
+    # healthy modeled round passes and is not speed-gated
+    _write(tmp_path / "BENCH_ANN.json", _ann_record())
+    status, msg = br.check_ann(br.collect_ann(str(tmp_path)))
+    assert status == br.PASS and "not speed-gated" in msg
+
+
+def test_check_ann_degraded_rounds_skip(tmp_path):
+    br = _tools_import("bench_report")
+    _write(tmp_path / "BENCH_ANN.json", _ann_record(best=0.5, ok=False,
+                                                    degr=2))
+    status, msg = br.check_ann(br.collect_ann(str(tmp_path)))
+    assert status == br.SKIP and "degrad" in msg
+
+
+def test_check_ann_recall_trend_and_measured_speed(tmp_path):
+    br = _tools_import("bench_report")
+    # recall drop beyond the slack vs the previous round → regress
+    _write(tmp_path / "ANN_r01.json", _ann_record(best=0.99))
+    _write(tmp_path / "BENCH_ANN.json", _ann_record(best=0.95))
+    status, msg = br.check_ann(br.collect_ann(str(tmp_path)))
+    assert status == br.REGRESS and "TREND" in msg
+    # measured rounds speed-gate search_ms at the floor point
+    _write(tmp_path / "ANN_r01.json", _ann_record(measured=True,
+                                                  search_ms=100.0))
+    _write(tmp_path / "BENCH_ANN.json", _ann_record(measured=True,
+                                                    search_ms=200.0))
+    status, msg = br.check_ann(br.collect_ann(str(tmp_path)))
+    assert status == br.REGRESS and "SEARCH-TIME" in msg
+    # within threshold: pass, with the ms trend in the message
+    _write(tmp_path / "BENCH_ANN.json", _ann_record(measured=True,
+                                                    search_ms=105.0))
+    status, msg = br.check_ann(br.collect_ann(str(tmp_path)))
+    assert status == br.PASS
+
+
+def test_committed_ann_artifact_schema():
+    """The committed BENCH_ANN.json must carry the frontier the gate
+    reads: recall + probed fraction + modeled GB/s per point, the
+    degenerate-exact verdict, and an honest measured stamp."""
+    root = os.path.join(os.path.dirname(__file__), "..")
+    path = os.path.join(root, "BENCH_ANN.json")
+    if not os.path.exists(path):
+        pytest.skip("no BENCH_ANN.json committed")
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["ok"] is True
+    assert rec["degenerate_exact"] is True
+    assert isinstance(rec["measured"], bool)
+    best = max(p["recall_at_k"] for p in rec["frontier"])
+    assert best >= rec["recall_floor"]
+    for p in rec["frontier"]:
+        assert 0 <= p["probed_frac"] <= 1
+        assert p["modeled_effective_gbps"] >= 0
+        assert p["n_probes"] <= p["n_lists"] or \
+            p["recall_at_k"] == best
+    br = _tools_import("bench_report")
+    assert "BENCH_ANN.json" in br.NAMED_ARTIFACTS

@@ -1,0 +1,162 @@
+"""Balanced k-means (raft_tpu.cluster) — fit/predict correctness vs
+the make_blobs ground truth, the balanced size penalty, per-iteration
+flight events, and the imbalanced-oracle make_blobs satellites.
+(ISSUE 8: mirrors the reference's kmeans.cuh / kmeans_balanced.cuh
+test surface.)"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.cluster import (KMeansResult, kmeans_fit, kmeans_inertia,
+                              kmeans_predict)
+from raft_tpu.random import make_blobs
+from raft_tpu.stats.cluster import adjusted_rand_index
+
+rng = np.random.default_rng(5)
+
+
+def _blobs(res, n=2000, d=8, k=6, std=0.5, seed=3, **kw):
+    X, lab = make_blobs(res, seed, n, d, n_clusters=k, cluster_std=std,
+                        **kw)
+    return np.asarray(X), np.asarray(lab)
+
+
+def test_kmeans_recovers_blobs(res):
+    X, truth = _blobs(res)
+    r = kmeans_fit(res, X, 6, max_iter=25, seed=1, n_init=4)
+    assert isinstance(r, KMeansResult)
+    assert r.centroids.shape == (6, 8)
+    assert r.labels.shape == (2000,)
+    ari = adjusted_rand_index(res, truth, np.asarray(r.labels))
+    assert ari > 0.8
+    # sizes account for every point
+    assert int(np.asarray(r.cluster_sizes).sum()) == 2000
+    assert r.n_iter >= 1
+
+
+def test_kmeans_inertia_monotone_vs_worse_centroids(res):
+    X, _ = _blobs(res, n=1000, k=4)
+    r = kmeans_fit(res, X, 4, max_iter=20, seed=2)
+    # fitted inertia must beat a random-centroid labeling's inertia
+    bad = X[:4] + 100.0
+    assert r.inertia < kmeans_inertia(res, bad, X)
+    # and must equal the recomputed inertia of its own assignment
+    recomputed = kmeans_inertia(res, r.centroids, X,
+                                np.asarray(r.labels))
+    assert abs(recomputed - r.inertia) / max(r.inertia, 1e-9) < 1e-3
+
+
+def test_kmeans_predict_matches_fit_assignment(res):
+    X, _ = _blobs(res, n=800, k=5)
+    r = kmeans_fit(res, X, 5, max_iter=15, seed=4)
+    pred = np.asarray(kmeans_predict(res, r.centroids, X))
+    # the last fit assignment used the final-iteration weights; for the
+    # UNBALANCED fit weights are 1, so predict must agree exactly up to
+    # the one centroid update after the last assignment
+    agree = (pred == np.asarray(r.labels)).mean()
+    assert agree > 0.99
+
+
+def test_balanced_penalty_tightens_sizes(res):
+    # an overlapping, heavily skewed cloud: one dominant mode + a small
+    # offset mode. The plain fit tracks the density (big spread in
+    # cluster sizes); the balanced penalty must tighten the spread.
+    big = rng.normal(0, 1.5, (1600, 6)).astype(np.float32)
+    small = rng.normal(2.0, 1.0, (400, 6)).astype(np.float32)
+    X = np.concatenate([big, small])
+    plain = kmeans_fit(res, X, 8, max_iter=20, seed=0)
+    bal = kmeans_fit(res, X, 8, max_iter=20, seed=0, balanced=True)
+    s_plain = np.asarray(plain.cluster_sizes, np.float64)
+    s_bal = np.asarray(bal.cluster_sizes, np.float64)
+    cv = lambda s: s.std() / max(s.mean(), 1e-9)   # noqa: E731
+    assert cv(s_bal) <= cv(s_plain) + 1e-6
+    # balance must not cost much inertia (it's a penalty, not a remap)
+    assert bal.inertia < plain.inertia * 1.5
+
+
+def test_empty_cluster_keeps_centroid(res):
+    X, _ = _blobs(res, n=200, k=2, std=0.1)
+    far = np.full((1, 8), 500.0, np.float32)
+    init = np.concatenate([X[:2], far])
+    r = kmeans_fit(res, X, 3, max_iter=5, seed=0, init_centroids=init)
+    sizes = np.asarray(r.cluster_sizes)
+    assert sizes.min() == 0                    # the far centroid starves
+    # and its centroid survived (kept, not NaN'd)
+    assert np.isfinite(np.asarray(r.centroids)).all()
+    assert np.allclose(np.asarray(r.centroids)[2], 500.0)
+
+
+def test_kmeans_emits_iteration_markers(res):
+    from raft_tpu.observability import get_flight_recorder
+
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        pytest.skip("flight recorder disabled")
+    X, _ = _blobs(res, n=400, k=3)
+    before = sum(1 for e in rec.events()
+                 if e.get("kind") == "marker"
+                 and e.get("name") == "kmeans_iteration")
+    r = kmeans_fit(res, X, 3, max_iter=10, seed=1)
+    after = sum(1 for e in rec.events()
+                if e.get("kind") == "marker"
+                and e.get("name") == "kmeans_iteration")
+    assert after - before == r.n_iter
+
+
+def test_kmeans_argument_validation(res):
+    X = rng.normal(size=(10, 4)).astype(np.float32)
+    with pytest.raises(Exception):
+        kmeans_fit(res, X, 11)                 # k > n
+    with pytest.raises(Exception):
+        kmeans_fit(res, X, 2, init="bogus")
+    with pytest.raises(Exception):
+        kmeans_predict(res, np.ones((2, 5), np.float32), X)  # dim
+
+
+def test_kmeans_random_init(res):
+    X, truth = _blobs(res, n=600, k=4)
+    r = kmeans_fit(res, X, 4, max_iter=25, seed=6, init="random")
+    assert adjusted_rand_index(res, truth, np.asarray(r.labels)) > 0.6
+
+
+# ---- make_blobs satellites (the controllable oracle) ----------------
+def test_make_blobs_proportions_counts(res):
+    X, lab = make_blobs(res, 9, 1000, 4, n_clusters=4,
+                        proportions=[0.5, 0.25, 0.15, 0.1])
+    counts = np.bincount(np.asarray(lab), minlength=4)
+    assert counts.sum() == 1000
+    assert counts[0] == 500 and counts[1] == 250
+    assert counts[2] == 150 and counts[3] == 100
+
+
+def test_make_blobs_proportions_remainder_deterministic(res):
+    _, lab1 = make_blobs(res, 9, 1001, 4, n_clusters=3,
+                         proportions=[1, 1, 1])
+    _, lab2 = make_blobs(res, 9, 1001, 4, n_clusters=3,
+                         proportions=[1, 1, 1])
+    c1 = np.bincount(np.asarray(lab1), minlength=3)
+    c2 = np.bincount(np.asarray(lab2), minlength=3)
+    assert (c1 == c2).all() and c1.sum() == 1001
+    assert c1.max() - c1.min() <= 1
+
+
+def test_make_blobs_per_center_std_and_centers(res):
+    stds = np.array([0.05, 2.0], np.float32)
+    X, lab, centers = make_blobs(res, 13, 4000, 6, n_clusters=2,
+                                 cluster_std=stds, return_centers=True,
+                                 shuffle=False)
+    X, lab = np.asarray(X), np.asarray(lab)
+    centers = np.asarray(centers)
+    assert centers.shape == (2, 6)
+    spread0 = X[lab == 0].std(axis=0).mean()
+    spread1 = X[lab == 1].std(axis=0).mean()
+    assert spread1 > 10 * spread0              # per-center std honored
+    # points scatter around their own center
+    assert np.abs(X[lab == 0].mean(axis=0) - centers[0]).max() < 0.1
+
+
+def test_make_blobs_proportions_validation(res):
+    with pytest.raises(ValueError):
+        make_blobs(res, 1, 100, 4, n_clusters=3, proportions=[1, 1])
+    with pytest.raises(ValueError):
+        make_blobs(res, 1, 100, 4, n_clusters=2, proportions=[-1, 2])

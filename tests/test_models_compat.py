@@ -53,6 +53,56 @@ def test_pca_model_distributed(res):
                                np.asarray(m1.mean_), atol=1e-4)
 
 
+def test_kmeans_model(res):
+    from raft_tpu.random import make_blobs
+    from raft_tpu.stats.cluster import adjusted_rand_index
+
+    X, truth = make_blobs(res, 31, 1500, 10, n_clusters=5,
+                          cluster_std=0.4)
+    m = models.KMeans(n_clusters=5, max_iter=25, random_state=1,
+                      res=res).fit(np.asarray(X))
+    assert m.cluster_centers_.shape == (5, 10)
+    assert m.labels_.shape == (1500,)
+    assert m.inertia_ > 0 and m.n_iter_ >= 1
+    ari = adjusted_rand_index(res, np.asarray(truth),
+                              np.asarray(m.labels_))
+    assert ari > 0.9
+    # predict is consistent with the fitted assignment
+    pred = np.asarray(m.predict(np.asarray(X)))
+    assert (pred == np.asarray(m.labels_)).mean() > 0.99
+    # transform returns euclidean distances to each center
+    T = np.asarray(m.transform(np.asarray(X)[:16]))
+    assert T.shape == (16, 5)
+    assert (T.argmin(axis=1) == pred[:16]).all()
+    # balanced variant routes through the same surface
+    mb = models.KMeans(n_clusters=5, max_iter=10, balanced=True,
+                      res=res).fit(np.asarray(X))
+    assert mb.cluster_centers_.shape == (5, 10)
+
+
+def test_nearest_neighbors_ivf_flat_compat(res):
+    X = rng.normal(size=(3000, 16)).astype(np.float32)
+    Q = rng.normal(size=(9, 16)).astype(np.float32)
+    brute = models.NearestNeighbors(n_neighbors=4, res=res).fit(X)
+    bd, bi = brute.kneighbors(Q)
+    # degenerate n_probes = n_lists: id sets must match brute exactly
+    ivf = models.NearestNeighbors(
+        n_neighbors=4, algorithm="ivf_flat", n_lists=8, n_probes=8,
+        res=res).fit(X)
+    d, i = ivf.kneighbors(Q)
+    for q in range(9):
+        assert set(np.asarray(i)[q].tolist()) == \
+            set(np.asarray(bi)[q].tolist())
+    # approximate mode returns well-formed results + honest recall
+    ivf2 = models.NearestNeighbors(
+        n_neighbors=4, algorithm="ivf_flat", n_lists=8, n_probes=2,
+        res=res).fit(X)
+    d2, i2 = ivf2.kneighbors(Q)
+    assert np.asarray(d2).shape == (9, 4)
+    # default algorithm unchanged: 'brute' path untouched by the knob
+    assert brute.algorithm == "brute"
+
+
 def test_nearest_neighbors_model_distributed(res):
     from raft_tpu.parallel import make_mesh
 

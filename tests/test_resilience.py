@@ -214,6 +214,46 @@ def _coo_small():
     return COOMatrix(r, c, v, (64, 64))
 
 
+def _drive_kmeans():
+    """Routes through BOTH kmeans sites: kmeans_fit fires at entry,
+    kmeans_iteration inside the first Lloyd pass."""
+    from raft_tpu.cluster import kmeans_fit
+
+    X = rng.normal(size=(32, 8)).astype(np.float32)
+    return kmeans_fit(None, X, 2, max_iter=1, seed=0)
+
+
+_ivf_index = None
+
+
+def _ivf_small():
+    global _ivf_index
+    if _ivf_index is None:
+        from raft_tpu.ann import build_ivf_flat
+
+        _ivf_index = build_ivf_flat(
+            None, rng.normal(size=(64, 8)).astype(np.float32),
+            n_lists=4, max_iter=2, seed=0)
+    return _ivf_index
+
+
+def _drive_ivf_build():
+    from raft_tpu.ann import build_ivf_flat
+
+    return build_ivf_flat(
+        None, rng.normal(size=(64, 8)).astype(np.float32),
+        n_lists=4, max_iter=1, seed=0)
+
+
+def _drive_ivf_search():
+    """The search fault site fires at entry, before the coarse probe —
+    the prebuilt tiny index keeps the driver cheap."""
+    from raft_tpu.ann import search_ivf_flat
+
+    return search_ivf_flat(None, _ivf_small(),
+                           np.ones((2, 8), np.float32), 2, n_probes=2)
+
+
 _serving_engine = None
 
 
@@ -277,6 +317,14 @@ def _always_raise_drivers():
         "host_sync": lambda: hc.sync_stream(jnp.ones(2)),
         "aot_compile": _drive_aot,
         "aot_dispatch": _drive_aot,
+        # clustering + ANN tier: the fit entry fires kmeans_fit, the
+        # Lloyd loop fires kmeans_iteration on the same drive; the IVF
+        # pair drives build (which the search driver re-runs cheaply —
+        # only the ARMED site fires)
+        "kmeans_fit": _drive_kmeans,
+        "kmeans_iteration": _drive_kmeans,
+        "ivf_build": _drive_ivf_build,
+        "ivf_search": _drive_ivf_search,
         "serving_enqueue": _drive_serving_enqueue,
         "sharded_dispatch": None,      # dedicated ladder tests below
         "merge_permute": None,

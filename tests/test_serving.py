@@ -429,6 +429,35 @@ def test_closed_loop_poisson_soak(data):
 
 
 # ------------------------------------------------------------------
+# the ANN tier behind the same bucket ladder (ISSUE 8)
+# ------------------------------------------------------------------
+
+def test_ivf_flat_serving_plane(data):
+    """algorithm='ivf_flat': the SnapshotStore holds an IVF snapshot
+    and the engine serves approximate queries behind the same bucket
+    ladder. At n_probes = n_lists the plane is degenerate-exact, so a
+    served batch must match the brute-force oracle's id sets."""
+    y, idx = data
+    eng = ServingEngine(y, k=K, buckets=(8,), flush_interval_s=0.005,
+                        algorithm="ivf_flat", n_lists=8, n_probes=8)
+    eng.start()
+    try:
+        x = rng.normal(size=(5, D)).astype(np.float32)
+        vals, ids = eng.query(x, timeout=120)
+        ov, oi = _oracle(x, idx)
+        for q in range(5):
+            assert set(ids[q].tolist()) == set(oi[q].tolist())
+        # the snapshot store really holds an IVF snapshot
+        from raft_tpu.ann import IvfFlatIndex
+
+        assert isinstance(eng.snapshot.index, IvfFlatIndex)
+    finally:
+        eng.stop()
+    with pytest.raises(ValueError):
+        ServingEngine(y, k=K, algorithm="bogus")
+
+
+# ------------------------------------------------------------------
 # bench_report: the serving gate
 # ------------------------------------------------------------------
 

@@ -54,6 +54,12 @@ ROUND_GLOB = "BENCH_r*.json"
 MULTICHIP_GLOB = "MULTICHIP_r*.json"
 SERVING_GLOB = "SERVING_r*.json"
 SERVING_NAME = "BENCH_SERVING.json"
+ANN_GLOB = "ANN_r*.json"
+ANN_NAME = "BENCH_ANN.json"
+# recall@k may drop at most this much ABSOLUTE between rounds (recall
+# is platform-independent math, so the trend gates modeled rounds too —
+# only the ms columns are speed and measured-only)
+ANN_RECALL_SLACK = 0.02
 BASELINE_NAME = "BENCH_LAST_GOOD.json"
 DRIFT_LEDGER_NAME = "DRIFT_LEDGER.json"
 DEFAULT_THRESHOLD = 0.15   # 15% relative drop (or slowdown) fails
@@ -70,7 +76,7 @@ DRIFT_BAND = 3.0
 # all predate multiple perf rounds at the time this gate shipped)
 NAMED_ARTIFACTS = ("SELECT_K_MATRIX.json", "PALLAS_SMOKE.json",
                    "TPU_FUZZ.json", "BUSBW_BENCH.json",
-                   "BENCH_SERVING.json")
+                   "BENCH_SERVING.json", "BENCH_ANN.json")
 
 # cost-model fields Fixture.run emits into BENCH artifacts (PR 2+)
 COST_FIELDS = ("flops", "bytes_accessed", "arithmetic_intensity",
@@ -375,6 +381,177 @@ def serving_trajectory(rounds: Sequence[Tuple[int, str,
             _fmt(rec.get("compile_misses_after_warmup")),
             _fmt(rec.get("measured")) if "measured" in rec else "-",
             normalize_metric(rec.get("metric", "serving"))))
+    widths = [max(len(c), *(len(str(r[i])) for r in rows))
+              for i, c in enumerate(cols)]
+    lines.append("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    lines.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        lines.append("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+    return "\n".join(lines) + "\n"
+
+
+def load_ann(path: str) -> Optional[Dict]:
+    """Flat ANN speed/recall frontier record (benchmarks/bench_ann.py):
+    unwraps the driver's envelope like :func:`load_serving`. A record
+    must carry an ``ok`` verdict or a frontier to count."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict):
+        return None
+    rec = data.get("parsed")
+    if isinstance(rec, dict) and ("ok" in rec or "frontier" in rec):
+        merged = dict(data)
+        merged.update(rec)
+        return merged
+    if "ok" in data or "frontier" in data:
+        return data
+    return None
+
+
+def collect_ann(directory: str) -> List[Tuple[int, str, Optional[Dict]]]:
+    """(round, path, record) for every ANN_r*.json, in round order,
+    plus the bare BENCH_ANN.json (when present) as the NEWEST entry —
+    same convention as :func:`collect_serving`."""
+    out = []
+    for path in glob.glob(os.path.join(directory, ANN_GLOB)):
+        m = re.search(r"ANN_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        out.append((int(m.group(1)), path, load_ann(path)))
+    out.sort(key=lambda t: t[0])
+    bare = os.path.join(directory, ANN_NAME)
+    if os.path.exists(bare):
+        n = (out[-1][0] + 1) if out else 1
+        out.append((n, bare, load_ann(bare)))
+    return out
+
+
+def _ann_best_recall(rec: Dict) -> Optional[float]:
+    frontier = rec.get("frontier")
+    if not isinstance(frontier, list):
+        return None
+    rs = [p.get("recall_at_k") for p in frontier
+          if isinstance(p, dict)
+          and isinstance(p.get("recall_at_k"), (int, float))]
+    return max(rs) if rs else None
+
+
+def check_ann(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
+              threshold: float = DEFAULT_THRESHOLD) -> Tuple[str, str]:
+    """Gate the ANN speed/recall frontier (BENCH_ANN / ANN_r*):
+
+    - the newest parseable round must be ``ok``;
+    - degraded rounds (nonzero resilience degradations) SKIP — outage
+      evidence is history, never a gate;
+    - **recall floor**: the frontier's best recall@k must reach the
+      artifact's own ``recall_floor`` (default 0.95) — recall is
+      platform-independent math, so this gates modeled rounds too;
+    - **degenerate-exact invariant**: the ``n_probes = n_lists`` sweep
+      point must have matched the brute-force oracle's id sets
+      (``degenerate_exact: true``);
+    - **recall trend**: best recall must not drop more than
+      ``ANN_RECALL_SLACK`` absolute vs the previous comparable round;
+    - **speed trend**: only MEASURED rounds gate search time — when the
+      newest and a previous measured round both carry ``search_ms`` at
+      the floor-recall point, it must not grow past ``threshold``
+      (modeled rounds are never speed-gated)."""
+    newest = None
+    for _, _, rec in reversed(rounds):
+        if rec is not None:
+            newest = rec
+            break
+    if newest is None:
+        return SKIP, "no ANN artifact to gate"
+    if newest.get("skipped"):
+        return SKIP, "latest ANN round skipped"
+    rd = newest.get("resilience_degradations")
+    if isinstance(rd, (int, float)) and rd > 0:
+        return SKIP, (
+            f"latest ANN round recorded {rd:g} degradation step(s) — "
+            f"a degraded run is history, never gated and never "
+            f"baseline material")
+    if not newest.get("ok", True):
+        return REGRESS, ("latest ANN round failed (ok=false) — the "
+                         "ANN tier regressed")
+    best = _ann_best_recall(newest)
+    floor = newest.get("recall_floor", 0.95)
+    if isinstance(best, (int, float)) and isinstance(floor,
+                                                     (int, float)):
+        if best < floor:
+            return REGRESS, (
+                f"ANN RECALL REGRESSION: best recall@k {best:.4f} < "
+                f"floor {floor:g} — no swept n_probes reaches the "
+                f"recall the frontier promises")
+    if "degenerate_exact" in newest and not newest["degenerate_exact"]:
+        return REGRESS, (
+            "ANN DEGENERATE-EXACT VIOLATION: the n_probes = n_lists "
+            "sweep point did not match the brute-force oracle's id "
+            "sets — probing everything must be exact search")
+    prev = None
+    for _, _, rec in reversed(rounds[:-1]):
+        if (rec is not None and not rec.get("skipped")
+                and _ann_best_recall(rec) is not None
+                and rec.get("k") == newest.get("k")):
+            prev = rec
+            break
+    msgs = [f"best recall@{newest.get('k', '?')} "
+            f"{best:.4f}" if isinstance(best, (int, float))
+            else "no recall points"]
+    if prev is not None and isinstance(best, (int, float)):
+        pbest = _ann_best_recall(prev)
+        if pbest is not None and best < pbest - ANN_RECALL_SLACK:
+            return REGRESS, (
+                f"ANN RECALL TREND REGRESSION: best recall {best:.4f} "
+                f"< previous {pbest:.4f} − {ANN_RECALL_SLACK:g}")
+        if pbest is not None:
+            msgs.append(f"prev {pbest:.4f}")
+    if newest.get("measured") and prev is not None \
+            and prev.get("measured"):
+        sm, pm = newest.get("search_ms"), prev.get("search_ms")
+        if isinstance(sm, (int, float)) and isinstance(pm, (int, float)) \
+                and pm > 0:
+            ceil = pm * (1.0 + threshold)
+            if sm > ceil:
+                return REGRESS, (
+                    f"ANN SEARCH-TIME REGRESSION: {sm:g} ms > {ceil:g} "
+                    f"(previous measured {pm:g} + {threshold:.0%})")
+            msgs.append(f"search {sm:g} vs {pm:g} ms")
+    elif not newest.get("measured"):
+        msgs.append("modeled — not speed-gated")
+    return PASS, "ann ok: " + "; ".join(msgs)
+
+
+def ann_trajectory(rounds: Sequence[Tuple[int, str,
+                                          Optional[Dict]]]) -> str:
+    """ANN frontier series: best recall, probed fraction at the floor,
+    degenerate-exact verdict per round."""
+    lines = ["ann trajectory (ANN_r*.json + BENCH_ANN.json)",
+             "=============================================="]
+    if not rounds:
+        return "\n".join(lines + ["(no ANN artifacts found)"]) + "\n"
+    cols = ("round", "ok", "best recall", "floor-probe%", "degen",
+            "lists", "measured", "metric")
+    rows = []
+    for n, path, rec in rounds:
+        if rec is None:
+            rows.append((f"r{n:02d}", "-", "-", "-", "-", "-", "-",
+                         f"<unparseable: {os.path.basename(path)}>"))
+            continue
+        best = _ann_best_recall(rec)
+        pf = rec.get("probed_frac_at_floor")
+        nl = sorted({p.get("n_lists") for p in rec.get("frontier", [])
+                     if isinstance(p, dict)})
+        rows.append((
+            f"r{n:02d}", _fmt(bool(rec.get("ok"))),
+            f"{best:.4f}" if isinstance(best, (int, float)) else "-",
+            f"{pf * 100:.1f}" if isinstance(pf, (int, float)) else "-",
+            _fmt(rec.get("degenerate_exact")),
+            ",".join(str(x) for x in nl if x is not None) or "-",
+            _fmt(rec.get("measured")) if "measured" in rec else "-",
+            normalize_metric(rec.get("metric", "ann"))))
     widths = [max(len(c), *(len(str(r[i])) for r in rows))
               for i, c in enumerate(cols)]
     lines.append("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
@@ -719,6 +896,7 @@ def main(argv: Sequence[str] = None) -> int:
     rounds = collect_rounds(args.dir)
     mrounds = collect_multichip(args.dir)
     srounds = collect_serving(args.dir)
+    arounds = collect_ann(args.dir)
     baseline_path = args.baseline or os.path.join(args.dir, BASELINE_NAME)
     baseline = load_record(baseline_path)
     stale = artifact_staleness(args.dir, baseline)
@@ -739,6 +917,8 @@ def main(argv: Sequence[str] = None) -> int:
         print(f"bench_report --check [multichip]: {mstatus}: {mmsg}")
         sstatus, smsg = check_serving(srounds, args.threshold)
         print(f"bench_report --check [serving]: {sstatus}: {smsg}")
+        astatus, amsg = check_ann(arounds, args.threshold)
+        print(f"bench_report --check [ann]: {astatus}: {amsg}")
         ledger_path = args.drift_ledger or os.path.join(
             args.dir, DRIFT_LEDGER_NAME)
         dstatus, dmsg = check_drift(load_drift_ledger(ledger_path),
@@ -752,7 +932,7 @@ def main(argv: Sequence[str] = None) -> int:
         # regression in ANY trend fails; missing baseline only when
         # nothing regressed
         rcs = (codes[status], codes[mstatus], codes[sstatus],
-               codes[dstatus])
+               codes[astatus], codes[dstatus])
         return 1 if 1 in rcs else max(rcs)
 
     if args.json:
@@ -765,6 +945,9 @@ def main(argv: Sequence[str] = None) -> int:
             "serving_rounds": [
                 {"round": n, "path": os.path.basename(path),
                  "record": rec} for n, path, rec in srounds],
+            "ann_rounds": [
+                {"round": n, "path": os.path.basename(path),
+                 "record": rec} for n, path, rec in arounds],
             "named_artifacts": stale,
             "baseline": baseline,
             "drift_ledger": load_drift_ledger(
@@ -779,6 +962,8 @@ def main(argv: Sequence[str] = None) -> int:
     sys.stdout.write(multichip_trajectory(mrounds))
     sys.stdout.write("\n")
     sys.stdout.write(serving_trajectory(srounds))
+    sys.stdout.write("\n")
+    sys.stdout.write(ann_trajectory(arounds))
     sys.stdout.write("\n")
     sys.stdout.write(staleness_section(stale))
     return 0

@@ -49,6 +49,8 @@ HOT_PATHS: Dict[str, Sequence[str]] = {
     "raft_tpu/distance/knn_sharded.py": ("knn_fused_sharded",),
     "raft_tpu/serving/engine.py": ("execute_batch",),
     "raft_tpu/serving/snapshot.py": ("build_snapshot",),
+    "raft_tpu/cluster/kmeans.py": ("kmeans_fit", "kmeans_predict"),
+    "raft_tpu/ann/ivf_flat.py": ("build_ivf_flat", "search_ivf_flat"),
 }
 
 # module (repo-relative) → profiler capture methods it must call
@@ -58,6 +60,11 @@ COST_CAPTURE_SITES: Dict[str, Sequence[str]] = {
     "raft_tpu/benchmark.py": ("capture_fn",),
     "raft_tpu/tune/fused.py": ("capture_fn",),
     "raft_tpu/tune/sharded.py": ("capture_fn",),
+    # the ANN tier's hot kernels: the k-means assignment tile and the
+    # IVF fine scan both feed the roofline profiler, so BENCH_ANN
+    # frontiers carry flops/bytes next to recall
+    "raft_tpu/cluster/kmeans.py": ("capture_fn",),
+    "raft_tpu/ann/ivf_flat.py": ("capture_fn",),
 }
 
 # sharded-merge observability sites: the merge rounds must flow through
@@ -103,6 +110,8 @@ FAULT_SITES: Dict[str, Sequence[str]] = {
                                      "host_sync"),
     "raft_tpu/serving/engine.py": ("serving_enqueue", "serving_flush"),
     "raft_tpu/serving/snapshot.py": ("serving_snapshot",),
+    "raft_tpu/cluster/kmeans.py": ("kmeans_fit", "kmeans_iteration"),
+    "raft_tpu/ann/ivf_flat.py": ("ivf_build", "ivf_search"),
 }
 
 # timeline-event gate: every hot-path module and every fault-site
@@ -164,6 +173,12 @@ EVENT_SITES: Dict[str, Sequence[str]] = {
     "raft_tpu/serving/snapshot.py": ("instrument", "fault_point",
                                      "emit_serving"),
     "raft_tpu/serving/buckets.py": ("emit_marker",),
+    # the ANN tier: per-iteration k-means markers, IVF build/search
+    # markers (probed-bytes fraction rides the search event)
+    "raft_tpu/cluster/kmeans.py": ("instrument", "fault_point",
+                                   "emit_marker"),
+    "raft_tpu/ann/ivf_flat.py": ("instrument", "fault_point",
+                                 "emit_marker"),
 }
 
 _FLIGHT_MODULE = "raft_tpu/observability/flight.py"
