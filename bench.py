@@ -373,6 +373,46 @@ def main():
             result[f] = r1[f]
     if fused_cfg is not None:
         result["fused_config"] = fused_cfg
+    # quantized-index-streaming evidence (ROADMAP item 2): the headline
+    # rows stream bf16; stamp the dtype, the MODELED int8/bf16
+    # streamed-bytes ratio for this round's geometry, and an id-parity
+    # spot check of the int8 path vs the f32 oracle on a subset —
+    # bench_report --check gates ratio ≤ 0.55 and parity ok=true.
+    result["db_dtype"] = "bf16"
+    try:
+        from raft_tpu.distance.knn_fused import knn_fused as _kf
+        from raft_tpu.observability.costmodel import (
+            quantized_bytes_ratio)
+
+        qcfg = fused_cfg or {"T": 2048, "Qb": 256, "g": 16,
+                             "grid_order": "db", "passes": 1}
+        q_order = qcfg["grid_order"] if qcfg["grid_order"] != "query" \
+            else "db"
+        ratio = quantized_bytes_ratio(
+            n_queries, n_index, dim, k, qcfg["T"], qcfg["Qb"],
+            qcfg["g"], qcfg["passes"], q_order)
+        mp, np_, kp = min(n_index, 50_000), min(n_queries, 256), k
+        Yp = X[:mp]
+        Qp = Q[:np_]
+        _, id_f = _kf(Qp, Yp, kp, passes=1, grid_order="db")
+        _, id_q = _kf(Qp, Yp, kp, passes=1, grid_order="db",
+                      db_dtype="int8")
+        import numpy as _np
+
+        parity_ok = bool(_np.array_equal(
+            _np.sort(_np.asarray(id_f), axis=1),
+            _np.sort(_np.asarray(id_q), axis=1)))
+        result["quantized"] = {
+            "db_dtype": "int8",
+            "quantized_y_ratio": round(float(ratio), 4),
+            "parity_rows": mp, "parity_queries": np_,
+            "ok": parity_ok,
+        }
+    except Exception:
+        import traceback
+
+        print("bench: quantized evidence failed (block omitted):\n"
+              + traceback.format_exc(), file=sys.stderr)
     if traffic_model is not None:
         result["model_total_bytes"] = traffic_model["total_bytes"]
         result["model_y_bytes"] = traffic_model["y_bytes"]

@@ -162,11 +162,47 @@ def main(argv=None) -> int:
                 "list_size_max": int(sizes.max()),
             })
 
+    # quantized-slab evidence: the int8 IVF index on the LAST swept
+    # n_lists — id-set parity vs the f32 IVF index at a mid probe count
+    # and oracle-exactness at the degenerate point, plus the modeled
+    # probed-gather bytes ratio. Gated by bench_report --check.
+    quantized = None
+    try:
+        L = lists[-1]
+        idx8 = build_ivf_flat(res, X, n_lists=L, max_iter=8, seed=3,
+                              db_dtype="int8")
+        Pq = max(1, min(L - 1, 1 + L // 8)) if L > 1 else 1
+        _, fi = search_ivf_flat(res, idx, Q, k, n_probes=Pq)
+        _, qi = search_ivf_flat(res, idx8, Q, k, n_probes=Pq)
+        fi, qi = np.asarray(fi), np.asarray(qi)
+        parity = all(set(fi[q]) == set(qi[q]) for q in range(nq))
+        _, qe = search_ivf_flat(res, idx8, Q, k, n_probes=L)
+        qe = np.asarray(qe)
+        q8_exact = all(set(qe[q]) == oracle_sets[q] for q in range(nq))
+        model8 = ivf_traffic_model(nq, m, d, k, L, Pq,
+                                   idx8.probe_window, idx8.slab_rows,
+                                   db_dtype="int8")
+        quantized = {
+            "db_dtype": "int8",
+            "n_lists": L, "n_probes": Pq,
+            "quantized_gather_ratio": round(
+                model8["quantized_gather_ratio"], 4),
+            "degenerate_exact": bool(q8_exact),
+            "ok": bool(parity and q8_exact),
+        }
+        if not quantized["ok"]:
+            errors.append("int8 IVF parity/degenerate check failed")
+    except Exception as e:
+        errors.append(f"int8 IVF evidence failed: "
+                      f"{type(e).__name__}: {e}"[:200])
+        quantized = {"error": str(e)[:200], "ok": False}
+
     best = max(p["recall_at_k"] for p in frontier)
     at_floor = [p for p in frontier if p["recall_at_k"] >= RECALL_FLOOR]
     floor_pt = min(at_floor, key=lambda p: p["probed_frac"]) \
         if at_floor else None
-    ok = best >= RECALL_FLOOR and degenerate_exact and not errors
+    ok = (best >= RECALL_FLOOR and degenerate_exact and not errors
+          and bool(quantized and quantized.get("ok")))
     degr = degradation_count() - degr0
     result = {
         "metric": f"ivf_flat recall@{k} frontier {nq}x{m}x{d} "
@@ -181,6 +217,8 @@ def main(argv=None) -> int:
         "k": k,
         "recall_floor": RECALL_FLOOR,
         "degenerate_exact": bool(degenerate_exact),
+        "db_dtype": "f32",
+        "quantized": quantized,
         "frontier": frontier,
         "probed_frac_at_floor": floor_pt["probed_frac"]
         if floor_pt else None,

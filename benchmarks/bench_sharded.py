@@ -176,6 +176,36 @@ def main() -> int:
             entry["error"] = f"{type(e).__name__}: {e}"[:300]
         strategies[strat] = entry
 
+    # quantized-index-streaming evidence: modeled int8/bf16 streamed-
+    # bytes ratio for the per-shard geometry + int8-vs-f32 id parity
+    # through the sharded pipeline (off-TPU: the full CPU parity pass;
+    # on TPU: a sampled check rides the same call path). Gated by
+    # bench_report --check (ratio ≤ 0.55, ok stays true).
+    quantized = None
+    try:
+        from raft_tpu.observability.costmodel import (
+            quantized_bytes_ratio)
+
+        ratio = quantized_bytes_ratio(
+            nq, -(-m // p), d, k, idx.T, idx.Qb, idx.g, idx.passes,
+            idx.grid_order if idx.grid_order != "query" else "db")
+        idx_q8 = prepare_knn_index_sharded(
+            X, mesh=mesh, T=idx.T, Qb=idx.Qb, g=idx.g,
+            grid_order="db", db_dtype="int8", res=res)
+        qv, qi = knn_fused_sharded(Q, idx_q8, k, mesh=mesh)
+        fv, fi = knn_fused_sharded(Q, idx, k, mesh=mesh)
+        q8_parity = bool(np.array_equal(
+            np.sort(np.asarray(qi), axis=1),
+            np.sort(np.asarray(fi), axis=1)))
+        ok = ok and q8_parity
+        quantized = {"db_dtype": "int8",
+                     "quantized_y_ratio": round(float(ratio), 4),
+                     "ok": q8_parity}
+    except Exception as e:
+        ok = False
+        quantized = {"error": f"{type(e).__name__}: {e}"[:300],
+                     "ok": False}
+
     best = max((s for s in strategies.values() if s.get("gbps")),
                key=lambda s: s["gbps"], default={})
     result = {
@@ -194,6 +224,8 @@ def main() -> int:
         "degraded": not measured,
         "chip": spec.name,
         "ici_bw": spec.ici_bw,
+        "db_dtype": "bf16",
+        "quantized": quantized,
         "strategies": strategies,
         "platform": jax.default_backend(),
         "git_commit": _git_commit(),

@@ -94,6 +94,17 @@ def _env_int(name: str, default: int, lo: int = 1) -> int:
 #: tile stays under ~256 MB f32
 _FINE_TILE = 1 << 26
 
+#: IVF storage dtypes for the fine-scan slab: "f32" gathers full rows;
+#: "int8" gathers the per-list symmetric-scale quantized slab (~¼ the
+#: probed bytes), prunes to a certified candidate pool and exact-
+#: rescoring it from the f32 rows — chunks whose certificate fails
+#: rerun the f32 scan, so returned ids never degrade
+IVF_DB_DTYPES = ("f32", "int8")
+
+#: rescue-pool oversampling of the quantized fine scan (candidates
+#: exact-rescored per query beyond k)
+_IVF_RESCORE_PAD = 32
+
 # compiled sharded-search programs, keyed by full static geometry
 # (same pattern as knn_sharded._SHARDED_FUSED_CACHE)
 _SHARDED_IVF_CACHE: dict = {}
@@ -112,7 +123,9 @@ class IvfFlatIndex:
     def __init__(self, centroids, slab, ids, yy_slab, offsets, sizes,
                  padded_sizes, n_rows: int, d_orig: int,
                  row_quantum: int, n_probes_default: int, Qb: int,
-                 kmeans_iters: int = 0, balanced: bool = True):
+                 kmeans_iters: int = 0, balanced: bool = True,
+                 db_dtype: str = "f32", slab_q=None, row_scale=None,
+                 yy_q=None, eq_rows=None):
         self.centroids = centroids          # [L, d] f32
         self.slab = slab                    # [R, d] f32 (pad rows zero)
         self.ids = ids                      # [R] int32 global ids, -1 pads
@@ -128,6 +141,17 @@ class IvfFlatIndex:
         self.kmeans_iters = kmeans_iters
         self.balanced = balanced
         self.metric = "l2"
+        # quantized fine-scan state (db_dtype="int8"): per-LIST
+        # symmetric int8 slab + per-row scale/Eq (rows of a list share
+        # its scale — stored per row so the probe-window gather pulls
+        # them alongside the codes), and the DEQUANTIZED row norms the
+        # approximate scorer uses. The f32 slab stays: it is the exact-
+        # rescore (and degenerate-exact / sharded) data plane.
+        self.db_dtype = db_dtype
+        self.slab_q = slab_q                # [R, d] int8 or None
+        self.row_scale = row_scale          # [R] f32
+        self.yy_q = yy_q                    # [R] f32 (‖ŷ‖², pads 0)
+        self.eq_rows = eq_rows              # [R] f32 per-row Eq bound
         # host copies of the geometry (numpy — search wrappers index
         # them without device sync) + the lazy ragged fused operands
         self._np_offsets = np.asarray(offsets)
@@ -160,8 +184,8 @@ def build_ivf_flat(res, y, n_lists: int, n_probes: Optional[int] = None,
                    max_iter: int = 10, seed: int = 0,
                    balanced: bool = True,
                    row_quantum: Optional[int] = None,
-                   max_train_rows: Optional[int] = None
-                   ) -> IvfFlatIndex:
+                   max_train_rows: Optional[int] = None,
+                   db_dtype: str = "f32") -> IvfFlatIndex:
     """Build an :class:`IvfFlatIndex` over ``y`` [m, d].
 
     (ref: ivf_flat::build — coarse-train on a sub-sample, assign every
@@ -170,11 +194,21 @@ def build_ivf_flat(res, y, n_lists: int, n_probes: Optional[int] = None,
     ``max_train_rows`` rows (default ``max(32·n_lists, 4096)`` — the
     trainset_fraction idea), full assignment runs the fusedL2NN argmin
     sweep, and the host lays the lists out as the padded ragged slab
-    described in the module doc."""
+    described in the module doc.
+
+    ``db_dtype="int8"`` (:data:`IVF_DB_DTYPES`) additionally packs the
+    slab with per-list symmetric int8 scales (the cuVS int8 IVF-Flat
+    shape): the fine scan gathers ~¼ the probed bytes, prunes to a
+    certified candidate pool and exact-rescoring it from the kept f32
+    rows — id sets never degrade (failed certificates rerun the f32
+    scan)."""
     from raft_tpu.cluster import kmeans_fit, kmeans_predict
 
     fault_point("ivf_build")
     res = ensure_resources(res)
+    if db_dtype not in IVF_DB_DTYPES:
+        raise ValueError(f"build_ivf_flat: db_dtype must be one of "
+                         f"{IVF_DB_DTYPES}, got {db_dtype!r}")
     if row_quantum is None:
         row_quantum = _env_int("RAFT_TPU_IVF_ROW_QUANTUM",
                                DEFAULT_ROW_QUANTUM)
@@ -219,6 +253,25 @@ def build_ivf_flat(res, y, n_lists: int, n_probes: Optional[int] = None,
 
     n_probes_default = int(n_probes) if n_probes else max(
         1, min(L, 1 + L // 8))
+    q8_kw = {}
+    if db_dtype == "int8":
+        fault_point("quantize_index")
+        from raft_tpu.distance.knn_fused import (q8_eq_bound,
+                                                 quantize_rows_q8)
+
+        gid = jnp.asarray(np.repeat(np.arange(L, dtype=np.int32),
+                                    padded))
+        slab_j = jnp.asarray(slab)
+        valid = jnp.asarray(ids >= 0)
+        slab_q, list_scale = quantize_rows_q8(slab_j, gid, L,
+                                              valid=valid)
+        eq_lists = q8_eq_bound(list_scale, d)
+        row_scale = jnp.take(list_scale, gid)
+        deq = slab_q.astype(jnp.float32) * row_scale[:, None]
+        q8_kw = dict(db_dtype="int8", slab_q=slab_q,
+                     row_scale=row_scale,
+                     yy_q=jnp.sum(deq * deq, axis=1),
+                     eq_rows=jnp.take(eq_lists, gid))
     idx = IvfFlatIndex(
         centroids=km.centroids,
         slab=jnp.asarray(slab),
@@ -230,12 +283,13 @@ def build_ivf_flat(res, y, n_lists: int, n_probes: Optional[int] = None,
         n_rows=m, d_orig=d, row_quantum=int(row_quantum),
         n_probes_default=n_probes_default,
         Qb=fused_config(3).Qb,
-        kmeans_iters=km.n_iter, balanced=balanced)
+        kmeans_iters=km.n_iter, balanced=balanced, **q8_kw)
     emit_marker("ivf_build", n_rows=m, n_lists=L, slab_rows=R,
                 window=idx.probe_window,
                 pad_frac=round(float(R - m) / max(m, 1), 4),
                 size_min=int(sizes.min()), size_max=int(sizes.max()),
-                kmeans_iters=km.n_iter, balanced=bool(balanced))
+                kmeans_iters=km.n_iter, balanced=bool(balanced),
+                db_dtype=db_dtype)
     return idx
 
 
@@ -269,6 +323,70 @@ def _fine_scan(x, slab, ids, yy_slab, starts, psizes,
     vals = -neg
     out_ids = jnp.take_along_axis(cid, pos, axis=1)
     return vals, jnp.where(jnp.isfinite(vals), out_ids, -1)
+
+
+@partial(jax.jit, static_argnames=("k", "P", "W", "C"))
+def _fine_scan_q8(x, slab, slab_q, row_scale, ids, yy_q, starts, psizes,
+                  k: int, P: int, W: int, C: int, eq_rows=None):
+    """Quantized fine scan: gather the probed windows from the INT8
+    slab (+ per-row scale/norm/Eq — ~(d+12)/(4d+8) of the f32 gather
+    bytes), score approximately against the dequantized rows ŷ, keep
+    the top ``C = k + pad`` candidates, exact-rescore THEM from the f32
+    slab, and certify per query that the true top-k cannot hide outside
+    the pool: every non-candidate has d2(x, ŷ) ≥ B (the C-th approx
+    score), so a violator with true d2 < θ would need
+    B ≤ (√θ + Eq)² + e_num — Eq the max quantization bound among the
+    probed rows, e_num a conservative f32-accumulation envelope.
+    Returns (vals, ids, certified) — the caller reruns failed queries
+    through the exact f32 scan, so ids never degrade."""
+    nq = x.shape[0]
+    ar = jnp.arange(W, dtype=jnp.int32)
+    rows = starts[:, :, None] + ar[None, None, :]          # [nq, P, W]
+    within = ar[None, None, :] < psizes[:, :, None]
+    rows = jnp.clip(rows, 0, slab_q.shape[0] - 1).reshape(nq, P * W)
+    within = within.reshape(nq, P * W)
+    cid = jnp.take(ids, rows)
+    valid = within & (cid >= 0)
+    yq = jnp.take(slab_q, rows, axis=0).astype(jnp.float32)
+    scl = jnp.take(row_scale, rows)
+    yc = yq * scl[:, :, None]                              # ŷ [nq, PW, d]
+    xx = jnp.sum(x * x, axis=1, keepdims=True)
+    yyq = jnp.take(yy_q, rows)
+    d2h = (xx + yyq
+           - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
+                              precision=jax.lax.Precision.HIGHEST))
+    d2h = jnp.where(valid, jnp.maximum(d2h, 0.0), jnp.inf)
+    neg_c, cpos = jax.lax.top_k(-d2h, C)                   # approx pool
+    bound = -neg_c[:, C - 1]
+    crow = jnp.take_along_axis(rows, cpos, axis=1)
+    ccid = jnp.take_along_axis(cid, cpos, axis=1)
+    cvalid = jnp.take_along_axis(valid, cpos, axis=1)
+    # exact f32 rescore of the C survivors — bitwise the same score
+    # the f32 fine scan computes for these rows
+    ycf = jnp.take(slab, crow, axis=0)                     # [nq, C, d]
+    d2 = (xx + jnp.sum(ycf * ycf, axis=2)
+          - 2.0 * jnp.einsum("qd,qcd->qc", x, ycf,
+                             precision=jax.lax.Precision.HIGHEST))
+    d2 = jnp.where(cvalid, jnp.maximum(d2, 0.0), jnp.inf)
+    neg_k, kpos = jax.lax.top_k(-d2, k)
+    vals = -neg_k
+    out_ids = jnp.take_along_axis(ccid, kpos, axis=1)
+    out_ids = jnp.where(jnp.isfinite(vals), out_ids, -1)
+    # ---- certificate ----
+    theta = vals[:, k - 1]
+    eqg = jnp.take(eq_rows, rows)
+    eq_w = jnp.max(jnp.where(valid, eqg, 0.0), axis=1)
+    yymax = jnp.max(jnp.where(valid, yyq, 0.0), axis=1)
+    d_feat = x.shape[1]
+    e_num = (d_feat * 2.0 ** -22) * (
+        jnp.sqrt(xx[:, 0]) + jnp.sqrt(yymax)) ** 2
+    sq_t = jnp.sqrt(jnp.maximum(theta, 0.0))
+    widen = 2.0 * sq_t * eq_w + eq_w * eq_w + e_num
+    # a pool that covers every probed candidate is trivially complete
+    n_valid = jnp.sum(valid.astype(jnp.int32), axis=1)
+    certified = (bound >= theta + widen) | (n_valid <= C) \
+        | ~jnp.isfinite(bound)
+    return vals, out_ids, certified
 
 
 def _coarse_probe(res, centroids, x, n_probes: int):
@@ -452,12 +570,38 @@ def search_ivf_flat(res, index, queries, k: int,
             k=k, P=P, W=W)
     except Exception:
         pass
+
+    quant = index.db_dtype == "int8"
+    C = min(k + _IVF_RESCORE_PAD, P * W)
+
+    def scan_chunk(xs, st, ps):
+        if not quant:
+            return _fine_scan(xs, index.slab, index.ids, index.yy_slab,
+                              st, ps, k=k, P=P, W=W)
+        vals, ids_c, ok = _fine_scan_q8(
+            xs, index.slab, index.slab_q, index.row_scale, index.ids,
+            index.yy_q, st, ps, k=k, P=P, W=W, C=C,
+            eq_rows=index.eq_rows)
+        n_fail = int(jnp.sum(~ok))
+        if n_fail:
+            # quantization certificate failed for some queries: the
+            # true top-k may extend past the rescored pool — rerun the
+            # chunk through the exact f32 scan and keep certified rows
+            # from the quantized pass (bytes saved stand; correctness
+            # never rides on the margin)
+            emit_marker("ivf_q8_fallback", n_fail=n_fail,
+                        nq=int(xs.shape[0]))
+            fv, fi = _fine_scan(xs, index.slab, index.ids,
+                                index.yy_slab, st, ps, k=k, P=P, W=W)
+            okc = ok[:, None]
+            vals = jnp.where(okc, vals, fv)
+            ids_c = jnp.where(okc, ids_c, fi)
+        return vals, ids_c
+
     if nq <= chunk:
-        return _fine_scan(x, index.slab, index.ids, index.yy_slab,
-                          starts, psizes, k=k, P=P, W=W)
-    outs = [_fine_scan(x[s:s + chunk], index.slab, index.ids,
-                       index.yy_slab, starts[s:s + chunk],
-                       psizes[s:s + chunk], k=k, P=P, W=W)
+        return scan_chunk(x, starts, psizes)
+    outs = [scan_chunk(x[s:s + chunk], starts[s:s + chunk],
+                       psizes[s:s + chunk])
             for s in range(0, nq, chunk)]
     return (jnp.concatenate([o[0] for o in outs]),
             jnp.concatenate([o[1] for o in outs]))

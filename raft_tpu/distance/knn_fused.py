@@ -75,8 +75,10 @@ from raft_tpu.ops.fused_l2_topk_pallas import (
     _LANES, _PACK_BITS, _PACK_MASK, _PACK_PAD, _PBITS_MAX,
     fused_l2_group_topk, fused_l2_group_topk_dchunk,
     fused_l2_group_topk_packed, fused_l2_group_topk_packed_db,
-    fused_l2_group_topk_packed_dbuf, fused_l2_group_topk_packed_dchunk,
-    split_hi_lo, vmem_budget, vmem_footprint)
+    fused_l2_group_topk_packed_db_q8, fused_l2_group_topk_packed_dbuf,
+    fused_l2_group_topk_packed_dbuf_q8,
+    fused_l2_group_topk_packed_dchunk, split_hi_lo, vmem_budget,
+    vmem_footprint)
 
 # grid iteration orders for the packed fused kernel (see the
 # DATABASE-MAJOR block comment in ops.fused_l2_topk_pallas):
@@ -87,6 +89,25 @@ from raft_tpu.ops.fused_l2_topk_pallas import (
 #   "dbuf"  — grid (n_groups,): explicit 2-slot double-buffered y-tile
 #             DMA, y streams once and only 2 tiles are VMEM-resident.
 GRID_ORDERS = ("query", "db", "dbuf")
+
+# storage dtypes for the STREAMED database slab:
+#   "bf16" — the historical hi(/lo) bf16 split: M·d·2 (p1) or M·d·4
+#            (p3) bytes per stream;
+#   "int8" — per-certificate-group symmetric-scale quantization:
+#            M·d·1 bytes per stream regardless of passes, with the
+#            twin-pool certificate widened by the recorded per-group
+#            quantization bound Eq and candidates ALWAYS exact-rescored
+#            in f32 from the original rows — returned ids are certified
+#            identical to the f32 oracle's (ROADMAP item 2).
+DB_DTYPES = ("bf16", "int8")
+
+# int8 quantization geometry: symmetric (zero_point = 0), code range
+# ±_Q8_LEVELS; the per-element round-trip error bound is
+# scale · _Q8_ERR (½ ulp of the code grid + headroom for the f32
+# divide/round/multiply chain — the property test drives adversarial
+# scale-boundary values at it)
+_Q8_LEVELS = 127
+_Q8_ERR = 0.5 * (1.0 + 2.0 ** -10)
 
 # past this feature width the single-shot kernel's [Qb/T, d] VMEM tiles
 # stop fitting; the d-chunked kernel (VMEM scratch accumulator) takes over
@@ -359,17 +380,128 @@ def _prepare_ops(y, T: int, g: int, metric: str,
     return yp, y_hi, y_lo, yyh_k, yy_raw
 
 
+def quantize_rows_q8(z, gid, n_groups: int, valid=None):
+    """Per-group symmetric int8 quantization of the stream operand
+    ``z`` [M, d] (group of row i = ``gid[i]``): scale_g =
+    max|z_group| / 127 (zero_point 0 — L2/IP operands are centered by
+    construction), codes clipped to ±127 so an f32 divide landing
+    epsilon past the last level can never overflow the int8 range.
+    Returns (y_q int8 [M, d], scales f32 [n_groups]). ``valid`` masks
+    rows out of the scale computation (pad/garbage rows must not
+    inflate a group's scale); their codes are still produced but every
+    consumer hides them behind the never-wins sentinel."""
+    absz = jnp.abs(z)
+    if valid is not None:
+        absz = jnp.where(valid.reshape(-1, 1), absz, 0.0)
+    row_max = jnp.max(absz, axis=1)
+    gmax = jax.ops.segment_max(row_max, gid, num_segments=n_groups)
+    gmax = jnp.maximum(gmax, 0.0)          # empty segment → -inf → 0
+    scales = jnp.where(gmax > 0, gmax / _Q8_LEVELS, 1.0)
+    srow = jnp.take(scales, gid).reshape(-1, 1)
+    q = jnp.clip(jnp.round(z / srow), -_Q8_LEVELS, _Q8_LEVELS)
+    return q.astype(jnp.int8), scales
+
+
+def q8_eq_bound(scales, d: int):
+    """Per-group quantization error bound Eq: an upper bound on the
+    ROW-VECTOR L2 error ‖z_row − dequant(quant(z_row))‖ for any row of
+    a group with scale ``scales[g]`` — per element the round-trip error
+    is ≤ scale·_Q8_ERR (½ code step + f32 divide/round/multiply
+    headroom; clipped boundary values err by ≤ scale·127·2⁻²³, well
+    inside), so the row bound is scale·_Q8_ERR·√d. Padded feature
+    columns are exactly zero → quantize exactly → contribute 0, so the
+    padded √d is simply a looser-but-sound bound. This is the margin
+    the twin-pool certificate is widened by (see _knn_fused_core), and
+    the bound the property test attacks with adversarial
+    scale-boundary values."""
+    import math
+
+    return scales * (_Q8_ERR * math.sqrt(max(d, 1)))
+
+
+@functools.partial(jax.jit, static_argnames=("T", "g", "metric",
+                                             "pbits", "grid_order"))
+def _prepare_ops_q8(y, T: int, g: int, metric: str,
+                    pbits: int = _PACK_BITS, grid_order: str = "db",
+                    n_valid=None, rows_valid=None):
+    """INT8 sibling of :func:`_prepare_ops` — index-side operand prep
+    for the quantized-streaming kernels: row padding to WHOLE
+    certificate groups, per-group symmetric int8 quantization of the
+    stream operand (y for l2, y/2 for ip), the group-scale tile, and
+    carriers computed from the DEQUANTIZED rows ŷ so the kernel's
+    folded value is exactly d2(x, ŷ)/2 (l2) — the codes, decode and
+    certificate algebra downstream are untouched.
+
+    Returns ``(yp, y_q, scale_k, yyh_k, yy_raw, eq_groups)``:
+    yp [M, d] f32 row-padded ORIGINAL rows (the exact-rescore source —
+    int8 indexes always store it), y_q [M, d] int8, scale_k
+    [G, 8, 128] f32 group-replicated, yyh_k [8, M] the dequantized
+    half-norm sentinel carrier, yy_raw [1, M] the dequantized
+    full-scale norms (the bf16 error bound's ymax), eq_groups [G] the
+    per-group quantization bound (see :func:`q8_eq_bound`).
+
+    ``n_valid``/``rows_valid`` follow _prepare_ops' contract (trailing
+    vs ragged pads). Packed/database-major only — the quantized
+    kernels are the stream-once ones."""
+    if grid_order not in ("db", "dbuf"):
+        raise ValueError("_prepare_ops_q8: int8 streaming is "
+                         "database-major only (grid_order 'db'/'dbuf')")
+    n_ch = T // _LANES
+    if g * n_ch > (1 << pbits):
+        raise ValueError("_prepare_ops_q8: int8 streaming needs the "
+                         "packed-code envelope (g·(T/128) ≤ 2^pbits)")
+    if rows_valid is not None:
+        m = y.shape[0]
+    else:
+        m = y.shape[0] if n_valid is None else n_valid
+    yp = _pad_rows_to(y, g * T)
+    M, d = yp.shape
+    G = M // (g * T)
+    if rows_valid is not None:
+        rv = jnp.asarray(rows_valid, jnp.bool_).reshape(-1)
+        pad = M - rv.shape[0]
+        if pad:
+            rv = jnp.concatenate([rv, jnp.zeros((pad,), jnp.bool_)])
+        valid_row = rv
+    else:
+        valid_row = jnp.arange(M, dtype=jnp.int32) < m
+    z = yp * 0.5 if metric == "ip" else yp
+    gid = jnp.arange(M, dtype=jnp.int32) // (g * T)
+    y_q, scales = quantize_rows_q8(z, gid, G, valid=valid_row)
+    eq_groups = q8_eq_bound(scales, d)
+    # dequantized stream operand ẑ — the rows the kernel actually
+    # scores; its norms ride the carrier so kernel values are exactly
+    # d2(x, ẑ) (l2) and the Eq widening is the ONLY new error term
+    zq = y_q.astype(jnp.float32) * jnp.take(scales, gid).reshape(-1, 1)
+    valid = valid_row[None, :]
+    if metric == "ip":
+        yyh_k = jnp.where(valid, 0.0, _PACK_PAD)
+        yhat_full = 2.0 * zq       # full-scale dequantized ŷ (= 2·ẑ)
+    else:
+        yy_hat = jnp.sum(zq * zq, axis=1)[None, :]
+        yyh_k = jnp.where(valid, 0.5 * yy_hat, _PACK_PAD)
+        yhat_full = zq
+    yy_raw = jnp.sum(yhat_full * yhat_full, axis=1)[None, :]
+    yyh_k = jnp.broadcast_to(yyh_k, (8, M))
+    scale_k = jnp.broadcast_to(scales.reshape(G, 1, 1), (G, 8, _LANES))
+    return yp, y_q, scale_k, yyh_k, yy_raw, eq_groups
+
+
 @functools.partial(jax.jit,
                    static_argnames=("k", "T", "Qb", "g", "passes", "metric",
                                     "m", "rescore", "pbits", "certify",
-                                    "pool_algo", "grid_order", "_diag"))
+                                    "pool_algo", "grid_order", "db_dtype",
+                                    "_diag"))
 def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                     k: int, T: int, Qb: int, g: int, passes: int,
                     metric: str, m: int, rescore: bool = True,
                     pbits: int = _PACK_BITS, certify: str = "kernel",
                     pool_algo: str = "xla", grid_order: str = "query",
+                    db_dtype: str = "bf16",
                     _diag: bool = False,
-                    m_valid=None, rows_valid=None) -> Tuple[jax.Array, ...]:
+                    m_valid=None, rows_valid=None,
+                    y_q=None, y_scale_k=None,
+                    eq_groups=None) -> Tuple[jax.Array, ...]:
     """Certified fused KNN on PREPARED operands (see _prepare_ops).
 
     ``m_valid`` (optional TRACED scalar) overrides the static ``m`` in
@@ -413,9 +545,31 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
     ≤ |v|·2⁻¹⁵, absorbed into the certificate margin e_pack.
     """
     Q, d = x.shape
-    M = y_hi.shape[0]
+    quant = db_dtype == "int8"
+    M = (y_q if quant else y_hi).shape[0]
     n_ch = T // _LANES
     packed = g * n_ch <= (1 << pbits)
+    if quant:
+        # the quantized-streaming contract (prepare_knn_index resolves
+        # requests outside it down to bf16 BEFORE the core): packed
+        # database-major kernels only, and the exact f32 rescore is
+        # mandatory — lite int8 results would be exact w.r.t. ŷ, a
+        # score function no caller asked for
+        if not packed or grid_order not in ("db", "dbuf"):
+            raise ValueError(
+                "_knn_fused_core: db_dtype='int8' needs the packed "
+                "database-major envelope (grid_order 'db'/'dbuf', "
+                "g·(T/128) ≤ 2^pbits)")
+        if not rescore or yp is None:
+            raise ValueError(
+                "_knn_fused_core: db_dtype='int8' requires the exact "
+                "f32 rescore (store_yp=True) — returned ids are "
+                "certified against the ORIGINAL rows, not ŷ")
+        if y_q is None or y_scale_k is None or eq_groups is None:
+            raise ValueError(
+                "_knn_fused_core: db_dtype='int8' needs y_q, "
+                "y_scale_k and eq_groups (prepare with "
+                "_prepare_ops_q8)")
 
     xx = jnp.sum(x * x, axis=1, keepdims=True)                  # [Q,1] f32
     if metric == "ip":
@@ -444,7 +598,13 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                   else jnp.reshape(m_eff, (1,)))
 
     if packed:
-        if d > _D_SINGLE_SHOT:
+        if quant:
+            kern = (fused_l2_group_topk_packed_db_q8
+                    if grid_order == "db"
+                    else fused_l2_group_topk_packed_dbuf_q8)
+            kw = {"pbits": pbits,
+                  "pair": passes == 1 and (T // _LANES) % 2 == 0}
+        elif d > _D_SINGLE_SHOT:
             kern, kw = fused_l2_group_topk_packed_dchunk, {
                 "dc": _DC, "pbits": pbits}
         elif grid_order in ("db", "dbuf"):
@@ -471,8 +631,14 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         # (measured at clustered 10M×256: the norm-scaled error failed
         # the certificate for ~80% of queries at pbits=11)
         xxh = 0.5 * xx if metric != "ip" else jnp.zeros_like(xx)
-        a1p, a2p, a3p = kern(x, y_hi, y_lo, yyh_k, m_real, T=T, Qb=Qb,
-                             passes=passes, tpg=g, xxh=xxh, **kw)
+        if quant:
+            a1p, a2p, a3p = kern(x, y_q, yyh_k, y_scale_k, m_real,
+                                 T=T, Qb=Qb, passes=passes, tpg=g,
+                                 xxh=xxh, **kw)
+        else:
+            a1p, a2p, a3p = kern(x, y_hi, y_lo, yyh_k, m_real, T=T,
+                                 Qb=Qb, passes=passes, tpg=g, xxh=xxh,
+                                 **kw)
         S_ = a1p.shape[1]
         # TWIN-POOL selection (round-3 redesign): top_k over a1p ONLY —
         # the XLA TopK measured ~2.5× superlinear in pool width inside
@@ -598,7 +764,7 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
     # every point outside its group's kept top-2 is ≥ that group's a3;
     # every pool entry not among the C candidates is ≥ the C-th pool value
     bound = jnp.minimum(a3_min, cand_v_hat[:, C - 1])
-    if passes == 3 or certify == "f32":
+    if quant or passes == 3 or certify == "f32":
         # ONE margin construction for both f32-certified modes; only
         # the coefficient differs. certify="f32" at passes=1 is
         # ADAPTIVE PRECISION: θ is the exact-f32 k-th candidate value
@@ -612,6 +778,26 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         err = coeff * jnp.sqrt(xx[:, 0]) * ymax + e_pack
     else:
         err = e_pack
+    if quant:
+        # QUANTIZATION widening: kernel scores are exact-w.r.t.-ŷ (the
+        # dequantized rows — their norms ride the carrier), so a
+        # non-candidate j has d2(x, ŷ_j) ≥ bound − err. If its TRUE
+        # d2(x, y_j) were < θ then ‖x − y_j‖ < √θ and
+        # d2(x, ŷ_j) ≤ d2(x, y_j) + 2‖x−y_j‖‖e_j‖ + ‖e_j‖²
+        #            < (√θ + Eq)², Eq = max_g eq_groups[g] —
+        # so bound − err ≥ (√θ + Eq)² = θ + 2√θ·Eq + Eq² excludes every
+        # violator. For IP the score is linear in y: |Δ| = |x·(ŷ−y)| ≤
+        # ‖x‖·2·Eq (Eq bounds the HALVED stream operand ŷ/2).
+        # The bf16 coeff·√xx·ymax term above covers the kernel-vs-ŷ
+        # arithmetic error (y_q is exact in bf16, so the p1/p3 bounds —
+        # which budget both factors rounding — safely envelope the
+        # x-only rounding plus the post-matmul scale multiply).
+        eq_max = jnp.max(eq_groups)
+        if metric == "ip":
+            err = err + 2.0 * jnp.sqrt(xx[:, 0]) * eq_max
+        else:
+            sq_theta = jnp.sqrt(jnp.maximum(theta, 0.0))
+            err = err + 2.0 * sq_theta * eq_max + eq_max * eq_max
     certified = bound >= theta + err                            # [Q] bool
     failed = ~certified
     n_fail = jnp.sum(failed.astype(jnp.int32))
@@ -763,7 +949,8 @@ _TUNED = ...   # lazy sentinel: {passes: (T, Qb, g)} once loaded
 
 
 def fit_config(T: int, Qb: int, d: int, passes: int,
-               g: Optional[int] = None, grid_order: str = "query"):
+               g: Optional[int] = None, grid_order: str = "query",
+               db_dtype: str = "bf16"):
     """Scoped-VMEM guard: shrink (T, Qb) until the kernel footprint fits
     Mosaic's stack budget — a config over it is a guaranteed compile
     failure (observed: the tuned-at-passes=1 winner OOMs at passes=3).
@@ -774,18 +961,19 @@ def fit_config(T: int, Qb: int, d: int, passes: int,
     no-op — its footprint prices the whole query batch — so the T loop
     carries the shrink.)"""
     budget = vmem_budget()
-    while (footprint_for(T, Qb, d, passes, g, grid_order) > budget
-           and Qb > 8):
+    while (footprint_for(T, Qb, d, passes, g, grid_order,
+                         db_dtype) > budget and Qb > 8):
         Qb = max(8, (Qb // 2) // 8 * 8)
-    while (footprint_for(T, Qb, d, passes, g, grid_order) > budget
-           and T > 2 * _LANES):
+    while (footprint_for(T, Qb, d, passes, g, grid_order,
+                         db_dtype) > budget and T > 2 * _LANES):
         T = max(2 * _LANES, (T // 2) // _LANES * _LANES)
     return T, Qb
 
 
 def footprint_for(T: int, Qb: int, d: int, passes: int,
                   g: Optional[int] = None,
-                  grid_order: str = "query") -> int:
+                  grid_order: str = "query",
+                  db_dtype: str = "bf16") -> int:
     """Scoped-VMEM footprint of the fused kernel at a RAW (unpadded)
     feature width — applies the same d-padding / d-chunk routing AND
     packed-vs-unpacked kernel choice ``knn_fused`` itself uses, so
@@ -806,7 +994,10 @@ def footprint_for(T: int, Qb: int, d: int, passes: int,
     packed = g is not None and g * (T // _LANES) <= (1 << _PBITS_MAX)
     dchunk = d_eff > _D_SINGLE_SHOT
     if packed and not dchunk and grid_order in ("db", "dbuf"):
-        kern = "stream_db" if grid_order == "db" else "stream_dbuf"
+        q8 = db_dtype == "int8"
+        kern = ("stream_db_q8" if q8 else "stream_db") \
+            if grid_order == "db" \
+            else ("stream_dbuf_q8" if q8 else "stream_dbuf")
         if grid_order == "dbuf":
             Qb = _Q_CHUNK
         return vmem_footprint(T, Qb, d_eff, passes, kernel=kern,
@@ -842,6 +1033,44 @@ def resolve_grid_order(grid_order: str, d: int, packed: bool) -> str:
     return "query"
 
 
+def resolve_db_dtype(db_dtype: str, d: int, packed: bool,
+                     grid_order: str, store_yp: bool = True) -> str:
+    """EFFECTIVE database storage dtype for an index build — decided
+    (and logged) in the non-jitted prepare path like
+    :func:`resolve_grid_order`, so a downgraded request is visible per
+    build instead of silently mislabeling what streams. int8 needs the
+    packed database-major envelope (the quantized kernels exist for
+    "db"/"dbuf" only) and the stored f32 rows for the mandatory exact
+    rescore; requests outside it downgrade to "bf16" with a logged
+    reason. A lite int8 index is an ERROR, not a downgrade — the
+    caller asked for two contradictory contracts."""
+    if db_dtype not in DB_DTYPES:
+        raise ValueError(f"db_dtype must be one of {DB_DTYPES}, "
+                         f"got {db_dtype!r}")
+    if db_dtype == "bf16":
+        return db_dtype
+    if not store_yp:
+        raise ValueError(
+            "db_dtype='int8' requires store_yp=True: quantized results "
+            "are certified by exact-rescoring candidates from the "
+            "original f32 rows — a lite index has nothing to rescore "
+            "from")
+    reason = None
+    if d > _D_SINGLE_SHOT:
+        reason = f"d={d} > {_D_SINGLE_SHOT} takes the d-chunked kernel"
+    elif not packed:
+        reason = "config is outside the packed-code envelope"
+    elif grid_order not in ("db", "dbuf"):
+        reason = f"grid_order={grid_order!r} is not database-major"
+    if reason is None:
+        return db_dtype
+    from raft_tpu.core.logger import log_warn
+
+    log_warn("db_dtype='int8' outside the quantized-streaming envelope "
+             "(%s) — storing bf16 for this index", reason)
+    return "bf16"
+
+
 def _valid_cfg(T, Qb, g, grid_order: str = "query") -> bool:
     # semantic validation, not just parseability: bad values would crash
     # every knn() call downstream; g = tiles-per-group ≥ 1
@@ -866,6 +1095,21 @@ class FusedConfig(Tuple[int, int, int, str]):
 _BUILTIN_CONFIG = FusedConfig(2048, 256, 16, "query")
 
 
+def _row_db_dtype(row) -> Optional[str]:
+    """The row's database storage dtype: absent (schema ≤ 3 rows were
+    all bf16-streamed) → "bf16"; an unknown value → None (the row is
+    rejected with a logged reason — serving an int4 row nobody measured
+    would route production to an unswept point)."""
+    dt = row.get("db_dtype", "bf16")
+    if dt not in DB_DTYPES:
+        from raft_tpu.tune.fused import table_degraded
+
+        table_degraded("fused", "row_rejected",
+                       f"row db_dtype={dt!r} is not one of {DB_DTYPES}")
+        return None
+    return dt
+
+
 def _row_config(row, d: Optional[int], passes: int) -> Optional[FusedConfig]:
     """A validated FusedConfig from one table row, or None. Beyond
     parseability, the config must (a) pass _valid_cfg and (b) survive
@@ -881,15 +1125,19 @@ def _row_config(row, d: Optional[int], passes: int) -> Optional[FusedConfig]:
         return None
     if not _valid_cfg(*cfg):
         return None
+    db_dtype = _row_db_dtype(row)
+    if db_dtype is None:
+        return None
     if d is not None and fit_config(cfg.T, cfg.Qb, d, passes, cfg.g,
-                                    cfg.grid_order) != (cfg.T, cfg.Qb):
+                                    cfg.grid_order,
+                                    db_dtype) != (cfg.T, cfg.Qb):
         from raft_tpu.tune.fused import table_degraded
 
         table_degraded(
             "fused", "row_rejected",
             f"row (T={cfg.T}, Qb={cfg.Qb}, g={cfg.g}, "
-            f"{cfg.grid_order}, passes={passes}) fails the scoped-VMEM "
-            f"fit at d={d}")
+            f"{cfg.grid_order}, passes={passes}, {db_dtype}) fails "
+            f"the scoped-VMEM fit at d={d}")
         return None
     return cfg
 
@@ -946,35 +1194,45 @@ def _load_tuned() -> dict:
         shape = tbl.get("shape")
         d = (int(shape[2]) if isinstance(shape, (list, tuple))
              and len(shape) >= 3 else None)
-        # per-passes winners from the measured rows; the legacy
-        # single "best" entry seeds any mode its passes matches (or
-        # both, for tables that never recorded passes)
+        # per-(passes, db_dtype) winners from the measured rows; the
+        # legacy single "best" entry seeds any mode its passes matches
+        # (or both, for tables that never recorded passes). Rows
+        # without a db_dtype (every schema ≤ 3 table, incl. the
+        # committed measured v5e one) are bf16 — that loading stays
+        # byte-identical to the schema-3 behavior.
         for row in sorted((r for r in tbl.get("rows", [])
                            if "seconds" in r),
                           key=lambda r: r["seconds"], reverse=True):
             p = int(row.get("passes", 0)) or None
+            dt = _row_db_dtype(row)
             cfg = _row_config(row, d, p or 3)
-            if cfg is not None:
-                tuned[p] = cfg
-        # explicit per-passes winners (schema ≥ 3 — the only signal a
-        # deterministic model-ranked table carries) take precedence
-        # over the legacy single "best"
-        best_by = tbl.get("best_by_passes") or {}
-        for p_str, row in best_by.items():
+            if cfg is not None and dt is not None:
+                tuned[(p, dt)] = cfg
+        # explicit winners: schema ≥ 4 keys "passes:db_dtype", schema 3
+        # keys bare "passes" (bf16); both take precedence over the
+        # legacy single "best"
+        best_by = dict(tbl.get("best_by_passes") or {})
+        best_by.update(tbl.get("best_by_passes_dtype") or {})
+        for key_str, row in best_by.items():
             try:
+                p_str, _, dt_str = str(key_str).partition(":")
                 p = int(p_str)
             except (TypeError, ValueError):
                 continue
+            dt = dt_str or _row_db_dtype(row)
+            if dt not in DB_DTYPES:
+                continue
             cfg = _row_config(row, d, p)
             if cfg is not None:
-                tuned.setdefault(p, cfg)
+                tuned.setdefault((p, dt), cfg)
         best = tbl.get("best")
         if best:
+            dt = _row_db_dtype(best)
             for p in (1, 3):
-                if int(best.get("passes", p)) == p:
+                if dt is not None and int(best.get("passes", p)) == p:
                     cfg = _row_config(best, d, p)
                     if cfg is not None:
-                        tuned.setdefault(p, cfg)
+                        tuned.setdefault((p, dt), cfg)
         prov = tbl.get("provenance", {})
         log_info("fused_defaults: loaded %s (schema %s, chip=%s, "
                  "commit=%s, measured=%s, written=%s)", path,
@@ -988,7 +1246,7 @@ def _load_tuned() -> dict:
     return tuned
 
 
-def fused_config(passes: int = 3) -> FusedConfig:
+def fused_config(passes: int = 3, db_dtype: str = "bf16") -> FusedConfig:
     """(T, Qb, g, grid_order) for the fused pipeline: the measured-best
     point from ``TUNE_FUSED.json`` (produced by the
     :mod:`raft_tpu.tune` autotuner — the analog of the reference's
@@ -1006,7 +1264,20 @@ def fused_config(passes: int = 3) -> FusedConfig:
     global _TUNED
     if _TUNED is ...:
         _TUNED = _load_tuned()
-    return (_TUNED.get(passes) or _TUNED.get(None) or _BUILTIN_CONFIG)
+    hit = (_TUNED.get((passes, db_dtype))
+           or _TUNED.get((None, db_dtype)))
+    if hit is not None:
+        return hit
+    if db_dtype != "bf16":
+        # no tuned int8 row yet: start from the bf16 winner's geometry
+        # (the stream-once shape is the same; only the y byte width
+        # changed), forcing a database-major order — "query" has no
+        # quantized kernel to run
+        base = fused_config(passes, "bf16")
+        if base.grid_order == "query":
+            return FusedConfig(base.T, base.Qb, base.g, "db")
+        return base
+    return _BUILTIN_CONFIG
 
 
 def fused_defaults(passes: int = 3) -> Tuple[int, int, int]:
@@ -1036,7 +1307,8 @@ class KnnIndex:
     def __init__(self, yp, y_hi, y_lo, yyh_k, yy_raw, n_rows: int,
                  T: int, Qb: int, g: int, passes: int, metric: str,
                  d_orig: int, pbits: int = _PACK_BITS,
-                 grid_order: str = "query"):
+                 grid_order: str = "query", db_dtype: str = "bf16",
+                 y_q=None, y_scale_k=None, eq_groups=None):
         # yp is the ROW-PADDED index; the original matrix is yp[:n_rows]
         # (NOT stored separately — at 1M×128 that would pin a redundant
         # ~512 MB f32 copy in HBM for the index lifetime)
@@ -1051,13 +1323,30 @@ class KnnIndex:
         # frozen at build: database-major indexes are row-padded to
         # whole [g·T] groups, so the grid order cannot change per query
         self.grid_order = grid_order
+        # quantized-streaming state (db_dtype="int8"): the int8 slab
+        # the kernel streams, the group-scale tile, and the per-group
+        # quantization bound Eq the certificate is widened by; y_hi /
+        # y_lo are None (nothing bf16 is streamed — the HBM win)
+        self.db_dtype = db_dtype
+        self.y_q = y_q
+        self.y_scale_k = y_scale_k
+        self.eq_groups = eq_groups
+
+    @property
+    def stream_width(self) -> int:
+        """Feature width of the operand the kernel streams (row-padded
+        d) — the shape queries must be padded to."""
+        src = self.y_q if self.db_dtype == "int8" else self.y_hi
+        return src.shape[1]
 
 
+@instrument("distance.prepare_knn_index")
 def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
                       T: Optional[int] = None, Qb: Optional[int] = None,
                       g: Optional[int] = None,
                       store_yp: bool = True,
-                      grid_order: Optional[str] = None) -> KnnIndex:
+                      grid_order: Optional[str] = None,
+                      db_dtype: str = "bf16") -> KnnIndex:
     """Build a :class:`KnnIndex` for repeated queries against ``y``.
 
     ``store_yp=False`` builds a LITE index: the f32 row-padded matrix
@@ -1067,20 +1356,41 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
     against a lite index run ``rescore=False``: results are the exact
     top-k of the KERNEL score function (bf16 / bf16x3), values within
     2^(pbits−23) relative of those scores (2⁻¹⁵ at the minimum pack
-    width, up to 2⁻¹⁰ at the auto-pack maximum pbits=13)."""
+    width, up to 2⁻¹⁰ at the auto-pack maximum pbits=13).
+
+    ``db_dtype="int8"`` (:data:`DB_DTYPES`) packs the STREAMED slab
+    int8 with per-certificate-group symmetric scales: the kernel
+    streams M·d·1 bytes instead of bf16's M·d·2(·2), the twin-pool
+    certificate is widened by the recorded per-group bound Eq, and
+    candidates are exact-rescored in f32 from the original rows —
+    returned ids are certified identical to the f32 oracle's.
+    Requires ``store_yp=True``; requests outside the packed
+    database-major envelope downgrade to bf16 with a logged reason
+    (RAFT_TPU_DB_DTYPE env sets the fleet-wide default at call sites
+    that pass none — see the serving engine)."""
     if metric not in ("l2", "ip"):
         raise ValueError(f"prepare_knn_index: metric must be 'l2' or "
                          f"'ip', got {metric!r}")
+    if db_dtype not in DB_DTYPES:
+        raise ValueError(f"prepare_knn_index: db_dtype must be one of "
+                         f"{DB_DTYPES}, got {db_dtype!r}")
     y = jnp.asarray(y, jnp.float32)
     m, d = y.shape
-    dcfg = fused_config(passes)
+    dcfg = fused_config(passes, db_dtype)
     T = dcfg.T if T is None else T
     Qb = dcfg.Qb if Qb is None else Qb
     grid_order = dcfg.grid_order if grid_order is None else grid_order
     if grid_order not in GRID_ORDERS:
         raise ValueError(f"prepare_knn_index: grid_order must be one of "
                          f"{GRID_ORDERS}, got {grid_order!r}")
-    T, Qb = fit_config(T, Qb, d, passes, g or dcfg.g, grid_order)
+    if db_dtype == "int8" and grid_order == "query":
+        # the quantized kernels are database-major; an int8 request on
+        # a query-major (tuned or explicit) geometry takes the
+        # stream-once order — that is the configuration the dtype
+        # exists to accelerate
+        grid_order = "db"
+    T, Qb = fit_config(T, Qb, d, passes, g or dcfg.g, grid_order,
+                       db_dtype)
     n_tiles_est = max(1, -(-m // T))
     if g is None:
         g = max(dcfg.g, (1 << auto_pack_bits(n_tiles_est, T))
@@ -1097,11 +1407,34 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
     # resolve the EFFECTIVE order now so the index rows are padded for
     # the kernel that will actually run (a db-padded index serves the
     # query-major kernel fine, but not vice versa)
-    grid_order = resolve_grid_order(
-        grid_order, d, g * (T // _LANES) <= (1 << pbits))
+    packed = g * (T // _LANES) <= (1 << pbits)
+    grid_order = resolve_grid_order(grid_order, d, packed)
+    db_dtype = resolve_db_dtype(db_dtype, d, packed, grid_order,
+                                store_yp)
     dpad = (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
     if dpad:
         y = jnp.concatenate([y, jnp.zeros((m, dpad), jnp.float32)], axis=1)
+    if db_dtype == "int8":
+        fault_point("quantize_index")
+        yp, y_q, scale_k, yyh_k, yy_raw, eq = _prepare_ops_q8(
+            y, T, g, metric, pbits=pbits, grid_order=grid_order)
+        try:
+            from raft_tpu.core.resources import ensure_resources
+            from raft_tpu.observability.timeline import emit_marker
+
+            emit_marker("quantize_index", n_rows=m, d=d,
+                        n_groups=int(eq.shape[0]),
+                        eq_max=float(jnp.max(eq)),
+                        db_dtype=db_dtype)
+            ensure_resources(None).profiler.capture_fn(
+                "distance.quantize_index", _prepare_ops_q8, y, T, g,
+                metric, pbits=pbits, grid_order=grid_order)
+        except Exception:
+            pass
+        return KnnIndex(yp, None, None, yyh_k, yy_raw, m, T, Qb, g,
+                        passes, metric, d, pbits=pbits,
+                        grid_order=grid_order, db_dtype="int8",
+                        y_q=y_q, y_scale_k=scale_k, eq_groups=eq)
     yp, y_hi, y_lo, yyh_k, yy_raw = _prepare_ops(y, T, g, metric,
                                                  pbits=pbits,
                                                  grid_order=grid_order)
@@ -1118,7 +1451,8 @@ def knn_fused(x, y, k: int, passes: int = 3,
               T: Optional[int] = None, Qb: Optional[int] = None,
               g: Optional[int] = None, metric: str = "l2",
               rescore: Optional[bool] = None, certify: str = "kernel",
-              grid_order: Optional[str] = None
+              grid_order: Optional[str] = None,
+              db_dtype: Optional[str] = None
               ) -> Tuple[jax.Array, jax.Array]:
     """Certified fused brute-force KNN.
 
@@ -1167,6 +1501,12 @@ def knn_fused(x, y, k: int, passes: int = 3,
         passes, metric = idx.passes, idx.metric
         m, d = idx.n_rows, idx.d_orig
         grid_order = idx.grid_order
+        db_dtype = idx.db_dtype
+    elif db_dtype is None:
+        db_dtype = "bf16"
+    if db_dtype not in DB_DTYPES:
+        raise ValueError(f"knn_fused: db_dtype must be one of "
+                         f"{DB_DTYPES}, got {db_dtype!r}")
     if metric not in ("l2", "ip"):
         raise ValueError(f"knn_fused: metric must be 'l2' or 'ip', "
                          f"got {metric!r}")
@@ -1186,7 +1526,7 @@ def knn_fused(x, y, k: int, passes: int = 3,
     if idx is None:
         y = jnp.asarray(y, jnp.float32)
         m, d = y.shape
-        dcfg = fused_config(passes)
+        dcfg = fused_config(passes, db_dtype)
         T = dcfg.T if T is None else T
         Qb = dcfg.Qb if Qb is None else Qb
         g = dcfg.g if g is None else g
@@ -1194,7 +1534,7 @@ def knn_fused(x, y, k: int, passes: int = 3,
         if grid_order not in GRID_ORDERS:
             raise ValueError(f"knn_fused: grid_order must be one of "
                              f"{GRID_ORDERS}, got {grid_order!r}")
-        T, Qb = fit_config(T, Qb, d, passes, g, grid_order)
+        T, Qb = fit_config(T, Qb, d, passes, g, grid_order, db_dtype)
     if d_x != d:
         raise ValueError(f"knn_fused: query width {d_x} != index {d}")
     if k > m:
@@ -1223,7 +1563,8 @@ def knn_fused(x, y, k: int, passes: int = 3,
         if idx is None:
             idx = prepare_knn_index(y, passes=passes, metric=metric,
                                     T=T, Qb=Qb, g=g,
-                                    grid_order=grid_order)
+                                    grid_order=grid_order,
+                                    db_dtype=db_dtype)
         outs = [knn_fused(x[s:s + _Q_CHUNK], idx, k, rescore=rescore,
                           certify=certify)
                 for s in range(0, Q, _Q_CHUNK)]
@@ -1233,11 +1574,13 @@ def knn_fused(x, y, k: int, passes: int = 3,
     # block size
     if idx is None:
         idx = prepare_knn_index(y, passes=passes, metric=metric,
-                                T=T, Qb=Qb, g=g, grid_order=grid_order)
-    # the EFFECTIVE order (prepare resolves the database-major envelope
-    # and pads the index rows accordingly)
+                                T=T, Qb=Qb, g=g, grid_order=grid_order,
+                                db_dtype=db_dtype)
+    # the EFFECTIVE order/dtype (prepare resolves the database-major
+    # and quantized envelopes and pads the index rows accordingly)
     grid_order = idx.grid_order
-    dpad = idx.y_hi.shape[1] - d
+    db_dtype = idx.db_dtype
+    dpad = idx.stream_width - d
     if dpad:
         x = jnp.concatenate(
             [x, jnp.zeros((Q, dpad), jnp.float32)], axis=1)
@@ -1250,6 +1593,10 @@ def knn_fused(x, y, k: int, passes: int = 3,
     if certify == "f32" and not rescore:
         raise ValueError("knn_fused: certify='f32' needs a yp-storing "
                          "index (store_yp=True) for the exact rescore")
+    if db_dtype == "int8" and not rescore:
+        raise ValueError("knn_fused: an int8-streamed index is always "
+                         "exact-rescored (rescore=False would return "
+                         "top-k of the QUANTIZED score function)")
     # effective pool-selection algorithm, decided (and logged) HERE in
     # the non-jitted wrapper, per call — the core's static pool geometry
     # reproduced exactly (S' = ceil(n_tiles/g)·128; packed pools are S'
@@ -1263,7 +1610,9 @@ def knn_fused(x, y, k: int, passes: int = 3,
         x, idx.yp, idx.y_hi, idx.y_lo, idx.yyh_k, idx.yy_raw,
         k=k, T=T, Qb=Qb, g=g, passes=passes, metric=metric, m=m,
         rescore=rescore, pbits=idx.pbits, certify=certify,
-        pool_algo=pool_algo, grid_order=grid_order)
+        pool_algo=pool_algo, grid_order=grid_order,
+        db_dtype=db_dtype, y_q=idx.y_q, y_scale_k=idx.y_scale_k,
+        eq_groups=idx.eq_groups)
     if vals.shape[0] != Q:
         vals, ids = vals[:Q], ids[:Q]
     # else: identity slices would still cost an eager dispatch each

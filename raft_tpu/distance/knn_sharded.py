@@ -72,9 +72,10 @@ from raft_tpu.resilience import (PoisonedOutputError, degrade_merge,
                                  record_retry)
 from raft_tpu.distance.knn_fused import (
     _D_SINGLE_SHOT, _DC, _LANES, _PACK_BITS, _PBITS_MAX, _POOL_PAD,
-    _Q_CHUNK, GRID_ORDERS, KnnIndex, _knn_fused_core, _prepare_ops,
-    auto_pack_bits, fit_config, fused_config, pool_select_algo,
-    prepare_knn_index, resolve_grid_order, resolve_pool_algo)
+    _Q_CHUNK, DB_DTYPES, GRID_ORDERS, KnnIndex, _knn_fused_core,
+    _prepare_ops, _prepare_ops_q8, auto_pack_bits, fit_config,
+    fused_config, pool_select_algo, prepare_knn_index, resolve_db_dtype,
+    resolve_grid_order, resolve_pool_algo)
 
 SHARD_MODES = ("db", "query")
 
@@ -133,7 +134,8 @@ class ShardedFusedIndex:
     def __init__(self, yp_s, y_hi_s, y_lo_s, yyh_s, yy_s, n_rows: int,
                  rows_per: int, mesh, axis: str, T: int, Qb: int, g: int,
                  passes: int, metric: str, d_orig: int, pbits: int,
-                 grid_order: str):
+                 grid_order: str, db_dtype: str = "bf16",
+                 y_q_s=None, scale_s=None, eq_s=None):
         self.yp_s = yp_s                  # [p·rows_per, d_eff] or None
         self.y_hi_s, self.y_lo_s = y_hi_s, y_lo_s
         self.yyh_s, self.yy_s = yyh_s, yy_s
@@ -145,6 +147,19 @@ class ShardedFusedIndex:
         self.d_orig = d_orig
         self.pbits = pbits
         self.grid_order = grid_order
+        # quantized-streaming state (db_dtype="int8"): each shard
+        # quantizes ITS groups — scales and the per-group Eq bound are
+        # per-shard values, so every shard's certificate widens by its
+        # own worst group, never a remote one's
+        self.db_dtype = db_dtype
+        self.y_q_s = y_q_s                # [p·rows_per, d_eff] int8
+        self.scale_s = scale_s            # [p·G_loc, 8, 128] f32
+        self.eq_s = eq_s                  # [p·G_loc] f32
+
+    @property
+    def stream_width(self) -> int:
+        src = self.y_q_s if self.db_dtype == "int8" else self.y_hi_s
+        return src.shape[1]
 
     @property
     def n_shards(self) -> int:
@@ -158,6 +173,7 @@ def prepare_knn_index_sharded(y, mesh=None, axis: str = "x",
                               g: Optional[int] = None,
                               store_yp: bool = True,
                               grid_order: Optional[str] = None,
+                              db_dtype: str = "bf16",
                               res=None) -> ShardedFusedIndex:
     """Build a :class:`ShardedFusedIndex`: rows pad to ``p`` equal
     shards of whole certificate groups (``g·T`` rows for the
@@ -184,17 +200,23 @@ def prepare_knn_index_sharded(y, mesh=None, axis: str = "x",
     if metric not in ("l2", "ip"):
         raise ValueError(f"prepare_knn_index_sharded: metric must be "
                          f"'l2' or 'ip', got {metric!r}")
+    if db_dtype not in DB_DTYPES:
+        raise ValueError(f"prepare_knn_index_sharded: db_dtype must be "
+                         f"one of {DB_DTYPES}, got {db_dtype!r}")
     y = np.asarray(y, np.float32)
     m, d = y.shape
     p = int(mesh.shape[axis])
-    dcfg = fused_config(passes)
+    dcfg = fused_config(passes, db_dtype)
     T = dcfg.T if T is None else T
     Qb = dcfg.Qb if Qb is None else Qb
     grid_order = dcfg.grid_order if grid_order is None else grid_order
     if grid_order not in GRID_ORDERS:
         raise ValueError(f"prepare_knn_index_sharded: grid_order must "
                          f"be one of {GRID_ORDERS}, got {grid_order!r}")
-    T, Qb = fit_config(T, Qb, d, passes, g or dcfg.g, grid_order)
+    if db_dtype == "int8" and grid_order == "query":
+        grid_order = "db"      # quantized kernels are database-major
+    T, Qb = fit_config(T, Qb, d, passes, g or dcfg.g, grid_order,
+                       db_dtype)
     m_shard = -(-m // p)
     n_tiles_est = max(1, -(-m_shard // T))
     if g is None:
@@ -202,8 +224,10 @@ def prepare_knn_index_sharded(y, mesh=None, axis: str = "x",
                 // (T // _LANES))
     pbits = min(_PBITS_MAX, max(_PACK_BITS, int(math.ceil(math.log2(
         max(g * (T // _LANES), 2))))))
-    grid_order = resolve_grid_order(
-        grid_order, d, g * (T // _LANES) <= (1 << pbits))
+    packed = g * (T // _LANES) <= (1 << pbits)
+    grid_order = resolve_grid_order(grid_order, d, packed)
+    db_dtype = resolve_db_dtype(db_dtype, d, packed, grid_order,
+                                store_yp)
     row_mult = g * T if grid_order in ("db", "dbuf") else T
     rows_per = max(1, -(-m_shard // row_mult)) * row_mult
     dpad = (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
@@ -213,6 +237,29 @@ def prepare_knn_index_sharded(y, mesh=None, axis: str = "x",
     yg = np.zeros((p * rows_per, d_eff), np.float32)
     yg[:m, :d] = y
     ys = jax.device_put(yg, NamedSharding(mesh, P(axis)))
+
+    if db_dtype == "int8":
+        fault_point("quantize_index")
+
+        def _prep_q8(y_loc):
+            r = jax.lax.axis_index(axis)
+            m_loc = jnp.clip(
+                jnp.int32(m) - r.astype(jnp.int32) * rows_per,
+                0, rows_per)
+            return _prepare_ops_q8(y_loc, T, g, metric, pbits=pbits,
+                                   grid_order=grid_order, n_valid=m_loc)
+
+        fn = jax.jit(jax.shard_map(
+            _prep_q8, mesh=mesh, in_specs=(P(axis),),
+            out_specs=(P(axis), P(axis), P(axis), P(None, axis),
+                       P(None, axis), P(axis)),
+            check_vma=False))
+        yp_s, y_q_s, scale_s, yyh_s, yy_s, eq_s = fn(ys)
+        return ShardedFusedIndex(yp_s, None, None, yyh_s, yy_s, m,
+                                 rows_per, mesh, axis, T, Qb, g, passes,
+                                 metric, d, pbits, grid_order,
+                                 db_dtype="int8", y_q_s=y_q_s,
+                                 scale_s=scale_s, eq_s=eq_s)
 
     def _prep(y_loc):
         r = jax.lax.axis_index(axis)
@@ -298,6 +345,7 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
                       T: Optional[int] = None, Qb: Optional[int] = None,
                       g: Optional[int] = None,
                       grid_order: Optional[str] = None,
+                      db_dtype: str = "bf16",
                       rescore: Optional[bool] = None,
                       certify: str = "kernel", store_yp: bool = True,
                       res=None) -> Tuple[jax.Array, jax.Array]:
@@ -346,7 +394,8 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
         with device_errors("distance.knn_fused_sharded[query]"):
             return _knn_query_sharded(x, y, k, mesh, axis, passes,
                                       metric, T, Qb, g, grid_order,
-                                      rescore, certify, res)
+                                      rescore, certify, res,
+                                      db_dtype=db_dtype)
 
     if isinstance(y, ShardedFusedIndex):
         idx = y
@@ -357,8 +406,9 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
         idx = prepare_knn_index_sharded(
             y, mesh=mesh, axis=axis, passes=passes, metric=metric,
             T=T, Qb=Qb, g=g, store_yp=store_yp, grid_order=grid_order,
-            res=res)
+            db_dtype=db_dtype, res=res)
     m = idx.n_rows
+    quant = idx.db_dtype == "int8"
     expects(k <= m, "knn_fused_sharded: k=%d > index size %d", k, m)
     if nq == 0:
         return (jnp.zeros((0, k), jnp.float32),
@@ -379,6 +429,9 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
     if certify == "f32" and not rescore:
         raise ValueError("knn_fused_sharded: certify='f32' needs the "
                          "exact rescore (store_yp=True)")
+    if quant and not rescore:
+        raise ValueError("knn_fused_sharded: an int8-streamed index is "
+                         "always exact-rescored")
 
     # ---- micro-batch request (caller / tuned table / default) -------
     nb_req = micro_batches
@@ -388,7 +441,7 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
         tuned = sharded_config(p)
         nb_req = tuned.get("micro_batches") if tuned else None
 
-    d_eff = idx.y_hi_s.shape[1]
+    d_eff = idx.stream_width
     if x.shape[1] != idx.d_orig:
         raise ValueError(f"knn_fused_sharded: query width {x.shape[1]} "
                          f"!= index {idx.d_orig}")
@@ -429,7 +482,7 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
         key = ("db", mesh, axis, k, idx.T, Qb_eff, idx.g, idx.passes,
                idx.metric, idx.rows_per, m, nb, qb_len, merge_eff,
                bool(rescore), idx.pbits, certify, pool_algo,
-               idx.grid_order, has_yp, has_ylo)
+               idx.grid_order, idx.db_dtype, has_yp, has_ylo)
         fn = _SHARDED_FUSED_CACHE.get(key)
         if fn is None:
             comms = MeshComms(axis, size=p)
@@ -438,14 +491,19 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
                         "host": None}[merge_eff]
             rows_per, T_, g_ = idx.rows_per, idx.T, idx.g
             passes_, metric_, pbits_ = idx.passes, idx.metric, idx.pbits
-            order_ = idx.grid_order
+            order_, dtype_ = idx.grid_order, idx.db_dtype
 
             def shard_fn(*ops_and_x):
                 *ops, xq_l = ops_and_x
                 it = iter(ops)
                 yp_l = next(it) if has_yp else None
-                yhi_l = next(it)
-                ylo_l = next(it) if has_ylo else None
+                if quant:
+                    yhi_l = ylo_l = None
+                    yq_l, scl_l, eq_l = next(it), next(it), next(it)
+                else:
+                    yq_l = scl_l = eq_l = None
+                    yhi_l = next(it)
+                    ylo_l = next(it) if has_ylo else None
                 yyh_l = next(it)
                 yy_l = next(it)
                 r = jax.lax.axis_index(axis)
@@ -466,7 +524,8 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
                         metric=metric_, m=rows_per, rescore=rescore,
                         pbits=pbits_, certify=certify,
                         pool_algo=pool_algo, grid_order=order_,
-                        m_valid=m_loc)
+                        db_dtype=dtype_, y_q=yq_l, y_scale_k=scl_l,
+                        eq_groups=eq_l, m_valid=m_loc)
                     # local → global ids; pad/sentinel candidates (id -1
                     # or non-finite value) must lose every merge
                     gid = jnp.where((ids >= 0) & jnp.isfinite(vals),
@@ -482,7 +541,11 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
                     return cat_v[None], cat_i[None]
                 return cat_v, cat_i
 
-            row_specs = [P(axis)] * (1 + int(has_yp) + int(has_ylo))
+            if quant:
+                # yp + (y_q, scale, eq) — all row/group-sharded
+                row_specs = [P(axis)] * 4
+            else:
+                row_specs = [P(axis)] * (1 + int(has_yp) + int(has_ylo))
             in_specs = tuple(row_specs
                              + [P(None, axis), P(None, axis), P()])
             out_specs = ((P(axis), P(axis)) if merge_eff == "host"
@@ -492,8 +555,12 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
                 out_specs=out_specs, check_vma=False))
             _SHARDED_FUSED_CACHE[key] = fn
 
-        operands = [o for o in (idx.yp_s, idx.y_hi_s, idx.y_lo_s)
-                    if o is not None] + [idx.yyh_s, idx.yy_s]
+        if quant:
+            operands = [idx.yp_s, idx.y_q_s, idx.scale_s, idx.eq_s,
+                        idx.yyh_s, idx.yy_s]
+        else:
+            operands = [o for o in (idx.yp_s, idx.y_hi_s, idx.y_lo_s)
+                        if o is not None] + [idx.yyh_s, idx.yy_s]
         vals, ids = fn(*operands, xq)
         if merge_eff == "host":
             vals, ids = _merge_host_pool(vals, ids, k)
@@ -574,7 +641,8 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
 
 
 def _knn_query_sharded(x, y, k, mesh, axis, passes, metric, T, Qb, g,
-                       grid_order, rescore, certify, res):
+                       grid_order, rescore, certify, res,
+                       db_dtype: str = "bf16"):
     """Query-sharded serving mode: replicated prepared index, queries
     row-sharded over the axis, per-shard certified fused pipeline —
     zero cross-shard candidate traffic (each query's top-k depends only
@@ -584,7 +652,8 @@ def _knn_query_sharded(x, y, k, mesh, axis, passes, metric, T, Qb, g,
     else:
         idx = prepare_knn_index(jnp.asarray(y, jnp.float32),
                                 passes=passes, metric=metric, T=T,
-                                Qb=Qb, g=g, grid_order=grid_order)
+                                Qb=Qb, g=g, grid_order=grid_order,
+                                db_dtype=db_dtype)
     m = idx.n_rows
     expects(k <= m, "knn_fused_sharded: k=%d > index size %d", k, m)
     nq = x.shape[0]
@@ -607,7 +676,8 @@ def _knn_query_sharded(x, y, k, mesh, axis, passes, metric, T, Qb, g,
                 for s in range(0, nq, step)]
         return (jnp.concatenate([o[0] for o in outs]),
                 jnp.concatenate([o[1] for o in outs]))
-    d_eff = idx.y_hi.shape[1]
+    quant = idx.db_dtype == "int8"
+    d_eff = idx.stream_width
     if x.shape[1] != idx.d_orig:
         raise ValueError(f"knn_fused_sharded: query width {x.shape[1]} "
                          f"!= index {idx.d_orig}")
@@ -634,27 +704,35 @@ def _knn_query_sharded(x, y, k, mesh, axis, passes, metric, T, Qb, g,
     has_ylo = idx.y_lo is not None
     key = ("query", mesh, axis, k, idx.T, Qb_eff, idx.g, idx.passes,
            idx.metric, m, qs_len, bool(rescore), idx.pbits, certify,
-           pool_algo, idx.grid_order, has_yp, has_ylo)
+           pool_algo, idx.grid_order, idx.db_dtype, has_yp, has_ylo)
     fn = _SHARDED_FUSED_CACHE.get(key)
     if fn is None:
         T_, g_, passes_, metric_ = idx.T, idx.g, idx.passes, idx.metric
-        pbits_, order_ = idx.pbits, idx.grid_order
+        pbits_, order_, dtype_ = idx.pbits, idx.grid_order, idx.db_dtype
 
         def shard_fn(*ops_and_x):
             *ops, xq = ops_and_x
             it = iter(ops)
             yp_l = next(it) if has_yp else None
-            yhi_l = next(it)
-            ylo_l = next(it) if has_ylo else None
+            if quant:
+                yhi_l = ylo_l = None
+                yq_l, scl_l, eq_l = next(it), next(it), next(it)
+            else:
+                yq_l = scl_l = eq_l = None
+                yhi_l = next(it)
+                ylo_l = next(it) if has_ylo else None
             yyh_l = next(it)
             yy_l = next(it)
             return _knn_fused_core(
                 xq, yp_l, yhi_l, ylo_l, yyh_l, yy_l,
                 k=k, T=T_, Qb=Qb_eff, g=g_, passes=passes_,
                 metric=metric_, m=m, rescore=rescore, pbits=pbits_,
-                certify=certify, pool_algo=pool_algo, grid_order=order_)
+                certify=certify, pool_algo=pool_algo, grid_order=order_,
+                db_dtype=dtype_, y_q=yq_l, y_scale_k=scl_l,
+                eq_groups=eq_l)
 
-        n_repl = 1 + int(has_yp) + int(has_ylo) + 2
+        n_repl = (1 + 3 if quant
+                  else 1 + int(has_yp) + int(has_ylo)) + 2
         in_specs = tuple([P()] * n_repl + [P(axis)])
         fn = jax.jit(jax.shard_map(
             shard_fn, mesh=mesh, in_specs=in_specs,
@@ -663,8 +741,12 @@ def _knn_query_sharded(x, y, k, mesh, axis, passes, metric, T, Qb, g,
 
     from raft_tpu.parallel import replicated
 
-    operands = [jax.device_put(o, replicated(mesh))
-                for o in (idx.yp, idx.y_hi, idx.y_lo) if o is not None]
+    if quant:
+        srcs = (idx.yp, idx.y_q, idx.y_scale_k, idx.eq_groups)
+    else:
+        srcs = tuple(o for o in (idx.yp, idx.y_hi, idx.y_lo)
+                     if o is not None)
+    operands = [jax.device_put(o, replicated(mesh)) for o in srcs]
     operands += [jax.device_put(idx.yyh_k, replicated(mesh)),
                  jax.device_put(idx.yy_raw, replicated(mesh))]
     xs = jax.device_put(x, NamedSharding(mesh, P(axis)))

@@ -115,6 +115,28 @@ def vmem_footprint(T: int, Qb: int, d: int, passes: int,
         bytes_ += 8 * g * T * 4 * 2                   # yyh carrier
         bytes_ += Qb * _LANES * 4 * 20                # fold state + temps
         return bytes_
+    if kernel == "stream_db_q8":
+        # int8-quantized database super-block: one [g·T, d] int8 slab
+        # (double-buffered by the standard pipeline) replaces the bf16
+        # hi(/lo) pair — 1 byte/element streamed regardless of passes
+        # (passes only splits the QUERY operand; y_q is exact in bf16).
+        # The per-group [8, 128] f32 scale tile is noise next to it.
+        bytes_ = g * T * d * 1 * 2
+        bytes_ += Qb * d * 6 + Qb * 8                 # x f32+bf16, xxh
+        bytes_ += 8 * g * T * 4 * 2                   # yyh carrier
+        bytes_ += 8 * _LANES * 4 * 2                  # scale tile
+        bytes_ += Qb * _LANES * 4 * 20                # fold state + temps
+        return bytes_
+    if kernel == "stream_dbuf_q8":
+        # int8 explicit double-buffered streaming: 2 int8 DMA tile
+        # slots; fold state covers the whole padded query batch like
+        # "stream_dbuf" (callers pass that as Qb)
+        bytes_ = 2 * T * d * 1                        # 2 int8 DMA slots
+        bytes_ += Qb * d * 6 + Qb * 8                 # x f32+bf16, xxh
+        bytes_ += 8 * g * T * 4 * 2                   # yyh carrier
+        bytes_ += 8 * _LANES * 4 * 2                  # scale tile
+        bytes_ += Qb * _LANES * 4 * 12                # fold state + temps
+        return bytes_
     if kernel == "stream_dbuf":
         # explicit double-buffered streaming: y tiles ride a 2-slot
         # manual-DMA scratch (only 2 tiles resident, whatever g is) but
@@ -186,6 +208,30 @@ def _contract(x, yhi, ylo):
             xhi, ylo, dims, preferred_element_type=jnp.float32)
         s = s + jax.lax.dot_general(
             xlo, yhi, dims, preferred_element_type=jnp.float32)
+    return s
+
+
+def _contract_q8(x, yq, passes: int):
+    """MXU contraction of an f32 x block with an INT8-quantized y tile
+    → f32 [Qb, T] partial scores in QUANTIZED units (the caller
+    multiplies by the group scale AFTER accumulation — cheaper and more
+    accurate than a per-element dequantize: int8 magnitudes ≤ 127 are
+    EXACT in bf16's 8-bit mantissa, so the y factor carries zero
+    rounding; only x is rounded). ``passes=3`` adds the x_lo pass
+    (x ≈ hi + lo to ~2⁻¹⁶), halving the x-side error at 2× MXU cost —
+    there is no y_lo: the quantization error is handled by the
+    certificate's Eq widening, not by extra precision."""
+    dims = (((1,), (1,)), ((), ()))
+    xhi = x.astype(jnp.bfloat16)
+    yqb = yq.astype(jnp.bfloat16)
+    s = jax.lax.dot_general(
+        xhi, yqb, dims, preferred_element_type=jnp.float32)
+    if passes == 3:
+        # unbarriered like _contract: Mosaic lowering, not the XLA
+        # bf16-propagation pass that folds the split
+        xlo = (x - xhi.astype(jnp.float32)).astype(jnp.bfloat16)
+        s = s + jax.lax.dot_general(
+            xlo, yqb, dims, preferred_element_type=jnp.float32)
     return s
 
 
@@ -813,21 +859,37 @@ def _group_kernel_packed_dchunk(m_real_ref, x_ref, yhi_ref, yyh_ref,
 
 
 def _fold_tile_packed(acc, x, ythi, ytlo, yyh_t, xxh, jj: int,
-                      *, T: int, Qb: int, pair: bool, pbits: int):
+                      *, T: int, Qb: int, pair: bool, pbits: int,
+                      scale=None, passes: int = 1):
     """Fold ONE y tile (rows [T, d], half-norms yyh_t [8, T]) into the
     packed (a1, a2, a3) carriers with within-group tile offset ``jj`` —
     the per-tile body shared by the database-major kernels. Chunk
     contractions are emitted individually (the "stream" idiom) so
-    Mosaic co-issues fold(r) with contract(r+1)."""
+    Mosaic co-issues fold(r) with contract(r+1).
+
+    ``scale`` (an [8, LANES] group-replicated f32 tile) switches the
+    tile to the INT8 path: ``ythi`` is then the int8 tile, ``ytlo`` is
+    unused, the contraction runs through :func:`_contract_q8` (passes
+    splits the x operand only) and the quantized partial scores are
+    rescaled after accumulation — the in-register dequantize of the
+    quantized-streaming design. The half-norm carrier must hold the
+    DEQUANTIZED rows' norms, so the folded value is exactly
+    d2(x, ŷ)/2 and every downstream consumer (codes, certificate,
+    decode) is untouched."""
     a1, a2, a3 = acc
     n_chunks = T // _LANES
     q8 = Qb // 8
 
     def chunk_score(r):
         sl = slice(r * _LANES, (r + 1) * _LANES)
-        s_r = _contract(x, ythi[sl, :],
-                        None if ytlo is None else ytlo[sl, :])
-        c = yyh_t[:, sl] - s_r.reshape(q8, 8, _LANES)
+        if scale is None:
+            s_r = _contract(x, ythi[sl, :],
+                            None if ytlo is None else ytlo[sl, :])
+            s3 = s_r.reshape(q8, 8, _LANES)
+        else:
+            s_r = _contract_q8(x, ythi[sl, :], passes)
+            s3 = s_r.reshape(q8, 8, _LANES) * scale
+        c = yyh_t[:, sl] - s3
         # c + xx/2 = d2/2 (see _group_fold_and_write_packed)
         return c if xxh is None else c + xxh
 
@@ -934,6 +996,81 @@ def _group_kernel_packed_dbuf(m_real_ref, x_ref, yhi_ref, yyh_ref,
         scoped.update(scratch_lo=pltpu.VMEM((2, T, d), jnp.bfloat16),
                       sem_lo=pltpu.SemaphoreType.DMA((2,)))
     pl.run_scoped(body, **scoped)
+
+
+def _group_kernel_packed_db_q8(m_real_ref, x_ref, yq_ref, yyh_ref,
+                               scl_ref, xxh_ref,
+                               a1_ref, a2_ref, a3_ref,
+                               *, T: int, Qb: int, tpg: int, passes: int,
+                               pair: bool = False,
+                               pbits: int = _PACK_BITS):
+    """INT8 database-major super-blocked cell: the resident [tpg·T, d]
+    y block is the QUANTIZED int8 slab (half the bf16 stream, a quarter
+    of the bf16x3 one); the per-group scale tile rescales the quantized
+    partial scores in-register after the MXU contraction (see
+    _contract_q8). Same outputs/codes/certificate semantics as
+    _group_kernel_packed_db."""
+    q8 = Qb // 8
+    big = jnp.full((q8, 8, _LANES), _PACK_PAD, jnp.float32)
+    acc = (big, big, big)
+    x = x_ref[...]
+    yyh = yyh_ref[...]                                   # [8, tpg·T]
+    scale = scl_ref[0]                                   # [8, LANES]
+    xxh = xxh_ref[...].reshape(q8, 8, 1)
+    for jj in range(tpg):
+        rs = slice(jj * T, (jj + 1) * T)
+        acc = _fold_tile_packed(
+            acc, x, yq_ref[rs, :], None, yyh[:, rs], xxh, jj,
+            T=T, Qb=Qb, pair=pair, pbits=pbits, scale=scale,
+            passes=passes)
+    a1_ref[...] = acc[0].reshape(Qb, _LANES)
+    a2_ref[...] = acc[1].reshape(Qb, _LANES)
+    a3_ref[...] = acc[2].reshape(Qb, _LANES)
+
+
+def _group_kernel_packed_dbuf_q8(m_real_ref, x_ref, yq_ref, yyh_ref,
+                                 scl_ref, xxh_ref,
+                                 a1_ref, a2_ref, a3_ref,
+                                 *, T: int, Qb: int, tpg: int,
+                                 passes: int, pair: bool = False,
+                                 pbits: int = _PACK_BITS):
+    """INT8 explicit double-buffered database streaming: like
+    _group_kernel_packed_dbuf but the manual 2-slot DMA pipeline moves
+    int8 tiles (1 byte/element on the wire; the dequantize is the
+    post-accumulation rescale, never a widened copy in VMEM)."""
+    sidx = pl.program_id(0)
+    d = yq_ref.shape[1]
+    q8 = Qb // 8
+
+    def body(scratch_q, sem_q):
+        def dma(slot, jj):
+            return pltpu.make_async_copy(
+                yq_ref.at[pl.ds((sidx * tpg + jj) * T, T), :],
+                scratch_q.at[slot], sem_q.at[slot])
+
+        dma(0, 0).start()
+        big = jnp.full((q8, 8, _LANES), _PACK_PAD, jnp.float32)
+        acc = (big, big, big)
+        x = x_ref[...]
+        yyh = yyh_ref[...]                               # [8, tpg·T]
+        scale = scl_ref[0]                               # [8, LANES]
+        xxh = xxh_ref[...].reshape(q8, 8, 1)
+        for jj in range(tpg):
+            slot = jj % 2
+            if jj + 1 < tpg:
+                dma((jj + 1) % 2, jj + 1).start()        # prefetch next
+            dma(slot, jj).wait()
+            acc = _fold_tile_packed(
+                acc, x, scratch_q[slot], None,
+                yyh[:, jj * T:(jj + 1) * T], xxh, jj,
+                T=T, Qb=Qb, pair=pair, pbits=pbits, scale=scale,
+                passes=passes)
+        a1_ref[...] = acc[0].reshape(Qb, _LANES)
+        a2_ref[...] = acc[1].reshape(Qb, _LANES)
+        a3_ref[...] = acc[2].reshape(Qb, _LANES)
+
+    pl.run_scoped(body, scratch_q=pltpu.VMEM((2, T, d), jnp.int8),
+                  sem_q=pltpu.SemaphoreType.DMA((2,)))
 
 
 def _group_kernel(m_real_ref, x_ref, yhi_ref, yyh_ref,
@@ -1170,13 +1307,23 @@ def fused_l2_group_topk_packed_dchunk(x, y_hi, y_lo, yy_half, m_real,
 
 def _group_pallas_call_db(dbuf: bool, x, y_hi, y_lo, yy_half, m_real,
                           *, T: int, Qb: int, passes: int, tpg: int,
-                          pair: bool, pbits: int, xxh):
+                          pair: bool, pbits: int, xxh, scale_k=None):
     """Scaffolding for the database-major packed entry points (specs,
-    grid, pallas_call in ONE place, mirroring _group_pallas_call)."""
+    grid, pallas_call in ONE place, mirroring _group_pallas_call).
+
+    ``scale_k`` ([n_groups, 8, LANES] f32, group-replicated) switches
+    the call to the INT8 kernels: ``y_hi`` is then the int8 slab,
+    ``y_lo`` must be None and ``xxh`` is required (the quantized path
+    always folds the query half-norm — it is the production packed
+    configuration)."""
     _check_tiling(T, Qb)
     _check_pack_envelope(T, tpg, pbits)
     Q, d = x.shape
     M = y_hi.shape[0]
+    q8_mode = scale_k is not None
+    if q8_mode and (y_lo is not None or xxh is None):
+        raise ValueError("db-major q8 fused kernel: int8 mode takes no "
+                         "y_lo and requires xxh")
     if M % (tpg * T):
         raise ValueError(
             f"database-major fused kernel: index rows M={M} must be a "
@@ -1201,9 +1348,12 @@ def _group_pallas_call_db(dbuf: bool, x, y_hi, y_lo, yy_half, m_real,
                                memory_space=pltpu.VMEM)
         xx_spec = pl.BlockSpec((Qb, 1), lambda s, *_: (0, 0),
                                memory_space=pltpu.VMEM)
+        scl_spec = pl.BlockSpec((1, 8, _LANES), lambda s, *_: (s, 0, 0),
+                                memory_space=pltpu.VMEM)
         out_spec = pl.BlockSpec((Qb, _LANES), lambda s, *_: (0, s),
                                 memory_space=pltpu.VMEM)
-        base = _group_kernel_packed_dbuf
+        base = _group_kernel_packed_dbuf_q8 if q8_mode \
+            else _group_kernel_packed_dbuf
     else:
         grid = (n_groups, nq)
         x_spec = pl.BlockSpec((Qb, d), lambda s, i, *_: (i, 0),
@@ -1217,21 +1367,31 @@ def _group_pallas_call_db(dbuf: bool, x, y_hi, y_lo, yy_half, m_real,
                                memory_space=pltpu.VMEM)
         xx_spec = pl.BlockSpec((Qb, 1), lambda s, i, *_: (i, 0),
                                memory_space=pltpu.VMEM)
+        scl_spec = pl.BlockSpec((1, 8, _LANES),
+                                lambda s, i, *_: (s, 0, 0),
+                                memory_space=pltpu.VMEM)
         out_spec = pl.BlockSpec((Qb, _LANES), lambda s, i, *_: (i, s),
                                 memory_space=pltpu.VMEM)
-        base = _group_kernel_packed_db
+        base = _group_kernel_packed_db_q8 if q8_mode \
+            else _group_kernel_packed_db
 
-    in_specs = [x_spec, y_spec, yy_spec]
-    operands = [x, y_hi, yy_half]
-    if passes == 3:
-        in_specs.insert(2, y_spec)                      # y_lo
-        operands.insert(2, y_lo)
-    if xxh is not None:
-        in_specs.append(xx_spec)
-        operands.append(xxh)
-    kernel = _make_group_kernel(base, passes, T, Qb, tpg=tpg,
-                                has_xxh=xxh is not None,
-                                pair=pair, pbits=pbits)
+    if q8_mode:
+        in_specs = [x_spec, y_spec, yy_spec, scl_spec, xx_spec]
+        operands = [x, y_hi, yy_half, scale_k, xxh]
+        kernel = functools.partial(base, T=T, Qb=Qb, tpg=tpg,
+                                   passes=passes, pair=pair, pbits=pbits)
+    else:
+        in_specs = [x_spec, y_spec, yy_spec]
+        operands = [x, y_hi, yy_half]
+        if passes == 3:
+            in_specs.insert(2, y_spec)                  # y_lo
+            operands.insert(2, y_lo)
+        if xxh is not None:
+            in_specs.append(xx_spec)
+            operands.append(xxh)
+        kernel = _make_group_kernel(base, passes, T, Qb, tpg=tpg,
+                                    has_xxh=xxh is not None,
+                                    pair=pair, pbits=pbits)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -1239,6 +1399,14 @@ def _group_pallas_call_db(dbuf: bool, x, y_hi, y_lo, yy_half, m_real,
         in_specs=in_specs,
         out_specs=[out_spec] * 3,
     )
+    cost = _slot_cost(Q, M, d, n_groups * _LANES, passes)
+    if q8_mode:
+        # the y stream is 1 byte/element (int8), not bf16 hi(/lo)
+        cost = pl.CostEstimate(
+            flops=2 * Q * M * d * (2 if passes == 3 else 1),
+            bytes_accessed=(Q * d * 4 + M * d
+                            + Q * n_groups * _LANES * 8),
+            transcendentals=0)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -1246,7 +1414,7 @@ def _group_pallas_call_db(dbuf: bool, x, y_hi, y_lo, yy_half, m_real,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",) * len(grid),
         ),
-        cost_estimate=_slot_cost(Q, M, d, n_groups * _LANES, passes),
+        cost_estimate=cost,
         interpret=interpret_mode(),
     )(m_real, *operands)
 
@@ -1289,6 +1457,50 @@ def fused_l2_group_topk_packed_dbuf(x, y_hi, y_lo, yy_half, m_real,
     return _group_pallas_call_db(True, x, y_hi, y_lo, yy_half, m_real,
                                  T=T, Qb=Qb, passes=passes, tpg=tpg,
                                  pair=pair, pbits=pbits, xxh=xxh)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("T", "Qb", "passes", "tpg", "pair",
+                                    "pbits"))
+def fused_l2_group_topk_packed_db_q8(x, y_q, yy_half, scale_k, m_real,
+                                     T: int, Qb: int, passes: int,
+                                     tpg: int = 16, pair: bool = False,
+                                     pbits: int = _PACK_BITS, xxh=None):
+    """INT8 database-major super-blocked packed fused kernel: the
+    contract of :func:`fused_l2_group_topk_packed_db` with the database
+    streamed as a QUANTIZED int8 slab — M·d·1 bytes instead of
+    M·d·2(·2), the quantized-index-streaming tentpole.
+
+    ``y_q`` [M, d] int8 is the per-certificate-group symmetric-scale
+    quantization of the index (see knn_fused._prepare_ops_q8);
+    ``scale_k`` [n_groups, 8, LANES] f32 carries each group's scale
+    replicated to a native tile; ``yy_half`` must hold the DEQUANTIZED
+    rows' half-norms (+ the _PACK_PAD sentinel on pads) so folded
+    values are exactly d2(x, ŷ)/2 and the codes/certificate decode
+    unchanged. ``passes`` splits only the x operand (int8 is exact in
+    bf16); ``xxh`` is required."""
+    return _group_pallas_call_db(False, x, y_q, None, yy_half, m_real,
+                                 T=T, Qb=Qb, passes=passes, tpg=tpg,
+                                 pair=pair, pbits=pbits, xxh=xxh,
+                                 scale_k=scale_k)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("T", "Qb", "passes", "tpg", "pair",
+                                    "pbits"))
+def fused_l2_group_topk_packed_dbuf_q8(x, y_q, yy_half, scale_k, m_real,
+                                       T: int, Qb: int, passes: int,
+                                       tpg: int = 16, pair: bool = False,
+                                       pbits: int = _PACK_BITS,
+                                       xxh=None):
+    """INT8 explicitly double-buffered database-major packed fused
+    kernel: :func:`fused_l2_group_topk_packed_dbuf`'s manual 2-slot DMA
+    pipeline moving int8 tiles — same contract as
+    :func:`fused_l2_group_topk_packed_db_q8`."""
+    return _group_pallas_call_db(True, x, y_q, None, yy_half, m_real,
+                                 T=T, Qb=Qb, passes=passes, tpg=tpg,
+                                 pair=pair, pbits=pbits, xxh=xxh,
+                                 scale_k=scale_k)
 
 
 def split_hi_lo(y: jax.Array) -> Tuple[jax.Array, jax.Array]:

@@ -75,6 +75,10 @@ KNOWN_SITES: Dict[str, Tuple[str, ...]] = {
     "aot_dispatch": ("oom", "error", "nan"),
     # fused KNN, single-device and sharded
     "knn_fused": ("oom", "error"),
+    # int8 index quantization at build time (prepare_knn_index /
+    # build_ivf_flat with db_dtype="int8"): a failing quantize must
+    # surface at build, never as a silently-bf16 index
+    "quantize_index": ("error",),
     "sharded_dispatch": ("oom", "error", "nan"),
     "merge_permute": ("oom", "error", "timeout", "hang"),
     "merge_allgather": ("oom", "error", "timeout", "hang"),

@@ -162,15 +162,27 @@ def knn_query(res, index, x, k: int, rescore: Optional[bool] = None,
     T_, g_, passes_ = idx.T, idx.g, idx.passes
     metric_, m_, pbits_ = idx.metric, idx.n_rows, idx.pbits
     order_ = idx.grid_order
+    dtype_ = getattr(idx, "db_dtype", "bf16")
+    quant = dtype_ == "int8"
+    if quant and not rescore:
+        raise ValueError("knn_query: an int8-streamed index is always "
+                         "exact-rescored")
 
     def run(xq, *ops):
         it = iter(ops)
         yp = next(it) if has_yp else None
-        y_hi = next(it)
-        y_lo = next(it) if has_ylo else None
+        if quant:
+            y_hi = y_lo = None
+            y_q, scale_k, eq = next(it), next(it), next(it)
+            stream_w = y_q.shape[1]
+        else:
+            y_q = scale_k = eq = None
+            y_hi = next(it)
+            y_lo = next(it) if has_ylo else None
+            stream_w = y_hi.shape[1]
         yyh_k = next(it)
         yy_raw = next(it)
-        dpad = y_hi.shape[1] - xq.shape[1]
+        dpad = stream_w - xq.shape[1]
         if dpad:
             xq = jnp.concatenate(
                 [xq, jnp.zeros((xq.shape[0], dpad), jnp.float32)], axis=1)
@@ -182,7 +194,8 @@ def knn_query(res, index, x, k: int, rescore: Optional[bool] = None,
             xq, yp, y_hi, y_lo, yyh_k, yy_raw,
             k=k, T=T_, Qb=Qb_eff, g=g_, passes=passes_, metric=metric_,
             m=m_, rescore=rescore, pbits=pbits_, certify=certify,
-            pool_algo=pool_algo, grid_order=order_)
+            pool_algo=pool_algo, grid_order=order_, db_dtype=dtype_,
+            y_q=y_q, y_scale_k=scale_k, eq_groups=eq)
         if qpad:
             vals, ids = vals[:Q], ids[:Q]
         if metric_ == "ip":
@@ -190,8 +203,13 @@ def knn_query(res, index, x, k: int, rescore: Optional[bool] = None,
         return vals, ids
 
     statics = (k, T_, Qb_eff, g_, passes_, metric_, m_, bool(rescore),
-               pbits_, certify, pool_algo, order_, has_yp, has_ylo, Q)
-    ops = [o for o in (idx.yp, idx.y_hi, idx.y_lo) if o is not None]
+               pbits_, certify, pool_algo, order_, dtype_, has_yp,
+               has_ylo, Q)
+    ops = [o for o in (idx.yp,) if o is not None]
+    if quant:
+        ops += [idx.y_q, idx.y_scale_k, idx.eq_groups]
+    else:
+        ops += [o for o in (idx.y_hi, idx.y_lo) if o is not None]
     ops += [idx.yyh_k, idx.yy_raw]
     return _aot_call(res, "knn_query", statics, run, x, *ops)
 

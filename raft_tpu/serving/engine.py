@@ -229,6 +229,7 @@ class ServingEngine:
                  algorithm: str = "brute",
                  n_lists: Optional[int] = None,
                  n_probes: Optional[int] = None,
+                 db_dtype: Optional[str] = None,
                  clock=time.monotonic):
         from raft_tpu.ann import IvfFlatIndex
         from raft_tpu.distance.knn_fused import KnnIndex
@@ -256,9 +257,20 @@ class ServingEngine:
         self._mesh, self._axis = mesh, axis
         self._rescore, self._certify = rescore, certify
         self._clock = clock
+        # db_dtype threads through EVERY snapshot rebuild/swap: an
+        # engine serving an int8-streamed index keeps serving int8
+        # after background updates (None = the per-plane default —
+        # bf16-streamed brute, f32 IVF slab; env RAFT_TPU_DB_DTYPE
+        # sets the fleet default without a code change)
+        if db_dtype is None:
+            env_dt = os.environ.get("RAFT_TPU_DB_DTYPE", "").strip()
+            db_dtype = env_dt or None
+        self._db_dtype = db_dtype
         self._build_kw = dict(passes=passes, metric=metric, T=T, Qb=Qb,
                               g=g, grid_order=grid_order,
                               store_yp=store_yp)
+        if db_dtype is not None:
+            self._build_kw["db_dtype"] = db_dtype
         if isinstance(index, (KnnIndex, IvfFlatIndex)):
             if isinstance(index, IvfFlatIndex) != (
                     algorithm == "ivf_flat"):
@@ -321,8 +333,10 @@ class ServingEngine:
 
             n_lists = self._n_lists or max(
                 1, min(1024, int(round(y.shape[0] ** 0.5))))
+            kw = ({"db_dtype": self._db_dtype}
+                  if self._db_dtype is not None else {})
             return build_ivf_flat(self.res, y, n_lists=n_lists,
-                                  n_probes=self._n_probes)
+                                  n_probes=self._n_probes, **kw)
         from raft_tpu.distance.knn_fused import prepare_knn_index
 
         return prepare_knn_index(y, **self._build_kw)

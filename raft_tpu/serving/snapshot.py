@@ -113,7 +113,9 @@ class SnapshotStore:
                 SNAPSHOT_SWAPS,
                 help="Index snapshot swaps installed").inc()
             emit_serving("swap", generation=snapshot.generation,
-                         n_rows=snapshot.n_rows)
+                         n_rows=snapshot.n_rows,
+                         db_dtype=getattr(snapshot.index, "db_dtype",
+                                          None))
         except Exception:
             pass
         return prev

@@ -43,7 +43,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from raft_tpu.observability import instrument
 from raft_tpu.resilience import fault_point
 
-TUNE_SCHEMA_VERSION = 3
+# schema 4 (this build): rows/winners carry ``db_dtype`` and winners
+# are also keyed per-(passes, dtype) under ``best_by_passes_dtype``.
+# Committed schema-3 tables (incl. the measured v5e one) load
+# unchanged: rows without db_dtype are bf16, ``best_by_passes`` keeps
+# its bare-passes keys.
+TUNE_SCHEMA_VERSION = 4
 
 # counter: tuned-table loads that degraded to built-in defaults, with a
 # reason label ("tune.table_degraded" in the metrics docs) — the silent
@@ -96,6 +101,7 @@ _GRID_AXES = {
     "g": (8, 16, 32),
     "grid_order": ("query", "db", "dbuf"),
     "passes": (1, 3),
+    "db_dtype": ("bf16", "int8"),
 }
 
 
@@ -106,10 +112,12 @@ class Candidate:
     g: int
     passes: int
     grid_order: str = "query"
+    db_dtype: str = "bf16"
 
     def as_row(self) -> Dict:
         return {"T": self.T, "Qb": self.Qb, "g": self.g,
-                "passes": self.passes, "grid_order": self.grid_order}
+                "passes": self.passes, "grid_order": self.grid_order,
+                "db_dtype": self.db_dtype}
 
 
 def candidate_space(d: int, axes: Optional[Dict] = None
@@ -119,19 +127,26 @@ def candidate_space(d: int, axes: Optional[Dict] = None
     feature width ``d`` — so nothing the runtime would reject or
     silently reshape is ever measured; each skip is recorded with its
     reason (no silent truncation of the sweep)."""
-    from raft_tpu.distance.knn_fused import _valid_cfg, fit_config
+    from raft_tpu.distance.knn_fused import (_D_SINGLE_SHOT, _valid_cfg,
+                                             fit_config)
 
     axes = dict(_GRID_AXES, **(axes or {}))
     kept: List[Candidate] = []
     skipped: List[Dict] = []
-    for T, Qb, g, order, p in itertools.product(
+    for T, Qb, g, order, p, dt in itertools.product(
             axes["T"], axes["Qb"], axes["g"], axes["grid_order"],
-            axes["passes"]):
-        cand = Candidate(T, Qb, g, p, order)
+            axes["passes"], axes.get("db_dtype", ("bf16",))):
+        cand = Candidate(T, Qb, g, p, order, dt)
         if not _valid_cfg(T, Qb, g, order):
             skipped.append(dict(cand.as_row(), skipped="invalid_cfg"))
             continue
-        if fit_config(T, Qb, d, p, g, order) != (T, Qb):
+        if dt == "int8" and (order == "query" or d > _D_SINGLE_SHOT):
+            # the quantized kernels are packed database-major
+            # single-shot only — prepare would downgrade the dtype, so
+            # the point would silently measure bf16
+            skipped.append(dict(cand.as_row(), skipped="q8_envelope"))
+            continue
+        if fit_config(T, Qb, d, p, g, order, dt) != (T, Qb):
             # over the scoped-VMEM budget: a guaranteed Mosaic compile
             # failure (or a silent shrink to a point already swept)
             skipped.append(dict(cand.as_row(),
@@ -207,11 +222,12 @@ def validate_tune_table(tbl) -> List[str]:
             for key in ("T", "Qb", "g"):
                 if not isinstance(row.get(key), int):
                     errors.append(f"rows[{i}].{key} missing/non-int")
-    for key in ("best", "best_by_passes"):
+    for key in ("best", "best_by_passes", "best_by_passes_dtype"):
         entry = tbl.get(key)
         if entry is None:
             continue
-        entries = (entry.values() if key == "best_by_passes"
+        entries = (entry.values()
+                   if key in ("best_by_passes", "best_by_passes_dtype")
                    and isinstance(entry, dict) else [entry])
         for e in entries:
             if not isinstance(e, dict) or not all(
@@ -248,10 +264,10 @@ def predicted_row(shape: Sequence[int], cand: Candidate,
     nq, m, d, k = (int(v) for v in shape[:4])
     model = costmodel.fused_traffic_model(
         nq, m, d, k, cand.T, cand.Qb, cand.g, cand.passes,
-        cand.grid_order)
+        cand.grid_order, cand.db_dtype)
     rec = costmodel.fused_traffic_record(
         nq, m, d, k, cand.T, cand.Qb, cand.g, cand.passes,
-        cand.grid_order)
+        cand.grid_order, cand.db_dtype)
     est = costmodel.roofline(rec, spec)
     row = cand.as_row()
     row.update({
@@ -296,7 +312,25 @@ def autotune_fused(res=None, shape: Sequence[int] = DRIVER_SHAPE,
     cands, skipped = candidate_space(d, axes)
     rows: List[Dict] = list(skipped)
 
-    def _flush(best, best_by_passes):
+    def _winners(ranked, key):
+        """(best_by_passes — bf16 rows under bare-passes keys, the
+        schema-3 contract old loaders read — and best_by_passes_dtype,
+        winners per (passes, db_dtype) under 'p:dtype' keys)."""
+        by_p: Dict[str, Dict] = {}
+        by_pd: Dict[str, Dict] = {}
+        for p in sorted({c.passes for c in cands}):
+            bp = [r for r in ranked if r["passes"] == p
+                  and r.get("db_dtype", "bf16") == "bf16"]
+            if bp:
+                by_p[str(p)] = min(bp, key=key)
+            for dt in sorted({c.db_dtype for c in cands}):
+                rp = [r for r in ranked if r["passes"] == p
+                      and r.get("db_dtype", "bf16") == dt]
+                if rp:
+                    by_pd[f"{p}:{dt}"] = min(rp, key=key)
+        return by_p, by_pd
+
+    def _flush(best, best_by_passes, best_by_dtype=None):
         prov = provenance(measured=measure)
         if not measure:
             prov["target_chip"] = target_spec().name
@@ -307,6 +341,7 @@ def autotune_fused(res=None, shape: Sequence[int] = DRIVER_SHAPE,
             "rows": rows,
             "best": best,
             "best_by_passes": best_by_passes,
+            "best_by_passes_dtype": best_by_dtype or {},
         }
         errors = validate_tune_table(tbl)
         if errors:     # writer self-check: never ship a corrupt table
@@ -327,13 +362,9 @@ def autotune_fused(res=None, shape: Sequence[int] = DRIVER_SHAPE,
         ranked = [r for r in rows if "predicted_seconds" in r]
         best = min(ranked, key=lambda r: r["predicted_seconds"],
                    default=None)
-        best_by = {}
-        for p in sorted({c.passes for c in cands}):
-            rp = [r for r in ranked if r["passes"] == p]
-            if rp:
-                best_by[str(p)] = min(
-                    rp, key=lambda r: r["predicted_seconds"])
-        return _flush(best, best_by)
+        by_p, by_pd = _winners(ranked,
+                               lambda r: r["predicted_seconds"])
+        return _flush(best, by_p, by_pd)
 
     from raft_tpu.benchmark import Fixture
     from raft_tpu.distance.knn_fused import knn_fused, prepare_knn_index
@@ -351,6 +382,7 @@ def autotune_fused(res=None, shape: Sequence[int] = DRIVER_SHAPE,
     deadline = time.monotonic() + budget_s
     best = None
     best_by: Dict[str, Dict] = {}
+    best_by_dt: Dict[str, Dict] = {}
     for cand in cands:
         if time.monotonic() > deadline:
             rows.append({"budget_expired_after":
@@ -360,14 +392,16 @@ def autotune_fused(res=None, shape: Sequence[int] = DRIVER_SHAPE,
         row.update({f"model_{key}": v for key, v in
                     costmodel.fused_traffic_model(
                         nq, m, d, k, cand.T, cand.Qb, cand.g,
-                        cand.passes, cand.grid_order).items()
-                    if key != "grid_order"})
+                        cand.passes, cand.grid_order,
+                        cand.db_dtype).items()
+                    if key not in ("grid_order", "db_dtype")})
         try:
             idx = prepare_knn_index(
                 X, passes=cand.passes, T=cand.T, Qb=cand.Qb, g=cand.g,
-                grid_order=cand.grid_order)
+                grid_order=cand.grid_order, db_dtype=cand.db_dtype)
             name = (f"tune_fused[T={cand.T},Qb={cand.Qb},g={cand.g},"
-                    f"{cand.grid_order},p{cand.passes}]")
+                    f"{cand.grid_order},p{cand.passes},"
+                    f"{cand.db_dtype}]")
             r = fx.run(lambda q: knn_fused(q, idx, k=k)[0], Q,
                        name=name)
             row["seconds"] = round(r["seconds"], 5)
@@ -387,12 +421,10 @@ def autotune_fused(res=None, shape: Sequence[int] = DRIVER_SHAPE,
         rows.append(row)
         ok = [r for r in rows if "seconds" in r]
         best = min(ok, key=lambda r: r["seconds"]) if ok else None
-        for p in sorted({c.passes for c in cands}):
-            op = [r for r in ok if r.get("passes") == p]
-            if op:
-                best_by[str(p)] = min(op, key=lambda r: r["seconds"])
-        _flush(best, best_by)   # incremental: a kill loses one point
-    return _flush(best, best_by)
+        best_by, best_by_dt = _winners(ok, lambda r: r["seconds"])
+        _flush(best, best_by, best_by_dt)  # incremental: a kill loses
+        #                                    one point
+    return _flush(best, best_by, best_by_dt)
 
 
 # kept as a module-level alias so callers can write tables produced

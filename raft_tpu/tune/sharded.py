@@ -59,6 +59,7 @@ _SHARDED_AXES = {
     "merge": ("allgather", "tournament"),
     "micro_batches": (1, 2, 4, 8),
     "passes": (1, 3),
+    "db_dtype": ("bf16", "int8"),
 }
 
 # the sharded sweep tunes the stream-once local kernel — the db-major
@@ -75,12 +76,14 @@ class ShardedCandidate:
     merge: str
     micro_batches: int
     passes: int
+    db_dtype: str = "bf16"
 
     def as_row(self) -> Dict:
         return {"T": self.T, "Qb": self.Qb, "g": self.g,
                 "merge": self.merge,
                 "micro_batches": self.micro_batches,
-                "passes": self.passes, "grid_order": _GRID_ORDER}
+                "passes": self.passes, "grid_order": _GRID_ORDER,
+                "db_dtype": self.db_dtype}
 
 
 def sharded_candidate_space(d: int, p: int, axes: Optional[Dict] = None
@@ -93,20 +96,26 @@ def sharded_candidate_space(d: int, p: int, axes: Optional[Dict] = None
     swept too: the stream-once db order holds a whole [g·T, d] group
     VMEM-resident, so the single-chip tuned g can be a guaranteed
     scoped-VMEM reject at the sharded d."""
-    from raft_tpu.distance.knn_fused import _valid_cfg, fit_config
+    from raft_tpu.distance.knn_fused import (_D_SINGLE_SHOT, _valid_cfg,
+                                             fit_config)
 
     axes = dict(_SHARDED_AXES, **(axes or {}))
     kept: List[ShardedCandidate] = []
     skipped: List[Dict] = []
     pow2 = p > 0 and not (p & (p - 1))
-    for T, Qb, g, merge, nb, passes in itertools.product(
+    for T, Qb, g, merge, nb, passes, dt in itertools.product(
             axes["T"], axes["Qb"], axes["g"], axes["merge"],
-            axes["micro_batches"], axes["passes"]):
-        cand = ShardedCandidate(T, Qb, g, merge, nb, passes)
+            axes["micro_batches"], axes["passes"],
+            axes.get("db_dtype", ("bf16",))):
+        cand = ShardedCandidate(T, Qb, g, merge, nb, passes, dt)
         if not _valid_cfg(T, Qb, g, _GRID_ORDER):
             skipped.append(dict(cand.as_row(), skipped="invalid_cfg"))
             continue
-        if fit_config(T, Qb, d, passes, g, _GRID_ORDER) != (T, Qb):
+        if dt == "int8" and d > _D_SINGLE_SHOT:
+            skipped.append(dict(cand.as_row(), skipped="q8_envelope"))
+            continue
+        if fit_config(T, Qb, d, passes, g, _GRID_ORDER,
+                      dt) != (T, Qb):
             skipped.append(dict(cand.as_row(),
                                 skipped="vmem_footprint"))
             continue
@@ -131,7 +140,7 @@ def sharded_time_model(shape: Sequence[int], p: int,
     m_loc = -(-m // max(p, 1))
     rec = costmodel.fused_traffic_record(
         nq, m_loc, d, k, cand.T, cand.Qb, cand.g, cand.passes,
-        _GRID_ORDER)
+        _GRID_ORDER, cand.db_dtype)
     local_s = costmodel.roofline(rec, spec).roof_seconds
     nb = max(1, cand.micro_batches)
     nq_b = -(-nq // nb)
@@ -260,7 +269,22 @@ def autotune_sharded(res=None, shape: Sequence[int] = NORTHSTAR_SHAPE,
     cands, skipped = sharded_candidate_space(d, p, axes)
     rows: List[Dict] = list(skipped)
 
-    def _flush(best, best_by_passes):
+    def _winners(ranked, key):
+        by_p: Dict[str, Dict] = {}
+        by_pd: Dict[str, Dict] = {}
+        for ps in sorted({c.passes for c in cands}):
+            bp = [r for r in ranked if r["passes"] == ps
+                  and r.get("db_dtype", "bf16") == "bf16"]
+            if bp:
+                by_p[str(ps)] = min(bp, key=key)
+            for dt in sorted({c.db_dtype for c in cands}):
+                rp = [r for r in ranked if r["passes"] == ps
+                      and r.get("db_dtype", "bf16") == dt]
+                if rp:
+                    by_pd[f"{ps}:{dt}"] = min(rp, key=key)
+        return by_p, by_pd
+
+    def _flush(best, best_by_passes, best_by_dtype=None):
         prov = provenance(measured=measure)
         if not measure:
             from raft_tpu.tune.fused import target_spec
@@ -274,6 +298,7 @@ def autotune_sharded(res=None, shape: Sequence[int] = NORTHSTAR_SHAPE,
             "rows": rows,
             "best": best,
             "best_by_passes": best_by_passes,
+            "best_by_passes_dtype": best_by_dtype or {},
         }
         errors = validate_tune_table(tbl)
         if errors:
@@ -292,13 +317,9 @@ def autotune_sharded(res=None, shape: Sequence[int] = NORTHSTAR_SHAPE,
         ranked = [r for r in rows if "predicted_seconds" in r]
         best = min(ranked, key=lambda r: r["predicted_seconds"],
                    default=None)
-        best_by = {}
-        for ps in sorted({c.passes for c in cands}):
-            rp = [r for r in ranked if r["passes"] == ps]
-            if rp:
-                best_by[str(ps)] = min(
-                    rp, key=lambda r: r["predicted_seconds"])
-        return _flush(best, best_by)
+        by_p, by_pd = _winners(ranked,
+                               lambda r: r["predicted_seconds"])
+        return _flush(best, by_p, by_pd)
 
     from raft_tpu.benchmark import Fixture
     from raft_tpu.distance.knn_sharded import (knn_fused_sharded,
@@ -320,7 +341,8 @@ def autotune_sharded(res=None, shape: Sequence[int] = NORTHSTAR_SHAPE,
     deadline = time.monotonic() + budget_s
     best = None
     best_by: Dict[str, Dict] = {}
-    indexes: Dict[Tuple, object] = {}   # (T, Qb, passes) → prepared idx
+    best_by_dt: Dict[str, Dict] = {}
+    indexes: Dict[Tuple, object] = {}   # (T, Qb, g, passes, dt) → idx
     for cand in cands:
         if time.monotonic() > deadline:
             rows.append({"budget_expired_after":
@@ -328,17 +350,18 @@ def autotune_sharded(res=None, shape: Sequence[int] = NORTHSTAR_SHAPE,
             break
         row = predicted_sharded_row(shape, p, cand)
         try:
-            ikey = (cand.T, cand.Qb, cand.g, cand.passes)
+            ikey = (cand.T, cand.Qb, cand.g, cand.passes,
+                    cand.db_dtype)
             idx = indexes.get(ikey)
             if idx is None:
                 idx = prepare_knn_index_sharded(
                     X, mesh=mesh, passes=cand.passes, T=cand.T,
                     Qb=cand.Qb, g=cand.g, grid_order=_GRID_ORDER,
-                    res=res)
+                    db_dtype=cand.db_dtype, res=res)
                 indexes[ikey] = idx
             name = (f"tune_sharded[p={p},T={cand.T},Qb={cand.Qb},"
                     f"{cand.merge},nb={cand.micro_batches},"
-                    f"p{cand.passes}]")
+                    f"p{cand.passes},{cand.db_dtype}]")
             run = fx.run(
                 lambda q: knn_fused_sharded(
                     q, idx, k, mesh=mesh, merge=cand.merge,
@@ -359,12 +382,9 @@ def autotune_sharded(res=None, shape: Sequence[int] = NORTHSTAR_SHAPE,
         rows.append(row)
         ok = [r for r in rows if "seconds" in r]
         best = min(ok, key=lambda r: r["seconds"]) if ok else None
-        for ps in sorted({c.passes for c in cands}):
-            op = [r for r in ok if r.get("passes") == ps]
-            if op:
-                best_by[str(ps)] = min(op, key=lambda r: r["seconds"])
-        _flush(best, best_by)
-    return _flush(best, best_by)
+        best_by, best_by_dt = _winners(ok, lambda r: r["seconds"])
+        _flush(best, best_by, best_by_dt)
+    return _flush(best, best_by, best_by_dt)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

@@ -389,7 +389,7 @@ def test_sharded_candidate_space_prunes_with_reasons():
     assert kept and skipped
     for c in kept:
         assert fit_config(c.T, c.Qb, 256, c.passes, c.g,
-                          _GRID_ORDER) == (c.T, c.Qb)
+                          _GRID_ORDER, c.db_dtype) == (c.T, c.Qb)
     assert all("skipped" in row for row in skipped)
     assert "vmem_footprint" in {r["skipped"] for r in skipped}
     # non-power-of-two shard counts shed every tournament candidate

@@ -13,6 +13,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -20,6 +22,17 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.xfail(
+    os.environ.get("JAX_PLATFORMS", "").startswith("cpu"),
+    reason="the CPU backend cannot run cross-process collectives — "
+           "jax.distributed on JAX_PLATFORMS=cpu fails inside the "
+           "worker with 'Multiprocess computations aren't implemented "
+           "on the CPU backend'. This is a backend limitation, not a "
+           "comms-stack bug: the launcher env detection, rendezvous "
+           "and session construction all succeed before the first "
+           "collective. Runs for real on the first multi-host TPU "
+           "session (ROADMAP item 4).",
+    strict=False)
 def test_two_process_battery():
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "_mp_worker.py")

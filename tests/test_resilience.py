@@ -323,6 +323,14 @@ def _always_raise_drivers():
         # only the ARMED site fires)
         "kmeans_fit": _drive_kmeans,
         "kmeans_iteration": _drive_kmeans,
+        # int8 index quantization: the site fires in prepare_knn_index
+        # before the quantize prep runs (db-major geometry keeps the
+        # tiny driver inside the packed envelope)
+        "quantize_index": lambda: __import__(
+            "raft_tpu.distance.knn_fused",
+            fromlist=["prepare_knn_index"]).prepare_knn_index(
+                np.ones((64, 8), np.float32), passes=1, T=256, Qb=32,
+                g=2, grid_order="db", db_dtype="int8"),
         "ivf_build": _drive_ivf_build,
         "ivf_search": _drive_ivf_search,
         "serving_enqueue": _drive_serving_enqueue,

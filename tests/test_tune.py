@@ -35,16 +35,20 @@ def test_candidate_space_prunes_with_production_predicate():
     assert kept and skipped
     # every kept candidate survives the runtime's own shrink guard
     # unshrunk — nothing production would reshape is ever measured
+    # (checked at the candidate's OWN storage dtype: an int8 point fits
+    # the int8 footprint, not necessarily the bigger bf16 one)
     for c in kept:
         assert fit_config(c.T, c.Qb, 128, c.passes, c.g,
-                          c.grid_order) == (c.T, c.Qb)
+                          c.grid_order, c.db_dtype) == (c.T, c.Qb)
     # every skip carries its reason (no silent sweep truncation)
     assert all("skipped" in row for row in skipped)
     reasons = {row["skipped"] for row in skipped}
     assert "vmem_footprint" in reasons
-    # the db orders are represented in the kept set at d=128
+    # the db orders are represented in the kept set at d=128, and both
+    # storage dtypes survive somewhere
     orders = {c.grid_order for c in kept}
     assert {"query", "db", "dbuf"} <= orders
+    assert {"bf16", "int8"} <= {c.db_dtype for c in kept}
 
 
 # --------------------------------------------- deterministic CPU fallback

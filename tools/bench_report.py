@@ -85,6 +85,12 @@ COST_FIELDS = ("flops", "bytes_accessed", "arithmetic_intensity",
 PASS, REGRESS, MISSING_BASELINE, SKIP = ("pass", "regress",
                                          "missing-baseline", "skip")
 
+#: quantized-index-streaming gate: int8 rows must model ≤ this fraction
+#: of the bf16 baseline's streamed database bytes (the point of the
+#: dtype — 1/2 at passes=1 before the scale-tile overhead), and their
+#: id-parity flag must hold
+QUANTIZED_RATIO_CEIL = 0.55
+
 
 def load_record(path: str) -> Optional[Dict]:
     """Flat benchmark record from a BENCH artifact: unwraps the driver's
@@ -859,6 +865,51 @@ def multichip_trajectory(rounds: Sequence[Tuple[int, str,
     return "\n".join(lines) + "\n"
 
 
+def check_quantized(records: Sequence[Tuple[str, Optional[Dict]]],
+                    ceil: float = QUANTIZED_RATIO_CEIL
+                    ) -> Tuple[str, str]:
+    """Gate the quantized-index-streaming evidence across artifact
+    families. ``records`` is [(family, newest record)] — each record
+    that carries a ``"quantized"`` block must have ``ok: true``
+    (id-parity int8-vs-f32 held) and its modeled bytes ratio
+    (``quantized_y_ratio`` for the fused stream,
+    ``quantized_gather_ratio`` for the IVF probe gather) ≤ ``ceil``.
+    Families without the block are noted; when NO family carries one
+    the gate SKIPs (pass-or-no-op — pre-quantization artifact sets)."""
+    checked, missing = [], []
+    for family, rec in records:
+        q = rec.get("quantized") if isinstance(rec, dict) else None
+        if not isinstance(q, dict):
+            missing.append(family)
+            continue
+        if not q.get("ok"):
+            detail = q.get("error") or ("int8 ids diverged from the "
+                                        "f32 oracle")
+            return REGRESS, (
+                f"QUANTIZED REGRESSION [{family}]: id-parity ok="
+                f"{q.get('ok')} ({detail})")
+        ratio = None
+        for key in ("quantized_y_ratio", "quantized_gather_ratio"):
+            if isinstance(q.get(key), (int, float)):
+                ratio = float(q[key])
+                break
+        if ratio is None:
+            return REGRESS, (
+                f"QUANTIZED REGRESSION [{family}]: block carries no "
+                f"modeled bytes ratio")
+        if ratio > ceil:
+            return REGRESS, (
+                f"QUANTIZED REGRESSION [{family}]: modeled streamed-"
+                f"bytes ratio {ratio:.3f} > {ceil:g}× the bf16/f32 "
+                f"baseline — the int8 path stopped paying for itself")
+        checked.append(f"{family}={ratio:.3f}")
+    if not checked:
+        return SKIP, "no artifact carries a quantized block — not gated"
+    note = f" (no block: {', '.join(missing)})" if missing else ""
+    return PASS, ("int8 ratios " + ", ".join(checked)
+                  + f" ≤ {ceil:g}, id-parity ok" + note)
+
+
 def staleness_section(entries: List[Dict]) -> str:
     lines = ["named artifacts (freshness vs the last-good commit)",
              "---------------------------------------------------"]
@@ -919,6 +970,20 @@ def main(argv: Sequence[str] = None) -> int:
         print(f"bench_report --check [serving]: {sstatus}: {smsg}")
         astatus, amsg = check_ann(arounds, args.threshold)
         print(f"bench_report --check [ann]: {astatus}: {amsg}")
+        # multichip: the bare benchmark artifact (written by
+        # benchmarks/bench_sharded.py) is the freshest carrier of the
+        # quantized block — driver rounds lag it by one round
+        newest_m = load_multichip(
+            os.path.join(args.dir, "MULTICHIP_SHARDED.json"))
+        if newest_m is None:
+            newest_m = next((rec for _, _, rec in reversed(mrounds)
+                             if rec is not None), None)
+        newest_a = next((rec for _, _, rec in reversed(arounds)
+                         if rec is not None), None)
+        qstatus, qmsg = check_quantized(
+            [("bench", candidate), ("multichip", newest_m),
+             ("ann", newest_a)])
+        print(f"bench_report --check [quantized]: {qstatus}: {qmsg}")
         ledger_path = args.drift_ledger or os.path.join(
             args.dir, DRIFT_LEDGER_NAME)
         dstatus, dmsg = check_drift(load_drift_ledger(ledger_path),
@@ -932,7 +997,7 @@ def main(argv: Sequence[str] = None) -> int:
         # regression in ANY trend fails; missing baseline only when
         # nothing regressed
         rcs = (codes[status], codes[mstatus], codes[sstatus],
-               codes[astatus], codes[dstatus])
+               codes[astatus], codes[qstatus], codes[dstatus])
         return 1 if 1 in rcs else max(rcs)
 
     if args.json:

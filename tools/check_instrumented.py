@@ -40,7 +40,8 @@ HOT_PATHS: Dict[str, Sequence[str]] = {
     "raft_tpu/distance/pairwise.py": ("pairwise_distance",),
     "raft_tpu/distance/fused_l2nn.py": (
         "fused_l2_nn_argmin", "knn", "knn_sharded"),
-    "raft_tpu/distance/knn_fused.py": ("knn_fused",),
+    "raft_tpu/distance/knn_fused.py": ("knn_fused",
+                                       "prepare_knn_index"),
     "raft_tpu/sparse/tiled.py": ("tile_csr", "tile_csr_pairs"),
     "raft_tpu/sparse/sharded.py": ("spmv_sharded", "spmm_sharded"),
     "raft_tpu/solver/linear_assignment.py": ("solve_lap",),
@@ -65,6 +66,8 @@ COST_CAPTURE_SITES: Dict[str, Sequence[str]] = {
     # frontiers carry flops/bytes next to recall
     "raft_tpu/cluster/kmeans.py": ("capture_fn",),
     "raft_tpu/ann/ivf_flat.py": ("capture_fn",),
+    # the int8 quantize prep (prepare_knn_index db_dtype="int8")
+    "raft_tpu/distance/knn_fused.py": ("capture_fn",),
 }
 
 # sharded-merge observability sites: the merge rounds must flow through
@@ -92,8 +95,10 @@ FAULT_SITES: Dict[str, Sequence[str]] = {
     "raft_tpu/runtime/entry_points.py": ("aot_compile", "aot_dispatch"),
     "raft_tpu/distance/knn_sharded.py": ("sharded_dispatch",
                                          "merge_permute",
-                                         "merge_allgather"),
-    "raft_tpu/distance/knn_fused.py": ("knn_fused", "tune_table_read"),
+                                         "merge_allgather",
+                                         "quantize_index"),
+    "raft_tpu/distance/knn_fused.py": ("knn_fused", "tune_table_read",
+                                       "quantize_index"),
     "raft_tpu/matrix/select_k.py": ("select_k",),
     "raft_tpu/matrix/select_k_chunked.py": ("select_k_chunked",),
     "raft_tpu/matrix/select_k_slotted.py": ("select_k_slotted",),
@@ -111,7 +116,8 @@ FAULT_SITES: Dict[str, Sequence[str]] = {
     "raft_tpu/serving/engine.py": ("serving_enqueue", "serving_flush"),
     "raft_tpu/serving/snapshot.py": ("serving_snapshot",),
     "raft_tpu/cluster/kmeans.py": ("kmeans_fit", "kmeans_iteration"),
-    "raft_tpu/ann/ivf_flat.py": ("ivf_build", "ivf_search"),
+    "raft_tpu/ann/ivf_flat.py": ("ivf_build", "ivf_search",
+                                 "quantize_index"),
 }
 
 # timeline-event gate: every hot-path module and every fault-site
@@ -179,6 +185,10 @@ EVENT_SITES: Dict[str, Sequence[str]] = {
                                    "emit_marker"),
     "raft_tpu/ann/ivf_flat.py": ("instrument", "fault_point",
                                  "emit_marker"),
+    # the quantized index build: the quantize_index marker (per-build
+    # Eq stats) rides next to the span + fault events
+    "raft_tpu/distance/knn_fused.py": ("instrument", "fault_point",
+                                       "emit_marker"),
 }
 
 _FLIGHT_MODULE = "raft_tpu/observability/flight.py"
@@ -192,7 +202,9 @@ KERNEL_VARIANTS: Dict[str, Tuple[Sequence[str], str]] = {
     "raft_tpu/ops/fused_l2_topk_pallas.py": (
         ("fused_l2_group_topk_packed",
          "fused_l2_group_topk_packed_db",
-         "fused_l2_group_topk_packed_dbuf"),
+         "fused_l2_group_topk_packed_dbuf",
+         "fused_l2_group_topk_packed_db_q8",
+         "fused_l2_group_topk_packed_dbuf_q8"),
         "raft_tpu/distance/knn_fused.py"),
 }
 
