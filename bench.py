@@ -413,6 +413,21 @@ def main():
 
         print("bench: quantized evidence failed (block omitted):\n"
               + traceback.format_exc(), file=sys.stderr)
+    # quality-telemetry block (ISSUE 10): the certificate/fixup
+    # counters this round's fused runs recorded (drained host-side) —
+    # the first measured TPU round lands ROADMAP item 2's fixup-rate
+    # evidence in this already-gated schema (bench_report [quality])
+    try:
+        from raft_tpu.observability.quality import quality_block
+
+        qb = quality_block()
+        if qb:
+            result["quality"] = qb
+    except Exception:
+        import traceback
+
+        print("bench: quality block failed (omitted):\n"
+              + traceback.format_exc(), file=sys.stderr)
     if traffic_model is not None:
         result["model_total_bytes"] = traffic_model["total_bytes"]
         result["model_y_bytes"] = traffic_model["y_bytes"]

@@ -232,6 +232,21 @@ def main(argv=None) -> int:
     }
     if degr:
         result["resilience_degradations"] = degr
+    # quality block (ISSUE 10): IVF certificate/rerun counters + the
+    # frontier's best OFFLINE recall, in the same shape the serving
+    # artifact carries its online shadow recall — one recall key
+    # family, one gate (bench_report --check [quality], ≥ 0.95 floor)
+    try:
+        from raft_tpu.observability.quality import quality_block
+
+        qb = quality_block()
+        if qb is None:
+            qb = {"fixup_rate": 0.0, "certificate_checks": 0,
+                  "certificate_fixups": 0, "sites": {}}
+        qb["offline_recall"] = round(best, 4)
+        result["quality"] = qb
+    except Exception as e:
+        print(f"bench_ann: quality block failed: {e}", file=sys.stderr)
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
         f.write("\n")

@@ -131,6 +131,12 @@ def main(argv=None) -> int:
     p.add_argument("--deterministic", action="store_true",
                    help="seeded arrival schedule, no sleeps (the "
                         "reproducible tier-1 variant; default off-TPU)")
+    p.add_argument("--shadow-frac", type=float, default=None,
+                   help="online recall shadow-sampling fraction "
+                        "(default: 1.0 off-TPU so the artifact carries "
+                        "a well-populated shadow recall, 0.05 on TPU "
+                        "where the oracle re-score costs real chip "
+                        "time)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
@@ -149,15 +155,18 @@ def main(argv=None) -> int:
     if args.clients is not None:
         clients = args.clients
 
+    shadow_frac = (args.shadow_frac if args.shadow_frac is not None
+                   else (0.05 if measured else 1.0))
     rng = np.random.default_rng(args.seed)
     Y = rng.normal(size=(m, d)).astype(np.float32)
     if measured:
         idx = prepare_knn_index(Y)
-        engine = ServingEngine(idx, k=k)
+        engine = ServingEngine(idx, k=k, shadow_frac=shadow_frac)
     else:
         idx = prepare_knn_index(Y, passes=3, T=256, Qb=32, g=2)
         engine = ServingEngine(idx, k=k, buckets=(8, 16, 32),
-                               flush_interval_s=0.002)
+                               flush_interval_s=0.002,
+                               shadow_frac=shadow_frac)
     ladder = engine.buckets
 
     # request mix: ragged sizes across the ladder (Poisson-ish bulk,
@@ -195,11 +204,15 @@ def main(argv=None) -> int:
         except Exception as e:
             ok = False
             errors.append(f"parity probe failed: {e}"[:200])
+    if engine.shadow is not None:
+        engine.shadow.flush(timeout=60)
+    stats = engine.stats()
     ok = ok and compile_misses == 0
     engine.stop()
 
+    from raft_tpu.observability.metrics import percentile
+
     lat_ms = np.sort(np.asarray(latencies)) * 1e3
-    stats = engine.stats()
     degr = degradation_count() - degr0
     result = {
         "metric": f"serving top-{k} closed-loop {n_requests} reqs x "
@@ -213,10 +226,9 @@ def main(argv=None) -> int:
         "measured": measured,
         "degraded": not measured,
         "deterministic": deterministic,
-        "p50_ms": round(float(lat_ms[len(lat_ms) // 2]), 3)
+        "p50_ms": round(percentile(lat_ms, 50), 3)
         if len(lat_ms) else None,
-        "p99_ms": round(float(lat_ms[min(len(lat_ms) - 1,
-                                         int(len(lat_ms) * 0.99))]), 3)
+        "p99_ms": round(percentile(lat_ms, 99), 3)
         if len(lat_ms) else None,
         "throughput_qps": round(len(latencies) / wall, 2) if wall
         else None,
@@ -239,6 +251,19 @@ def main(argv=None) -> int:
         "git_commit": _git_commit(),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    # quality block (ISSUE 10): fixup-rate counters from the serving
+    # AOT plane + the shadow sampler's online recall — gated by
+    # bench_report --check [quality] (shadow recall ≥ the 0.95 floor)
+    try:
+        from raft_tpu.observability.quality import quality_block
+
+        qb = quality_block()
+        if qb is not None:
+            qb["shadow_frac"] = shadow_frac
+            result["quality"] = qb
+    except Exception as e:
+        print(f"bench_serving: quality block failed: {e}",
+              file=sys.stderr)
     if degr:
         result["resilience_degradations"] = degr
     with open(OUT_PATH, "w") as f:

@@ -231,6 +231,18 @@ def main() -> int:
         "git_commit": _git_commit(),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    # quality block (ISSUE 10): per-shard certificate/fixup counters
+    # drained from this run's sharded dispatches — gated by
+    # bench_report --check [quality]
+    try:
+        from raft_tpu.observability.quality import quality_block
+
+        qb = quality_block()
+        if qb is not None:
+            result["quality"] = qb
+    except Exception as e:
+        print(f"bench_sharded: quality block failed: {e}",
+              file=sys.stderr)
     with open(OUT_PATH, "w") as f:
         json.dump(result, f, indent=1)
         f.write("\n")

@@ -66,6 +66,8 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.resources import ensure_resources
 from raft_tpu.observability import instrument
 from raft_tpu.observability.flight import get_flight_recorder
+from raft_tpu.observability.quality import (record_certificate,
+                                            record_pending)
 from raft_tpu.observability.timeline import emit_marker
 from raft_tpu.resilience import fault_point
 
@@ -472,10 +474,18 @@ def _exact_search(res, index: IvfFlatIndex, x, k: int):
     if qpad:
         x = jnp.concatenate(
             [x, jnp.zeros((qpad, x.shape[1]), jnp.float32)])
-    vals, pos = _knn_fused_core(
+    vals, pos, n_fail = _knn_fused_core(
         x, yp, y_hi, y_lo, yyh_k, yy_raw, k=k, T=T, Qb=Qb_eff, g=g,
         passes=3, metric="l2", m=M, rescore=True, pbits=pbits,
-        rows_valid=rv)
+        with_stats=True, rows_valid=rv)
+    # certificate telemetry for the degenerate-exact plane (device
+    # scalar — resolved at the next quality.drain())
+    from raft_tpu.distance.knn_fused import (fixup_tiers_for,
+                                             rescore_pool_width)
+
+    record_pending("ann.ivf_exact", n_fail, n_queries=x.shape[0],
+                   pool_width=rescore_pool_width(k, S_pool, True),
+                   fix_tiers=fixup_tiers_for(M))
     vals, pos = vals[:nq], pos[:nq]
     gids = jnp.where(pos >= 0,
                      jnp.take(index.ids, jnp.maximum(pos, 0)), -1)
@@ -583,6 +593,14 @@ def search_ivf_flat(res, index, queries, k: int,
             index.yy_q, st, ps, k=k, P=P, W=W, C=C,
             eq_rows=index.eq_rows)
         n_fail = int(jnp.sum(~ok))
+        # quality telemetry: this path ALREADY syncs (the int() above
+        # decides the rerun), so the counters cost nothing extra —
+        # the IVF slice of the certificate/fixup evidence plane
+        record_certificate("ann.search_ivf_flat",
+                           n_queries=int(xs.shape[0]), n_fail=n_fail,
+                           pool_width=C, fixup_rows=n_fail or None,
+                           rerun=bool(n_fail), db_dtype="int8",
+                           n_probes=P)
         if n_fail:
             # quantization certificate failed for some queries: the
             # true top-k may extend past the rescored pool — rerun the

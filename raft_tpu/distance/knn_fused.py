@@ -491,14 +491,14 @@ def _prepare_ops_q8(y, T: int, g: int, metric: str,
                    static_argnames=("k", "T", "Qb", "g", "passes", "metric",
                                     "m", "rescore", "pbits", "certify",
                                     "pool_algo", "grid_order", "db_dtype",
-                                    "_diag"))
+                                    "_diag", "with_stats"))
 def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
                     k: int, T: int, Qb: int, g: int, passes: int,
                     metric: str, m: int, rescore: bool = True,
                     pbits: int = _PACK_BITS, certify: str = "kernel",
                     pool_algo: str = "xla", grid_order: str = "query",
                     db_dtype: str = "bf16",
-                    _diag: bool = False,
+                    _diag: bool = False, with_stats: bool = False,
                     m_valid=None, rows_valid=None,
                     y_q=None, y_scale_k=None,
                     eq_groups=None) -> Tuple[jax.Array, ...]:
@@ -942,7 +942,37 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         branch = (lambda op, t=t, nxt=branch: jax.lax.cond(
             n_fail <= t, make_fixup(t), nxt, op))
     vals, ids = jax.lax.cond(n_fail == 0, no_fixup, branch, (vals, ids))
+    if with_stats:
+        # ``with_stats``: the certificate-failure count rides out as a
+        # third (scalar) output so the NON-jitted wrappers can report
+        # fixup-rate telemetry host-side (observability.quality) — one
+        # extra int32 per program, no extra compute, fixup semantics
+        # untouched
+        return vals, ids, n_fail
     return vals, ids
+
+
+def rescore_pool_width(k: int, S_pool: int, packed: bool) -> int:
+    """The candidate-pool width C the core exact-rescores — the HOST
+    mirror of the static pool geometry inside ``_knn_fused_core``
+    (packed: twin-pool Ca oversample then prune to C; unpacked: one
+    pick over the 2·S' concat pool). Quality telemetry reports it so
+    q8 rescore pool widths are observable without re-deriving kernel
+    geometry (observability.quality)."""
+    if packed:
+        ca = min(k + _POOL_PAD, S_pool)
+        return min(k + _POOL_PAD, 2 * ca)
+    return min(k + _POOL_PAD, 2 * S_pool)
+
+
+def fixup_tiers_for(m_padded: int) -> Tuple[int, ...]:
+    """The eligible static fixup tiers at a PREPARED (padded) row count
+    — the host mirror of the ladder filter in ``_knn_fused_core``
+    (a tier is eligible only while its [F, M] f32 tile fits the
+    budget). Quality telemetry maps a drained failure count back to
+    the tier that absorbed it (quality.fixup_tier_for)."""
+    return tuple(t for t in _FIXUP_TIERS
+                 if t * m_padded * 4 <= _FIXUP_TILE_BUDGET)
 
 
 _TUNED = ...   # lazy sentinel: {passes: (T, Qb, g)} once loaded
@@ -1602,17 +1632,30 @@ def knn_fused(x, y, k: int, passes: int = 3,
     # reproduced exactly (S' = ceil(n_tiles/g)·128; packed pools are S'
     # wide, unpacked 2·S')
     S_pool = -(-n_tiles // g) * _LANES
-    pool_len = (S_pool if g * (T // _LANES) <= (1 << idx.pbits)
-                else 2 * S_pool)
+    packed_env = g * (T // _LANES) <= (1 << idx.pbits)
+    pool_len = S_pool if packed_env else 2 * S_pool
     pool_algo = resolve_pool_algo(pool_select_algo(), pool_len,
                                   min(k + _POOL_PAD, pool_len))
-    vals, ids = _knn_fused_core(
+    vals, ids, n_fail = _knn_fused_core(
         x, idx.yp, idx.y_hi, idx.y_lo, idx.yyh_k, idx.yy_raw,
         k=k, T=T, Qb=Qb, g=g, passes=passes, metric=metric, m=m,
         rescore=rescore, pbits=idx.pbits, certify=certify,
         pool_algo=pool_algo, grid_order=grid_order,
-        db_dtype=db_dtype, y_q=idx.y_q, y_scale_k=idx.y_scale_k,
-        eq_groups=idx.eq_groups)
+        db_dtype=db_dtype, with_stats=True, y_q=idx.y_q,
+        y_scale_k=idx.y_scale_k, eq_groups=idx.eq_groups)
+    # certificate/fixup telemetry: the failure count is a device scalar
+    # — queue it UNRESOLVED (quality.drain() converts later, after the
+    # program's results have been consumed; no sync on this path)
+    try:
+        from raft_tpu.observability.quality import record_pending
+
+        record_pending(
+            "distance.knn_fused", n_fail, n_queries=x.shape[0],
+            pool_width=rescore_pool_width(k, S_pool, packed_env),
+            fix_tiers=fixup_tiers_for(idx.yyh_k.shape[1]),
+            db_dtype=db_dtype, passes=passes, certify=certify)
+    except Exception:
+        pass
     if vals.shape[0] != Q:
         vals, ids = vals[:Q], ids[:Q]
     # else: identity slices would still cost an eager dispatch each

@@ -74,8 +74,10 @@ from raft_tpu.distance.knn_fused import (
     _D_SINGLE_SHOT, _DC, _LANES, _PACK_BITS, _PBITS_MAX, _POOL_PAD,
     _Q_CHUNK, DB_DTYPES, GRID_ORDERS, KnnIndex, _knn_fused_core,
     _prepare_ops, _prepare_ops_q8, auto_pack_bits, fit_config,
-    fused_config, pool_select_algo, prepare_knn_index, resolve_db_dtype,
-    resolve_grid_order, resolve_pool_algo)
+    fixup_tiers_for, fused_config, pool_select_algo, prepare_knn_index,
+    rescore_pool_width, resolve_db_dtype, resolve_grid_order,
+    resolve_pool_algo)
+from raft_tpu.observability.quality import record_pending
 
 SHARD_MODES = ("db", "query")
 
@@ -512,20 +514,22 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
                     0, rows_per)
                 off = r.astype(jnp.int32) * rows_per
                 out_v, out_i = [], []
+                nf = jnp.zeros((), jnp.int32)
                 # micro-batch pipeline: block b's kernel is independent
                 # of block b−1's merge collectives — the scheduler may
                 # overlap
                 for b in range(nb):
                     xb = jax.lax.slice_in_dim(xq_l, b * qb_len,
                                               (b + 1) * qb_len, axis=0)
-                    vals, ids = _knn_fused_core(
+                    vals, ids, nfb = _knn_fused_core(
                         xb, yp_l, yhi_l, ylo_l, yyh_l, yy_l,
                         k=k, T=T_, Qb=Qb_eff, g=g_, passes=passes_,
                         metric=metric_, m=rows_per, rescore=rescore,
                         pbits=pbits_, certify=certify,
                         pool_algo=pool_algo, grid_order=order_,
-                        db_dtype=dtype_, y_q=yq_l, y_scale_k=scl_l,
-                        eq_groups=eq_l, m_valid=m_loc)
+                        db_dtype=dtype_, with_stats=True, y_q=yq_l,
+                        y_scale_k=scl_l, eq_groups=eq_l, m_valid=m_loc)
+                    nf = nf + nfb
                     # local → global ids; pad/sentinel candidates (id -1
                     # or non-finite value) must lose every merge
                     gid = jnp.where((ids >= 0) & jnp.isfinite(vals),
@@ -537,9 +541,11 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
                     out_i.append(gid)
                 cat_v = jnp.concatenate(out_v, axis=0)
                 cat_i = jnp.concatenate(out_i, axis=0)
+                # per-shard certificate-failure count: rank-major [p]
+                # on the host side of the shard_map (quality telemetry)
                 if merge_fn is None:   # host merge: per-shard locals out
-                    return cat_v[None], cat_i[None]
-                return cat_v, cat_i
+                    return cat_v[None], cat_i[None], nf.reshape(1)
+                return cat_v, cat_i, nf.reshape(1)
 
             if quant:
                 # yp + (y_q, scale, eq) — all row/group-sharded
@@ -548,8 +554,9 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
                 row_specs = [P(axis)] * (1 + int(has_yp) + int(has_ylo))
             in_specs = tuple(row_specs
                              + [P(None, axis), P(None, axis), P()])
-            out_specs = ((P(axis), P(axis)) if merge_eff == "host"
-                         else (P(), P()))
+            out_specs = ((P(axis), P(axis), P(axis))
+                         if merge_eff == "host"
+                         else (P(), P(), P(axis)))
             fn = jax.jit(jax.shard_map(
                 shard_fn, mesh=mesh, in_specs=in_specs,
                 out_specs=out_specs, check_vma=False))
@@ -561,12 +568,12 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
         else:
             operands = [o for o in (idx.yp_s, idx.y_hi_s, idx.y_lo_s)
                         if o is not None] + [idx.yyh_s, idx.yy_s]
-        vals, ids = fn(*operands, xq)
+        vals, ids, nf = fn(*operands, xq)
         if merge_eff == "host":
             vals, ids = _merge_host_pool(vals, ids, k)
         if nq_pad != nq:
             vals, ids = vals[:nq], ids[:nq]
-        return vals, ids
+        return vals, ids, nf
 
     # ---- resilience driver ------------------------------------------
     # The fast path is one trip through the loop body with zero extra
@@ -591,7 +598,8 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
             elif merge_eff == "allgather":
                 fault_point("merge_allgather")
             with device_errors(site):
-                vals, ids = _dispatch(merge_eff, nb_cur, Qb_base)
+                vals, ids, nf_shards = _dispatch(merge_eff, nb_cur,
+                                                 Qb_base)
             if poison == "nan":   # simulated kernel-output poisoning
                 vals = jnp.full_like(vals, jnp.nan)
             if validate and not bool(jnp.isfinite(vals).all()):
@@ -635,6 +643,19 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
                 raise
             record_degradation(site, f"merge:{merge_eff}->{nxt}")
             merge_eff = nxt
+    # quality telemetry: the per-shard certificate-failure counts stay
+    # a device [p] array here — quality.drain() sums them host-side
+    # later (every shard evaluates the certificate over the whole
+    # padded query batch)
+    try:
+        record_pending(
+            site, nf_shards, n_queries=p * _geometry(nb_cur, Qb_base)[3],
+            pool_width=rescore_pool_width(
+                k, -(-n_tiles_loc // idx.g) * _LANES, packed),
+            fix_tiers=fixup_tiers_for(idx.rows_per),
+            db_dtype=idx.db_dtype, merge=merge_eff, shards=p)
+    except Exception:
+        pass
     if idx.metric == "ip":
         return -vals, ids           # internal −x·y ascending → IP desc
     return vals, ids
@@ -723,20 +744,21 @@ def _knn_query_sharded(x, y, k, mesh, axis, passes, metric, T, Qb, g,
                 ylo_l = next(it) if has_ylo else None
             yyh_l = next(it)
             yy_l = next(it)
-            return _knn_fused_core(
+            v, i, nf = _knn_fused_core(
                 xq, yp_l, yhi_l, ylo_l, yyh_l, yy_l,
                 k=k, T=T_, Qb=Qb_eff, g=g_, passes=passes_,
                 metric=metric_, m=m, rescore=rescore, pbits=pbits_,
                 certify=certify, pool_algo=pool_algo, grid_order=order_,
-                db_dtype=dtype_, y_q=yq_l, y_scale_k=scl_l,
-                eq_groups=eq_l)
+                db_dtype=dtype_, with_stats=True, y_q=yq_l,
+                y_scale_k=scl_l, eq_groups=eq_l)
+            return v, i, nf.reshape(1)
 
         n_repl = (1 + 3 if quant
                   else 1 + int(has_yp) + int(has_ylo)) + 2
         in_specs = tuple([P()] * n_repl + [P(axis)])
         fn = jax.jit(jax.shard_map(
             shard_fn, mesh=mesh, in_specs=in_specs,
-            out_specs=(P(axis), P(axis)), check_vma=False))
+            out_specs=(P(axis), P(axis), P(axis)), check_vma=False))
         _SHARDED_FUSED_CACHE[key] = fn
 
     from raft_tpu.parallel import replicated
@@ -750,7 +772,15 @@ def _knn_query_sharded(x, y, k, mesh, axis, passes, metric, T, Qb, g,
     operands += [jax.device_put(idx.yyh_k, replicated(mesh)),
                  jax.device_put(idx.yy_raw, replicated(mesh))]
     xs = jax.device_put(x, NamedSharding(mesh, P(axis)))
-    vals, ids = fn(*operands, xs)
+    vals, ids, nf_shards = fn(*operands, xs)
+    try:
+        record_pending(
+            "distance.knn_fused_sharded", nf_shards, n_queries=nq_pad,
+            pool_width=rescore_pool_width(k, S_pool, packed),
+            fix_tiers=fixup_tiers_for(idx.yyh_k.shape[1]),
+            db_dtype=idx.db_dtype, merge="query_sharded", shards=p)
+    except Exception:
+        pass
     if nq_pad != nq:
         vals, ids = vals[:nq], ids[:nq]
     if idx.metric == "ip":

@@ -60,6 +60,7 @@ from raft_tpu.observability.metrics import (
     set_registry,
     enable,
     disable,
+    percentile,
     tracing_enabled,
 )
 from raft_tpu.observability.flight import (
@@ -116,13 +117,25 @@ from raft_tpu.observability.profiler import (
     get_profiler,
     set_profiler,
 )
+from raft_tpu.observability.quality import (
+    ShadowSampler,
+    quality_block,
+    quality_enabled,
+    recall_at_k,
+    record_certificate,
+    record_pending,
+)
 
 
 def reset() -> None:
-    """Clear the process-global registry (metrics AND events) and the
-    flight-recorder ring."""
+    """Clear the process-global registry (metrics AND events), the
+    flight-recorder ring, and any pending (undrained) quality
+    records."""
+    from raft_tpu.observability import quality as _quality
+
     get_registry().reset()
     get_flight_recorder().clear()
+    _quality.clear()
 
 
 __all__ = [
@@ -179,4 +192,11 @@ __all__ = [
     "Profiler",
     "get_profiler",
     "set_profiler",
+    "percentile",
+    "ShadowSampler",
+    "quality_block",
+    "quality_enabled",
+    "recall_at_k",
+    "record_certificate",
+    "record_pending",
 ]

@@ -127,7 +127,11 @@ def export_jsonl(registry: Optional[MetricsRegistry] = None,
 
 
 def summary_table(registry: Optional[MetricsRegistry] = None) -> str:
-    """Aligned human-readable metric table (histograms as count/mean/sum)."""
+    """Aligned human-readable metric table. Histograms render
+    count/mean/p50/p99/sum — the percentiles are bucket-interpolated
+    estimates (:meth:`~raft_tpu.observability.metrics.Histogram.
+    percentile`), so latency histograms are actually readable in a
+    ``statusz`` snapshot instead of just a sum/count pair."""
     reg = registry if registry is not None else get_registry()
     rows = []
     for metric in reg.collect():
@@ -135,8 +139,12 @@ def summary_table(registry: Optional[MetricsRegistry] = None) -> str:
         if isinstance(metric, Histogram):
             cnt = metric.count
             mean = metric.sum / cnt if cnt else 0.0
+            p50, p99 = metric.percentile(50), metric.percentile(99)
+            pct = (f" p50={p50:.6g} p99={p99:.6g}"
+                   if p50 is not None else " p50=- p99=-")
             rows.append((metric.name, label_s,
-                         f"count={cnt} mean={mean:.6g} sum={metric.sum:.6g}"))
+                         f"count={cnt} mean={mean:.6g}{pct} "
+                         f"sum={metric.sum:.6g}"))
         else:
             rows.append((metric.name, label_s, _fmt_value(metric.value)))
     if not rows:
@@ -153,7 +161,9 @@ def summary_table(registry: Optional[MetricsRegistry] = None) -> str:
 
 #: event fields consumed by the trace-event envelope itself; everything
 #: else a flight event carries rides in Perfetto's ``args`` pane.
-_PERFETTO_ENVELOPE = ("kind", "name", "ph", "ts", "dur", "lane")
+#: ``flow_id`` becomes the trace event's ``id`` (flow binding key).
+_PERFETTO_ENVELOPE = ("kind", "name", "ph", "ts", "dur", "lane",
+                      "flow_id")
 
 
 def export_perfetto(recorder=None) -> Dict:
@@ -192,6 +202,14 @@ def export_perfetto(recorder=None) -> Dict:
             te["dur"] = max(float(ev.get("dur", 0.0)), 0.0) * 1e6
         elif ph == "i":
             te["s"] = "t"          # instant scoped to its thread track
+        elif ph in ("s", "t", "f"):
+            # flow events bind on (cat, name, id): the per-request
+            # journey (enqueue → batch → dispatch → response) renders
+            # as one connected arrow chain across lanes
+            te["id"] = str(int(ev.get("flow_id", 0)))
+            if ph == "f":
+                te["bp"] = "e"     # bind the terminus to the enclosing
+                #                    slice, Chrome's recommended mode
         args = {k: v for k, v in ev.items()
                 if k not in _PERFETTO_ENVELOPE and v is not None}
         if args:

@@ -69,6 +69,8 @@ KNOWN_EVENT_KINDS = (
     "drift",         # model-vs-measured drift ledger records
     "marker",        # free-form instants (benchmark phases etc.)
     "serving",       # serving engine: enqueue/flush/shed/swap/warmup
+    "quality",       # certificate failures / fixups / q8 reruns
+    "flow",          # per-request Perfetto flow points (ph s/t/f)
 )
 
 #: events attached to DeviceError/DeadlineExceededError payloads

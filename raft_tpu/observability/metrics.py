@@ -58,6 +58,30 @@ def _label_key(labels: LabelDict) -> _LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def percentile(values: Iterable[float], q: float) -> float:
+    """Linear-interpolated percentile of raw samples (numpy's default
+    "linear" method: rank = (n−1)·q/100, interpolate between the two
+    neighboring order statistics).
+
+    THE shared implementation: ``ServingEngine.snapshot_stats`` and the
+    benchmarks use this instead of the old ``min(len−1, int(n·0.99))``
+    index pick (which reported the 99.6th percentile at n=250 and the
+    max at n<100). ``tools/bench_report.py`` carries a mirror (that
+    tool stays raft_tpu-import-free); tests pin the two equal."""
+    vs = sorted(float(v) for v in values)
+    if not vs:
+        raise ValueError("percentile: empty sample set")
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"percentile: q={q} outside [0, 100]")
+    if len(vs) == 1:
+        return vs[0]
+    rank = (len(vs) - 1) * (q / 100.0)
+    lo = int(math.floor(rank))
+    hi = min(lo + 1, len(vs) - 1)
+    frac = rank - lo
+    return vs[lo] + (vs[hi] - vs[lo]) * frac
+
+
 class Counter:
     """Monotonically increasing value. (Prometheus counter semantics.)"""
 
@@ -171,6 +195,30 @@ class Histogram:
                 out.append(acc)
             return out
 
+    def percentile(self, q: float) -> Optional[float]:
+        """Estimated q-th percentile from the bucket counts (the
+        ``histogram_quantile`` method: find the bucket holding the
+        target rank, interpolate linearly inside it; the first bucket's
+        lower edge is 0 for non-negative bounds, observations past the
+        last finite bound report that bound). None when empty —
+        exporters render a dash instead of a fake zero."""
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"percentile: q={q} outside [0, 100]")
+        cum = self.cumulative_counts()
+        total = cum[-1]
+        if total == 0:
+            return None
+        rank = (q / 100.0) * total
+        for i, b in enumerate(self.buckets):
+            if cum[i] >= rank:
+                lo = (self.buckets[i - 1] if i > 0
+                      else min(0.0, b))
+                prev = cum[i - 1] if i > 0 else 0
+                in_bucket = cum[i] - prev
+                frac = ((rank - prev) / in_bucket) if in_bucket else 1.0
+                return lo + (b - lo) * frac
+        return self.buckets[-1]   # +Inf bucket: clamp to the last bound
+
 
 class _NullMetric:
     """Shared do-nothing metric returned by a disabled registry.
@@ -205,6 +253,9 @@ class _NullMetric:
     @property
     def sum(self) -> float:
         return 0.0
+
+    def percentile(self, q: float) -> Optional[float]:
+        return None
 
 
 NULL_METRIC = _NullMetric()

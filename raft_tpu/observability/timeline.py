@@ -175,6 +175,38 @@ def emit_marker(name: str, **args) -> None:
     rec.record("marker", name, **args)
 
 
+def emit_quality(site: str, **args) -> None:
+    """One quality-plane incident (``quality`` kind): a nonzero batch
+    of certificate failures, the fixup tier that absorbed them, or an
+    IVF q8 exact-scan rerun — result-quality anomalies land on the same
+    timeline as the perf events around them (emitted by
+    :mod:`raft_tpu.observability.quality`)."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("quality", site, **args)
+
+
+def emit_flow(step: str, rid: int, ph: str = "t",
+              outcome: Optional[str] = None, **args) -> None:
+    """One per-request flow point (``flow`` kind, Chrome flow-event
+    phases): ``ph="s"`` starts request ``rid``'s flow at enqueue,
+    ``ph="t"`` steps it through batch assembly / dispatch / requeue on
+    the batcher thread, ``ph="f"`` terminates it at completion.
+    ``outcome`` annotates the terminus (``ok`` / ``shed`` / ``expired``
+    / ``deadline`` / ``reject`` / ``error``). All points share the
+    constant event name — Chrome binds flows on (cat, name, id), so
+    one request renders as one connected arrow chain across lanes; the
+    step label rides in args."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    if outcome is not None:
+        args["outcome"] = outcome
+    rec.record("flow", "request", ph=ph, flow_id=int(rid), step=step,
+               **args)
+
+
 def emit_serving(event: str, **args) -> None:
     """One serving-engine lifecycle event (``serving`` kind). ``event``
     names the step — ``enqueue`` (request admitted, with queue depth),

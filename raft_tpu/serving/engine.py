@@ -36,7 +36,27 @@ labeled counter through the MetricsRegistry — the evidence surface
 ``benchmarks/bench_serving.py`` turns into the ``BENCH_SERVING.json``
 SLO artifact.
 
-Env knobs (see README "Serving & SLO workflow"):
+Quality plane (ISSUE 10):
+
+- **per-request flow tracing** — every admitted request gets a
+  monotonic id at enqueue and emits Perfetto flow points
+  (:func:`~raft_tpu.observability.timeline.emit_flow`): ``s`` on the
+  client thread at enqueue, ``t`` steps through batch assembly and
+  dispatch on the batcher thread, ``f`` at the terminus — so one
+  request renders as ONE connected flow across lanes in the trace, and
+  shed / queue-expiry / requeue / deadline outcomes annotate the
+  terminus instead of vanishing into counters.
+- **online recall shadow-sampling** — a configurable fraction of live
+  requests (``RAFT_TPU_SERVING_SHADOW_FRAC`` or ``shadow_frac=``) is
+  re-scored against the exact brute-force oracle on a background
+  thread (:class:`~raft_tpu.observability.quality.ShadowSampler`);
+  the rolling recall@k gauge plus a ``drift`` flight event below the
+  floor is the ONLINE counterpart of the offline ANN recall gate — an
+  index swap or a bad ``RAFT_TPU_ANN_NPROBES`` can no longer silently
+  degrade answers between benchmark rounds.
+
+Env knobs (see README "Serving & SLO workflow" + "Quality telemetry
+& request tracing"):
 
 - ``RAFT_TPU_SERVING_BUCKETS``   — bucket ladder (buckets.py)
 - ``RAFT_TPU_SERVING_FLUSH_MS``  — flush window for a partial batch
@@ -46,6 +66,8 @@ Env knobs (see README "Serving & SLO workflow"):
   admission sheds (default 4096)
 - ``RAFT_TPU_SERVING_DEADLINE_S`` — default per-request deadline
   budget (unset = requests carry no deadline unless submitted with one)
+- ``RAFT_TPU_SERVING_SHADOW_FRAC`` / ``RAFT_TPU_SERVING_SHADOW_FLOOR``
+  — shadow-sampling fraction (0 = off) and recall floor (0.95)
 """
 
 from __future__ import annotations
@@ -63,7 +85,11 @@ from raft_tpu.core.error import (DeadlineExceededError, LogicError,
                                  RaftException, expects)
 from raft_tpu.core.resources import ensure_resources
 from raft_tpu.observability import instrument
-from raft_tpu.observability.timeline import emit_serving
+from raft_tpu.observability.metrics import percentile
+from raft_tpu.observability.quality import (ShadowSampler,
+                                            shadow_floor_default,
+                                            shadow_frac_default)
+from raft_tpu.observability.timeline import emit_flow, emit_serving
 from raft_tpu.resilience import deadline, fault_point, record_degradation
 from raft_tpu.serving.buckets import bucket_for, bucket_ladder
 from raft_tpu.serving.snapshot import IndexSnapshot, SnapshotStore
@@ -140,15 +166,17 @@ class ServingFuture:
 
 class _Request:
     __slots__ = ("x", "n", "enqueued_at", "deadline_at", "future",
-                 "requeues")
+                 "requeues", "rid")
 
-    def __init__(self, x, n, enqueued_at, deadline_at, future):
+    def __init__(self, x, n, enqueued_at, deadline_at, future,
+                 rid=0):
         self.x = x
         self.n = n
         self.enqueued_at = enqueued_at
         self.deadline_at = deadline_at
         self.future = future
         self.requeues = 0
+        self.rid = rid          # monotonic flow-trace id (enqueue order)
 
 
 @instrument("serving.execute_batch")
@@ -230,6 +258,8 @@ class ServingEngine:
                  n_lists: Optional[int] = None,
                  n_probes: Optional[int] = None,
                  db_dtype: Optional[str] = None,
+                 shadow_frac: Optional[float] = None,
+                 shadow_floor: Optional[float] = None,
                  clock=time.monotonic):
         from raft_tpu.ann import IvfFlatIndex
         from raft_tpu.distance.knn_fused import KnnIndex
@@ -325,6 +355,15 @@ class ServingEngine:
         self._latencies: collections.deque = collections.deque(
             maxlen=4096)
         self._stats = collections.Counter()
+        self._next_rid = 0       # per-request flow-trace ids
+        # online recall shadow-sampling (ISSUE 10): frac 0 = off;
+        # constructor args win, env sets the fleet default
+        self._shadow_frac = (shadow_frac_default() if shadow_frac is None
+                             else max(0.0, min(1.0, float(shadow_frac))))
+        self._shadow_floor = (shadow_floor_default()
+                              if shadow_floor is None
+                              else float(shadow_floor))
+        self._shadow: Optional[ShadowSampler] = None
 
     # -- construction helpers --------------------------------------------
     def _build_index(self, y):
@@ -383,6 +422,10 @@ class ServingEngine:
             self._started = True
             self._stop = False
         self._warm_snapshot(self._store.current())
+        if self._shadow_frac > 0.0 and self._shadow is None:
+            self._shadow = ShadowSampler(
+                self._shadow_oracle, self.k, self._shadow_frac,
+                floor=self._shadow_floor).start()
         self._thread = threading.Thread(target=self._loop,
                                         name="serving-batcher",
                                         daemon=True)
@@ -390,7 +433,8 @@ class ServingEngine:
         return self
 
     def stop(self, timeout: float = 30.0) -> None:
-        """Drain the queue, then stop the batcher."""
+        """Drain the queue, then stop the batcher (and the shadow
+        scorer, after it drains its own queue)."""
         with self._cond:
             self._stop = True
             self._cond.notify_all()
@@ -398,8 +442,31 @@ class ServingEngine:
         if t is not None:
             t.join(timeout)
         self._thread = None
+        if self._shadow is not None:
+            self._shadow.flush(timeout=min(10.0, timeout))
+            self._shadow.stop()
         with self._cond:
             self._started = False
+
+    def _shadow_oracle(self, x):
+        """The exact reference plane the shadow sampler re-scores
+        against: brute-force certified KNN over the CURRENT snapshot
+        (for the IVF plane, the degenerate ``n_probes = n_lists`` exact
+        search — bit-for-bit the brute oracle over the same rows). Runs
+        on the shadow thread, never on the serving path."""
+        snap = self._store.current()
+        if self._algorithm == "ivf_flat":
+            from raft_tpu.ann import search_ivf_flat
+
+            return search_ivf_flat(self.res, snap.index, x, self.k,
+                                   n_probes=snap.index.n_lists)
+        from raft_tpu.distance.knn_fused import knn_fused
+
+        return knn_fused(x, snap.index, self.k)
+
+    @property
+    def shadow(self) -> Optional[ShadowSampler]:
+        return self._shadow
 
     def _warm_snapshot(self, snap: IndexSnapshot) -> None:
         """Pre-compile every bucket shape against ``snap`` — run at
@@ -438,9 +505,17 @@ class ServingEngine:
             fut._complete(np.zeros((0, self.k), np.float32),
                           np.zeros((0, self.k), np.int32))
             return fut
+        # flow trace: the request's journey starts HERE (client
+        # thread); every admission outcome terminates the same flow id
+        with self._cond:
+            self._next_rid += 1
+            rid = self._next_rid
+        emit_flow("enqueue", rid, ph="s", rows=n)
         if n > self._ladder[-1]:
             self._count_request("rejected")
-            emit_serving("reject", rows=n, top_bucket=self._ladder[-1])
+            emit_serving("reject", rows=n, top_bucket=self._ladder[-1],
+                         rid=rid)
+            emit_flow("reject", rid, ph="f", outcome="reject")
             raise RequestTooLargeError(
                 f"serving: request of {n} rows exceeds the largest "
                 f"bucket {self._ladder[-1]} — split it client-side or "
@@ -450,7 +525,7 @@ class ServingEngine:
                   else self._default_deadline_s)
         req = _Request(x, n, now,
                        now + budget if budget else None,
-                       ServingFuture())
+                       ServingFuture(), rid=rid)
         with self._cond:
             if self._depth_rows + n > self._max_queue_rows:
                 self._count_request("shed")
@@ -463,7 +538,8 @@ class ServingEngine:
                     pass
                 record_degradation("serving.engine", "shed:overload")
                 emit_serving("shed", rows=n,
-                             queue_rows=self._depth_rows)
+                             queue_rows=self._depth_rows, rid=rid)
+                emit_flow("shed", rid, ph="f", outcome="shed")
                 raise OverloadShedError(
                     f"serving: queue at capacity "
                     f"({self._depth_rows}/{self._max_queue_rows} rows)"
@@ -473,7 +549,7 @@ class ServingEngine:
             self._gauge_depth()
             emit_serving("enqueue", rows=n,
                          queue_rows=self._depth_rows,
-                         deadline_s=budget)
+                         deadline_s=budget, rid=rid)
             self._cond.notify_all()
         return req.future
 
@@ -548,19 +624,26 @@ class ServingEngine:
 
     def stats(self) -> dict:
         """Live counters + latency percentiles (engine-side; the
-        BENCH_SERVING artifact measures client-side)."""
+        BENCH_SERVING artifact measures client-side). Percentiles use
+        the shared interpolating :func:`~raft_tpu.observability.
+        metrics.percentile` (the old index pick reported the max for
+        small windows)."""
         with self._cond:
             out = dict(self._stats)
             out["queue_rows"] = self._depth_rows
-        lat = sorted(self._latencies)
+            lat = list(self._latencies)
         if lat:
-            out["p50_ms"] = 1e3 * lat[len(lat) // 2]
-            out["p99_ms"] = 1e3 * lat[min(len(lat) - 1,
-                                          int(len(lat) * 0.99))]
+            out["p50_ms"] = 1e3 * percentile(lat, 50)
+            out["p99_ms"] = 1e3 * percentile(lat, 99)
         out["generation"] = self._store.generation
         out["compile_misses"] = self.res.compile_cache.misses
         out["buckets"] = self._ladder
+        if self._shadow is not None:
+            out.update(self._shadow.snapshot())
         return out
+
+    # the name the quality-telemetry plane documents; same snapshot
+    snapshot_stats = stats
 
     # -- the batcher ------------------------------------------------------
     def flush(self, timeout: float = 30.0) -> bool:
@@ -607,6 +690,7 @@ class ServingEngine:
         for req in expired:
             self._count_request("deadline")
             self._stats["expired_in_queue"] += 1
+            emit_flow("expire", req.rid, ph="f", outcome="expired")
             req.future._fail(DeadlineExceededError(
                 "serving: request deadline expired while queued",
                 seconds=(req.deadline_at - req.enqueued_at
@@ -669,6 +753,12 @@ class ServingEngine:
             return self._run_batch(batch, sum(r.n for r in batch))
         self._stats["batches"] += 1
         self._stats["padded_rows"] += bucket - total
+        # flow trace: each rider steps onto the batcher thread (batch
+        # assembly), then through the dispatch — the t points connect
+        # the client-thread `s` to the terminus across lanes
+        for req in batch:
+            emit_flow("batch", req.rid, ph="t", bucket=bucket,
+                      riders=len(batch))
         try:
             self.res.metrics.counter(
                 BATCHES, {"bucket": str(bucket)},
@@ -679,6 +769,9 @@ class ServingEngine:
             ).inc(bucket - total)
         except Exception:
             pass
+        for req in batch:
+            emit_flow("dispatch", req.rid, ph="t",
+                      generation=snap.generation)
         try:
             vals, ids = execute_batch(self._plane, snap, x, bucket,
                                       total, budget)
@@ -688,6 +781,7 @@ class ServingEngine:
         except Exception as e:
             for req in batch:
                 self._count_request("error")
+                emit_flow("fail", req.rid, ph="f", outcome="error")
                 req.future._fail(e)
             return
         off = 0
@@ -695,6 +789,13 @@ class ServingEngine:
         for req in batch:
             req.future._complete(vals[off:off + req.n],
                                  ids[off:off + req.n])
+            emit_flow("response", req.rid, ph="f", outcome="ok")
+            if self._shadow is not None and self._shadow.want(req.rid):
+                # off the hot path: queue (request, served ids) for the
+                # background oracle re-score; a full shadow queue drops
+                # the sample, never blocks the batcher
+                self._shadow.submit(req.rid, req.x,
+                                    np.asarray(ids[off:off + req.n]))
             off += req.n
             self._count_request("ok")
             self._observe_latency(max(0.0, done - req.enqueued_at))
@@ -710,12 +811,16 @@ class ServingEngine:
         for req in batch:
             if req.deadline_at is not None and req.deadline_at <= now:
                 self._count_request("deadline")
+                emit_flow("fail", req.rid, ph="f", outcome="deadline")
                 req.future._fail(err)
             elif req.requeues >= _MAX_REQUEUES:
                 self._count_request("error")
+                emit_flow("fail", req.rid, ph="f", outcome="error")
                 req.future._fail(err)
             else:
                 req.requeues += 1
+                emit_flow("requeue", req.rid, ph="t",
+                          outcome="requeue", attempt=req.requeues)
                 requeue.append(req)
         if requeue:
             self._stats["requeued"] += len(requeue)

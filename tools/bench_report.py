@@ -91,6 +91,31 @@ PASS, REGRESS, MISSING_BASELINE, SKIP = ("pass", "regress",
 #: id-parity flag must hold
 QUANTIZED_RATIO_CEIL = 0.55
 
+#: quality-telemetry gate: any recall a ``quality`` block carries
+#: (online shadow recall, offline ANN recall) must reach this floor —
+#: the same 0.95 the ANN frontier gate enforces. Mirror of
+#: raft_tpu.observability.quality.DEFAULT_SHADOW_FLOOR (this tool
+#: stays raft_tpu-import-free); tests pin the two equal.
+QUALITY_RECALL_FLOOR = 0.95
+
+
+def percentile(values, q: float) -> float:
+    """Linear-interpolated percentile (numpy's default method). Mirror
+    of ``raft_tpu.observability.metrics.percentile`` — this tool stays
+    raft_tpu-import-free, so the implementation is duplicated and
+    tests/test_quality.py pins the two equal on random data."""
+    vs = sorted(float(v) for v in values)
+    if not vs:
+        raise ValueError("percentile: empty sample set")
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"percentile: q={q} outside [0, 100]")
+    if len(vs) == 1:
+        return vs[0]
+    rank = (len(vs) - 1) * (q / 100.0)
+    lo = int(rank)
+    hi = min(lo + 1, len(vs) - 1)
+    return vs[lo] + (vs[hi] - vs[lo]) * (rank - lo)
+
 
 def load_record(path: str) -> Optional[Dict]:
     """Flat benchmark record from a BENCH artifact: unwraps the driver's
@@ -393,6 +418,14 @@ def serving_trajectory(rounds: Sequence[Tuple[int, str,
     lines.append("  ".join("-" * w for w in widths))
     for r in rows:
         lines.append("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+    p99s = [rec["p99_ms"] for _, _, rec in rounds
+            if rec is not None
+            and isinstance(rec.get("p99_ms"), (int, float))]
+    if p99s:
+        lines.append(
+            f"p99 across rounds: median {percentile(p99s, 50):.4g} ms, "
+            f"p90 {percentile(p99s, 90):.4g} ms over {len(p99s)} "
+            f"round(s)")
     return "\n".join(lines) + "\n"
 
 
@@ -910,6 +943,54 @@ def check_quantized(records: Sequence[Tuple[str, Optional[Dict]]],
                   + f" ≤ {ceil:g}, id-parity ok" + note)
 
 
+def check_quality(records: Sequence[Tuple[str, Optional[Dict]]],
+                  floor: float = QUALITY_RECALL_FLOOR
+                  ) -> Tuple[str, str]:
+    """Gate the quality-telemetry evidence across artifact families.
+
+    ``records`` is [(family, newest record)]. Each record that carries
+    a ``"quality"`` block must have a numeric ``fixup_rate`` (the
+    certificate/fixup counters actually flowed — a block without it
+    means the telemetry plane silently broke), and any recall the
+    block carries (``shadow_recall`` from the online sampler,
+    ``offline_recall`` from the ANN frontier) must reach ``floor``.
+    Families without a block are noted; when NO family carries one the
+    gate SKIPs (pass-or-no-op — pre-quality artifact sets). Quality is
+    platform-independent math, so modeled rounds gate too — only
+    SPEED is ever measured-only."""
+    checked, missing = [], []
+    for family, rec in records:
+        q = rec.get("quality") if isinstance(rec, dict) else None
+        if not isinstance(q, dict):
+            missing.append(family)
+            continue
+        if not isinstance(q.get("fixup_rate"), (int, float)):
+            return REGRESS, (
+                f"QUALITY REGRESSION [{family}]: quality block carries "
+                f"no fixup_rate — the certificate/fixup counters "
+                f"stopped flowing into the artifact")
+        notes = [f"fixup_rate={q['fixup_rate']:g}"]
+        for key in ("shadow_recall", "offline_recall"):
+            r = q.get(key)
+            if r is None:
+                continue
+            if not isinstance(r, (int, float)):
+                return REGRESS, (
+                    f"QUALITY REGRESSION [{family}]: {key} is "
+                    f"non-numeric ({r!r})")
+            if r < floor:
+                return REGRESS, (
+                    f"QUALITY REGRESSION [{family}]: {key} "
+                    f"{r:.4f} < floor {floor:g} — served answers "
+                    f"degraded below the gated recall")
+            notes.append(f"{key}={r:.4f}")
+        checked.append(f"{family}({', '.join(notes)})")
+    if not checked:
+        return SKIP, "no artifact carries a quality block — not gated"
+    note = f" (no block: {', '.join(missing)})" if missing else ""
+    return PASS, "quality ok: " + "; ".join(checked) + note
+
+
 def staleness_section(entries: List[Dict]) -> str:
     lines = ["named artifacts (freshness vs the last-good commit)",
              "---------------------------------------------------"]
@@ -984,6 +1065,14 @@ def main(argv: Sequence[str] = None) -> int:
             [("bench", candidate), ("multichip", newest_m),
              ("ann", newest_a)])
         print(f"bench_report --check [quantized]: {qstatus}: {qmsg}")
+        # quality: every family's newest artifact — blocks are stamped
+        # by benchmark.Fixture.run / the bench writers (ISSUE 10)
+        newest_s = next((rec for _, _, rec in reversed(srounds)
+                         if rec is not None), None)
+        qlstatus, qlmsg = check_quality(
+            [("bench", candidate), ("multichip", newest_m),
+             ("serving", newest_s), ("ann", newest_a)])
+        print(f"bench_report --check [quality]: {qlstatus}: {qlmsg}")
         ledger_path = args.drift_ledger or os.path.join(
             args.dir, DRIFT_LEDGER_NAME)
         dstatus, dmsg = check_drift(load_drift_ledger(ledger_path),
@@ -997,7 +1086,8 @@ def main(argv: Sequence[str] = None) -> int:
         # regression in ANY trend fails; missing baseline only when
         # nothing regressed
         rcs = (codes[status], codes[mstatus], codes[sstatus],
-               codes[astatus], codes[qstatus], codes[dstatus])
+               codes[astatus], codes[qstatus], codes[qlstatus],
+               codes[dstatus])
         return 1 if 1 in rcs else max(rcs)
 
     if args.json:

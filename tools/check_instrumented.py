@@ -148,6 +148,12 @@ EMITTER_KINDS: Dict[str, str] = {
     "record_drift": "drift",
     "emit_marker": "marker",
     "emit_serving": "serving",
+    "emit_quality": "quality",
+    "emit_flow": "flow",
+    # quality-plane recorders: both route nonzero failure batches
+    # through emit_quality (observability/quality.py)
+    "record_certificate": "quality",
+    "record_pending": "quality",
 }
 
 EVENT_SITES: Dict[str, Sequence[str]] = {
@@ -175,7 +181,7 @@ EVENT_SITES: Dict[str, Sequence[str]] = {
     # appear here (enforced structurally by check_serving_coverage) —
     # enqueue/flush/shed/swap/warmup all flow through emit_serving
     "raft_tpu/serving/engine.py": ("instrument", "fault_point",
-                                   "emit_serving"),
+                                   "emit_serving", "emit_flow"),
     "raft_tpu/serving/snapshot.py": ("instrument", "fault_point",
                                      "emit_serving"),
     "raft_tpu/serving/buckets.py": ("emit_marker",),
@@ -188,7 +194,27 @@ EVENT_SITES: Dict[str, Sequence[str]] = {
     # the quantized index build: the quantize_index marker (per-build
     # Eq stats) rides next to the span + fault events
     "raft_tpu/distance/knn_fused.py": ("instrument", "fault_point",
-                                       "emit_marker"),
+                                       "emit_marker", "record_pending"),
+    # the quality plane itself: its recorders must still route through
+    # the flight emitter (deleting the bridge would silently empty the
+    # quality timeline while every call site keeps "recording")
+    "raft_tpu/observability/quality.py": ("emit_quality",),
+}
+
+#: quality-telemetry gate (ISSUE 10): every module with a certificate /
+#: fixup / rescore path must report into the quality plane — a
+#: certified result path that silently stops counting its fixups is
+#: exactly the evidence regression ROADMAP item 2 cannot afford (the
+#: measured TPU fixup rate decides per-query Eq tightening). Each
+#: module must reference the listed observability.quality recorders.
+QUALITY_SITES: Dict[str, Sequence[str]] = {
+    "raft_tpu/distance/knn_fused.py": ("record_pending",),
+    "raft_tpu/distance/knn_sharded.py": ("record_pending",),
+    "raft_tpu/ann/ivf_flat.py": ("record_certificate",
+                                 "record_pending"),
+    "raft_tpu/runtime/entry_points.py": ("record_pending",),
+    # the serving engine's quality surface is the shadow sampler
+    "raft_tpu/serving/engine.py": ("ShadowSampler",),
 }
 
 _FLIGHT_MODULE = "raft_tpu/observability/flight.py"
@@ -490,6 +516,33 @@ def check_sharded_merge(root: str = _REPO_ROOT,
     return errors
 
 
+def check_quality_sites(root: str = _REPO_ROOT,
+                        sites: Dict[str, Sequence[str]] = None
+                        ) -> List[str]:
+    """Violations for :data:`QUALITY_SITES` (empty = clean): every
+    certificate/fixup/rescore module must reference its quality
+    recorders — the static guarantee that fixup-rate evidence keeps
+    flowing into the ``quality`` artifact blocks ``bench_report
+    --check`` gates."""
+    sites = QUALITY_SITES if sites is None else sites
+    errors: List[str] = []
+    for rel, names in sorted(sites.items()):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            errors.append(f"{rel}: quality-site module missing")
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        referenced = _referenced_names(tree)
+        for name in names:
+            if name not in referenced:
+                errors.append(
+                    f"{rel}: no reference to quality recorder "
+                    f"{name!r} — certificate/fixup telemetry would "
+                    f"silently stop flowing (observability/quality.py)")
+    return errors
+
+
 _SERVING_DIR = "raft_tpu/serving"
 
 
@@ -559,6 +612,7 @@ def check(root: str = _REPO_ROOT,
         errors.extend(check_fault_sites(root))
         errors.extend(check_event_sites(root))
         errors.extend(check_serving_coverage(root))
+        errors.extend(check_quality_sites(root))
     return errors
 
 
@@ -579,7 +633,8 @@ def main(argv: Sequence[str] = ()) -> int:
               f"{len(COUNTED_COLLECTIVES)} counted collectives; "
               f"{sum(len(v) for v in FAULT_SITES.values())} fault-"
               f"injection sites in {len(FAULT_SITES)} modules; "
-              f"{len(EVENT_SITES)} timeline-event-emitting modules")
+              f"{len(EVENT_SITES)} timeline-event-emitting modules; "
+              f"{len(QUALITY_SITES)} quality-telemetry modules")
     return 1 if errors else 0
 
 

@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""statusz — one-call operator health snapshot of a raft_tpu process.
+
+Renders, from the live in-process observability surfaces, the page an
+operator reads FIRST when paged:
+
+- **quality**: certificate fixup rates per site (twin-pool failures /
+  checks), q8 rescore-pool widths, IVF certificate-rerun counts, and
+  the online shadow recall gauge + breach count — the result-quality
+  plane (``raft_tpu.observability.quality``);
+- **latency**: p50/p99 of every ``*_seconds`` histogram in the
+  registry (bucket-interpolated) — serving request latency included;
+- **degradations**: the resilience ladder's step count — a nonzero
+  value means some hot path is running below its configured rung;
+- **flight tail**: the newest flight-recorder events, time-ordered —
+  the last thing that happened before you looked;
+- the full registry summary table for everything else.
+
+Import :func:`render_statusz` inside a serving process (tests and
+``benchmarks/bench_serving.py`` do), or run ``python tools/statusz.py
+--demo`` for a self-contained deterministic serving round followed by
+its own snapshot — the zero-to-evidence smoke an operator can run on
+any checkout without a TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import sys
+from typing import Optional, Sequence
+
+# runnable as a script from anywhere: the repo root precedes any
+# installed raft_tpu (same convention as benchmarks/_common.py)
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+_TAIL_DEFAULT = 16
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    return f"{v * 1e3:.3f} ms" if v < 10 else f"{v:.3f} s"
+
+
+def render_statusz(registry=None, recorder=None, engine=None,
+                   tail: int = _TAIL_DEFAULT) -> str:
+    """The health snapshot as one printable string. Never raises — a
+    broken subsystem renders as a note, not a crash (this page is what
+    you read WHILE things are broken)."""
+    from raft_tpu.observability import quality as q
+    from raft_tpu.observability.exporters import summary_table
+    from raft_tpu.observability.flight import get_flight_recorder
+    from raft_tpu.observability.metrics import Histogram, get_registry
+
+    reg = registry if registry is not None else get_registry()
+    rec = recorder if recorder is not None else get_flight_recorder()
+    out = io.StringIO()
+    out.write("raft_tpu statusz\n================\n\n")
+
+    # ---- quality plane ------------------------------------------------
+    out.write("quality (certificate / fixup / shadow recall)\n")
+    out.write("---------------------------------------------\n")
+    try:
+        block = q.quality_block(registry=reg)
+        if block is None:
+            out.write("(no quality telemetry recorded yet)\n")
+        else:
+            out.write(f"fixup_rate      {block['fixup_rate']:.6f}  "
+                      f"({block['certificate_fixups']} fixups / "
+                      f"{block['certificate_checks']} checks)\n")
+            for site, s in sorted(block.get("sites", {}).items()):
+                extra = (f" reruns={s['cert_reruns']}"
+                         if "cert_reruns" in s else "")
+                out.write(f"  {site:<32} rate={s['fixup_rate']:.6f} "
+                          f"fixups={s.get('fixups', 0)} "
+                          f"checks={s.get('checks', 0)}{extra}\n")
+            for site, p in sorted(
+                    block.get("rescore_pool_widths", {}).items()):
+                out.write(f"  {site:<32} rescore pool mean width "
+                          f"{p['mean']:g} over {p['count']} batch(es)\n")
+            if "shadow_recall" in block:
+                out.write(f"shadow recall   {block['shadow_recall']:.4f}"
+                          f" over {block.get('shadow_samples', 0)} "
+                          f"sample(s), "
+                          f"{block.get('shadow_breaches', 0)} "
+                          f"breach(es)\n")
+            else:
+                out.write("shadow recall   (sampler off — set "
+                          "RAFT_TPU_SERVING_SHADOW_FRAC)\n")
+    except Exception as e:
+        out.write(f"(quality section unavailable: {e})\n")
+
+    # ---- latency percentiles ------------------------------------------
+    out.write("\nlatency percentiles (registry histograms)\n")
+    out.write("-----------------------------------------\n")
+    try:
+        any_h = False
+        for metric in reg.collect():
+            if not isinstance(metric, Histogram) or not metric.count:
+                continue
+            if not metric.name.endswith("_seconds"):
+                continue
+            any_h = True
+            label_s = ",".join(f"{k}={v}" for k, v in
+                               sorted(metric.labels.items()))
+            name = metric.name + (f"{{{label_s}}}" if label_s else "")
+            out.write(f"  {name:<48} p50={_fmt_s(metric.percentile(50))}"
+                      f"  p99={_fmt_s(metric.percentile(99))}"
+                      f"  n={metric.count}\n")
+        if not any_h:
+            out.write("(no time histograms recorded yet)\n")
+    except Exception as e:
+        out.write(f"(latency section unavailable: {e})\n")
+
+    # ---- engine + degradations ----------------------------------------
+    if engine is not None:
+        out.write("\nserving engine\n--------------\n")
+        try:
+            st = engine.snapshot_stats()
+            for key in ("queue_rows", "batches", "shed",
+                        "expired_in_queue", "requeued", "p50_ms",
+                        "p99_ms", "shadow_recall", "shadow_samples",
+                        "generation", "compile_misses"):
+                if key in st and st[key] is not None:
+                    v = st[key]
+                    out.write(f"  {key:<18} "
+                              f"{v:.4f}\n" if isinstance(v, float)
+                              else f"  {key:<18} {v}\n")
+        except Exception as e:
+            out.write(f"(engine stats unavailable: {e})\n")
+    out.write("\ndegradations\n------------\n")
+    try:
+        from raft_tpu.resilience import degradation_count
+
+        out.write(f"resilience ladder steps this process: "
+                  f"{degradation_count()}\n")
+    except Exception as e:
+        out.write(f"(degradation count unavailable: {e})\n")
+
+    # ---- registry summary ---------------------------------------------
+    out.write("\nmetrics registry\n----------------\n")
+    try:
+        out.write(summary_table(reg))
+    except Exception as e:
+        out.write(f"(registry summary unavailable: {e})\n")
+
+    # ---- flight tail ---------------------------------------------------
+    out.write(f"\nflight tail (newest {tail} events)\n")
+    out.write("----------------------------------\n")
+    try:
+        events = rec.tail(tail)
+        if not events:
+            out.write("(flight recorder empty)\n")
+        for ev in events:
+            extra = ev.get("step") or ev.get("action") or \
+                ev.get("event") or ""
+            out.write(f"  {ev.get('ts', 0.0):>12.6f}  "
+                      f"{ev.get('kind', '?'):<11} "
+                      f"{str(ev.get('name', '?')):<28} "
+                      f"lane={ev.get('lane', '-')}"
+                      + (f" [{extra}]" if extra else "") + "\n")
+    except Exception as e:
+        out.write(f"(flight tail unavailable: {e})\n")
+    return out.getvalue()
+
+
+def _demo_round() -> "object":
+    """A tiny deterministic serving round (CPU-sized) so a bare
+    checkout produces a populated statusz page: brute engine, shadow
+    sampling at 100%, a handful of ragged requests."""
+    import numpy as np
+
+    from raft_tpu.distance.knn_fused import prepare_knn_index
+    from raft_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(0)
+    y = rng.normal(size=(2048, 32)).astype(np.float32)
+    idx = prepare_knn_index(y, passes=3, T=256, Qb=32, g=2)
+    eng = ServingEngine(idx, k=8, buckets=(8, 16),
+                        flush_interval_s=0.002, shadow_frac=1.0)
+    eng.start()
+    futs = [eng.submit(rng.normal(size=(n, 32)).astype(np.float32))
+            for n in (1, 4, 8, 3, 6)]
+    eng.flush()
+    for f in futs:
+        f.result(timeout=60)
+    if eng.shadow is not None:
+        eng.shadow.flush()
+    return eng
+
+
+def main(argv: Sequence[str] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--demo", action="store_true",
+                   help="run a deterministic CPU serving round first, "
+                        "then render its snapshot")
+    p.add_argument("--tail", type=int, default=_TAIL_DEFAULT,
+                   help="flight-tail length")
+    args = p.parse_args(argv)
+
+    engine = None
+    if args.demo:
+        engine = _demo_round()
+    sys.stdout.write(render_statusz(engine=engine, tail=args.tail))
+    if engine is not None:
+        engine.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
