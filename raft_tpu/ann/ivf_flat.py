@@ -54,7 +54,6 @@ scan's XLA cost is captured through ``res.profiler.capture_fn``.
 
 from __future__ import annotations
 
-import math
 from functools import partial
 from typing import Optional, Tuple
 
@@ -180,6 +179,22 @@ class IvfFlatIndex:
                 f"slab_rows={self.slab_rows}, "
                 f"window={self.probe_window})")
 
+    def layout(self):
+        """This index's slab as the shared explicit
+        :class:`~raft_tpu.mutable.layout.IndexLayout` struct — the
+        degenerate-exact plane, the mutable subsystem and the brute
+        plane all drive the same pure ops over it."""
+        from raft_tpu.mutable.layout import IndexLayout
+
+        return IndexLayout(
+            self.slab, self.ids, np.asarray(self.ids) >= 0,
+            n_rows=self.n_rows, d_orig=self.d_orig,
+            offsets=self._np_offsets, sizes=self._np_sizes,
+            padded_sizes=self._np_padded, row_quantum=self.row_quantum,
+            db_dtype=self.db_dtype if self.db_dtype == "int8" else "f32",
+            slab_q=self.slab_q, row_scale=self.row_scale,
+            eq_rows=self.eq_rows)
+
 
 @instrument("ann.build_ivf_flat")
 def build_ivf_flat(res, y, n_lists: int, n_probes: Optional[int] = None,
@@ -231,25 +246,16 @@ def build_ivf_flat(res, y, n_lists: int, n_probes: Optional[int] = None,
                     balanced=balanced)
     labels = np.asarray(kmeans_predict(res, km.centroids, y))
 
-    # ---- host-side ragged layout ------------------------------------
-    sizes = np.bincount(labels, minlength=L).astype(np.int32)
-    padded = ((sizes + row_quantum - 1) // row_quantum
-              * row_quantum).astype(np.int32)
-    padded[sizes == 0] = 0                     # empty lists cost nothing
-    offsets = np.concatenate(
-        [[0], np.cumsum(padded, dtype=np.int64)]).astype(np.int32)
-    R = int(offsets[-1])
-    slab = np.zeros((R, d), np.float32)
-    ids = np.full(R, -1, np.int32)
-    order = np.argsort(labels, kind="stable")
-    sorted_labels = labels[order]
-    # rank of each row within its list (order is label-sorted, so the
-    # rank is position minus the first position of that label)
-    first = np.concatenate([[0], np.cumsum(sizes, dtype=np.int64)[:-1]])
-    rank = np.arange(m) - first[sorted_labels]
-    dest = offsets[sorted_labels] + rank
-    slab[dest] = y[order]
-    ids[dest] = order.astype(np.int32)
+    # ---- host-side ragged layout: the shared IndexLayout op (the
+    # mutable subsystem and this builder spell the padded ragged slab
+    # through ONE function — raft_tpu.mutable.layout) ----------------
+    from raft_tpu.mutable.layout import (quantize_layout,
+                                         ragged_layout_from_lists)
+
+    lay = ragged_layout_from_lists(y, labels, L, row_quantum)
+    sizes, padded, offsets = lay.sizes, lay.padded_sizes, lay.offsets
+    R = lay.slab_rows
+    slab, ids = lay.slab, lay.ids
 
     from raft_tpu.distance.knn_fused import fused_config
 
@@ -258,22 +264,12 @@ def build_ivf_flat(res, y, n_lists: int, n_probes: Optional[int] = None,
     q8_kw = {}
     if db_dtype == "int8":
         fault_point("quantize_index")
-        from raft_tpu.distance.knn_fused import (q8_eq_bound,
-                                                 quantize_rows_q8)
-
-        gid = jnp.asarray(np.repeat(np.arange(L, dtype=np.int32),
-                                    padded))
-        slab_j = jnp.asarray(slab)
-        valid = jnp.asarray(ids >= 0)
-        slab_q, list_scale = quantize_rows_q8(slab_j, gid, L,
-                                              valid=valid)
-        eq_lists = q8_eq_bound(list_scale, d)
-        row_scale = jnp.take(list_scale, gid)
-        deq = slab_q.astype(jnp.float32) * row_scale[:, None]
-        q8_kw = dict(db_dtype="int8", slab_q=slab_q,
-                     row_scale=row_scale,
+        lay = quantize_layout(lay)
+        deq = lay.slab_q.astype(jnp.float32) * lay.row_scale[:, None]
+        q8_kw = dict(db_dtype="int8", slab_q=lay.slab_q,
+                     row_scale=lay.row_scale,
                      yy_q=jnp.sum(deq * deq, axis=1),
-                     eq_rows=jnp.take(eq_lists, gid))
+                     eq_rows=lay.eq_rows)
     idx = IvfFlatIndex(
         centroids=km.centroids,
         slab=jnp.asarray(slab),
@@ -406,39 +402,20 @@ def _coarse_probe(res, centroids, x, n_probes: int):
 # ------------------------------------------------- exact degradation
 def _slab_fused_geometry(index: IvfFlatIndex):
     """Lazy certified-fused operands for the WHOLE slab with the ragged
-    ``rows_valid`` mask — the degenerate-exact data plane (and the one
-    consumer that exercises the ragged ``_prepare_ops`` path end to
-    end). Mirrors ``prepare_knn_index`` but forces the packed
-    query-major envelope the ragged mask requires."""
+    ``rows_valid`` mask — the degenerate-exact data plane. Re-expressed
+    over the shared layout ops (:func:`raft_tpu.mutable.layout.
+    fused_ops_for_layout` — ONE spelling of the packed ragged geometry
+    for this plane, the brute plane and the mutable subsystem); the
+    exact plane always prepares the f32 slab (it IS the rescore
+    source), whatever the index streams."""
     if index._fused_ops is not None:
         return index._fused_ops
-    from raft_tpu.distance.knn_fused import (_LANES, _PACK_BITS,
-                                             _PBITS_MAX, _prepare_ops,
-                                             auto_pack_bits, fit_config,
-                                             fused_config)
+    from raft_tpu.mutable.layout import fused_ops_for_layout
 
-    R, d = index.slab.shape
-    cfg = fused_config(3)
-    T, Qb = fit_config(cfg.T, cfg.Qb, d, 3, cfg.g, "query")
-    n_tiles_est = max(1, -(-R // T))
-    g = max(cfg.g, (1 << auto_pack_bits(n_tiles_est, T)) // (T // _LANES))
-    n_ch = T // _LANES
-    pbits = min(_PBITS_MAX, max(_PACK_BITS, int(math.ceil(math.log2(
-        max(g * n_ch, 2))))))
-    if g * n_ch > (1 << pbits):
-        g = max(1, (1 << pbits) // n_ch)   # ragged mask is packed-only
-    dpad = (-d) % _LANES
-    slab = index.slab
-    if dpad:
-        slab = jnp.concatenate(
-            [slab, jnp.zeros((R, dpad), jnp.float32)], axis=1)
-    valid = index.ids >= 0
-    ops = _prepare_ops(slab, T, g, "l2", pbits=pbits,
-                       grid_order="query", rows_valid=valid)
-    M = ops[0].shape[0]
-    rv = jnp.concatenate(
-        [valid, jnp.zeros((M - R,), jnp.bool_)]) if M > R else valid
-    index._fused_ops = (ops, rv, T, Qb, g, pbits)
+    fops = fused_ops_for_layout(index.layout(), passes=3, metric="l2",
+                                db_dtype=None)
+    index._fused_ops = (fops.ops, fops.rv, fops.T, fops.Qb, fops.g,
+                        fops.pbits)
     return index._fused_ops
 
 

@@ -687,9 +687,31 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
         # used the GLOBAL worst case (xx + 2·yymax)/2 — at clustered
         # 10M×256 scale that margin (~2× the true bound−θ gap) failed
         # the certificate for every query (measured).
+        # SENTINEL terms are excluded from the magnitude: a pool with
+        # fewer than C real rows (the mutable delta tail, tiny ragged
+        # slabs) puts the 2^125 never-wins pad in the C-th/Ca-th slot,
+        # and folding ITS magnitude into e_pack blew the margin to
+        # ~2^105 — every query failed into the fixup. Sound because a
+        # sentinel-valued term only ever appears inside bound's min()
+        # — either it is discarded by a finite term whose perturbation
+        # the finite magnitudes below already cover, or bound itself is
+        # sentinel-scale and exceeds θ + err by ~2^100 even after its
+        # own (≤ |v|·2^−10) perturbation.
+        def _real_half(v):
+            return jnp.where(v < _PACK_PAD * 0.25, jnp.abs(v), 0.0)
+
+        # the θ-slot magnitude stays UNMASKED: lite-mode θ is a cleaned
+        # packed value whose own perturbation must be covered, and the
+        # ascending order no longer bounds it by the (masked) C-th
+        # term. When the k-th slot IS a sentinel (< k real rows) the
+        # blown margin just forces the fixup θ = inf forces anyway.
         half_mag = jnp.maximum(
-            jnp.maximum(jnp.abs(cand_p[:, 0]), jnp.abs(cand_p[:, C - 1])),
-            jnp.maximum(jnp.abs(a3_half_min), jnp.abs(a1_sel[:, Ca - 1])))
+            jnp.maximum(_real_half(cand_p[:, 0]),
+                        _real_half(cand_p[:, C - 1])),
+            jnp.maximum(
+                jnp.maximum(_real_half(a3_half_min),
+                            _real_half(a1_sel[:, Ca - 1])),
+                jnp.abs(cand_p[:, k - 1])))
         e_pack = 8.0 * half_mag * 2.0 ** (pbits - 23)
     else:
         if d > _D_SINGLE_SHOT:
@@ -1338,7 +1360,8 @@ class KnnIndex:
                  T: int, Qb: int, g: int, passes: int, metric: str,
                  d_orig: int, pbits: int = _PACK_BITS,
                  grid_order: str = "query", db_dtype: str = "bf16",
-                 y_q=None, y_scale_k=None, eq_groups=None):
+                 y_q=None, y_scale_k=None, eq_groups=None,
+                 rows_valid=None, ids=None):
         # yp is the ROW-PADDED index; the original matrix is yp[:n_rows]
         # (NOT stored separately — at 1M×128 that would pin a redundant
         # ~512 MB f32 copy in HBM for the index lifetime)
@@ -1361,6 +1384,12 @@ class KnnIndex:
         self.y_q = y_q
         self.y_scale_k = y_scale_k
         self.eq_groups = eq_groups
+        # RAGGED layout state (built from an IndexLayout / rows_valid):
+        # the live-row mask over the PREPARED slab (pads may be
+        # interspersed anywhere — the PR-8 never-wins sentinel path)
+        # and the slab-position → global-id map queries decode through
+        self.rows_valid = rows_valid
+        self.ids = ids
 
     @property
     def stream_width(self) -> int:
@@ -1376,7 +1405,8 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
                       g: Optional[int] = None,
                       store_yp: bool = True,
                       grid_order: Optional[str] = None,
-                      db_dtype: str = "bf16") -> KnnIndex:
+                      db_dtype: str = "bf16",
+                      rows_valid=None, ids=None) -> KnnIndex:
     """Build a :class:`KnnIndex` for repeated queries against ``y``.
 
     ``store_yp=False`` builds a LITE index: the f32 row-padded matrix
@@ -1397,7 +1427,26 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
     Requires ``store_yp=True``; requests outside the packed
     database-major envelope downgrade to bf16 with a logged reason
     (RAFT_TPU_DB_DTYPE env sets the fleet-wide default at call sites
-    that pass none — see the serving engine)."""
+    that pass none — see the serving engine).
+
+    ``y`` may also be an :class:`~raft_tpu.mutable.layout.IndexLayout`
+    — the explicit slab struct the mutable subsystem shares with the
+    IVF plane — in which case its slab/ids/``rows_valid`` drive a
+    RAGGED build: pads (and tombstones) may be interspersed anywhere,
+    carried through the PR-8 never-wins sentinel path, and queries
+    decode slab positions back through ``ids``. Ragged builds force
+    the packed-code envelope (the unpacked kernels mask by prefix
+    count only). ``rows_valid``/``ids`` may equally be passed
+    directly with a raw matrix."""
+    try:
+        from raft_tpu.mutable.layout import IndexLayout
+
+        if isinstance(y, IndexLayout):
+            rows_valid = y.rows_valid if rows_valid is None else rows_valid
+            ids = y.ids if ids is None else ids
+            y = y.slab
+    except ImportError:
+        pass
     if metric not in ("l2", "ip"):
         raise ValueError(f"prepare_knn_index: metric must be 'l2' or "
                          f"'ip', got {metric!r}")
@@ -1433,6 +1482,10 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
 
     pbits = min(_PBITS_MAX, max(_PACK_BITS, int(math.ceil(math.log2(
         max(g * (T // _LANES), 2))))))
+    if rows_valid is not None and g * (T // _LANES) > (1 << pbits):
+        # the ragged mask rides the packed sentinel carrier only — the
+        # unpacked kernels prefix-mask in-kernel and cannot honor it
+        g = max(1, (1 << pbits) // (T // _LANES))
     # the database-major kernels are packed-only/single-shot-only:
     # resolve the EFFECTIVE order now so the index rows are padded for
     # the kernel that will actually run (a db-padded index serves the
@@ -1444,10 +1497,31 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
     dpad = (-d) % (_DC if d > _D_SINGLE_SHOT else _LANES)
     if dpad:
         y = jnp.concatenate([y, jnp.zeros((m, dpad), jnp.float32)], axis=1)
+    rv_in = (None if rows_valid is None
+             else jnp.asarray(rows_valid, jnp.bool_).reshape(-1))
+
+    def _ragged_state(M: int):
+        """(rows_valid, ids) padded to the PREPARED row count M."""
+        if rv_in is None:
+            return None, None
+        rv = rv_in
+        if M > rv.shape[0]:
+            rv = jnp.concatenate(
+                [rv, jnp.zeros((M - rv.shape[0],), jnp.bool_)])
+        id_map = None
+        if ids is not None:
+            id_map = jnp.asarray(ids, jnp.int32).reshape(-1)
+            if M > id_map.shape[0]:
+                id_map = jnp.concatenate(
+                    [id_map,
+                     jnp.full((M - id_map.shape[0],), -1, jnp.int32)])
+        return rv, id_map
+
     if db_dtype == "int8":
         fault_point("quantize_index")
         yp, y_q, scale_k, yyh_k, yy_raw, eq = _prepare_ops_q8(
-            y, T, g, metric, pbits=pbits, grid_order=grid_order)
+            y, T, g, metric, pbits=pbits, grid_order=grid_order,
+            rows_valid=rv_in)
         try:
             from raft_tpu.core.resources import ensure_resources
             from raft_tpu.observability.timeline import emit_marker
@@ -1461,19 +1535,24 @@ def prepare_knn_index(y, passes: int = 3, metric: str = "l2",
                 metric, pbits=pbits, grid_order=grid_order)
         except Exception:
             pass
+        rv, id_map = _ragged_state(yp.shape[0])
         return KnnIndex(yp, None, None, yyh_k, yy_raw, m, T, Qb, g,
                         passes, metric, d, pbits=pbits,
                         grid_order=grid_order, db_dtype="int8",
-                        y_q=y_q, y_scale_k=scale_k, eq_groups=eq)
+                        y_q=y_q, y_scale_k=scale_k, eq_groups=eq,
+                        rows_valid=rv, ids=id_map)
     yp, y_hi, y_lo, yyh_k, yy_raw = _prepare_ops(y, T, g, metric,
                                                  pbits=pbits,
-                                                 grid_order=grid_order)
+                                                 grid_order=grid_order,
+                                                 rows_valid=rv_in)
+    rv, id_map = _ragged_state(yp.shape[0])
     if not store_yp:
         yp = None
         if passes == 1:
             y_lo = None    # the 1-pass kernel and lite fixup never read it
     return KnnIndex(yp, y_hi, y_lo, yyh_k, yy_raw, m, T, Qb, g, passes,
-                    metric, d, pbits=pbits, grid_order=grid_order)
+                    metric, d, pbits=pbits, grid_order=grid_order,
+                    rows_valid=rv, ids=id_map)
 
 
 @instrument("distance.knn_fused")
@@ -1642,7 +1721,8 @@ def knn_fused(x, y, k: int, passes: int = 3,
         rescore=rescore, pbits=idx.pbits, certify=certify,
         pool_algo=pool_algo, grid_order=grid_order,
         db_dtype=db_dtype, with_stats=True, y_q=idx.y_q,
-        y_scale_k=idx.y_scale_k, eq_groups=idx.eq_groups)
+        y_scale_k=idx.y_scale_k, eq_groups=idx.eq_groups,
+        rows_valid=idx.rows_valid)
     # certificate/fixup telemetry: the failure count is a device scalar
     # — queue it UNRESOLVED (quality.drain() converts later, after the
     # program's results have been consumed; no sync on this path)
@@ -1660,6 +1740,12 @@ def knn_fused(x, y, k: int, passes: int = 3,
         vals, ids = vals[:Q], ids[:Q]
     # else: identity slices would still cost an eager dispatch each
     # (~2 ms RTT on the tunneled device) — skip when Q needed no pad
+    if idx.ids is not None:
+        # ragged-layout index: slab positions decode to global ids;
+        # non-finite rows (fewer live rows than k) carry raw columns
+        # out of the fixup's unmasked top_k — sentinel them to −1
+        ids = jnp.where((ids >= 0) & jnp.isfinite(vals),
+                        jnp.take(idx.ids, jnp.maximum(ids, 0)), -1)
     if metric == "ip":
         return -vals, ids           # internal −x·y ascending → IP desc
     return vals, ids

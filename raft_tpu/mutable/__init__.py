@@ -1,0 +1,54 @@
+"""raft_tpu.mutable — streaming upserts/deletes over immutable bases.
+
+The mutation plane (ROADMAP item 3): every index gets an append delta
+slab (8-row quantum, quantized/certified on ingest), tombstone bitmaps
+applied through the ragged never-wins sentinel path (a delete is
+visible on the next batch without touching the slab), a two-slab
+search merged with the PR-4 rank-ordered merge, and a background
+compactor that folds deltas past ``RAFT_TPU_COMPACT_THRESHOLD`` into a
+fresh snapshot through the existing warmed rebuild-and-swap — readers
+never block, generation semantics stay last-wins.
+
+- :class:`~raft_tpu.mutable.index.MutableIndex` — the mutation plane
+  (brute f32 / brute int8 / IVF-Flat bases).
+- :mod:`~raft_tpu.mutable.layout` — :class:`IndexLayout`, the explicit
+  slab struct (slab, ids, offsets/sizes, rows_valid, int8 sidecar)
+  shared by the brute, IVF-Flat and quantized planes, with the
+  build/search machinery re-expressed as pure ops over it.
+
+Evidence: ``benchmarks/bench_mutation.py`` drives a closed-loop mixed
+read/write load across a full compaction cycle and writes
+``BENCH_MUTATION.json``, gated by ``tools/bench_report.py --check``.
+"""
+
+from raft_tpu.mutable.index import (COMPACT_THRESHOLD_ENV,
+                                    DELTA_CAP_ENV, MutableIndex,
+                                    MutableView, apply_delete,
+                                    apply_upsert,
+                                    compact_threshold_default,
+                                    delta_cap_default, search_view)
+from raft_tpu.mutable.layout import (FusedOps, IndexLayout, dense_layout,
+                                     fused_geometry, fused_ops_for_layout,
+                                     quantize_layout,
+                                     ragged_layout_from_lists,
+                                     run_fused_ops)
+
+__all__ = [
+    "COMPACT_THRESHOLD_ENV",
+    "DELTA_CAP_ENV",
+    "FusedOps",
+    "IndexLayout",
+    "MutableIndex",
+    "MutableView",
+    "apply_delete",
+    "apply_upsert",
+    "compact_threshold_default",
+    "delta_cap_default",
+    "dense_layout",
+    "fused_geometry",
+    "fused_ops_for_layout",
+    "quantize_layout",
+    "ragged_layout_from_lists",
+    "run_fused_ops",
+    "search_view",
+]

@@ -1,0 +1,891 @@
+"""MutableIndex — streaming upserts/deletes over immutable snapshots.
+
+Every index in this repo was frozen at build: the only update path was
+``SnapshotStore``'s full rebuild-and-swap (PR 7) — O(index) per change.
+This module gives every plane a MUTATION plane (ROADMAP item 3, the
+raft-dask rebuild/redistribute orchestration re-imagined as a serving
+feature):
+
+- **base snapshot** — an immutable prepared index (brute f32, brute
+  int8, or IVF-Flat) held in a :class:`~raft_tpu.serving.snapshot.
+  SnapshotStore`; readers take a consistent :class:`MutableView` and
+  NEVER block on a writer.
+- **append delta slab** — a fixed-capacity [cap, d] tail sized to the
+  8-row quantum. New rows land in the next free slots and the delta is
+  re-prepared through the SAME certified fused machinery as the base
+  (:func:`raft_tpu.mutable.layout.fused_ops_for_layout` — int8 bases
+  quantize/certify delta rows on ingest via the PR-9
+  ``quantize_rows_q8``/Eq path, so the delta tail streams through the
+  same certified kernels). Fixed capacity means fixed shapes: every
+  mutation generation serves from the same compiled programs.
+- **tombstones** — a delete (or the old copy under an upsert) flips the
+  row's ``rows_valid`` bit and scatters the never-wins sentinel into
+  the prepared carrier column (the ragged PR-8 path): O(changed) work,
+  the slab itself untouched, and the delete is visible to the very next
+  batch. IVF bases additionally mask the row's slab id so the probed
+  fine scan skips it.
+- **two-slab search** — a query runs the base plane (tombstone-masked)
+  and the delta plane and merges the two top-k pools with the PR-4
+  rank-major merge (:func:`raft_tpu.distance.knn_sharded.
+  _merge_host_pool`) — deterministic, exact-value preserving, so
+  interleaved mutations stay id-identical to a from-scratch rebuild
+  oracle (pinned by tests/test_mutable.py on all three planes).
+- **background compaction** — past ``RAFT_TPU_COMPACT_THRESHOLD``
+  delta slots, a compactor thread folds (live base + live delta) into a
+  fresh snapshot through the EXISTING warmed rebuild-and-swap
+  (``SnapshotStore.update``), then rebases the retained delta tail and
+  any tombstones that landed mid-fold onto the new base. Readers keep
+  the old view until the swap; generation semantics stay last-wins; a
+  crash anywhere in the fold keeps the old snapshot serving (no torn
+  generation — the ``compact_fold`` fault site + tests pin it).
+- **write-ahead flight events** — every mutation emits through
+  :func:`~raft_tpu.observability.timeline.emit_mutation`
+  (upsert/delete/compact_start/compact_swap/compact_abort) next to live
+  gauges: delta occupancy, tombstone fraction, compaction debt.
+
+Env knobs (README "Mutable indexes & compaction"):
+
+- ``RAFT_TPU_COMPACT_THRESHOLD`` — delta slots that trigger a
+  background fold (default 1024).
+- ``RAFT_TPU_DELTA_CAP`` — delta slab capacity (default 2× the
+  threshold, rounded to the 8-row quantum). A writer that fills the
+  cap while a fold is in flight WAITS for the swap — writers may
+  block, readers never.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.observability import instrument
+from raft_tpu.observability.quality import record_pending
+from raft_tpu.observability.timeline import emit_mutation
+from raft_tpu.resilience import fault_point
+
+from raft_tpu.mutable.layout import (FusedOps, IndexLayout, dense_layout,
+                                     fused_ops_for_layout, run_fused_ops)
+
+COMPACT_THRESHOLD_ENV = "RAFT_TPU_COMPACT_THRESHOLD"
+DELTA_CAP_ENV = "RAFT_TPU_DELTA_CAP"
+DEFAULT_COMPACT_THRESHOLD = 1024
+
+# the mutation slice of the metric vocabulary
+DELTA_ROWS = "raft_tpu_mutable_delta_rows"
+TOMBSTONE_FRAC = "raft_tpu_mutable_tombstone_frac"
+COMPACTION_DEBT = "raft_tpu_mutable_compaction_debt"
+MUTATIONS = "raft_tpu_mutable_mutations_total"
+COMPACTIONS = "raft_tpu_mutable_compactions_total"
+
+#: delta-plane tiling: small fixed geometry — the delta slab is bounded
+#: by the compact threshold, so the tuned production tile would mostly
+#: pad (T must stay a multiple of 128, Qb of 8)
+_DELTA_T = 256
+_DELTA_QB = 128
+_DELTA_G = 2
+
+
+def compact_threshold_default() -> int:
+    try:
+        return max(8, int(os.environ.get(COMPACT_THRESHOLD_ENV,
+                                         DEFAULT_COMPACT_THRESHOLD)))
+    except (TypeError, ValueError):
+        return DEFAULT_COMPACT_THRESHOLD
+
+
+def delta_cap_default(threshold: int) -> int:
+    try:
+        raw = os.environ.get(DELTA_CAP_ENV, "").strip()
+        cap = int(raw) if raw else 2 * threshold
+    except (TypeError, ValueError):
+        cap = 2 * threshold
+    cap = max(cap, threshold, 8)
+    return -(-cap // 8) * 8                       # 8-row quantum
+
+
+def _gauges(registry, delta_rows: int, cap: int, tombs: int,
+            base_rows: int, threshold: int) -> None:
+    try:
+        registry.gauge(
+            DELTA_ROWS, help="Delta-slab slots written (live + "
+                             "tombstoned) awaiting compaction"
+        ).set(delta_rows)
+        registry.gauge(
+            TOMBSTONE_FRAC,
+            help="Tombstoned fraction of the base snapshot's rows"
+        ).set(tombs / max(1, base_rows))
+        registry.gauge(
+            COMPACTION_DEBT,
+            help="Delta occupancy over the compaction watermark "
+                 "(>= 1.0 means a fold is due)"
+        ).set(delta_rows / max(1, threshold))
+    except Exception:
+        pass
+
+
+class _BasePlane:
+    """One immutable base snapshot: the prepared index + its external-id
+    maps + the certified-fused operand bundle the mutable search drives.
+    Never mutated — tombstone state lives in :class:`MutableIndex` and
+    is rebuilt per swap."""
+
+    __slots__ = ("kind", "index", "exts_np", "fops", "ext_slab",
+                 "ext_row", "n_rows", "d_orig", "Qb")
+
+    def __init__(self, kind: str, index, exts_np: np.ndarray,
+                 fops: FusedOps):
+        import jax.numpy as jnp
+
+        self.kind = kind
+        self.index = index
+        self.exts_np = np.asarray(exts_np, np.int32)
+        self.fops = fops
+        self.n_rows = int(index.n_rows)
+        self.d_orig = int(index.d_orig)
+        self.Qb = int(index.Qb)
+        M = fops.slab_rows
+        # slab position → external id (pads −1): brute slab positions
+        # ARE row ids; IVF slab positions map through the layout ids
+        if kind == "brute":
+            ext_slab = np.full(M, -1, np.int32)
+            ext_slab[:self.n_rows] = self.exts_np
+        else:
+            ids = np.asarray(fops.ids)
+            ext_slab = np.where(ids >= 0, self.exts_np[np.maximum(ids, 0)],
+                                -1).astype(np.int32)
+        self.ext_slab = jnp.asarray(ext_slab)
+        # global row id → external id (the IVF probe path returns row
+        # ids; the brute plane uses ext_slab directly)
+        self.ext_row = jnp.asarray(self.exts_np)
+
+
+def _brute_fops(idx) -> FusedOps:
+    """The FusedOps bundle of an already-prepared dense
+    :class:`~raft_tpu.distance.knn_fused.KnnIndex` — the brute base
+    plane reuses the snapshot's operands verbatim (no re-prep); only
+    the mask/carrier pair is overridden per mutation generation."""
+    import jax.numpy as jnp
+
+    M = idx.yyh_k.shape[1]
+    rv = jnp.arange(M, dtype=jnp.int32) < idx.n_rows
+    ids = jnp.where(rv, jnp.arange(M, dtype=jnp.int32), -1)
+    if idx.db_dtype == "int8":
+        ops = (idx.yp, idx.y_q, idx.y_scale_k, idx.yyh_k, idx.yy_raw,
+               idx.eq_groups)
+    else:
+        ops = (idx.yp, idx.y_hi, idx.y_lo, idx.yyh_k, idx.yy_raw)
+    return FusedOps(db_dtype="int8" if idx.db_dtype == "int8" else "f32",
+                    ops=ops, rv=rv, ids=ids, T=idx.T, Qb=idx.Qb,
+                    g=idx.g, pbits=idx.pbits, grid_order=idx.grid_order,
+                    passes=idx.passes, metric=idx.metric)
+
+
+class MutableView:
+    """One consistent read view: immutable references to the base
+    plane, its tombstone-updated (mask, carrier) pair, the prepared
+    delta operands and the live counts — everything a search needs,
+    captured under the writer lock in O(1). Queries racing a mutation
+    or a compaction swap each see exactly one generation."""
+
+    __slots__ = ("plane", "base_rv", "base_yyh", "ids_live", "base_live",
+                 "delta_fops", "delta_live", "generation", "seq")
+
+    def __init__(self, plane, base_rv, base_yyh, ids_live, base_live,
+                 delta_fops, delta_live, generation, seq):
+        self.plane = plane
+        self.base_rv = base_rv
+        self.base_yyh = base_yyh
+        self.ids_live = ids_live
+        self.base_live = base_live
+        self.delta_fops = delta_fops
+        self.delta_live = delta_live
+        self.generation = generation
+        self.seq = seq
+
+    @property
+    def n_rows(self) -> int:
+        """Live logical row count (base + delta, tombstones excluded)."""
+        return self.base_live + self.delta_live
+
+
+class MutableIndex:
+    """A mutation plane over any supported index (see the module doc).
+
+    ``index`` may be a raw [m, d] matrix, a prepared ``KnnIndex``
+    (``algorithm="brute"`` — requires ``store_yp``; the f32 rows are
+    the compaction source), or an ``IvfFlatIndex``
+    (``algorithm="ivf_flat"``, f32 slab). ``ids`` are the EXTERNAL
+    row ids (non-negative int32; default ``arange(m)``) — searches
+    return them, upserts/deletes address them.
+    """
+
+    def __init__(self, index, ids=None, *, algorithm: str = "brute",
+                 res=None, passes: int = 3, metric: str = "l2",
+                 T: Optional[int] = None, Qb: Optional[int] = None,
+                 g: Optional[int] = None, db_dtype: Optional[str] = None,
+                 n_lists: Optional[int] = None,
+                 n_probes: Optional[int] = None,
+                 compact_threshold: Optional[int] = None,
+                 delta_cap: Optional[int] = None,
+                 auto_compact: bool = True):
+        from raft_tpu.ann import IvfFlatIndex
+        from raft_tpu.core.resources import ensure_resources
+        from raft_tpu.distance.knn_fused import KnnIndex
+
+        expects(algorithm in ("brute", "ivf_flat"),
+                "MutableIndex: algorithm must be 'brute' or 'ivf_flat',"
+                " got %r", algorithm)
+        expects(metric == "l2",
+                "MutableIndex: the mutation plane serves metric='l2' "
+                "only (the merge and the rebuild oracle are l2-space)")
+        self.res = ensure_resources(res)
+        self._algorithm = algorithm
+        self._metric = metric
+        self._passes = passes
+        self._db_dtype = db_dtype
+        self._build_kw = dict(passes=passes, metric=metric, T=T, Qb=Qb,
+                              g=g)
+        self._n_lists, self._n_probes = n_lists, n_probes
+        self._threshold = (compact_threshold_default()
+                           if compact_threshold is None
+                           else max(8, int(compact_threshold)))
+        self._cap = (delta_cap_default(self._threshold)
+                     if delta_cap is None
+                     else max(8, -(-int(delta_cap) // 8) * 8,
+                              self._threshold))
+        self._auto_compact = bool(auto_compact)
+
+        self._cond = threading.Condition(threading.RLock())
+        self._seq = 0
+        self._tomb_count = 0
+        self._folding = False
+        self._fold_thread: Optional[threading.Thread] = None
+        self._fold_result = None
+        self._compactions = 0
+
+        if isinstance(index, KnnIndex):
+            expects(algorithm == "brute",
+                    "MutableIndex: a KnnIndex serves algorithm='brute'")
+            expects(index.yp is not None,
+                    "MutableIndex: the brute plane needs the stored f32"
+                    " rows (store_yp=True) — compaction folds from them")
+            expects(index.metric == "l2",
+                    "MutableIndex: the mutation plane serves "
+                    "metric='l2' only")
+            plane_idx = index
+            self._db_dtype = index.db_dtype
+            self._passes = index.passes
+            m = index.n_rows
+        elif isinstance(index, IvfFlatIndex):
+            expects(algorithm == "ivf_flat",
+                    "MutableIndex: an IvfFlatIndex serves "
+                    "algorithm='ivf_flat'")
+            expects(index.db_dtype == "f32",
+                    "MutableIndex: the mutable IVF plane serves the f32"
+                    " slab (int8 IVF stays frozen-index only)")
+            plane_idx = index
+            m = index.n_rows
+        else:
+            y = np.asarray(index, np.float32)
+            m = y.shape[0]
+            plane_idx = self._build_index(y)
+        exts = (np.arange(m, dtype=np.int32) if ids is None
+                else np.asarray(ids, np.int32))
+        expects(exts.shape == (m,),
+                "MutableIndex: ids must be [m] external ids")
+        expects(exts.size == 0 or int(exts.min()) >= 0,
+                "MutableIndex: external ids must be non-negative")
+        expects(np.unique(exts).size == exts.size,
+                "MutableIndex: external ids must be unique")
+        plane = self._make_plane(plane_idx, exts)
+        self.d_orig = plane.d_orig
+        self.Qb = plane.Qb
+
+        from raft_tpu.serving.snapshot import SnapshotStore
+
+        self._store = SnapshotStore(self._fold_builder,
+                                    initial_index=plane)
+        self._install_base(plane)
+        self._reset_delta()
+        self._refresh_delta()
+
+    # -- construction ------------------------------------------------------
+    def _build_index(self, y):
+        if self._algorithm == "ivf_flat":
+            from raft_tpu.ann import build_ivf_flat
+
+            n_lists = self._n_lists or max(
+                1, min(1024, int(round(y.shape[0] ** 0.5))))
+            return build_ivf_flat(self.res, y, n_lists=n_lists,
+                                  n_probes=self._n_probes)
+        from raft_tpu.distance.knn_fused import prepare_knn_index
+
+        kw = dict(self._build_kw)
+        if self._db_dtype is not None:
+            kw["db_dtype"] = self._db_dtype
+        return prepare_knn_index(y, **kw)
+
+    def _make_plane(self, index, exts: np.ndarray) -> _BasePlane:
+        if self._algorithm == "brute":
+            return _BasePlane("brute", index, exts, _brute_fops(index))
+        fops = fused_ops_for_layout(index.layout(), passes=self._passes,
+                                    metric="l2")
+        return _BasePlane("ivf", index, exts, fops)
+
+    def _fold_builder(self, payload, **_kw):
+        rows, exts = payload
+        plane = self._make_plane(self._build_index(rows), exts)
+        self._fold_result = plane
+        return plane
+
+    # -- base tombstone state (reset per swap) -----------------------------
+    def _install_base(self, plane: _BasePlane) -> None:
+        self._plane = plane
+        self._base_rv = plane.fops.rv
+        self._base_yyh = plane.fops.ops[plane.fops.yyh_index]
+        self._ids_live = (plane.index.ids if plane.kind == "ivf"
+                          else None)
+        self._base_live = plane.n_rows
+        self._tomb_count = 0
+        # base lookup: external id → ("base", slab position)
+        if plane.kind == "brute":
+            skeys = np.arange(plane.n_rows)
+        else:
+            ids_np = np.asarray(plane.fops.ids)
+            slab_pos = np.nonzero(ids_np >= 0)[0]
+            # slab position of each global row id
+            skeys = np.empty(plane.n_rows, np.int64)
+            skeys[ids_np[slab_pos]] = slab_pos
+        self._lookup = {int(e): ("base", int(skeys[i]))
+                        for i, e in enumerate(plane.exts_np)}
+
+    def _reset_delta(self) -> None:
+        self._d_rows = np.zeros((self._cap, self.d_orig), np.float32)
+        self._d_ext = np.full(self._cap, -1, np.int32)
+        self._d_valid = np.zeros(self._cap, np.bool_)
+        self._d_count = 0
+
+    def _refresh_delta(self) -> None:
+        """Re-prepare the delta operands (writer-side — readers only
+        swap references). The slab shape is FIXED at the cap, so every
+        refresh serves from the same compiled programs."""
+        layout = dense_layout(self._d_rows, ids=self._d_ext,
+                              rows_valid=self._d_valid)
+        self._d_fops = fused_ops_for_layout(
+            layout, passes=self._passes, metric=self._metric,
+            T=_DELTA_T, Qb=_DELTA_QB, g=_DELTA_G,
+            db_dtype="int8" if self._db_dtype == "int8" else None)
+        self._d_live = int(self._d_valid.sum())
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def store(self):
+        return self._store
+
+    @property
+    def generation(self) -> int:
+        return self._store.generation
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    @property
+    def n_rows(self) -> int:
+        with self._cond:
+            return self._base_live + self._d_live
+
+    @property
+    def delta_rows(self) -> int:
+        with self._cond:
+            return self._d_count
+
+    @property
+    def delta_cap(self) -> int:
+        return self._cap
+
+    @property
+    def compact_threshold(self) -> int:
+        return self._threshold
+
+    @property
+    def compactions(self) -> int:
+        with self._cond:
+            return self._compactions
+
+    @property
+    def folding(self) -> bool:
+        with self._cond:
+            return self._folding
+
+    def view(self) -> MutableView:
+        """A consistent, immutable read view — O(1) reference capture
+        under the writer lock. The search path is lock-free after this."""
+        with self._cond:
+            return MutableView(
+                plane=self._plane, base_rv=self._base_rv,
+                base_yyh=self._base_yyh, ids_live=self._ids_live,
+                base_live=self._base_live, delta_fops=self._d_fops,
+                delta_live=self._d_live,
+                generation=self._store.generation, seq=self._seq)
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "generation": self._store.generation,
+                "seq": self._seq,
+                "base_rows": self._plane.n_rows,
+                "base_live": self._base_live,
+                "delta_rows": self._d_count,
+                "delta_live": self._d_live,
+                "delta_cap": self._cap,
+                "tombstones": self._tomb_count,
+                "compact_threshold": self._threshold,
+                "compactions": self._compactions,
+                "folding": self._folding,
+            }
+
+    # -- mutation internals ------------------------------------------------
+    def _tombstone_locked(self, exts: Sequence[int]) -> int:
+        """Flip the live bit for every found external id: base rows get
+        the never-wins sentinel scattered into the carrier column (+ the
+        slab-id mask on IVF); delta slots drop their valid bit. Returns
+        how many ids were found. Caller holds the lock."""
+        from raft_tpu.ops.fused_l2_topk_pallas import _PACK_PAD
+
+        base_rows, delta_slots, found = [], [], 0
+        for e in exts:
+            loc = self._lookup.pop(int(e), None)
+            if loc is None:
+                continue
+            found += 1
+            if loc[0] == "base":
+                base_rows.append(loc[1])
+            else:
+                delta_slots.append(loc[1])
+        if base_rows:
+            rows = np.asarray(base_rows, np.int32)
+            self._base_rv = self._base_rv.at[rows].set(False)
+            self._base_yyh = self._base_yyh.at[:, rows].set(
+                float(_PACK_PAD))
+            if self._ids_live is not None:
+                self._ids_live = self._ids_live.at[rows].set(-1)
+            self._base_live -= len(base_rows)
+            self._tomb_count += len(base_rows)
+        for s in delta_slots:
+            self._d_valid[s] = False
+        return found
+
+    def _ensure_delta_space_locked(self, n: int) -> None:
+        """Block the WRITER until ``n`` delta slots are free — waits for
+        an in-flight fold, else folds inline. Readers never wait here."""
+        expects(n <= self._cap,
+                "MutableIndex: upsert of %d rows exceeds the delta "
+                "capacity %d (raise %s)", n, self._cap, DELTA_CAP_ENV)
+        while self._cap - self._d_count < n:
+            if self._folding:
+                self._cond.wait(0.05)
+                continue
+            # inline fold on the writer thread — the delta is full and
+            # nobody else is folding
+            upto = self._begin_fold_locked()
+            self._cond.release()
+            try:
+                self._fold(upto)
+            finally:
+                self._cond.acquire()
+
+    def _mutation_epilogue_locked(self, kind: str, n: int) -> None:
+        self._seq += 1
+        self._refresh_delta()
+        try:
+            self.res.metrics.counter(
+                MUTATIONS, {"kind": kind},
+                help="Mutable-index mutations applied").inc(n)
+        except Exception:
+            pass
+        _gauges(self.res.metrics, self._d_count, self._cap,
+                self._tomb_count, self._plane.n_rows, self._threshold)
+        emit_mutation(kind, rows=n, seq=self._seq,
+                      delta_rows=self._d_count, delta_live=self._d_live,
+                      tombstones=self._tomb_count,
+                      generation=self._store.generation)
+
+    def _upsert(self, exts: np.ndarray, rows: np.ndarray) -> int:
+        n = rows.shape[0]
+        with self._cond:
+            self._ensure_delta_space_locked(n)
+            self._tombstone_locked(exts)          # old copies, any plane
+            c = self._d_count
+            self._d_rows[c:c + n] = rows
+            self._d_ext[c:c + n] = exts
+            self._d_valid[c:c + n] = True
+            for i, e in enumerate(exts):
+                self._lookup[int(e)] = ("delta", c + i)
+            self._d_count = c + n
+            self._mutation_epilogue_locked("upsert", n)
+        self._maybe_compact()
+        return n
+
+    def _delete(self, exts: np.ndarray) -> int:
+        with self._cond:
+            found = self._tombstone_locked(exts)
+            self._mutation_epilogue_locked("delete", found)
+        self._maybe_compact()
+        return found
+
+    # -- compaction --------------------------------------------------------
+    def _begin_fold_locked(self) -> int:
+        self._folding = True
+        return self._d_count
+
+    def _maybe_compact(self) -> None:
+        with self._cond:
+            if (not self._auto_compact or self._folding
+                    or self._d_count < self._threshold):
+                return
+            upto = self._begin_fold_locked()
+            t = threading.Thread(target=self._fold_guarded, args=(upto,),
+                                 name="mutable-compactor", daemon=True)
+            self._fold_thread = t
+        t.start()
+
+    def compact(self, block: bool = True) -> bool:
+        """Fold (live base + live delta) into a fresh base snapshot.
+        ``block=True`` folds inline and returns whether a swap landed;
+        ``block=False`` starts the background compactor (the auto
+        trigger's path) and returns True when one was started. A fold
+        already in flight is waited for (block) or left alone."""
+        with self._cond:
+            if self._folding:
+                if not block:
+                    return True
+                while self._folding:
+                    self._cond.wait(0.05)
+                return self._fold_result is not None
+            upto = self._begin_fold_locked()
+            if not block:
+                t = threading.Thread(target=self._fold_guarded,
+                                     args=(upto,),
+                                     name="mutable-compactor",
+                                     daemon=True)
+                self._fold_thread = t
+        if not block:
+            t.start()
+            return True
+        self._fold(upto)
+        return self._fold_result is not None
+
+    def wait_for_compaction(self, timeout: Optional[float] = None) -> None:
+        t = self._fold_thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+    def _fold_guarded(self, upto: int) -> None:
+        """Background-compactor wrapper: a crash is logged + counted,
+        never propagated (the old snapshot keeps serving)."""
+        try:
+            self._fold(upto)
+        except Exception as e:
+            from raft_tpu.core.logger import log_warn
+
+            log_warn("mutable: background compaction failed (%s: %s) — "
+                     "keeping the current snapshot",
+                     type(e).__name__, str(e)[:200])
+
+    def _count_compaction(self, status: str) -> None:
+        try:
+            self.res.metrics.counter(
+                COMPACTIONS, {"status": status},
+                help="Mutable-index compaction folds by outcome").inc()
+        except Exception:
+            pass
+
+    def _fold(self, upto: int) -> None:
+        """One compaction cycle: materialize the live rows as of entry,
+        rebuild through the warmed rebuild-and-swap, then rebase the
+        retained delta tail + mid-fold mutations onto the new base.
+        Caller must have set ``_folding`` (``_begin_fold_locked``)."""
+        self._fold_result = None
+        try:
+            fault_point("compact_fold")
+            with self._cond:
+                gen0 = self._store.generation
+                emit_mutation("compact_start", generation=gen0,
+                              delta_rows=upto,
+                              tombstones=self._tomb_count)
+                rows, exts = self._materialize_locked(upto)
+            # the EXPENSIVE part — outside the lock: readers keep the
+            # old view, writers keep appending past `upto`
+            self._store.update((rows, exts), block=True)
+            plane = self._fold_result
+            if plane is None:
+                raise RuntimeError(
+                    self._store.last_error
+                    or "snapshot rebuild failed during compaction")
+            with self._cond:
+                self._rebase_locked(plane, upto)
+                self._compactions += 1
+                _gauges(self.res.metrics, self._d_count, self._cap,
+                        self._tomb_count, self._plane.n_rows,
+                        self._threshold)
+                emit_mutation("compact_swap",
+                              generation=self._store.generation,
+                              folded_rows=int(rows.shape[0]),
+                              retained_delta=self._d_count)
+            self._count_compaction("ok")
+        except Exception:
+            self._count_compaction("failed")
+            with self._cond:
+                emit_mutation("compact_abort",
+                              generation=self._store.generation)
+            raise
+        finally:
+            with self._cond:
+                self._folding = False
+                self._cond.notify_all()
+
+    def _materialize_locked(self, upto: int
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+        """The fold input: live base rows + live delta rows in slots
+        [0, upto), in deterministic (base order, then append order)."""
+        plane = self._plane
+        if plane.kind == "brute":
+            live = np.asarray(self._base_rv)[:plane.n_rows]
+            # yp is the d-PADDED prepared slab — fold from the original
+            # feature width (zero pad columns are re-derived at build)
+            base_rows = np.asarray(
+                plane.index.yp)[:plane.n_rows, :plane.d_orig][live]
+            base_exts = plane.exts_np[live]
+        else:
+            ids_live = np.asarray(self._ids_live)
+            pos = np.nonzero(ids_live >= 0)[0]
+            order = np.argsort(ids_live[pos], kind="stable")
+            pos = pos[order]                       # original row order
+            base_rows = np.asarray(plane.index.slab)[pos]
+            base_exts = plane.exts_np[ids_live[pos]]
+        dl = self._d_valid[:upto]
+        rows = np.concatenate([base_rows, self._d_rows[:upto][dl]])
+        exts = np.concatenate([base_exts, self._d_ext[:upto][dl]])
+        return np.ascontiguousarray(rows), np.ascontiguousarray(exts)
+
+    def _rebase_locked(self, plane: _BasePlane, upto: int) -> None:
+        """Install the folded base and replay everything that happened
+        mid-fold: the live ``_lookup`` is the single source of truth —
+        a folded copy whose external id now lives elsewhere (re-upserted
+        into the retained delta) or nowhere (deleted) is tombstoned in
+        the NEW base before it ever serves."""
+        old_lookup = self._lookup
+        retained = [(self._d_rows[s].copy(), int(self._d_ext[s]),
+                     bool(self._d_valid[s]))
+                    for s in range(upto, self._d_count)]
+        self._install_base(plane)                  # fresh lookup/masks
+        # replay the mid-fold mutations: the pre-swap lookup is the
+        # single source of truth — a folded copy is live only if its
+        # external id still pointed at the folded content (the old
+        # base, or a delta slot below the fold line) at swap time;
+        # anything else (deleted, or re-upserted into the retained
+        # tail) is tombstoned in the NEW base before it ever serves
+        stale = []
+        for e in list(self._lookup):
+            loc = old_lookup.get(e)
+            folded_is_live = loc is not None and (
+                loc[0] == "base" or (loc[0] == "delta"
+                                     and loc[1] < upto))
+            if not folded_is_live:
+                stale.append(e)
+        if stale:
+            self._tombstone_locked(stale)
+        # retained delta tail → front of a fresh delta
+        self._reset_delta()
+        for row, ext, valid in retained:
+            s = self._d_count
+            self._d_rows[s] = row
+            self._d_ext[s] = ext
+            self._d_valid[s] = valid
+            if valid:
+                self._lookup[ext] = ("delta", s)
+            self._d_count = s + 1
+        self._seq += 1
+        self._refresh_delta()
+
+
+# ------------------------------------------------------- module entry ops
+@instrument("mutable.apply_upsert")
+def apply_upsert(index: MutableIndex, ids, rows) -> int:
+    """Upsert ``rows`` [n, d] under external ``ids`` [n]: existing
+    copies are tombstoned, the new rows land in the delta slab —
+    quantized/certified on ingest when the base streams int8 — and the
+    change is visible to the next search. Returns the applied count.
+    Carries the ``mutate_ingest`` fault site (before any state change:
+    an injected crash leaves the index untouched)."""
+    fault_point("mutate_ingest")
+    rows = np.asarray(rows, np.float32)
+    if rows.ndim == 1:
+        rows = rows[None]
+    ids = np.atleast_1d(np.asarray(ids, np.int32))
+    expects(rows.ndim == 2 and rows.shape[1] == index.d_orig,
+            "apply_upsert: rows must be [n, %d] (got %s)", index.d_orig,
+            rows.shape)
+    expects(ids.shape[0] == rows.shape[0],
+            "apply_upsert: ids/rows length mismatch (%d vs %d)",
+            ids.shape[0], rows.shape[0])
+    expects(ids.size == 0 or int(ids.min()) >= 0,
+            "apply_upsert: external ids must be non-negative")
+    expects(np.unique(ids).size == ids.size,
+            "apply_upsert: duplicate external ids in one batch")
+    return index._upsert(ids, rows)
+
+
+@instrument("mutable.apply_delete")
+def apply_delete(index: MutableIndex, ids) -> int:
+    """Delete the rows under external ``ids``: a tombstone-bitmap flip
+    + a never-wins sentinel scatter — the slab is untouched and the
+    delete is visible to the next search. Returns how many ids were
+    found. Carries the ``tombstone_apply`` fault site."""
+    fault_point("tombstone_apply")
+    ids = np.atleast_1d(np.asarray(ids, np.int32))
+    return index._delete(ids)
+
+
+def _pad_pool(vals, ids, k: int):
+    """Widen a [nq, k'] pool to k columns with (inf, −1) riders — a
+    slab with fewer than k live rows searches at k' = live (asking for
+    more would leave θ = inf and fail EVERY certificate into the
+    fixup, whose dot_general rounds differently than the rescore) and
+    pads back up for the rank-major merge."""
+    import jax.numpy as jnp
+
+    pad = k - vals.shape[1]
+    if pad <= 0:
+        return vals, ids
+    nq = vals.shape[0]
+    return (jnp.concatenate(
+        [vals, jnp.full((nq, pad), jnp.inf, vals.dtype)], axis=1),
+        jnp.concatenate(
+            [ids, jnp.full((nq, pad), -1, jnp.int32)], axis=1))
+
+
+def _search_base(view: MutableView, x, k: int, exact: bool,
+                 n_probes: Optional[int], res):
+    """Top-k over the (tombstone-masked) base plane → (vals, EXTERNAL
+    ids, n_fail device or None)."""
+    import jax.numpy as jnp
+
+    plane = view.plane
+    k = min(k, view.base_live)
+    if plane.kind == "ivf" and not exact:
+        base = plane.index
+        L = base.n_lists
+        P = int(n_probes) if n_probes else base.n_probes_default
+        if P < L:
+            from raft_tpu.ann.ivf_flat import (_FINE_TILE, _coarse_probe,
+                                               _fine_scan)
+
+            W = base.probe_window
+            if k <= P * W:
+                probes = _coarse_probe(res, base.centroids, x, P)
+                starts = jnp.take(base.offsets[:-1], probes)
+                psizes = jnp.take(base.padded_sizes, probes)
+                d = x.shape[1]
+                chunk = max(8, _FINE_TILE // max(1, P * W * max(d, 1)))
+                outs = []
+                for s in range(0, x.shape[0], chunk):
+                    v, g = _fine_scan(
+                        x[s:s + chunk], base.slab, view.ids_live,
+                        base.yy_slab, starts[s:s + chunk],
+                        psizes[s:s + chunk], k=k, P=P, W=W)
+                    outs.append((v, g))
+                vals = jnp.concatenate([o[0] for o in outs])
+                gids = jnp.concatenate([o[1] for o in outs])
+                ext = jnp.where(gids >= 0,
+                                jnp.take(plane.ext_row,
+                                         jnp.maximum(gids, 0)), -1)
+                return vals, ext, None
+        # degenerate regime (n_probes >= n_lists / k over capacity):
+        # fall through to the certified exact scan below
+    vals, pos, n_fail = run_fused_ops(plane.fops, x, k,
+                                      rows_valid=view.base_rv,
+                                      yyh_k=view.base_yyh)
+    ext = jnp.where(pos >= 0,
+                    jnp.take(plane.ext_slab, jnp.maximum(pos, 0)), -1)
+    return vals, ext, n_fail
+
+
+@instrument("mutable.search_view")
+def search_view(index, x, k: int, view: Optional[MutableView] = None,
+                n_probes: Optional[int] = None, exact: bool = False,
+                res=None) -> Tuple:
+    """Certified top-k over one consistent :class:`MutableView` (taken
+    from ``index`` when not given): the tombstone-masked base and the
+    delta tail each produce a top-k pool and the two merge rank-major
+    (the PR-4 merge) — exact values, ids identical to a from-scratch
+    rebuild over the live rows. Returns (vals [nq, k] ascending,
+    EXTERNAL ids [nq, k]; −1 entries pad when fewer than k rows are
+    live). ``exact=True`` forces the IVF plane through the certified
+    exact scan (the shadow-sampling oracle's switch)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.distance.knn_sharded import _merge_host_pool
+
+    if view is None:
+        view = index.view() if isinstance(index, MutableIndex) else index
+    mi = index if isinstance(index, MutableIndex) else None
+    if res is None and mi is not None:
+        res = mi.res
+    x = jnp.asarray(x, jnp.float32)
+    expects(x.ndim == 2 and x.shape[1] == view.plane.d_orig,
+            "search_view: queries must be [nq, %d] (got %s)",
+            view.plane.d_orig, x.shape)
+    expects(k >= 1, "search_view: k must be >= 1")
+    nq = x.shape[0]
+    if nq == 0:
+        return (jnp.zeros((0, k), jnp.float32),
+                jnp.zeros((0, k), jnp.int32))
+    pools = []
+    if view.base_live > 0:
+        bv, bi, nf = _search_base(view, x, k, exact, n_probes, res)
+        pools.append(_pad_pool(bv, bi, k))
+        if nf is not None:
+            from raft_tpu.distance.knn_fused import (fixup_tiers_for,
+                                                     rescore_pool_width)
+
+            fops = view.plane.fops
+            record_pending(
+                "mutable.search_base", nf, n_queries=nq,
+                pool_width=rescore_pool_width(k, fops.pool_width // 2,
+                                              True),
+                fix_tiers=fixup_tiers_for(fops.slab_rows),
+                db_dtype=fops.db_dtype, generation=view.generation)
+    if view.delta_live > 0:
+        kd = min(k, view.delta_live)
+        dv, dpos, nf = run_fused_ops(view.delta_fops, x, kd)
+        di = jnp.where(dpos >= 0,
+                       jnp.take(view.delta_fops.ids,
+                                jnp.maximum(dpos, 0)), -1)
+        pools.append(_pad_pool(dv, di, k))
+        from raft_tpu.distance.knn_fused import (fixup_tiers_for,
+                                                 rescore_pool_width)
+
+        record_pending(
+            "mutable.search_delta", nf, n_queries=nq,
+            pool_width=rescore_pool_width(
+                k, view.delta_fops.pool_width // 2, True),
+            fix_tiers=fixup_tiers_for(view.delta_fops.slab_rows),
+            db_dtype=view.delta_fops.db_dtype, generation=view.generation)
+    if not pools:
+        return (jnp.full((nq, k), jnp.inf, jnp.float32),
+                jnp.full((nq, k), -1, jnp.int32))
+    if len(pools) == 1:
+        return pools[0]
+    # two-slab rank-major merge: (base, delta) pool order is fixed, so
+    # the result is deterministic — and bit-identical to one top-k over
+    # the concatenated live rows (the rebuild-oracle parity the tests
+    # pin)
+    gv = jnp.stack([p[0] for p in pools])
+    gi = jnp.stack([p[1] for p in pools])
+    return _merge_host_pool(gv, gi, k)

@@ -71,6 +71,8 @@ KNOWN_EVENT_KINDS = (
     "serving",       # serving engine: enqueue/flush/shed/swap/warmup
     "quality",       # certificate failures / fixups / q8 reruns
     "flow",          # per-request Perfetto flow points (ph s/t/f)
+    "mutation",      # mutable-index write-ahead stream: upsert/delete/
+    #                  compact_start/compact_swap (raft_tpu.mutable)
 )
 
 #: events attached to DeviceError/DeadlineExceededError payloads

@@ -207,13 +207,30 @@ def emit_flow(step: str, rid: int, ph: str = "t",
                **args)
 
 
+def emit_mutation(event: str, **args) -> None:
+    """One mutable-index write-ahead event (``mutation`` kind). The
+    mutation plane's flight stream IS its write-ahead log for
+    observability purposes: ``event`` names the step — ``upsert`` /
+    ``delete`` (with row counts and the post-apply delta/tombstone
+    occupancy), ``compact_start`` / ``compact_swap`` / ``compact_abort``
+    (the background fold's lifecycle, with generation numbers) — so a
+    Perfetto trace shows every write interleaved with the query
+    batches, swaps and deadline scopes around it
+    (:mod:`raft_tpu.mutable`)."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("mutation", event, lane="mutable", **args)
+
+
 def emit_serving(event: str, **args) -> None:
     """One serving-engine lifecycle event (``serving`` kind). ``event``
     names the step — ``enqueue`` (request admitted, with queue depth),
     ``flush`` (a coalesced micro-batch dispatched, with bucket/rows),
     ``shed`` (overload admission rejection), ``swap`` (index snapshot
     generation change), ``warmup`` (bucket pre-compile at engine
-    start), ``reject`` (request larger than the bucket ladder) — so a
+    start), ``reject`` (request larger than the bucket ladder),
+    ``mutate`` (an upsert/delete applied on the batcher) — so a
     Perfetto trace shows the queue → batch → dispatch pipeline next to
     the compile/dispatch/deadline events it feeds."""
     rec = get_flight_recorder()

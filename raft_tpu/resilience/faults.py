@@ -113,6 +113,13 @@ KNOWN_SITES: Dict[str, Tuple[str, ...]] = {
     "serving_enqueue": ("error",),
     "serving_flush": ("oom", "error", "timeout", "hang"),
     "serving_snapshot": ("error",),
+    # mutable indexes (raft_tpu.mutable): the delta-slab ingest, the
+    # tombstone apply, and the background compaction fold — a crash at
+    # any of them must leave the current snapshot serving (no torn
+    # generation; pinned by tests/test_resilience.py)
+    "mutate_ingest": ("error",),
+    "tombstone_apply": ("error",),
+    "compact_fold": ("oom", "error"),
 }
 
 

@@ -122,6 +122,9 @@ def knn_query(res, index, x, k: int, rescore: Optional[bool] = None,
     expects(isinstance(index, KnnIndex),
             "knn_query: index must be a prepared KnnIndex (see "
             "distance.prepare_knn_index)")
+    expects(getattr(index, "rows_valid", None) is None,
+            "knn_query: ragged-layout indexes (rows_valid) query "
+            "through knn_fused / the mutable plane, not the AOT entry")
     idx = index
     if certify not in ("kernel", "f32"):
         raise ValueError(f"knn_query: certify must be 'kernel' or "

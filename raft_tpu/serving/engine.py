@@ -166,10 +166,10 @@ class ServingFuture:
 
 class _Request:
     __slots__ = ("x", "n", "enqueued_at", "deadline_at", "future",
-                 "requeues", "rid")
+                 "requeues", "rid", "kind", "ids")
 
     def __init__(self, x, n, enqueued_at, deadline_at, future,
-                 rid=0):
+                 rid=0, kind="query", ids=None):
         self.x = x
         self.n = n
         self.enqueued_at = enqueued_at
@@ -177,6 +177,8 @@ class _Request:
         self.future = future
         self.requeues = 0
         self.rid = rid          # monotonic flow-trace id (enqueue order)
+        self.kind = kind        # "query" | "upsert" | "delete"
+        self.ids = ids          # external row ids (mutation requests)
 
 
 @instrument("serving.execute_batch")
@@ -260,6 +262,10 @@ class ServingEngine:
                  db_dtype: Optional[str] = None,
                  shadow_frac: Optional[float] = None,
                  shadow_floor: Optional[float] = None,
+                 mutable: bool = False,
+                 index_ids=None,
+                 compact_threshold: Optional[int] = None,
+                 delta_cap: Optional[int] = None,
                  clock=time.monotonic):
         from raft_tpu.ann import IvfFlatIndex
         from raft_tpu.distance.knn_fused import KnnIndex
@@ -301,26 +307,56 @@ class ServingEngine:
                               store_yp=store_yp)
         if db_dtype is not None:
             self._build_kw["db_dtype"] = db_dtype
-        if isinstance(index, (KnnIndex, IvfFlatIndex)):
-            if isinstance(index, IvfFlatIndex) != (
-                    algorithm == "ivf_flat"):
-                raise ValueError(
-                    "ServingEngine: prepared index type does not match "
-                    "algorithm=%r" % (algorithm,))
-            initial = index
+        # mutable=True: the engine fronts a MutableIndex — queries see a
+        # consistent view per batch, and upsert()/delete() requests ride
+        # the SAME queue, admission control and deadline scopes as
+        # queries (the ISSUE-11 mutation plane). The engine's store IS
+        # the mutable index's SnapshotStore, so generation accounting,
+        # swap events and the snapshot gauges stay one surface.
+        self._mutable = None
+        if mutable:
+            expects(mesh is None,
+                    "ServingEngine: the mutable plane is single-device "
+                    "(shard outside the engine)")
+            from raft_tpu.mutable import MutableIndex
+
+            src = (index if isinstance(index, (KnnIndex, IvfFlatIndex))
+                   else np.asarray(index, np.float32))
+            self._mutable = MutableIndex(
+                src, ids=index_ids, algorithm=algorithm, res=self.res,
+                passes=passes, metric=metric, T=T, Qb=Qb, g=g,
+                db_dtype=db_dtype, n_lists=n_lists, n_probes=n_probes,
+                compact_threshold=compact_threshold,
+                delta_cap=delta_cap)
+            expects(self.k <= self._mutable.n_rows,
+                    "ServingEngine: k=%d > index size %d", self.k,
+                    self._mutable.n_rows)
+            self.d = self._mutable.d_orig
+            self._store = self._mutable.store
+            qb_hint = self._mutable.Qb
         else:
-            initial = self._build_index(np.asarray(index, np.float32))
-        expects(self.k <= initial.n_rows,
-                "ServingEngine: k=%d > index size %d", self.k,
-                initial.n_rows)
-        self.d = initial.d_orig
-        self._store = SnapshotStore(self._build_index,
-                                    initial_index=initial)
+            if isinstance(index, (KnnIndex, IvfFlatIndex)):
+                if isinstance(index, IvfFlatIndex) != (
+                        algorithm == "ivf_flat"):
+                    raise ValueError(
+                        "ServingEngine: prepared index type does not "
+                        "match algorithm=%r" % (algorithm,))
+                initial = index
+            else:
+                initial = self._build_index(np.asarray(index,
+                                                       np.float32))
+            expects(self.k <= initial.n_rows,
+                    "ServingEngine: k=%d > index size %d", self.k,
+                    initial.n_rows)
+            self.d = initial.d_orig
+            self._store = SnapshotStore(self._build_index,
+                                        initial_index=initial)
+            qb_hint = initial.Qb
         if buckets is None or isinstance(buckets, str):
-            self._ladder = bucket_ladder(initial.Qb, buckets)
+            self._ladder = bucket_ladder(qb_hint, buckets)
         else:
             self._ladder = bucket_ladder(
-                initial.Qb, ",".join(str(int(b)) for b in buckets))
+                qb_hint, ",".join(str(int(b)) for b in buckets))
         if flush_interval_s is None:
             try:
                 flush_interval_s = float(
@@ -380,11 +416,19 @@ class ServingEngine:
 
         return prepare_knn_index(y, **self._build_kw)
 
-    def _plane(self, snap: IndexSnapshot, xb):
+    def _plane(self, snap, xb):
         """The data plane for one padded bucket batch: the AOT runtime
         entry on one device, the PR-4 query-sharded replicated-index
-        mode over the mesh, or the ANN tier's IVF probe search
-        (``algorithm="ivf_flat"``)."""
+        mode over the mesh, the ANN tier's IVF probe search
+        (``algorithm="ivf_flat"``), or the mutable two-slab search
+        (``mutable=True`` — ``snap`` is then a MutableView)."""
+        if self._mutable is not None:
+            from raft_tpu.mutable import MutableView, search_view
+
+            view = (snap if isinstance(snap, MutableView)
+                    else self._mutable.view())
+            return search_view(self._mutable, xb, self.k, view=view,
+                               n_probes=self._n_probes, res=self.res)
         if self._algorithm == "ivf_flat":
             from raft_tpu.ann import search_ivf_flat
 
@@ -454,6 +498,11 @@ class ServingEngine:
         (for the IVF plane, the degenerate ``n_probes = n_lists`` exact
         search — bit-for-bit the brute oracle over the same rows). Runs
         on the shadow thread, never on the serving path."""
+        if self._mutable is not None:
+            from raft_tpu.mutable import search_view
+
+            return search_view(self._mutable, x, self.k, exact=True,
+                               res=self.res)
         snap = self._store.current()
         if self._algorithm == "ivf_flat":
             from raft_tpu.ann import search_ivf_flat
@@ -559,13 +608,110 @@ class ServingEngine:
         """Blocking convenience: submit + wait."""
         return self.submit(x, deadline_s=deadline_s).result(timeout)
 
+    # -- mutations (mutable=True) ------------------------------------------
+    def _submit_mutation(self, kind: str, ids, rows,
+                         deadline_s: Optional[float]) -> ServingFuture:
+        """Enqueue one mutation request — the SAME pipe as queries:
+        admission control (queue row cap sheds, an upsert past the
+        delta capacity is rejected classified), FIFO ordering with the
+        queries around it, per-request deadline scopes on the batcher
+        thread, and flow tracing end to end."""
+        from raft_tpu.core.error import expects as _expects
+
+        _expects(self._mutable is not None,
+                 "serving: %s() needs a mutable engine "
+                 "(ServingEngine(..., mutable=True))", kind)
+        fault_point("serving_enqueue")
+        ids = np.atleast_1d(np.asarray(ids, np.int32))
+        if rows is not None:
+            rows = np.asarray(rows, np.float32)
+            if rows.ndim == 1:
+                rows = rows[None]
+            _expects(rows.ndim == 2 and rows.shape[1] == self.d,
+                     "serving: %s rows must be [n, %d] (got %s)", kind,
+                     self.d, rows.shape)
+            _expects(ids.shape[0] == rows.shape[0],
+                     "serving: %s ids/rows length mismatch", kind)
+        n = int(ids.shape[0])
+        if n == 0:
+            fut = ServingFuture()
+            fut._complete({"applied": 0, "kind": kind}, None)
+            return fut
+        with self._cond:
+            self._next_rid += 1
+            rid = self._next_rid
+        emit_flow("enqueue", rid, ph="s", rows=n, op=kind)
+        if rows is not None and n > self._mutable.delta_cap:
+            self._count_request("rejected")
+            emit_serving("reject", rows=n, op=kind, rid=rid,
+                         delta_cap=self._mutable.delta_cap)
+            emit_flow("reject", rid, ph="f", outcome="reject")
+            raise RequestTooLargeError(
+                f"serving: upsert of {n} rows exceeds the delta "
+                f"capacity {self._mutable.delta_cap} — split it or "
+                f"raise RAFT_TPU_DELTA_CAP")
+        now = self._clock()
+        budget = (deadline_s if deadline_s is not None
+                  else self._default_deadline_s)
+        req = _Request(rows, n, now, now + budget if budget else None,
+                       ServingFuture(), rid=rid, kind=kind, ids=ids)
+        with self._cond:
+            if self._depth_rows + n > self._max_queue_rows:
+                self._count_request("shed")
+                self._stats["shed"] += 1
+                try:
+                    self.res.metrics.counter(
+                        SHED, help="Requests shed by admission control "
+                                   "(queue at its row cap)").inc()
+                except Exception:
+                    pass
+                record_degradation("serving.engine", "shed:overload")
+                emit_serving("shed", rows=n, op=kind,
+                             queue_rows=self._depth_rows, rid=rid)
+                emit_flow("shed", rid, ph="f", outcome="shed")
+                raise OverloadShedError(
+                    f"serving: queue at capacity "
+                    f"({self._depth_rows}/{self._max_queue_rows} rows)"
+                    f" — {kind} shed; back off and retry")
+            self._queue.append(req)
+            self._depth_rows += n
+            self._gauge_depth()
+            emit_serving("enqueue", rows=n, op=kind,
+                         queue_rows=self._depth_rows,
+                         deadline_s=budget, rid=rid)
+            self._cond.notify_all()
+        return req.future
+
+    def upsert(self, ids, rows, deadline_s: Optional[float] = None
+               ) -> ServingFuture:
+        """Enqueue an upsert of ``rows`` [n, d] under external ``ids``
+        [n] (mutable engines). The future resolves to a dict with the
+        applied count and the index seq/generation once the batcher
+        applies it — strictly ordered against the queries around it."""
+        return self._submit_mutation("upsert", ids, rows, deadline_s)
+
+    def delete(self, ids, deadline_s: Optional[float] = None
+               ) -> ServingFuture:
+        """Enqueue a delete of external ``ids`` (mutable engines) —
+        visible to every query batch dispatched after it."""
+        return self._submit_mutation("delete", ids, None, deadline_s)
+
     # -- index updates ----------------------------------------------------
+    @property
+    def mutable(self):
+        """The engine's MutableIndex (None on immutable engines)."""
+        return self._mutable
+
     def update_index(self, y, block: bool = False):
         """Rebuild the index from ``y`` and swap it in — in the
         background by default; queries keep hitting the current
         snapshot until the new one is built AND pre-warmed (every
         bucket compiled against the new geometry before the swap), so
         readers never block and never pay a compile."""
+        expects(self._mutable is None,
+                "serving: a mutable engine updates through upsert()/"
+                "delete() (compaction folds the delta in the "
+                "background) — update_index is the immutable path")
         y = np.asarray(y, np.float32)
         expects(y.ndim == 2 and y.shape[1] == self.d,
                 "serving: replacement index must be [m, %d] (got %s)",
@@ -638,6 +784,8 @@ class ServingEngine:
         out["generation"] = self._store.generation
         out["compile_misses"] = self.res.compile_cache.misses
         out["buckets"] = self._ladder
+        if self._mutable is not None:
+            out["mutable"] = self._mutable.stats()
         if self._shadow is not None:
             out.update(self._shadow.snapshot())
         return out
@@ -670,6 +818,7 @@ class ServingEngine:
         batch = []
         total = 0
         expired = []
+        mutation = None
         while self._queue:
             req = self._queue[0]
             if req.deadline_at is not None and req.deadline_at <= now:
@@ -677,6 +826,16 @@ class ServingEngine:
                 self._depth_rows -= req.n
                 expired.append(req)
                 continue
+            if req.kind != "query":
+                # a mutation is a strict ordering barrier: queries
+                # ahead of it dispatch first (this batch), the mutation
+                # runs alone next, queries behind it see its effect
+                if batch:
+                    break
+                self._queue.popleft()
+                self._depth_rows -= req.n
+                mutation = req
+                break
             if total + req.n > self._ladder[-1]:
                 break
             self._queue.popleft()
@@ -684,7 +843,7 @@ class ServingEngine:
             batch.append(req)
             total += req.n
         self._gauge_depth()
-        return batch, total, expired
+        return batch, total, expired, mutation
 
     def _fail_expired(self, expired) -> None:
         for req in expired:
@@ -720,20 +879,25 @@ class ServingEngine:
                     self._busy = False
                     self._cond.notify_all()
                     return
-                batch, total, expired = self._pop_batch_locked()
-                self._busy = bool(batch)
+                batch, total, expired, mutation = \
+                    self._pop_batch_locked()
+                self._busy = bool(batch) or mutation is not None
             self._fail_expired(expired)
-            if batch:
+            if batch or mutation is not None:
                 try:
-                    self._run_batch(batch, total)
+                    if batch:
+                        self._run_batch(batch, total)
+                    if mutation is not None:
+                        self._run_mutation(mutation)
                 finally:
                     with self._cond:
                         self._busy = False
                         self._cond.notify_all()
 
     def _run_batch(self, batch, total: int) -> None:
-        snap = self._store.current()       # ONE snapshot per batch —
-        #                                    every rider sees one index
+        # ONE snapshot/view per batch — every rider sees one index
+        snap = (self._mutable.view() if self._mutable is not None
+                else self._store.current())
         bucket = bucket_for(total, self._ladder)
         x = (batch[0].x if len(batch) == 1
              else np.concatenate([r.x for r in batch], axis=0))
@@ -799,6 +963,55 @@ class ServingEngine:
             off += req.n
             self._count_request("ok")
             self._observe_latency(max(0.0, done - req.enqueued_at))
+
+    def _run_mutation(self, req) -> None:
+        """Apply ONE mutation request on the batcher thread, inside its
+        own deadline scope — the write half of the serving contract:
+        strictly ordered against query batches, never concurrent with a
+        dispatch, and an expired/hung apply fails typed exactly like a
+        query batch would."""
+        from raft_tpu.mutable import apply_delete, apply_upsert
+
+        now = self._clock()
+        budget = (req.deadline_at - now if req.deadline_at is not None
+                  else None)
+        if budget is not None and budget <= 0:
+            self._fail_expired([req])
+            return
+        emit_flow("dispatch", req.rid, ph="t", op=req.kind)
+        emit_serving("mutate", op=req.kind, rows=req.n, rid=req.rid,
+                     budget_s=budget)
+        self._stats[f"{req.kind}s"] += 1
+
+        def _apply():
+            if req.kind == "upsert":
+                return apply_upsert(self._mutable, req.ids, req.x)
+            return apply_delete(self._mutable, req.ids)
+
+        try:
+            if budget is not None:
+                with deadline(budget, label="serving_mutation"):
+                    applied = _apply()
+            else:
+                applied = _apply()
+        except DeadlineExceededError as e:
+            self._count_request("deadline")
+            emit_flow("fail", req.rid, ph="f", outcome="deadline")
+            req.future._fail(e)
+            return
+        except Exception as e:
+            self._count_request("error")
+            emit_flow("fail", req.rid, ph="f", outcome="error")
+            req.future._fail(e)
+            return
+        done = self._clock()
+        emit_flow("response", req.rid, ph="f", outcome="ok")
+        self._count_request("ok")
+        self._observe_latency(max(0.0, done - req.enqueued_at))
+        req.future._complete(
+            {"kind": req.kind, "applied": int(applied),
+             "seq": self._mutable.seq,
+             "generation": self._mutable.generation}, None)
 
     def _on_batch_deadline(self, batch, err: DeadlineExceededError
                            ) -> None:

@@ -32,6 +32,23 @@ from raft_tpu.resilience import fault_point
 
 SNAPSHOT_SWAPS = "raft_tpu_serving_snapshot_swaps_total"
 SNAPSHOT_FAILURES = "raft_tpu_serving_snapshot_failures_total"
+#: the CURRENT snapshot generation, as a gauge (an operator watching
+#: dashboards sees swaps land without diffing counters)
+SNAPSHOT_GENERATION = "raft_tpu_serving_snapshot_generation"
+#: background rebuilds currently in flight (0 or 1 — at most one runs)
+REBUILD_INFLIGHT = "raft_tpu_serving_snapshot_rebuild_inflight"
+#: update() builds whose swap was coalesced away by a NEWER generation
+#: winning the race — previously this drop was silent
+SNAPSHOT_COALESCED = "raft_tpu_serving_snapshot_coalesced_total"
+
+
+def _gauge(name: str, value: float, help: str) -> None:
+    try:
+        from raft_tpu.observability import get_registry
+
+        get_registry().gauge(name, help=help).set(value)
+    except Exception:
+        pass
 
 
 class IndexSnapshot:
@@ -112,6 +129,8 @@ class SnapshotStore:
             get_registry().counter(
                 SNAPSHOT_SWAPS,
                 help="Index snapshot swaps installed").inc()
+            _gauge(SNAPSHOT_GENERATION, snapshot.generation,
+                   "Generation of the currently-serving index snapshot")
             emit_serving("swap", generation=snapshot.generation,
                          n_rows=snapshot.n_rows,
                          db_dtype=getattr(snapshot.index, "db_dtype",
@@ -132,6 +151,8 @@ class SnapshotStore:
             gen = self._generation
 
         def _build():
+            _gauge(REBUILD_INFLIGHT, 1,
+                   "Background snapshot rebuilds currently in flight")
             try:
                 snap = build_snapshot(y, self._builder, gen, **build_kw)
             except Exception as e:
@@ -151,11 +172,25 @@ class SnapshotStore:
                          "(%s: %s) — keeping the current snapshot",
                          gen, type(e).__name__, str(e)[:200])
                 return
+            finally:
+                _gauge(REBUILD_INFLIGHT, 0,
+                       "Background snapshot rebuilds currently in flight")
             with self._lock:
                 # a swap is installed only if no NEWER generation beat
-                # us to it (two racing updates: last requested wins)
+                # us to it (two racing updates: last requested wins) —
+                # the coalesced build is COUNTED, not silently dropped
                 cur = self._current
                 if cur is not None and cur.generation > gen:
+                    try:
+                        from raft_tpu.observability import get_registry
+
+                        get_registry().counter(
+                            SNAPSHOT_COALESCED,
+                            help="Snapshot rebuilds coalesced away by a "
+                                 "newer generation winning the race"
+                        ).inc()
+                    except Exception:
+                        pass
                     return
             self.swap(snap)
 

@@ -250,3 +250,87 @@ def test_committed_ann_artifact_schema():
             p["recall_at_k"] == best
     br = _tools_import("bench_report")
     assert "BENCH_ANN.json" in br.NAMED_ARTIFACTS
+
+
+# ------------------------------------------------------------------
+# mutation gate (ISSUE 11): BENCH_MUTATION / MUTATION_r*
+# ------------------------------------------------------------------
+
+def _mut_record(ok=True, recall=1.0, cycles=2, measured=False,
+                p99=50.0, qps=100.0, degr=0):
+    rec = {
+        "metric": "mutation top-8 mixed load 120 reads over 2048x32",
+        "value": qps, "unit": "req/s", "ok": ok, "skipped": False,
+        "measured": measured, "recall": recall, "recall_floor": 0.95,
+        "compaction_cycles": cycles, "p99_ms": p99,
+        "throughput_qps": qps, "reads_during_fold": 3,
+    }
+    if degr:
+        rec["resilience_degradations"] = degr
+    return rec
+
+
+def test_check_mutation_gates_ok_cycles_and_recall(tmp_path):
+    br = _tools_import("bench_report")
+    # nothing to gate → skip (pass-or-no-op)
+    status, _ = br.check_mutation(br.collect_mutation(str(tmp_path)))
+    assert status == br.SKIP
+    # ok=false → regress
+    _write(tmp_path / "BENCH_MUTATION.json", _mut_record(ok=False))
+    status, msg = br.check_mutation(br.collect_mutation(str(tmp_path)))
+    assert status == br.REGRESS and "ok=false" in msg
+    # zero compaction cycles → regress (no fill→fold→swap evidence)
+    _write(tmp_path / "BENCH_MUTATION.json", _mut_record(cycles=0))
+    status, msg = br.check_mutation(br.collect_mutation(str(tmp_path)))
+    assert status == br.REGRESS and "COMPACTION" in msg
+    # recall below the floor → regress even on a modeled round
+    _write(tmp_path / "BENCH_MUTATION.json", _mut_record(recall=0.90))
+    status, msg = br.check_mutation(br.collect_mutation(str(tmp_path)))
+    assert status == br.REGRESS and "RECALL" in msg
+    # degraded run → skip
+    _write(tmp_path / "BENCH_MUTATION.json", _mut_record(degr=1))
+    status, msg = br.check_mutation(br.collect_mutation(str(tmp_path)))
+    assert status == br.SKIP and "degrad" in msg
+    # healthy modeled round passes, not speed-gated
+    _write(tmp_path / "BENCH_MUTATION.json", _mut_record())
+    status, msg = br.check_mutation(br.collect_mutation(str(tmp_path)))
+    assert status == br.PASS and "not speed-gated" in msg
+
+
+def test_check_mutation_measured_speed_trend(tmp_path):
+    br = _tools_import("bench_report")
+    _write(tmp_path / "MUTATION_r01.json",
+           _mut_record(measured=True, p99=100.0, qps=100.0))
+    _write(tmp_path / "BENCH_MUTATION.json",
+           _mut_record(measured=True, p99=200.0, qps=100.0))
+    status, msg = br.check_mutation(br.collect_mutation(str(tmp_path)))
+    assert status == br.REGRESS and "P99" in msg
+    _write(tmp_path / "BENCH_MUTATION.json",
+           _mut_record(measured=True, p99=105.0, qps=50.0))
+    status, msg = br.check_mutation(br.collect_mutation(str(tmp_path)))
+    assert status == br.REGRESS and "THROUGHPUT" in msg
+    _write(tmp_path / "BENCH_MUTATION.json",
+           _mut_record(measured=True, p99=105.0, qps=95.0))
+    status, msg = br.check_mutation(br.collect_mutation(str(tmp_path)))
+    assert status == br.PASS
+    out = br.mutation_trajectory(br.collect_mutation(str(tmp_path)))
+    assert "r01" in out and "recall" in out
+
+
+def test_committed_mutation_artifact_schema():
+    """The committed BENCH_MUTATION.json must carry what the gate
+    reads: ok, recall ≥ floor, ≥ 1 full compaction cycle, and an
+    honest measured stamp."""
+    root = os.path.join(os.path.dirname(__file__), "..")
+    path = os.path.join(root, "BENCH_MUTATION.json")
+    if not os.path.exists(path):
+        pytest.skip("no BENCH_MUTATION.json committed")
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["ok"] is True
+    assert isinstance(rec["measured"], bool)
+    assert rec["recall"] >= rec["recall_floor"] >= 0.95
+    assert rec["compaction_cycles"] >= 1
+    assert rec["quality"]["fixup_rate"] >= 0.0
+    br = _tools_import("bench_report")
+    assert "BENCH_MUTATION.json" in br.NAMED_ARTIFACTS

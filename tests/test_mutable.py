@@ -1,0 +1,475 @@
+"""Mutable-index tests (ISSUE 11 tentpole).
+
+The mixed read/write parity contract: upserts/deletes interleaved with
+queries must match a from-scratch rebuild oracle exactly (values
+bit-equal, id sets identical) at EVERY generation, across the brute
+f32, brute int8 and IVF planes — including a query racing a compaction
+swap, a query completing WHILE a fold is in flight (readers never
+block on a writer), and online shadow recall holding the 0.95 floor
+while the delta tail grows. Plus the IndexLayout pure-ops refactor
+(ragged prepare_knn_index, the shared IVF layout) and the serving
+engine's mutation request types.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import resilience
+from raft_tpu.distance.knn_fused import knn_fused, prepare_knn_index
+from raft_tpu.mutable import (IndexLayout, MutableIndex, apply_delete,
+                              apply_upsert, dense_layout,
+                              fused_ops_for_layout,
+                              ragged_layout_from_lists, run_fused_ops,
+                              search_view)
+
+rng = np.random.default_rng(23)
+
+D, K = 16, 5
+CFG = dict(passes=3, T=256, Qb=32, g=2)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+class _Model:
+    """Host-side truth: external id → row, in insertion order — the
+    from-scratch rebuild oracle's input."""
+
+    def __init__(self, y, ids):
+        self.rows = {int(e): y[i] for i, e in enumerate(ids)}
+
+    def upsert(self, ids, rows):
+        for e, r in zip(ids, rows):
+            self.rows.pop(int(e), None)
+            self.rows[int(e)] = r
+
+    def delete(self, ids):
+        for e in ids:
+            self.rows.pop(int(e), None)
+
+    def oracle(self, x, k):
+        exts = np.asarray(list(self.rows), np.int32)
+        mat = np.stack([self.rows[int(e)] for e in exts])
+        ov, oi = knn_fused(x, mat, k, **CFG)
+        return np.asarray(ov), exts[np.asarray(oi)]
+
+
+def _assert_parity(mi, model, x, k, exact=False):
+    """IDS are the bit-identical contract (the acceptance criterion);
+    values are exact-f32 on both sides but may differ in the last ulp
+    when a certificate fixup fires on one side only (the fixup's
+    dot_general rounds differently than the rescore einsum)."""
+    ov, oe = model.oracle(x, k)
+    sv, si = search_view(mi, x, k, exact=exact)
+    assert np.allclose(np.asarray(sv), ov, rtol=1e-5, atol=1e-5)
+    assert np.array_equal(np.sort(np.asarray(si), 1), np.sort(oe, 1))
+
+
+def _make(plane, y, threshold=48, auto=False):
+    if plane == "brute_f32":
+        return MutableIndex(y, **CFG, compact_threshold=threshold,
+                            auto_compact=auto)
+    if plane == "brute_int8":
+        return MutableIndex(y, **CFG, db_dtype="int8",
+                            compact_threshold=threshold,
+                            auto_compact=auto)
+    return MutableIndex(y, algorithm="ivf_flat", n_lists=8,
+                        compact_threshold=threshold, auto_compact=auto)
+
+
+PLANES = ("brute_f32", "brute_int8", "ivf")
+
+
+@pytest.mark.parametrize("plane", PLANES)
+def test_mixed_mutation_parity_every_generation(plane):
+    """Interleaved upsert/delete/search vs the rebuild oracle at every
+    step, across a full compaction cycle, on all three planes. The
+    int8 plane's ids are certified against the F32 oracle (the PR-9
+    contract carries straight onto the delta tail)."""
+    m = 320
+    y = rng.normal(size=(m, D)).astype(np.float32)
+    x = rng.normal(size=(7, D)).astype(np.float32)
+    mi = _make(plane, y)
+    model = _Model(y, np.arange(m))
+    exact = plane == "ivf"
+    _assert_parity(mi, model, x, K, exact)
+
+    # generation 1: deletes (base tombstones)
+    dels = [0, 17, 31, 200]
+    assert apply_delete(mi, dels) == 4
+    model.delete(dels)
+    _assert_parity(mi, model, x, K, exact)
+
+    # generation 2: fresh inserts
+    ids1 = np.arange(1000, 1020)
+    rows1 = rng.normal(size=(20, D)).astype(np.float32)
+    apply_upsert(mi, ids1, rows1)
+    model.upsert(ids1, rows1)
+    _assert_parity(mi, model, x, K, exact)
+
+    # generation 3: overwrites — one base row, one delta row, one
+    # resurrecting a deleted id
+    ids2 = np.array([5, 1000, 17])
+    rows2 = rng.normal(size=(3, D)).astype(np.float32)
+    apply_upsert(mi, ids2, rows2)
+    model.upsert(ids2, rows2)
+    _assert_parity(mi, model, x, K, exact)
+
+    # generation 4: delete a delta row
+    apply_delete(mi, [1001])
+    model.delete([1001])
+    _assert_parity(mi, model, x, K, exact)
+
+    # compaction folds everything into a fresh base — content invariant
+    gen0 = mi.generation
+    assert mi.compact(block=True)
+    assert mi.generation > gen0
+    st = mi.stats()
+    assert st["delta_rows"] == 0 and st["tombstones"] == 0
+    assert st["base_live"] == len(model.rows)
+    _assert_parity(mi, model, x, K, exact)
+
+    # post-compaction churn: the rebased lookup keeps answering
+    ids3 = np.array([1000, 2000])
+    rows3 = rng.normal(size=(2, D)).astype(np.float32)
+    apply_upsert(mi, ids3, rows3)
+    model.upsert(ids3, rows3)
+    apply_delete(mi, [5])
+    model.delete([5])
+    _assert_parity(mi, model, x, K, exact)
+
+
+def test_ivf_probe_path_masks_tombstones():
+    """The probed (approximate) IVF path must never return a deleted
+    id, and full probing equals the exact oracle."""
+    m = 400
+    y = rng.normal(size=(m, D)).astype(np.float32)
+    x = rng.normal(size=(9, D)).astype(np.float32)
+    mi = _make("ivf", y)
+    model = _Model(y, np.arange(m))
+    dels = list(range(0, 40))
+    apply_delete(mi, dels)
+    model.delete(dels)
+    new = np.arange(900, 910)
+    rows = rng.normal(size=(10, D)).astype(np.float32)
+    apply_upsert(mi, new, rows)
+    model.upsert(new, rows)
+    ov, oe = model.oracle(x, K)
+    for P in (3, 6):
+        sv, si = search_view(mi, x, K, n_probes=P)
+        assert not (set(np.asarray(si).ravel().tolist()) & set(dels))
+    # n_probes ≥ n_lists degrades to the certified exact scan
+    sv, si = search_view(mi, x, K, n_probes=8)
+    assert np.array_equal(np.asarray(sv), ov)
+    assert np.array_equal(np.sort(np.asarray(si), 1), np.sort(oe, 1))
+
+
+def test_auto_compaction_trigger_and_delta_cap_wait():
+    """Crossing the watermark triggers the background fold; a writer
+    that fills the delta cap folds inline instead of failing."""
+    m = 256
+    y = rng.normal(size=(m, D)).astype(np.float32)
+    mi = MutableIndex(y, **CFG, compact_threshold=32, delta_cap=64,
+                      auto_compact=True)
+    model = _Model(y, np.arange(m))
+    for b in range(6):                       # 6 × 16 = 96 rows > cap
+        ids = np.arange(5000 + 16 * b, 5000 + 16 * (b + 1))
+        rows = rng.normal(size=(16, D)).astype(np.float32)
+        apply_upsert(mi, ids, rows)
+        model.upsert(ids, rows)
+    mi.wait_for_compaction(timeout=60)
+    assert mi.compactions >= 1
+    x = rng.normal(size=(5, D)).astype(np.float32)
+    _assert_parity(mi, model, x, K)
+
+
+def test_query_races_compaction_swap():
+    """Queries hammering the index while a fold runs + swaps must each
+    see a consistent view — and since a fold is content-invariant,
+    every result equals the oracle regardless of which side of the
+    swap it lands on."""
+    m = 512
+    y = rng.normal(size=(m, D)).astype(np.float32)
+    x = rng.normal(size=(6, D)).astype(np.float32)
+    mi = _make("brute_f32", y, threshold=64)
+    model = _Model(y, np.arange(m))
+    ids = np.arange(3000, 3070)
+    rows = rng.normal(size=(70, D)).astype(np.float32)
+    apply_upsert(mi, ids, rows)
+    model.upsert(ids, rows)
+    ov, oe = model.oracle(x, K)
+    results, errors = [], []
+
+    def reader():
+        try:
+            for _ in range(12):
+                sv, si = search_view(mi, x, K)
+                results.append((np.asarray(sv), np.asarray(si)))
+        except Exception as e:                # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    assert mi.compact(block=True)
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(results) == 36
+    for sv, si in results:
+        assert np.allclose(sv, ov, rtol=1e-5, atol=1e-5)
+        assert np.array_equal(np.sort(si, 1), np.sort(oe, 1))
+    _assert_parity(mi, model, x, K)
+
+
+def test_readers_complete_while_fold_in_flight():
+    """The structural never-block proof: the fold's rebuild is held on
+    a barrier while a reader completes a full search — readers never
+    wait on the compactor."""
+    m = 256
+    y = rng.normal(size=(m, D)).astype(np.float32)
+    x = rng.normal(size=(4, D)).astype(np.float32)
+    mi = _make("brute_f32", y)
+    model = _Model(y, np.arange(m))
+    ids = np.arange(4000, 4020)
+    rows = rng.normal(size=(20, D)).astype(np.float32)
+    apply_upsert(mi, ids, rows)
+    model.upsert(ids, rows)
+
+    gate = threading.Event()
+    inner = mi._build_index
+
+    def held_build(yy):
+        assert gate.wait(timeout=60)
+        return inner(yy)
+
+    mi._build_index = held_build
+    try:
+        assert mi.compact(block=False)
+        t0 = time.monotonic()
+        while not mi.folding and time.monotonic() - t0 < 10:
+            time.sleep(0.001)
+        assert mi.folding
+        # a read AND a write both complete while the fold is held
+        _assert_parity(mi, model, x, K)
+        apply_delete(mi, [4000])
+        model.delete([4000])
+        _assert_parity(mi, model, x, K)
+    finally:
+        gate.set()
+        mi._build_index = inner
+    mi.wait_for_compaction(timeout=60)
+    assert mi.compactions == 1
+    # the mid-fold delete survived the rebase onto the new base
+    _assert_parity(mi, model, x, K)
+
+
+def test_mutation_flight_events_and_gauges():
+    """The write-ahead mutation stream: upsert/delete/compact events in
+    order, and the delta/tombstone gauges live."""
+    from raft_tpu.observability import get_flight_recorder, get_registry
+
+    m = 128
+    y = rng.normal(size=(m, D)).astype(np.float32)
+    mi = _make("brute_f32", y)
+    apply_upsert(mi, [9000], rng.normal(size=(1, D)).astype(np.float32))
+    apply_delete(mi, [0])
+    assert mi.compact(block=True)
+    kinds = [e.get("name") for e in get_flight_recorder().events()
+             if e.get("kind") == "mutation"]
+    for want in ("upsert", "delete", "compact_start", "compact_swap"):
+        assert want in kinds, kinds
+    gauges = {m_.name: m_.value for m_ in get_registry().collect()
+              if m_.name.startswith("raft_tpu_mutable_")}
+    assert "raft_tpu_mutable_delta_rows" in gauges
+    assert "raft_tpu_mutable_tombstone_frac" in gauges
+    assert "raft_tpu_mutable_compaction_debt" in gauges
+
+
+def test_delta_search_reports_quality_counters():
+    """The delta tail is a certified path like any other: searches must
+    queue certificate/fixup telemetry under the mutable sites."""
+    from raft_tpu.observability import quality
+
+    m = 128
+    y = rng.normal(size=(m, D)).astype(np.float32)
+    mi = _make("brute_f32", y)
+    apply_upsert(mi, np.arange(8000, 8010),
+                 rng.normal(size=(10, D)).astype(np.float32))
+    quality.drain()
+    search_view(mi, rng.normal(size=(4, D)).astype(np.float32), K)
+    quality.drain()
+    sites = set()
+    for metric in quality.get_registry().collect():
+        if metric.name == quality.CERT_CHECKS:
+            sites.add(metric.labels.get("site"))
+    assert "mutable.search_base" in sites
+    assert "mutable.search_delta" in sites
+
+
+# ------------------------------------------------------------------
+# IndexLayout pure ops
+# ------------------------------------------------------------------
+
+def test_prepare_knn_index_accepts_ragged_layout():
+    """A layout with interspersed invalid rows builds a ragged
+    KnnIndex whose queries decode through the layout ids and match the
+    dense oracle over the live rows."""
+    m = 200
+    y = rng.normal(size=(m, D)).astype(np.float32)
+    valid = rng.random(m) > 0.3
+    ids = np.arange(100, 100 + m, dtype=np.int32)
+    lay = dense_layout(y, ids=ids, rows_valid=valid)
+    idx = prepare_knn_index(lay, **CFG)
+    x = rng.normal(size=(6, D)).astype(np.float32)
+    sv, si = knn_fused(x, idx, K)
+    ov, oi = knn_fused(x, y[valid], K, **CFG)
+    assert np.array_equal(np.asarray(sv), np.asarray(ov))
+    assert np.array_equal(np.sort(np.asarray(si), 1),
+                          np.sort(ids[valid][np.asarray(oi)], 1))
+
+
+def test_run_fused_ops_matches_oracle_f32_and_int8():
+    for dt in (None, "int8"):
+        m = 180
+        y = rng.normal(size=(m, D)).astype(np.float32)
+        valid = np.ones(m, bool)
+        valid[::7] = False
+        lay = dense_layout(y, rows_valid=valid)
+        fops = fused_ops_for_layout(lay, T=256, Qb=32, g=2, db_dtype=dt)
+        x = rng.normal(size=(5, D)).astype(np.float32)
+        vals, pos, n_fail = run_fused_ops(fops, x, K)
+        import jax.numpy as jnp
+
+        gids = np.asarray(jnp.where(pos >= 0,
+                                    jnp.take(fops.ids,
+                                             jnp.maximum(pos, 0)), -1))
+        ov, oi = knn_fused(x, y[valid], K, **CFG)
+        live_ids = np.arange(m)[valid]
+        assert np.array_equal(np.asarray(vals), np.asarray(ov))
+        assert np.array_equal(np.sort(gids, 1),
+                              np.sort(live_ids[np.asarray(oi)], 1))
+
+
+def test_ragged_layout_from_lists_invariants():
+    m, L, q = 123, 7, 8
+    y = rng.normal(size=(m, D)).astype(np.float32)
+    labels = rng.integers(0, L, m)
+    lay = ragged_layout_from_lists(y, labels, L, q)
+    assert isinstance(lay, IndexLayout) and lay.ragged
+    sizes = np.asarray(lay.sizes)
+    padded = np.asarray(lay.padded_sizes)
+    offsets = np.asarray(lay.offsets)
+    assert np.array_equal(sizes, np.bincount(labels, minlength=L))
+    assert (padded % q == 0).all()
+    assert offsets[-1] == padded.sum() == lay.slab_rows
+    ids = np.asarray(lay.ids)
+    assert np.array_equal(np.sort(ids[ids >= 0]), np.arange(m))
+    # every real row landed in its own list's window, bit-identical
+    for gl in range(L):
+        seg = ids[offsets[gl]:offsets[gl] + sizes[gl]]
+        assert (labels[seg] == gl).all()
+        assert np.array_equal(np.asarray(lay.slab)[offsets[gl]:
+                                                   offsets[gl]
+                                                   + sizes[gl]], y[seg])
+
+
+# ------------------------------------------------------------------
+# serving engine: mutation request types through the batcher
+# ------------------------------------------------------------------
+
+@pytest.fixture()
+def mutable_engine():
+    from raft_tpu.serving import ServingEngine
+
+    y = rng.normal(size=(300, D)).astype(np.float32)
+    eng = ServingEngine(y, k=K, mutable=True, buckets=(8, 32),
+                        **CFG, compact_threshold=1000,
+                        flush_interval_s=0.002)
+    eng.start()
+    yield eng, y
+    eng.stop()
+
+
+def test_engine_mutations_ordered_with_queries(mutable_engine):
+    eng, y = mutable_engine
+    x = rng.normal(size=(4, D)).astype(np.float32)
+    v, i = eng.query(x)
+    ov, oi = knn_fused(x, y, K, **CFG)
+    assert np.array_equal(v, np.asarray(ov))
+    info, _ = eng.delete([0, 1]).result(timeout=30)
+    assert info["applied"] == 2
+    # a delete enqueued BEFORE a query is visible to it (strict order)
+    fut_d = eng.delete([2])
+    fut_q = eng.submit(x)
+    fut_d.result(timeout=30)
+    _, i2 = fut_q.result(timeout=30)
+    assert not (set(np.asarray(i2).ravel().tolist()) & {0, 1, 2})
+    info, _ = eng.upsert(
+        [700], rng.normal(size=(1, D)).astype(np.float32)
+    ).result(timeout=30)
+    assert info["applied"] == 1
+    st = eng.stats()
+    assert st["mutable"]["delta_live"] == 1
+    assert st["upserts"] == 1 and st["deletes"] == 2
+
+
+def test_engine_upsert_past_delta_cap_rejected(mutable_engine):
+    from raft_tpu.serving import RequestTooLargeError
+
+    eng, _ = mutable_engine
+    cap = eng.mutable.delta_cap
+    with pytest.raises(RequestTooLargeError):
+        eng.upsert(np.arange(10_000, 10_001 + cap),
+                   rng.normal(size=(cap + 1, D)).astype(np.float32))
+
+
+def test_engine_immutable_rejects_mutations():
+    from raft_tpu.core.error import LogicError
+    from raft_tpu.serving import ServingEngine
+
+    y = rng.normal(size=(64, D)).astype(np.float32)
+    eng = ServingEngine(y, k=2, buckets=(8,), **CFG)
+    with pytest.raises(LogicError):
+        eng.delete([0])
+    # and a mutable engine rejects the whole-index replace path
+    eng2 = ServingEngine(y, k=2, mutable=True, buckets=(8,), **CFG)
+    with pytest.raises(LogicError):
+        eng2.update_index(y)
+
+
+def test_engine_shadow_recall_holds_while_delta_grows(mutable_engine):
+    """Online recall shadow-sampling (PR 10) stays ≥ 0.95 while the
+    delta tail grows — the serving-quality acceptance of ISSUE 11.
+    (The brute mutable plane is exact, so the floor holds with margin;
+    the point is the PIPE: live mutable responses re-scored against
+    the exact view oracle.)"""
+    eng, _ = mutable_engine
+    eng._shadow_frac = 1.0
+    from raft_tpu.observability.quality import ShadowSampler
+
+    eng._shadow = ShadowSampler(eng._shadow_oracle, eng.k, 1.0,
+                                floor=0.95).start()
+    x = rng.normal(size=(4, D)).astype(np.float32)
+    try:
+        for b in range(4):
+            ids = np.arange(6000 + 10 * b, 6000 + 10 * (b + 1))
+            eng.upsert(ids, rng.normal(size=(10, D)).astype(np.float32)
+                       ).result(timeout=30)
+            eng.query(x)
+        assert eng.shadow.flush(timeout=60)
+        snap = eng.shadow.snapshot()
+        assert snap["shadow_samples"] >= 2
+        assert snap["shadow_recall"] >= 0.95
+        assert snap["shadow_breaches"] == 0
+        assert eng.stats()["mutable"]["delta_live"] == 40
+    finally:
+        eng._shadow.stop()
+        eng._shadow = None

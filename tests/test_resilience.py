@@ -254,6 +254,44 @@ def _drive_ivf_search():
                            np.ones((2, 8), np.float32), 2, n_probes=2)
 
 
+_mutable_index = None
+
+
+def _mutable_small():
+    """A tiny shared MutableIndex (auto-compaction off — the matrix
+    drivers route through one site each; the high watermark keeps the
+    upsert/delete drivers from triggering a background fold)."""
+    global _mutable_index
+    if _mutable_index is None:
+        from raft_tpu.mutable import MutableIndex
+
+        _mutable_index = MutableIndex(
+            rng.normal(size=(64, 8)).astype(np.float32),
+            T=256, Qb=32, g=2, compact_threshold=10_000,
+            auto_compact=False)
+    return _mutable_index
+
+
+def _drive_mutate_ingest():
+    from raft_tpu.mutable import apply_upsert
+
+    return apply_upsert(_mutable_small(), [100],
+                        rng.normal(size=(1, 8)).astype(np.float32))
+
+
+def _drive_tombstone_apply():
+    from raft_tpu.mutable import apply_delete
+
+    return apply_delete(_mutable_small(), [0])
+
+
+def _drive_compact_fold():
+    """The fault site fires at the top of the fold, BEFORE the rebuild
+    — the old snapshot provably keeps serving (the dedicated torn-
+    generation test below pins the evidence)."""
+    return _mutable_small().compact(block=True)
+
+
 _serving_engine = None
 
 
@@ -334,6 +372,12 @@ def _always_raise_drivers():
         "ivf_build": _drive_ivf_build,
         "ivf_search": _drive_ivf_search,
         "serving_enqueue": _drive_serving_enqueue,
+        # mutable indexes: ingest / tombstone / compaction fold — each
+        # site fires before any state change, so the shared index stays
+        # consistent across the matrix
+        "mutate_ingest": _drive_mutate_ingest,
+        "tombstone_apply": _drive_tombstone_apply,
+        "compact_fold": _drive_compact_fold,
         "sharded_dispatch": None,      # dedicated ladder tests below
         "merge_permute": None,
         "merge_allgather": None,
@@ -400,6 +444,81 @@ def test_injected_faults_carry_marker():
                 InjectedTimeout("x")):
         assert isinstance(exc, InjectedFault)
         assert isinstance(exc, DeviceError)
+
+
+# ------------------------------------------------------------------
+# mutable indexes: a mid-compaction crash keeps the old snapshot
+# ------------------------------------------------------------------
+
+def _fresh_mutable(m=128, d=8, threshold=32):
+    from raft_tpu.mutable import MutableIndex
+
+    y = rng.normal(size=(m, d)).astype(np.float32)
+    return y, MutableIndex(y, T=256, Qb=32, g=2,
+                           compact_threshold=threshold,
+                           auto_compact=False)
+
+
+def test_compact_fold_crash_keeps_old_snapshot():
+    """An injected crash at the ``compact_fold`` site (and at the
+    ``serving_snapshot`` rebuild inside the fold) must leave the old
+    generation serving with the delta/tombstone state intact — no torn
+    generation, and a later clean compaction succeeds."""
+    from raft_tpu.distance.knn_fused import knn_fused
+    from raft_tpu.mutable import apply_delete, apply_upsert, search_view
+
+    y, mi = _fresh_mutable()
+    d = y.shape[1]
+    apply_delete(mi, [0, 1])
+    apply_upsert(mi, np.arange(500, 540),
+                 rng.normal(size=(40, d)).astype(np.float32))
+    gen0 = mi.generation
+    seq0 = mi.seq
+    stats0 = mi.stats()
+
+    # leg 1: crash at the fold entry (before any rebuild)
+    resilience.configure_faults("compact_fold:error")
+    with pytest.raises(InjectedDeviceError):
+        mi.compact(block=True)
+    assert mi.generation == gen0
+    assert not mi.folding
+    st = mi.stats()
+    assert st["delta_rows"] == stats0["delta_rows"]
+    assert st["tombstones"] == stats0["tombstones"]
+
+    # leg 2: crash inside the snapshot rebuild (SnapshotStore swallows,
+    # the fold reports failure) — old snapshot still serving
+    resilience.configure_faults("serving_snapshot:error")
+    with pytest.raises(Exception):
+        mi.compact(block=True)
+    assert not mi.folding
+    assert mi.stats()["delta_rows"] == stats0["delta_rows"]
+
+    # the surviving state still answers exactly like the rebuild oracle
+    resilience.clear_faults()
+    x = rng.normal(size=(5, d)).astype(np.float32)
+    live = np.ones(y.shape[0], bool)
+    live[[0, 1]] = False
+    rows = np.concatenate(
+        [y[live], np.asarray(mi._d_rows[:40], np.float32)])
+    exts = np.concatenate([np.arange(y.shape[0])[live],
+                           np.arange(500, 540)])
+    ov, oi = knn_fused(x, rows, 5, passes=3, T=256, Qb=32, g=2)
+    sv, si = search_view(mi, x, 5)
+    assert np.array_equal(np.asarray(sv), np.asarray(ov))
+    assert np.array_equal(np.sort(np.asarray(si), 1),
+                          np.sort(exts[np.asarray(oi)], 1))
+
+    # a clean compaction now lands: generation advances, delta folds
+    assert mi.compact(block=True)
+    assert mi.generation > gen0
+    assert mi.seq > seq0
+    st = mi.stats()
+    assert st["delta_rows"] == 0 and st["tombstones"] == 0
+    sv, si = search_view(mi, x, 5)
+    assert np.array_equal(np.asarray(sv), np.asarray(ov))
+    assert np.array_equal(np.sort(np.asarray(si), 1),
+                          np.sort(exts[np.asarray(oi)], 1))
 
 
 # ------------------------------------------------------------------

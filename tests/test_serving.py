@@ -242,6 +242,51 @@ def test_snapshot_build_failure_keeps_current(data):
     assert store.current().generation == 2
 
 
+def _metric_value(name, default=None):
+    for m in get_registry().collect():
+        if m.name == name:
+            return m.value
+    return default
+
+
+def test_snapshot_store_gauges_and_coalesced_counter(data):
+    """ISSUE-11 satellite: the store exposes its generation and
+    in-flight-rebuild state as gauges, and a build whose swap lost the
+    generation race is COUNTED instead of silently dropped
+    (snapshot.py's last-wins branch)."""
+    from raft_tpu.serving.snapshot import (REBUILD_INFLIGHT,
+                                           SNAPSHOT_COALESCED,
+                                           SNAPSHOT_GENERATION)
+
+    y, idx = data
+    gate = threading.Event()
+    order = []
+
+    def builder(yy, **kw):
+        tag = yy.shape[0]
+        if tag == 64:          # the SLOW build — held until released
+            assert gate.wait(timeout=30)
+        order.append(tag)
+        return prepare_knn_index(yy, **CFG)
+
+    store = SnapshotStore(builder, initial_index=idx)
+    coalesced0 = _metric_value(SNAPSHOT_COALESCED, 0.0) or 0.0
+    slow = rng.normal(size=(64, D)).astype(np.float32)
+    fast = rng.normal(size=(72, D)).astype(np.float32)
+    t = store.update(slow, block=False)       # gen 1, held
+    store.update(fast, block=True)            # gen 2, swaps first
+    assert store.current().generation == 2
+    assert _metric_value(SNAPSHOT_GENERATION) == 2
+    gate.set()
+    t.join(30)
+    # the gen-1 build finished AFTER gen 2 swapped: coalesced, counted,
+    # and the serving snapshot is still gen 2
+    assert store.current().generation == 2
+    assert (_metric_value(SNAPSHOT_COALESCED, 0.0) or 0.0) \
+        == coalesced0 + 1
+    assert _metric_value(REBUILD_INFLIGHT) == 0
+
+
 # ------------------------------------------------------------------
 # AOT warm-up: zero compile misses in steady state
 # ------------------------------------------------------------------

@@ -56,6 +56,8 @@ SERVING_GLOB = "SERVING_r*.json"
 SERVING_NAME = "BENCH_SERVING.json"
 ANN_GLOB = "ANN_r*.json"
 ANN_NAME = "BENCH_ANN.json"
+MUTATION_GLOB = "MUTATION_r*.json"
+MUTATION_NAME = "BENCH_MUTATION.json"
 # recall@k may drop at most this much ABSOLUTE between rounds (recall
 # is platform-independent math, so the trend gates modeled rounds too —
 # only the ms columns are speed and measured-only)
@@ -76,7 +78,8 @@ DRIFT_BAND = 3.0
 # all predate multiple perf rounds at the time this gate shipped)
 NAMED_ARTIFACTS = ("SELECT_K_MATRIX.json", "PALLAS_SMOKE.json",
                    "TPU_FUZZ.json", "BUSBW_BENCH.json",
-                   "BENCH_SERVING.json", "BENCH_ANN.json")
+                   "BENCH_SERVING.json", "BENCH_ANN.json",
+                   "BENCH_MUTATION.json")
 
 # cost-model fields Fixture.run emits into BENCH artifacts (PR 2+)
 COST_FIELDS = ("flops", "bytes_accessed", "arithmetic_intensity",
@@ -600,6 +603,171 @@ def ann_trajectory(rounds: Sequence[Tuple[int, str,
     return "\n".join(lines) + "\n"
 
 
+def load_mutation(path: str) -> Optional[Dict]:
+    """Flat mixed read/write record (benchmarks/bench_mutation.py):
+    unwraps the driver's envelope like :func:`load_serving`. A record
+    must carry an ``ok`` verdict, a recall, or a compaction count to
+    count."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict):
+        return None
+    rec = data.get("parsed")
+    keys = ("ok", "recall", "compaction_cycles")
+    if isinstance(rec, dict) and any(k in rec for k in keys):
+        merged = dict(data)
+        merged.update(rec)
+        return merged
+    if any(k in data for k in keys):
+        return data
+    return None
+
+
+def collect_mutation(directory: str
+                     ) -> List[Tuple[int, str, Optional[Dict]]]:
+    """(round, path, record) for every MUTATION_r*.json, in round
+    order, plus the bare BENCH_MUTATION.json (when present) as the
+    NEWEST entry — same convention as :func:`collect_serving`."""
+    out = []
+    for path in glob.glob(os.path.join(directory, MUTATION_GLOB)):
+        m = re.search(r"MUTATION_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        out.append((int(m.group(1)), path, load_mutation(path)))
+    out.sort(key=lambda t: t[0])
+    bare = os.path.join(directory, MUTATION_NAME)
+    if os.path.exists(bare):
+        n = (out[-1][0] + 1) if out else 1
+        out.append((n, bare, load_mutation(bare)))
+    return out
+
+
+def check_mutation(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
+                   threshold: float = DEFAULT_THRESHOLD
+                   ) -> Tuple[str, str]:
+    """Gate the mutable-index mixed read/write evidence
+    (BENCH_MUTATION / MUTATION_r*):
+
+    - the newest parseable round must be ``ok`` (rebuild-oracle recall
+      held, every read completed — a broken mutation plane is a
+      regression, not a footnote);
+    - degraded rounds (nonzero resilience degradations) SKIP;
+    - **compaction cycle**: the round must have completed ≥ 1 full
+      delta-fill → fold → swap cycle under load — an artifact that
+      never folded proved nothing about the tentpole;
+    - **recall floor**: quiescent recall vs the from-scratch rebuild
+      oracle must reach the artifact's ``recall_floor`` (0.95) —
+      platform-independent, so modeled rounds gate too;
+    - **speed trend**: only MEASURED rounds gate read p99 / throughput
+      (same ±threshold convention as the serving gate)."""
+    newest = None
+    for _, _, rec in reversed(rounds):
+        if rec is not None:
+            newest = rec
+            break
+    if newest is None:
+        return SKIP, "no mutation artifact to gate"
+    if newest.get("skipped"):
+        return SKIP, "latest mutation round skipped"
+    rd = newest.get("resilience_degradations")
+    if isinstance(rd, (int, float)) and rd > 0:
+        return SKIP, (
+            f"latest mutation round recorded {rd:g} degradation "
+            f"step(s) — a degraded run is history, never gated and "
+            f"never baseline material")
+    if not newest.get("ok", True):
+        return REGRESS, ("latest mutation round failed (ok=false) — "
+                         "the mutation plane regressed")
+    cycles = newest.get("compaction_cycles")
+    if isinstance(cycles, (int, float)) and cycles < 1:
+        return REGRESS, (
+            "MUTATION COMPACTION REGRESSION: the round completed 0 "
+            "compaction cycles — the delta never folded, so the "
+            "artifact carries no evidence for the fill→fold→swap "
+            "contract")
+    recall = newest.get("recall")
+    floor = newest.get("recall_floor", QUALITY_RECALL_FLOOR)
+    if isinstance(recall, (int, float)) and isinstance(floor,
+                                                       (int, float)):
+        if recall < floor:
+            return REGRESS, (
+                f"MUTATION RECALL REGRESSION: rebuild-oracle recall "
+                f"{recall:.4f} < floor {floor:g} — interleaved "
+                f"mutations degraded served answers")
+    msgs = [f"recall {recall:.4f}" if isinstance(recall, (int, float))
+            else "no recall field",
+            f"{cycles:g} compaction cycle(s)"
+            if isinstance(cycles, (int, float)) else "no cycle count"]
+    if not newest.get("measured"):
+        return PASS, ("mutation ok: " + "; ".join(msgs)
+                      + " (modeled — not speed-gated)")
+    prev = None
+    for _, _, rec in reversed(rounds[:-1]):
+        if (rec is not None and rec.get("measured")
+                and not rec.get("skipped")
+                and isinstance(rec.get("p99_ms"), (int, float))):
+            prev = rec
+            break
+    if prev is None:
+        return PASS, ("mutation ok: " + "; ".join(msgs)
+                      + " (first measured round)")
+    p99, pp99 = newest.get("p99_ms"), prev.get("p99_ms")
+    if isinstance(p99, (int, float)) and isinstance(pp99, (int, float)):
+        ceil = pp99 * (1.0 + threshold)
+        if p99 > ceil:
+            return REGRESS, (
+                f"MUTATION P99 REGRESSION: {p99:g} ms > {ceil:g} "
+                f"(previous measured {pp99:g} + {threshold:.0%})")
+        msgs.append(f"p99 {p99:g} vs {pp99:g} ms")
+    qps, pqps = newest.get("throughput_qps"), prev.get("throughput_qps")
+    if isinstance(qps, (int, float)) and isinstance(pqps, (int, float)) \
+            and pqps > 0:
+        fl = pqps * (1.0 - threshold)
+        if qps < fl:
+            return REGRESS, (
+                f"MUTATION THROUGHPUT REGRESSION: {qps:g} req/s < "
+                f"{fl:g} (previous measured {pqps:g} − {threshold:.0%})")
+        msgs.append(f"{qps:g} vs {pqps:g} req/s")
+    return PASS, "mutation ok: " + "; ".join(msgs)
+
+
+def mutation_trajectory(rounds: Sequence[Tuple[int, str,
+                                               Optional[Dict]]]) -> str:
+    """Mixed read/write series: read p99, recall, compaction cycles and
+    mid-fold read evidence per round."""
+    lines = [
+        "mutation trajectory (MUTATION_r*.json + BENCH_MUTATION.json)",
+        "============================================================"]
+    if not rounds:
+        return "\n".join(lines + ["(no mutation artifacts found)"]) \
+            + "\n"
+    cols = ("round", "ok", "p99 ms", "req/s", "recall", "cycles",
+            "in-fold", "measured", "metric")
+    rows = []
+    for n, path, rec in rounds:
+        if rec is None:
+            rows.append((f"r{n:02d}", "-", "-", "-", "-", "-", "-", "-",
+                         f"<unparseable: {os.path.basename(path)}>"))
+            continue
+        rows.append((
+            f"r{n:02d}", _fmt(bool(rec.get("ok"))),
+            _fmt(rec.get("p99_ms")), _fmt(rec.get("throughput_qps")),
+            _fmt(rec.get("recall")), _fmt(rec.get("compaction_cycles")),
+            _fmt(rec.get("reads_during_fold")),
+            _fmt(rec.get("measured")) if "measured" in rec else "-",
+            normalize_metric(rec.get("metric", "mutation"))))
+    widths = [max(len(c), *(len(str(r[i])) for r in rows))
+              for i, c in enumerate(cols)]
+    lines.append("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    lines.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        lines.append("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+    return "\n".join(lines) + "\n"
+
+
 def load_drift_ledger(path: str) -> Optional[Dict]:
     """DRIFT_LEDGER.json → {site: [entries...]}; None for a missing or
     unreadable ledger (the no-op case — the gate must not fail repos
@@ -1029,6 +1197,7 @@ def main(argv: Sequence[str] = None) -> int:
     mrounds = collect_multichip(args.dir)
     srounds = collect_serving(args.dir)
     arounds = collect_ann(args.dir)
+    murounds = collect_mutation(args.dir)
     baseline_path = args.baseline or os.path.join(args.dir, BASELINE_NAME)
     baseline = load_record(baseline_path)
     stale = artifact_staleness(args.dir, baseline)
@@ -1051,6 +1220,8 @@ def main(argv: Sequence[str] = None) -> int:
         print(f"bench_report --check [serving]: {sstatus}: {smsg}")
         astatus, amsg = check_ann(arounds, args.threshold)
         print(f"bench_report --check [ann]: {astatus}: {amsg}")
+        mustatus, mumsg = check_mutation(murounds, args.threshold)
+        print(f"bench_report --check [mutation]: {mustatus}: {mumsg}")
         # multichip: the bare benchmark artifact (written by
         # benchmarks/bench_sharded.py) is the freshest carrier of the
         # quantized block — driver rounds lag it by one round
@@ -1069,9 +1240,12 @@ def main(argv: Sequence[str] = None) -> int:
         # by benchmark.Fixture.run / the bench writers (ISSUE 10)
         newest_s = next((rec for _, _, rec in reversed(srounds)
                          if rec is not None), None)
+        newest_mu = next((rec for _, _, rec in reversed(murounds)
+                          if rec is not None), None)
         qlstatus, qlmsg = check_quality(
             [("bench", candidate), ("multichip", newest_m),
-             ("serving", newest_s), ("ann", newest_a)])
+             ("serving", newest_s), ("ann", newest_a),
+             ("mutation", newest_mu)])
         print(f"bench_report --check [quality]: {qlstatus}: {qlmsg}")
         ledger_path = args.drift_ledger or os.path.join(
             args.dir, DRIFT_LEDGER_NAME)
@@ -1086,8 +1260,8 @@ def main(argv: Sequence[str] = None) -> int:
         # regression in ANY trend fails; missing baseline only when
         # nothing regressed
         rcs = (codes[status], codes[mstatus], codes[sstatus],
-               codes[astatus], codes[qstatus], codes[qlstatus],
-               codes[dstatus])
+               codes[astatus], codes[mustatus], codes[qstatus],
+               codes[qlstatus], codes[dstatus])
         return 1 if 1 in rcs else max(rcs)
 
     if args.json:
@@ -1103,6 +1277,9 @@ def main(argv: Sequence[str] = None) -> int:
             "ann_rounds": [
                 {"round": n, "path": os.path.basename(path),
                  "record": rec} for n, path, rec in arounds],
+            "mutation_rounds": [
+                {"round": n, "path": os.path.basename(path),
+                 "record": rec} for n, path, rec in murounds],
             "named_artifacts": stale,
             "baseline": baseline,
             "drift_ledger": load_drift_ledger(
@@ -1119,6 +1296,8 @@ def main(argv: Sequence[str] = None) -> int:
     sys.stdout.write(serving_trajectory(srounds))
     sys.stdout.write("\n")
     sys.stdout.write(ann_trajectory(arounds))
+    sys.stdout.write("\n")
+    sys.stdout.write(mutation_trajectory(murounds))
     sys.stdout.write("\n")
     sys.stdout.write(staleness_section(stale))
     return 0

@@ -52,6 +52,8 @@ HOT_PATHS: Dict[str, Sequence[str]] = {
     "raft_tpu/serving/snapshot.py": ("build_snapshot",),
     "raft_tpu/cluster/kmeans.py": ("kmeans_fit", "kmeans_predict"),
     "raft_tpu/ann/ivf_flat.py": ("build_ivf_flat", "search_ivf_flat"),
+    "raft_tpu/mutable/index.py": ("apply_upsert", "apply_delete",
+                                  "search_view"),
 }
 
 # module (repo-relative) → profiler capture methods it must call
@@ -118,6 +120,11 @@ FAULT_SITES: Dict[str, Sequence[str]] = {
     "raft_tpu/cluster/kmeans.py": ("kmeans_fit", "kmeans_iteration"),
     "raft_tpu/ann/ivf_flat.py": ("ivf_build", "ivf_search",
                                  "quantize_index"),
+    # mutable indexes (raft_tpu.mutable): ingest / tombstone / fold —
+    # a mid-compaction crash must provably keep the old snapshot
+    # serving (tests/test_resilience.py)
+    "raft_tpu/mutable/index.py": ("mutate_ingest", "tombstone_apply",
+                                  "compact_fold"),
 }
 
 # timeline-event gate: every hot-path module and every fault-site
@@ -150,6 +157,7 @@ EMITTER_KINDS: Dict[str, str] = {
     "emit_serving": "serving",
     "emit_quality": "quality",
     "emit_flow": "flow",
+    "emit_mutation": "mutation",
     # quality-plane recorders: both route nonzero failure batches
     # through emit_quality (observability/quality.py)
     "record_certificate": "quality",
@@ -199,6 +207,13 @@ EVENT_SITES: Dict[str, Sequence[str]] = {
     # the flight emitter (deleting the bridge would silently empty the
     # quality timeline while every call site keeps "recording")
     "raft_tpu/observability/quality.py": ("emit_quality",),
+    # the mutation plane: every write emits into the write-ahead
+    # mutation stream, the layout prep marks its geometry, and the
+    # delta-tail searches report certificate/fixup counters like every
+    # other certified path
+    "raft_tpu/mutable/index.py": ("instrument", "fault_point",
+                                  "emit_mutation", "record_pending"),
+    "raft_tpu/mutable/layout.py": ("emit_marker",),
 }
 
 #: quality-telemetry gate (ISSUE 10): every module with a certificate /
@@ -215,6 +230,10 @@ QUALITY_SITES: Dict[str, Sequence[str]] = {
     "raft_tpu/runtime/entry_points.py": ("record_pending",),
     # the serving engine's quality surface is the shadow sampler
     "raft_tpu/serving/engine.py": ("ShadowSampler",),
+    # the mutable planes: base and delta-tail searches both report
+    # certificate/fixup counters (the delta tail is a certified path
+    # like any other — ISSUE 11)
+    "raft_tpu/mutable/index.py": ("record_pending",),
 }
 
 _FLIGHT_MODULE = "raft_tpu/observability/flight.py"
