@@ -58,6 +58,13 @@ from raft_tpu.core.serialize import (
     deserialize_scalar,
     mdspan_to_bytes,
     mdspan_from_bytes,
+    read_framed,
+)
+from raft_tpu.core.diskio import (
+    atomic_write,
+    atomic_write_bytes,
+    atomic_write_text,
+    fsync_dir,
 )
 from raft_tpu.core.memory import (
     MemoryTracker,
@@ -99,6 +106,8 @@ __all__ = [
     "operators", "nvtx", "interruptible",
     "serialize_mdspan", "deserialize_mdspan", "serialize_scalar",
     "deserialize_scalar", "mdspan_to_bytes", "mdspan_from_bytes",
+    "read_framed", "atomic_write", "atomic_write_bytes",
+    "atomic_write_text", "fsync_dir",
     "MemoryTracker", "StatisticsAdaptor", "NotifyingAdaptor",
     "ResourceMonitor", "device_memory_stats",
     "DeviceResourcesManager", "get_device_resources",

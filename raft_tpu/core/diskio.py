@@ -1,0 +1,86 @@
+"""Durable file I/O: the one spelling of "atomic write" in the repo.
+
+Every persistent artifact (tile plans, drift ledgers, WAL manifests,
+checkpoint slabs) needs the same four-step dance to survive a crash at
+any instruction boundary:
+
+1. write the payload to a temp file **in the destination directory**
+   (same filesystem — ``os.replace`` must not fall back to copy);
+2. ``fsync`` the temp file, so the DATA is on disk before the name is;
+3. ``os.replace`` onto the final name (atomic on POSIX);
+4. ``fsync`` the parent DIRECTORY, so the rename itself is durable — a
+   rename without the directory fsync can vanish on power loss even
+   though the process saw it succeed (the bug ``DriftLedger.save``
+   shipped with until this module existed).
+
+Callers that must never raise into their hot path keep their own
+try/except around these helpers — this module reports failures
+honestly and leaves no temp litter behind.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, Optional
+
+
+def fsync_dir(path: str) -> bool:
+    """Flush a DIRECTORY's metadata (new/renamed entries) to disk.
+    Returns False where directories cannot be fsynced (some network
+    filesystems, non-POSIX platforms) — best-effort by design, the
+    data-file fsync already happened."""
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return False
+    try:
+        os.fsync(fd)
+        return True
+    except OSError:
+        return False
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, writer: Callable, mode: str = "wb") -> str:
+    """Write ``path`` atomically + durably: ``writer(f)`` fills a temp
+    file in the destination directory, which is fsynced, renamed over
+    ``path``, and made durable with a parent-directory fsync. Returns
+    ``path``. Raises on failure (callers own their degrade policy);
+    the temp file never survives an error."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".atomic-", suffix=".tmp")
+    try:
+        with os.fdopen(fd, mode) as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    fsync_dir(d)
+    return path
+
+
+def atomic_write_bytes(path: str, data: bytes) -> str:
+    """:func:`atomic_write` of one bytes payload."""
+    return atomic_write(path, lambda f: f.write(data))
+
+
+def atomic_write_text(path: str, text: str,
+                      encoding: str = "utf-8") -> str:
+    """:func:`atomic_write` of one text payload."""
+    return atomic_write_bytes(path, text.encode(encoding))
+
+
+def read_bytes(path: str) -> Optional[bytes]:
+    """The file's bytes, or None for missing/unreadable — the tolerant
+    read half of the durable-store contract (corrupt degrades, never
+    raises; callers validate content themselves)."""
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None

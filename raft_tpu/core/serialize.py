@@ -4,16 +4,35 @@
 core/detail/mdspan_numpy_serializer.hpp — hand-rolled npy header writer.
 Python has numpy; the contract kept is the wire format (standard .npy) and
 the mdspan-level API names, incl. scalar serialization.)
+
+The BYTES-level API (:func:`mdspan_to_bytes` / :func:`mdspan_from_bytes`)
+frames the npy payload with a magic / version / length header so that a
+truncated stream is detected HERE, with an honest message, instead of
+surfacing as a raw ``np.load`` pickle error three layers down — the WAL
+and checkpoint planes (:mod:`raft_tpu.mutable.wal`) depend on exactly
+this property to classify torn records. ``mdspan_from_bytes`` still
+reads the old unframed format (bare .npy bytes) for compatibility with
+payloads written before the framing shipped. The STREAM-level API
+(:func:`serialize_mdspan`) stays bare .npy — that is the RAFT wire
+contract.
 """
 
 from __future__ import annotations
 
 import io
-from typing import Any, BinaryIO
+import struct
+from typing import Any, BinaryIO, Tuple
 
 import numpy as np
 
 from raft_tpu.core.mdarray import MdSpan, wrap
+
+#: framed-bytes header: magic + format version + payload length. The
+#: magic cannot collide with .npy (which starts ``\x93NUMPY``), so the
+#: unframed fallback is unambiguous.
+FRAME_MAGIC = b"RTNP"
+FRAME_VERSION = 1
+_FRAME_HEADER = struct.Struct("<4sHQ")
 
 
 def _logical_numpy(obj: Any) -> np.ndarray:
@@ -46,10 +65,48 @@ def deserialize_scalar(res, stream: BinaryIO):
 
 
 def mdspan_to_bytes(obj: Any) -> bytes:
+    """Framed bytes: magic + version + payload length, then the
+    standard .npy payload — self-delimiting, so frames concatenate
+    (:func:`read_framed`) and truncation is detectable."""
     buf = io.BytesIO()
     serialize_mdspan(None, buf, obj)
-    return buf.getvalue()
+    payload = buf.getvalue()
+    return _FRAME_HEADER.pack(FRAME_MAGIC, FRAME_VERSION,
+                              len(payload)) + payload
+
+
+def read_framed(data: bytes, offset: int = 0) -> Tuple[MdSpan, int]:
+    """Decode ONE framed mdspan at ``offset``; returns (array, offset
+    past the frame) — the sequential-parse primitive WAL payloads use.
+    Raises ``ValueError`` with an honest message on a bad magic, a
+    future version, or a truncated frame."""
+    data = bytes(data)
+    end_h = offset + _FRAME_HEADER.size
+    if len(data) < end_h:
+        raise ValueError(
+            f"truncated framed mdspan stream: {len(data) - offset} "
+            f"byte(s) at offset {offset}, header needs "
+            f"{_FRAME_HEADER.size}")
+    magic, version, plen = _FRAME_HEADER.unpack_from(data, offset)
+    if magic != FRAME_MAGIC:
+        raise ValueError(f"framed mdspan stream: bad magic {magic!r} "
+                         f"at offset {offset}")
+    if version > FRAME_VERSION:
+        raise ValueError(f"framed mdspan stream: version {version} is "
+                         f"newer than this reader ({FRAME_VERSION})")
+    if len(data) < end_h + plen:
+        raise ValueError(
+            f"truncated framed mdspan stream: header promises {plen} "
+            f"payload byte(s), only {len(data) - end_h} present")
+    arr = deserialize_mdspan(None, io.BytesIO(data[end_h:end_h + plen]))
+    return arr, end_h + plen
 
 
 def mdspan_from_bytes(data: bytes) -> MdSpan:
+    """Read one array from ``data``: framed (the current writer) or
+    bare .npy (the pre-framing format, kept as a fallback reader)."""
+    data = bytes(data)
+    if data[:len(FRAME_MAGIC)] == FRAME_MAGIC:
+        arr, _ = read_framed(data)
+        return arr
     return deserialize_mdspan(None, io.BytesIO(data))

@@ -16,17 +16,37 @@ never block, generation semantics stay last-wins.
   shared by the brute, IVF-Flat and quantized planes, with the
   build/search machinery re-expressed as pure ops over it.
 
+Durability (ISSUE 12): :mod:`~raft_tpu.mutable.wal` (segmented
+write-ahead log — framed records, CRC trailers, group-commit fsync,
+torn-tail truncation) + :mod:`~raft_tpu.mutable.checkpoint` (atomic
+manifest-verified checkpoints, two-phase ``CURRENT`` commit, and
+:func:`~raft_tpu.mutable.checkpoint.recover` = newest-valid-checkpoint
+load + WAL tail replay). ``MutableIndex(durable_dir=...)`` /
+``ServingEngine(durable=True)`` turn it on; acked writes then survive
+SIGKILL at any instruction boundary (the crash matrix in
+tests/test_durability.py).
+
 Evidence: ``benchmarks/bench_mutation.py`` drives a closed-loop mixed
 read/write load across a full compaction cycle and writes
-``BENCH_MUTATION.json``, gated by ``tools/bench_report.py --check``.
+``BENCH_MUTATION.json``; ``benchmarks/bench_recovery.py`` measures the
+durable-write overhead + recovery time vs WAL tail length and writes
+``BENCH_RECOVERY.json`` — both gated by ``tools/bench_report.py
+--check``.
 """
 
+from raft_tpu.mutable.checkpoint import (CheckpointStore,
+                                         DurabilityPlane,
+                                         has_durable_state,
+                                         last_recovery, recover)
 from raft_tpu.mutable.index import (COMPACT_THRESHOLD_ENV,
                                     DELTA_CAP_ENV, MutableIndex,
                                     MutableView, apply_delete,
                                     apply_upsert,
                                     compact_threshold_default,
                                     delta_cap_default, search_view)
+from raft_tpu.mutable.wal import (OP_CHECKPOINT, OP_DELETE, OP_UPSERT,
+                                  WalRecord, WalWriter,
+                                  replay as wal_replay)
 from raft_tpu.mutable.layout import (FusedOps, IndexLayout, dense_layout,
                                      fused_geometry, fused_ops_for_layout,
                                      quantize_layout,
@@ -35,11 +55,18 @@ from raft_tpu.mutable.layout import (FusedOps, IndexLayout, dense_layout,
 
 __all__ = [
     "COMPACT_THRESHOLD_ENV",
+    "CheckpointStore",
     "DELTA_CAP_ENV",
+    "DurabilityPlane",
     "FusedOps",
     "IndexLayout",
     "MutableIndex",
     "MutableView",
+    "OP_CHECKPOINT",
+    "OP_DELETE",
+    "OP_UPSERT",
+    "WalRecord",
+    "WalWriter",
     "apply_delete",
     "apply_upsert",
     "compact_threshold_default",
@@ -47,8 +74,12 @@ __all__ = [
     "dense_layout",
     "fused_geometry",
     "fused_ops_for_layout",
+    "has_durable_state",
+    "last_recovery",
     "quantize_layout",
     "ragged_layout_from_lists",
+    "recover",
     "run_fused_ops",
     "search_view",
+    "wal_replay",
 ]

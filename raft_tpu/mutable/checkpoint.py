@@ -1,0 +1,415 @@
+"""Checkpointed snapshots + crash recovery for the mutation plane.
+
+The other half of the ISSUE-12 durability plane: the WAL
+(:mod:`raft_tpu.mutable.wal`) bounds what a crash can lose, this
+module bounds how long recovery takes. A checkpoint is one atomic,
+self-verifying copy of the full live state (rows + external ids) at an
+LSN watermark:
+
+- **slab files** are written with the shared atomic-write helper
+  (:mod:`raft_tpu.core.diskio` — tmp + fsync + ``os.replace`` + parent
+  directory fsync), payloads framed via ``core.serialize`` (the
+  ``serialize_mdspan`` layer PAPER.md ships as ``raft::core``
+  serialization, pointed at durability);
+- the **manifest** carries per-file sha256, the LSN watermark, the
+  snapshot generation and a schema version — a checkpoint is valid
+  only if every hash verifies;
+- **two-phase commit**: the ``CURRENT`` pointer file is atomically
+  replaced only after the manifest is durable, so a crash at ANY
+  instruction boundary leaves either the old checkpoint or the new one
+  committed — never a torn pointer (fault sites ``checkpoint_write`` /
+  ``manifest_commit`` + the SIGKILL matrix in tests/test_durability.py
+  prove it);
+- ``CheckpointStore.load`` returns the NEWEST VALID checkpoint: the
+  pointer's target when it verifies, else a newest-first scan — a
+  corrupt/partial checkpoint degrades to the previous one, never
+  raises. WAL segments are retired only up to the OLDEST retained
+  checkpoint, so the fallback always has its replay tail.
+
+:func:`recover` is the proof-bearing entry: newest-valid-checkpoint
+load + WAL tail replay through the existing ``apply_upsert`` /
+``apply_delete`` — yielding a ``MutableIndex`` whose live state equals
+the pre-crash index for every acked write (ids bit-identical, values
+within the documented rescore rounding), with recovery wall-time /
+replayed-records / truncated-bytes emitted as flight events, metrics
+gauges, and the ``tools/statusz.py`` durability panel.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import shutil
+import time
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.core.diskio import (atomic_write_bytes, atomic_write_text,
+                                  fsync_dir, read_bytes)
+from raft_tpu.core.serialize import mdspan_from_bytes, mdspan_to_bytes
+from raft_tpu.mutable import wal as _wal
+from raft_tpu.resilience import fault_point
+
+CKPT_SCHEMA = 1
+_CURRENT = "CURRENT"
+_MANIFEST = "manifest.json"
+#: checkpoints retained after a prune — the newest serves, the older
+#: one is the fallback a torn newest degrades to
+KEEP_CHECKPOINTS = 2
+
+DURABLE_DIR_ENV = "RAFT_TPU_DURABLE_DIR"
+
+# the durability slice of the metric vocabulary
+CHECKPOINTS = "raft_tpu_checkpoints_total"
+CHECKPOINT_LSN = "raft_tpu_checkpoint_lsn"
+RECOVERIES = "raft_tpu_recovery_total"
+RECOVERY_SECONDS = "raft_tpu_recovery_seconds"
+RECOVERY_REPLAYED = "raft_tpu_recovery_replayed_records"
+RECOVERY_TRUNCATED = "raft_tpu_recovery_truncated_bytes"
+
+#: last completed recovery's stats (process-global — the statusz panel
+#: reads it; None until a recovery ran)
+_LAST_RECOVERY: Optional[Dict] = None
+
+
+def _count(name: str, help: str, **labels) -> None:
+    try:
+        from raft_tpu.observability import get_registry
+
+        get_registry().counter(name, labels or None, help=help).inc()
+    except Exception:
+        pass
+
+
+def _gauge(name: str, value: float, help: str) -> None:
+    try:
+        from raft_tpu.observability import get_registry
+
+        get_registry().gauge(name, help=help).set(value)
+    except Exception:
+        pass
+
+
+class CheckpointData(NamedTuple):
+    """One loaded-and-verified checkpoint."""
+
+    rows: np.ndarray
+    exts: np.ndarray
+    lsn: int
+    generation: int
+    path: str
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+class CheckpointStore:
+    """Atomic checkpoint directory manager (see module doc)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    # -- write -------------------------------------------------------------
+    def write(self, rows, exts, lsn: int, generation: int) -> str:
+        """Write + commit one checkpoint; returns its directory path.
+        Carries ``checkpoint_write`` (before any byte lands) and
+        ``manifest_commit`` (between the durable manifest and the
+        pointer flip — the two-phase-commit seam the crash matrix
+        kills at)."""
+        fault_point("checkpoint_write")
+        rows = np.ascontiguousarray(rows, np.float32)
+        exts = np.ascontiguousarray(exts, np.int32)
+        name = f"ckpt-{int(generation):08d}-{int(lsn):016d}"
+        d = os.path.join(self.directory, name)
+        os.makedirs(d, exist_ok=True)
+        files = {}
+        for fname, payload in (("rows.msp", mdspan_to_bytes(rows)),
+                               ("exts.msp", mdspan_to_bytes(exts))):
+            atomic_write_bytes(os.path.join(d, fname), payload)
+            files[fname] = _sha256(payload)
+        manifest = {
+            "schema": CKPT_SCHEMA,
+            "lsn": int(lsn),
+            "generation": int(generation),
+            "n_rows": int(rows.shape[0]),
+            "d": int(rows.shape[1]) if rows.ndim == 2 else 0,
+            "files": files,
+        }
+        atomic_write_text(os.path.join(d, _MANIFEST),
+                          json.dumps(manifest, indent=1, sort_keys=True)
+                          + "\n")
+        fsync_dir(d)
+        # phase two: flip the pointer — the one atomic instant the new
+        # checkpoint becomes THE checkpoint
+        fault_point("manifest_commit")
+        atomic_write_text(os.path.join(self.directory, _CURRENT),
+                          name + "\n")
+        _count(CHECKPOINTS, "Mutation-plane checkpoints committed",
+               status="ok")
+        _gauge(CHECKPOINT_LSN, lsn,
+               "LSN watermark of the newest committed checkpoint")
+        try:
+            from raft_tpu.observability.timeline import emit_mutation
+
+            emit_mutation("checkpoint", lsn=int(lsn),
+                          generation=int(generation),
+                          rows=int(rows.shape[0]))
+        except Exception:
+            pass
+        return d
+
+    # -- read --------------------------------------------------------------
+    def _manifest_of(self, d: str) -> Optional[Dict]:
+        """Parsed-and-verified manifest of one checkpoint dir, or None
+        (missing/garbage manifest, missing slab file, sha mismatch —
+        every failure mode degrades to "not a checkpoint")."""
+        raw = read_bytes(os.path.join(d, _MANIFEST))
+        if raw is None:
+            return None
+        try:
+            m = json.loads(raw.decode("utf-8", errors="replace"))
+        except ValueError:
+            return None
+        if not isinstance(m, dict) or m.get("schema") != CKPT_SCHEMA:
+            return None
+        files = m.get("files")
+        if not isinstance(files, dict) or not files:
+            return None
+        for fname, digest in files.items():
+            payload = read_bytes(os.path.join(d, str(fname)))
+            if payload is None or _sha256(payload) != digest:
+                return None
+        if not isinstance(m.get("lsn"), int) \
+                or not isinstance(m.get("generation"), int):
+            return None
+        return m
+
+    def _dirs(self) -> List[str]:
+        """Checkpoint dirs, newest (generation, lsn) first."""
+        return sorted(glob.glob(os.path.join(self.directory, "ckpt-*")),
+                      reverse=True)
+
+    def manifests(self) -> List[Tuple[str, Dict]]:
+        """(dir, verified manifest) for every VALID checkpoint, newest
+        first."""
+        out = []
+        for d in self._dirs():
+            m = self._manifest_of(d)
+            if m is not None:
+                out.append((d, m))
+        return out
+
+    def load(self) -> Optional[CheckpointData]:
+        """The newest VALID checkpoint: the ``CURRENT`` pointer's
+        target when it verifies, else a newest-first scan; None when
+        nothing durable survives. Never raises."""
+        candidates: List[str] = []
+        cur = read_bytes(os.path.join(self.directory, _CURRENT))
+        if cur is not None:
+            name = cur.decode("utf-8", errors="replace").strip()
+            if name and os.sep not in name:
+                candidates.append(os.path.join(self.directory, name))
+        candidates.extend(d for d in self._dirs()
+                          if d not in candidates)
+        for d in candidates:
+            m = self._manifest_of(d)
+            if m is None:
+                continue
+            try:
+                rows = mdspan_from_bytes(read_bytes(
+                    os.path.join(d, "rows.msp"))).as_numpy()
+                exts = mdspan_from_bytes(read_bytes(
+                    os.path.join(d, "exts.msp"))).as_numpy()
+            except Exception:
+                continue
+            return CheckpointData(rows.astype(np.float32, copy=False),
+                                  exts.astype(np.int32, copy=False),
+                                  int(m["lsn"]), int(m["generation"]), d)
+        return None
+
+    def prune(self, keep: int = KEEP_CHECKPOINTS) -> int:
+        """Delete all but the newest ``keep`` VALID checkpoints (plus
+        any invalid litter older than them); returns the retained
+        checkpoints' MINIMUM lsn — the safe WAL retirement watermark
+        (retiring past it would strand the fallback checkpoint without
+        its replay tail)."""
+        valid = self.manifests()
+        keep_dirs = {d for d, _ in valid[:keep]}
+        for d in self._dirs():
+            if d in keep_dirs:
+                continue
+            try:
+                shutil.rmtree(d)
+            except OSError:
+                pass
+        retained = [m["lsn"] for d, m in valid[:keep]]
+        return min(retained) if retained else 0
+
+
+# ---------------------------------------------------- durability plane
+class DurabilityPlane:
+    """The WAL + checkpoint pair one durable ``MutableIndex`` owns.
+
+    Layout under ``directory``: ``wal/`` (segments), ``ckpt-*/``
+    (checkpoints), ``CURRENT`` (the committed pointer)."""
+
+    def __init__(self, directory: str, sync: Optional[str] = None,
+                 next_lsn: int = 1,
+                 segment_bytes: Optional[int] = None):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.checkpoints = CheckpointStore(directory)
+        self.wal = _wal.WalWriter(os.path.join(directory, "wal"),
+                                  sync=sync, next_lsn=next_lsn,
+                                  segment_bytes=segment_bytes)
+
+    # -- logging (the write-ahead half) -----------------------------------
+    def log_upsert(self, ids, rows) -> int:
+        return self.wal.append(_wal.OP_UPSERT,
+                               _wal.encode_upsert(ids, rows))
+
+    def log_delete(self, ids) -> int:
+        return self.wal.append(_wal.OP_DELETE, _wal.encode_delete(ids))
+
+    def commit(self) -> int:
+        """The fsync horizon an ack waits on."""
+        return self.wal.commit()
+
+    # -- checkpointing ------------------------------------------------------
+    def checkpoint(self, rows, exts, lsn: int, generation: int) -> str:
+        """Write + commit a checkpoint at ``lsn``, mark it in the WAL,
+        rotate the active segment, and retire segments the RETAINED
+        checkpoints no longer need."""
+        path = self.checkpoints.write(rows, exts, lsn, generation)
+        self.wal.append(_wal.OP_CHECKPOINT,
+                        _wal.encode_checkpoint_mark(
+                            lsn, generation, os.path.basename(path)))
+        self.wal.commit()
+        self.wal.rotate()
+        watermark = self.checkpoints.prune()
+        if watermark:
+            self.wal.retire_through(watermark)
+        return path
+
+    def stats(self) -> Dict:
+        out = {"directory": self.directory}
+        out.update(self.wal.stats())
+        manifests = self.checkpoints.manifests()
+        out["checkpoints"] = len(manifests)
+        if manifests:
+            out["checkpoint_lsn"] = manifests[0][1]["lsn"]
+            out["checkpoint_generation"] = manifests[0][1]["generation"]
+        return out
+
+    def close(self) -> None:
+        self.wal.close()
+
+
+def has_durable_state(directory: str) -> bool:
+    """True when ``directory`` holds anything recoverable (a committed
+    pointer, a checkpoint dir, or WAL segments)."""
+    if not directory or not os.path.isdir(directory):
+        return False
+    if os.path.exists(os.path.join(directory, _CURRENT)):
+        return True
+    if glob.glob(os.path.join(directory, "ckpt-*")):
+        return True
+    return bool(glob.glob(os.path.join(directory, "wal", "wal-*.log")))
+
+
+def last_recovery() -> Optional[Dict]:
+    """The process's most recent recovery stats (statusz panel)."""
+    return dict(_LAST_RECOVERY) if _LAST_RECOVERY else None
+
+
+def recover(directory: str, *, res=None, wal_sync: Optional[str] = None,
+            attach: bool = True, **mutable_kw):
+    """Rebuild a ``MutableIndex`` from the newest valid checkpoint +
+    the WAL tail (see module doc). Returns ``(index, stats)`` or None
+    when nothing durable survives (an empty/virgin directory — by the
+    genesis-checkpoint invariant nothing was ever acked from it).
+
+    ``attach=True`` re-attaches a live durability plane (appends
+    continue past the recovered tail) and, when any records were
+    replayed, writes a fresh checkpoint so the NEXT recovery starts
+    from a rebounded tail. ``attach=False`` is the inspection mode the
+    crash-matrix verifier uses. ``mutable_kw`` forwards the index
+    geometry (algorithm / passes / T / Qb / g / db_dtype / ...)."""
+    global _LAST_RECOVERY
+
+    from raft_tpu.mutable.index import (MutableIndex, apply_delete,
+                                        apply_upsert)
+
+    t0 = time.perf_counter()
+    store = CheckpointStore(directory)
+    ck = store.load()
+    if ck is None:
+        _count(RECOVERIES, "Mutation-plane recoveries by outcome",
+               status="empty")
+        return None
+    idx = MutableIndex(ck.rows, ids=ck.exts, res=res, **mutable_kw)
+    records, rstats = _wal.replay(os.path.join(directory, "wal"),
+                                  from_lsn=ck.lsn, truncate=True)
+    replayed = 0
+    for rec in records:
+        try:
+            if rec.op == _wal.OP_UPSERT:
+                ids, rows = _wal.decode_upsert(rec.payload)
+                apply_upsert(idx, ids, rows)
+            elif rec.op == _wal.OP_DELETE:
+                apply_delete(idx, _wal.decode_delete(rec.payload))
+            replayed += 1
+        except Exception as e:
+            # a record that decodes/applies no further marks the end
+            # of the consistent prefix — same contract as a torn tail
+            from raft_tpu.core.logger import log_warn
+
+            log_warn("recovery: WAL replay stopped at lsn %d (%s: %s) "
+                     "— recovered through the preceding record",
+                     rec.lsn, type(e).__name__, str(e)[:200])
+            rstats["stopped_early"] = True
+            rstats["stop_reason"] = f"replay: {type(e).__name__}"
+            break
+    seconds = time.perf_counter() - t0
+    stats = {
+        "checkpoint_lsn": ck.lsn,
+        "checkpoint_generation": ck.generation,
+        "checkpoint_path": os.path.basename(ck.path),
+        "checkpoint_rows": int(ck.rows.shape[0]),
+        "replayed_records": replayed,
+        "truncated_bytes": int(rstats.get("truncated_bytes", 0)),
+        "wal_last_lsn": int(rstats.get("last_lsn", 0)),
+        "stopped_early": bool(rstats.get("stopped_early")),
+        "stop_reason": rstats.get("stop_reason", ""),
+        "seconds": seconds,
+    }
+    if attach:
+        next_lsn = max(ck.lsn, stats["wal_last_lsn"]) + 1
+        idx._attach_durability(
+            DurabilityPlane(directory, sync=wal_sync,
+                            next_lsn=next_lsn))
+        if replayed:
+            # rebound the tail: the next recovery replays from here
+            idx.checkpoint()
+    _count(RECOVERIES, "Mutation-plane recoveries by outcome",
+           status="ok")
+    _gauge(RECOVERY_SECONDS, seconds,
+           "Wall time of the last crash recovery")
+    _gauge(RECOVERY_REPLAYED, replayed,
+           "WAL records replayed by the last recovery")
+    _gauge(RECOVERY_TRUNCATED, stats["truncated_bytes"],
+           "Torn-tail bytes truncated by the last recovery")
+    try:
+        from raft_tpu.observability.timeline import emit_mutation
+
+        emit_mutation("recovery", **{k: v for k, v in stats.items()
+                                     if k != "stop_reason"})
+    except Exception:
+        pass
+    _LAST_RECOVERY = dict(stats)
+    return idx, stats

@@ -51,6 +51,18 @@ Env knobs (README "Mutable indexes & compaction"):
   threshold, rounded to the 8-row quantum). A writer that fills the
   cap while a fold is in flight WAITS for the swap — writers may
   block, readers never.
+
+Durability (ISSUE 12, default OFF): ``durable_dir=`` attaches a
+:class:`~raft_tpu.mutable.checkpoint.DurabilityPlane` — every mutation
+is appended to the segmented WAL BEFORE it is applied and fsynced (per
+``wal_sync`` / ``RAFT_TPU_WAL_SYNC``) BEFORE it returns, so an acked
+write survives a crash; the compactor commits an atomic checkpoint at
+every swap (and a genesis checkpoint at attach, so recovery always has
+a floor); :func:`raft_tpu.mutable.checkpoint.recover` rebuilds the
+index from newest-valid-checkpoint + WAL tail replay. With
+``durable_dir=None`` the plane is ``None`` and the mutation/search hot
+paths are byte-for-byte the PR-11 ones — no new dispatches, no
+compile-cache traffic (pinned by tests/test_durability.py).
 """
 
 from __future__ import annotations
@@ -231,7 +243,9 @@ class MutableIndex:
                  n_probes: Optional[int] = None,
                  compact_threshold: Optional[int] = None,
                  delta_cap: Optional[int] = None,
-                 auto_compact: bool = True):
+                 auto_compact: bool = True,
+                 durable_dir: Optional[str] = None,
+                 wal_sync: Optional[str] = None):
         from raft_tpu.ann import IvfFlatIndex
         from raft_tpu.core.resources import ensure_resources
         from raft_tpu.distance.knn_fused import KnnIndex
@@ -312,6 +326,18 @@ class MutableIndex:
         self._install_base(plane)
         self._reset_delta()
         self._refresh_delta()
+
+        # durability (off by default — the plane is pure host-side
+        # file I/O, so durable=False keeps the hot path untouched)
+        self._dur = None
+        if durable_dir:
+            from raft_tpu.mutable.checkpoint import DurabilityPlane
+
+            self._attach_durability(DurabilityPlane(durable_dir,
+                                                    sync=wal_sync))
+            # genesis checkpoint: recovery ALWAYS finds a floor, so a
+            # WAL record can never exist without a checkpoint under it
+            self.checkpoint()
 
     # -- construction ------------------------------------------------------
     def _build_index(self, y):
@@ -519,6 +545,12 @@ class MutableIndex:
         n = rows.shape[0]
         with self._cond:
             self._ensure_delta_space_locked(n)
+            if self._dur is not None:
+                # write-ahead: the record lands in the WAL before any
+                # in-memory state changes (an append failure leaves
+                # the index untouched; a crash after it replays a
+                # submitted-but-unacked write in FULL — never half)
+                self._dur.log_upsert(exts, rows)
             self._tombstone_locked(exts)          # old copies, any plane
             c = self._d_count
             self._d_rows[c:c + n] = rows
@@ -528,15 +560,51 @@ class MutableIndex:
                 self._lookup[int(e)] = ("delta", c + i)
             self._d_count = c + n
             self._mutation_epilogue_locked("upsert", n)
+        if self._dur is not None:
+            self._dur.commit()       # the fsync horizon — ack AFTER it
         self._maybe_compact()
         return n
 
     def _delete(self, exts: np.ndarray) -> int:
         with self._cond:
+            if self._dur is not None:
+                self._dur.log_delete(exts)
             found = self._tombstone_locked(exts)
             self._mutation_epilogue_locked("delete", found)
+        if self._dur is not None:
+            self._dur.commit()
         self._maybe_compact()
         return found
+
+    # -- durability --------------------------------------------------------
+    @property
+    def durability(self):
+        """The attached DurabilityPlane (None = the in-memory index)."""
+        return self._dur
+
+    def _attach_durability(self, plane) -> None:
+        self._dur = plane
+
+    def checkpoint(self) -> Optional[str]:
+        """Commit one atomic full-state checkpoint (live base + live
+        delta + the current LSN watermark, captured consistently under
+        the writer lock; files written outside it). No-op without a
+        durability plane. The compactor calls this at every swap."""
+        if self._dur is None:
+            return None
+        with self._cond:
+            rows, exts = self._materialize_locked(self._d_count)
+            lsn = self._dur.wal.last_lsn
+            gen = self._store.generation
+        return self._dur.checkpoint(rows, exts, lsn, gen)
+
+    def close(self) -> None:
+        """Flush + close the durability plane (no-op when in-memory).
+        The index itself stays queryable; further durable mutations
+        need a fresh attach (``checkpoint.recover``)."""
+        if self._dur is not None:
+            self._dur.close()
+            self._dur = None
 
     # -- compaction --------------------------------------------------------
     def _begin_fold_locked(self) -> int:
@@ -637,6 +705,18 @@ class MutableIndex:
                               generation=self._store.generation,
                               folded_rows=int(rows.shape[0]),
                               retained_delta=self._d_count)
+            if self._dur is not None:
+                try:
+                    # bound the next recovery's tail at the swap; a
+                    # failed checkpoint keeps the older one + a longer
+                    # WAL tail — degraded, never lost
+                    self.checkpoint()
+                except Exception as e:
+                    from raft_tpu.core.logger import log_warn
+
+                    log_warn("mutable: post-fold checkpoint failed "
+                             "(%s: %s) — WAL tail keeps covering the "
+                             "delta", type(e).__name__, str(e)[:200])
             self._count_compaction("ok")
         except Exception:
             self._count_compaction("failed")

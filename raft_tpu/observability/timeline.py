@@ -62,6 +62,39 @@ DRIFT_BAND = 3.0
 DRIFT_SCHEMA = 1
 DRIFT_RECORDS = "raft_tpu_drift_records_total"
 DRIFT_RATIO = "raft_tpu_drift_seconds_ratio"
+#: ledger loads that degraded to empty, by reason (unreadable /
+#: invalid) — the PR-5 tune-loader convention: counted always, WARNed
+#: once per (path, reason) per process. A silently-empty evidence
+#: trail was the old behavior this counter replaces.
+DRIFT_DEGRADED = "raft_tpu_drift_ledger_degraded_total"
+
+_degraded_warned: set = set()
+
+
+def _ledger_degraded(path: str, reason: str, detail: str = "") -> None:
+    try:
+        from raft_tpu.observability.metrics import get_registry
+
+        get_registry().counter(
+            DRIFT_DEGRADED, {"reason": reason},
+            help="Drift-ledger loads degraded to empty, by reason"
+        ).inc()
+    except Exception:
+        pass
+    key = (path, reason)
+    if key not in _degraded_warned:
+        _degraded_warned.add(key)
+        from raft_tpu.core.logger import log_warn
+
+        log_warn("drift ledger %s degraded to empty (%s)%s — this WARN "
+                 "fires once per process; the drift_ledger_degraded "
+                 "counter keeps counting", path, reason,
+                 f": {detail}" if detail else "")
+
+
+def _reset_degraded_warnings() -> None:
+    """Test hook: re-arm the once-per-process WARN."""
+    _degraded_warned.clear()
 
 
 def _now() -> float:
@@ -356,12 +389,18 @@ class DriftLedger:
             return None
         try:
             payload = self.to_dict()
-            tmp = target + ".tmp"
-            with open(tmp, "w") as f:
+
+            def _write(f):
                 json.dump(payload, f, indent=1, sort_keys=True,
                           default=str)
                 f.write("\n")
-            os.replace(tmp, target)
+
+            from raft_tpu.core.diskio import atomic_write
+
+            # tmp + fsync + replace + parent-dir fsync: the bare
+            # rename this shipped with could leave an EMPTY file
+            # behind the "atomic" swap on power loss
+            atomic_write(target, _write, mode="w")
             return target
         except Exception as e:
             from raft_tpu.core.logger import log_warn
@@ -373,22 +412,35 @@ class DriftLedger:
     def load(path: str, max_entries: int = 20) -> "DriftLedger":
         """Read a ledger file; corrupt/missing degrades to empty (the
         plan-cache contract: a torn evidence file recomputes, never
-        raises)."""
+        raises) — but no longer SILENTLY: every degraded load counts
+        under :data:`DRIFT_DEGRADED` with a once-per-process WARN (an
+        absent file is the normal cold state, not a degradation)."""
         led = DriftLedger(path=path, max_entries=max_entries)
         try:
             with open(path) as f:
                 data = json.load(f)
-            entries = data.get("entries")
-            if isinstance(entries, dict):
-                with led._lock:
-                    for site, hist in entries.items():
-                        if isinstance(hist, list):
-                            led._entries[str(site)] = [
-                                dict(e) for e in hist
-                                if isinstance(e, dict)
-                            ][-max_entries:]
-        except Exception:
-            pass
+        except FileNotFoundError:
+            return led
+        except Exception as e:
+            _ledger_degraded(path, "unreadable",
+                             f"{type(e).__name__}: {e}")
+            return led
+        entries = data.get("entries") if isinstance(data, dict) else None
+        if not isinstance(entries, dict):
+            _ledger_degraded(path, "invalid",
+                             "no entries mapping in the payload")
+            return led
+        try:
+            with led._lock:
+                for site, hist in entries.items():
+                    if isinstance(hist, list):
+                        led._entries[str(site)] = [
+                            dict(e) for e in hist
+                            if isinstance(e, dict)
+                        ][-max_entries:]
+        except Exception as e:
+            _ledger_degraded(path, "invalid",
+                             f"{type(e).__name__}: {e}")
         return led
 
 

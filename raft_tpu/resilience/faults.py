@@ -120,6 +120,16 @@ KNOWN_SITES: Dict[str, Tuple[str, ...]] = {
     "mutate_ingest": ("error",),
     "tombstone_apply": ("error",),
     "compact_fold": ("oom", "error"),
+    # durability plane (raft_tpu.mutable.wal / .checkpoint): the WAL
+    # append + fsync pair and the checkpoint write / pointer-commit
+    # pair — an injected failure at any of them must leave the index
+    # state untouched and the on-disk state recoverable (the SIGKILL
+    # crash matrix in tests/test_durability.py kills at the same
+    # four sites)
+    "wal_append": ("error",),
+    "wal_fsync": ("error",),
+    "checkpoint_write": ("error",),
+    "manifest_commit": ("error",),
 }
 
 

@@ -68,6 +68,10 @@ Env knobs (see README "Serving & SLO workflow" + "Quality telemetry
   budget (unset = requests carry no deadline unless submitted with one)
 - ``RAFT_TPU_SERVING_SHADOW_FRAC`` / ``RAFT_TPU_SERVING_SHADOW_FLOOR``
   — shadow-sampling fraction (0 = off) and recall floor (0.95)
+- ``RAFT_TPU_DURABLE_DIR`` / ``RAFT_TPU_WAL_SYNC`` — the durability
+  plane's directory (``durable=True``) and WAL fsync policy
+  (``always`` / ``batch`` [default] / ``none`` — README "Durability &
+  recovery")
 """
 
 from __future__ import annotations
@@ -266,6 +270,9 @@ class ServingEngine:
                  index_ids=None,
                  compact_threshold: Optional[int] = None,
                  delta_cap: Optional[int] = None,
+                 durable: bool = False,
+                 durable_dir: Optional[str] = None,
+                 wal_sync: Optional[str] = None,
                  clock=time.monotonic):
         from raft_tpu.ann import IvfFlatIndex
         from raft_tpu.distance.knn_fused import KnnIndex
@@ -307,6 +314,28 @@ class ServingEngine:
                               store_yp=store_yp)
         if db_dtype is not None:
             self._build_kw["db_dtype"] = db_dtype
+        # durable=True (ISSUE 12): the mutation plane writes ahead —
+        # every upsert/delete is WAL-appended + fsynced (per wal_sync /
+        # RAFT_TPU_WAL_SYNC) BEFORE its future resolves, the compactor
+        # commits an atomic checkpoint at every swap, and constructing
+        # an engine over a directory that already holds durable state
+        # RECOVERS from it (newest-valid-checkpoint + WAL tail replay
+        # through the warmed rebuild machinery) instead of cold-building
+        # from `index`. Implies mutable=True. Default OFF: the serving
+        # hot path is byte-for-byte the non-durable one.
+        self._durable = bool(durable)
+        self._recovery = None
+        if durable:
+            mutable = True
+            if durable_dir is None:
+                from raft_tpu.mutable.checkpoint import DURABLE_DIR_ENV
+
+                durable_dir = (os.environ.get(DURABLE_DIR_ENV, "").strip()
+                               or None)
+            expects(durable_dir is not None,
+                    "serving: durable=True needs durable_dir= (or "
+                    "RAFT_TPU_DURABLE_DIR)")
+        self._durable_dir = durable_dir if durable else None
         # mutable=True: the engine fronts a MutableIndex — queries see a
         # consistent view per batch, and upsert()/delete() requests ride
         # the SAME queue, admission control and deadline scopes as
@@ -322,12 +351,36 @@ class ServingEngine:
 
             src = (index if isinstance(index, (KnnIndex, IvfFlatIndex))
                    else np.asarray(index, np.float32))
-            self._mutable = MutableIndex(
-                src, ids=index_ids, algorithm=algorithm, res=self.res,
-                passes=passes, metric=metric, T=T, Qb=Qb, g=g,
-                db_dtype=db_dtype, n_lists=n_lists, n_probes=n_probes,
-                compact_threshold=compact_threshold,
-                delta_cap=delta_cap)
+            mut_kw = dict(algorithm=algorithm, passes=passes,
+                          metric=metric, T=T, Qb=Qb, g=g,
+                          db_dtype=db_dtype, n_lists=n_lists,
+                          n_probes=n_probes,
+                          compact_threshold=compact_threshold,
+                          delta_cap=delta_cap)
+            if durable:
+                from raft_tpu.mutable.checkpoint import (
+                    has_durable_state, recover)
+
+                recovered = None
+                if has_durable_state(durable_dir):
+                    expects(not isinstance(index,
+                                           (KnnIndex, IvfFlatIndex)),
+                            "serving: durable recovery rebuilds the "
+                            "index from disk — pass the raw matrix "
+                            "(the bootstrap fallback), not a prepared "
+                            "index")
+                    recovered = recover(durable_dir, res=self.res,
+                                        wal_sync=wal_sync, **mut_kw)
+                if recovered is not None:
+                    self._mutable, self._recovery = recovered
+                else:
+                    self._mutable = MutableIndex(
+                        src, ids=index_ids, res=self.res,
+                        durable_dir=durable_dir, wal_sync=wal_sync,
+                        **mut_kw)
+            else:
+                self._mutable = MutableIndex(src, ids=index_ids,
+                                             res=self.res, **mut_kw)
             expects(self.k <= self._mutable.n_rows,
                     "ServingEngine: k=%d > index size %d", self.k,
                     self._mutable.n_rows)
@@ -489,6 +542,12 @@ class ServingEngine:
         if self._shadow is not None:
             self._shadow.flush(timeout=min(10.0, timeout))
             self._shadow.stop()
+        if self._durable and self._mutable is not None:
+            # flush + close the WAL: a clean stop is indistinguishable
+            # from a crash-after-fsync to the recovery path (restart =
+            # construct a durable engine over the same directory)
+            self._mutable.wait_for_compaction(timeout=min(30.0, timeout))
+            self._mutable.close()
         with self._cond:
             self._started = False
 
@@ -702,6 +761,13 @@ class ServingEngine:
         """The engine's MutableIndex (None on immutable engines)."""
         return self._mutable
 
+    @property
+    def recovery(self):
+        """Stats of the startup crash recovery this engine performed
+        (None when it cold-started — a fresh durable dir or
+        durable=False)."""
+        return dict(self._recovery) if self._recovery else None
+
     def update_index(self, y, block: bool = False):
         """Rebuild the index from ``y`` and swap it in — in the
         background by default; queries keep hitting the current
@@ -786,6 +852,10 @@ class ServingEngine:
         out["buckets"] = self._ladder
         if self._mutable is not None:
             out["mutable"] = self._mutable.stats()
+            if self._mutable.durability is not None:
+                out["durability"] = self._mutable.durability.stats()
+        if self._recovery is not None:
+            out["recovery"] = dict(self._recovery)
         if self._shadow is not None:
             out.update(self._shadow.snapshot())
         return out

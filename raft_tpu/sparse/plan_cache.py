@@ -19,8 +19,10 @@ Contract:
   hit. The pair layout is structure-only, so it hits regardless of
   values.
 - Loads/saves NEVER raise into the conversion path: any I/O or format
-  problem degrades to a miss (save: a logged warning). Writes are
-  atomic (tmp + rename), so a killed process cannot leave a torn plan.
+  problem degrades to a miss (save: a logged warning). Writes go
+  through the shared :mod:`raft_tpu.core.diskio` atomic-write helper
+  (tmp + fsync + rename + parent-dir fsync), so a killed process — or
+  a power loss right after the rename — cannot leave a torn plan.
 
 Config (env):
 
@@ -48,7 +50,6 @@ from __future__ import annotations
 
 import hashlib
 import os
-import tempfile
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -200,14 +201,10 @@ def save_plan(fingerprint: str, arrays: Dict[str, np.ndarray],
         payload["__version__"] = np.asarray(PLAN_VERSION)
         if vals_digest is not None:
             payload["__vals_digest__"] = np.asarray(vals_digest)
-        fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez(f, **payload)
-            os.replace(tmp, os.path.join(d, f"{fingerprint}.npz"))
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+        from raft_tpu.core.diskio import atomic_write
+
+        atomic_write(os.path.join(d, f"{fingerprint}.npz"),
+                     lambda f: np.savez(f, **payload))
         _enforce_cap(d)
         return True
     except Exception as e:

@@ -1,0 +1,126 @@
+"""Subprocess driver for the crash-recovery proof matrix.
+
+Usage: ``python tests/_crash_worker.py <durable_dir> <site> <nth>
+<side_dir>`` — builds a durable ``MutableIndex``, applies a scripted
+mutation sequence, and SIGKILLs ITSELF on the ``nth`` call to fault
+site ``site`` (wrapping ``resilience.faults.fault_point`` — the same
+seams the PR-5 DSL injects at, taken all the way to process death).
+
+Evidence protocol (the parent test reads both):
+
+- ``side_dir/submitted.jsonl`` — one fsynced line per op, written
+  BEFORE the op is submitted;
+- ``side_dir/acked.jsonl`` — one fsynced line per op, written AFTER
+  the apply returned (i.e. after the index's fsync horizon — the op is
+  ACKED).
+
+The op stream is deterministic and every op changes the live state
+(fresh-id upserts, deletes of established ids), so the recovered state
+matches exactly ONE prefix of the submitted stream — the parent
+asserts that prefix covers every acked op. Row contents derive from
+:func:`row_for` so the parent can rebuild the oracle without IPC.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+D = 8
+BASE_ROWS = 64
+SEED = 0
+
+
+def row_for(ext: int, d: int = D):
+    """Deterministic row content per external id (parent mirrors it)."""
+    import numpy as np
+
+    return (((ext * 37 + np.arange(d)) % 101).astype(np.float32)
+            / 10.0 - 5.0)
+
+
+def base_matrix():
+    import numpy as np
+
+    rng = np.random.default_rng(SEED)
+    return rng.normal(size=(BASE_ROWS, D)).astype(np.float32)
+
+
+def scripted_ops():
+    """(kind, ids) per op — every op changes the live state."""
+    return [
+        ("upsert", [100, 101]),
+        ("delete", [0, 3]),
+        ("upsert", [102]),
+        ("upsert", [103, 104, 105]),
+        ("delete", [100, 5]),
+        ("upsert", [106]),
+    ]
+
+
+def main() -> int:
+    durable_dir, site, nth, side = (sys.argv[1], sys.argv[2],
+                                    int(sys.argv[3]), sys.argv[4])
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    from raft_tpu.resilience import faults
+
+    real_fault_point = faults.fault_point
+    calls = {"n": 0}
+
+    def killing_fault_point(name):
+        if name == site:
+            calls["n"] += 1
+            if calls["n"] == nth:
+                os.kill(os.getpid(), signal.SIGKILL)
+        return real_fault_point(name)
+
+    faults.fault_point = killing_fault_point
+    # the durability modules bound the name at import — patch theirs too
+    import raft_tpu.mutable.checkpoint as ckpt_mod
+    import raft_tpu.mutable.wal as wal_mod
+
+    wal_mod.fault_point = killing_fault_point
+    ckpt_mod.fault_point = killing_fault_point
+
+    from raft_tpu.mutable import MutableIndex, apply_delete, apply_upsert
+
+    def log_line(path, obj):
+        with open(path, "a") as f:
+            f.write(json.dumps(obj) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    sub_path = os.path.join(side, "submitted.jsonl")
+    ack_path = os.path.join(side, "acked.jsonl")
+
+    # sync="always" so the per-record fsync seam (wal_fsync) is on the
+    # path of every mutation, not just the commit horizon
+    idx = MutableIndex(base_matrix(), T=256, Qb=32, g=2,
+                       auto_compact=False, compact_threshold=10_000,
+                       durable_dir=durable_dir, wal_sync="always")
+    for i, (kind, ids) in enumerate(scripted_ops()):
+        log_line(sub_path, {"kind": kind, "ids": ids})
+        if kind == "upsert":
+            rows = np.stack([row_for(e) for e in ids])
+            apply_upsert(idx, ids, rows)
+        else:
+            apply_delete(idx, ids)
+        log_line(ack_path, {"kind": kind, "ids": ids})
+        if i == 2:
+            idx.checkpoint()       # mid-run checkpoint → site call 2
+    idx.close()
+    print("COMPLETED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -334,3 +334,95 @@ def test_committed_mutation_artifact_schema():
     assert rec["quality"]["fixup_rate"] >= 0.0
     br = _tools_import("bench_report")
     assert "BENCH_MUTATION.json" in br.NAMED_ARTIFACTS
+
+
+# ------------------------------------------------------------------
+# the durability/recovery gate (ISSUE 12)
+def _rec_record(ok=True, zero_loss=True, rec_ms=150.0, bound=120000.0,
+                qps=400.0, overhead=1.4, measured=False, degr=0):
+    rec = {
+        "metric": "durability sync=batch 12x16 writes + recovery over "
+                  "512x32",
+        "value": qps, "unit": "req/s", "ok": ok, "skipped": False,
+        "measured": measured, "zero_acked_loss": zero_loss,
+        "recovery_ms": rec_ms, "recovery_ms_bound": bound,
+        "recovery_points": [{"wal_records": 48, "recovery_ms": rec_ms,
+                             "replayed_records": 48,
+                             "truncated_bytes": 0}],
+        "throughput_qps": qps, "durable_overhead_x": overhead,
+        "wal_sync": "batch",
+    }
+    if degr:
+        rec["resilience_degradations"] = degr
+    return rec
+
+
+def test_check_recovery_gates_loss_flag_and_bound(tmp_path):
+    br = _tools_import("bench_report")
+    # nothing to gate → skip (pass-or-no-op)
+    status, _ = br.check_recovery(br.collect_recovery(str(tmp_path)))
+    assert status == br.SKIP
+    # ok=false → regress
+    _write(tmp_path / "BENCH_RECOVERY.json", _rec_record(ok=False))
+    status, msg = br.check_recovery(br.collect_recovery(str(tmp_path)))
+    assert status == br.REGRESS and "ok=false" in msg
+    # a lost acked write (or a missing flag) → regress even modeled
+    _write(tmp_path / "BENCH_RECOVERY.json",
+           _rec_record(zero_loss=False))
+    status, msg = br.check_recovery(br.collect_recovery(str(tmp_path)))
+    assert status == br.REGRESS and "ACKED-LOSS" in msg
+    rec = _rec_record()
+    del rec["zero_acked_loss"]
+    rec["recovery_ms"] = 1.0   # keep the record parseable by its keys
+    _write(tmp_path / "BENCH_RECOVERY.json", rec)
+    status, msg = br.check_recovery(br.collect_recovery(str(tmp_path)))
+    assert status == br.REGRESS and "ACKED-LOSS" in msg
+    # recovery over the artifact's own bound → regress
+    _write(tmp_path / "BENCH_RECOVERY.json",
+           _rec_record(rec_ms=130000.0))
+    status, msg = br.check_recovery(br.collect_recovery(str(tmp_path)))
+    assert status == br.REGRESS and "TIME" in msg
+    # degraded run → skip
+    _write(tmp_path / "BENCH_RECOVERY.json", _rec_record(degr=1))
+    status, msg = br.check_recovery(br.collect_recovery(str(tmp_path)))
+    assert status == br.SKIP and "degrad" in msg
+    # healthy modeled round passes, not speed-gated
+    _write(tmp_path / "BENCH_RECOVERY.json", _rec_record())
+    status, msg = br.check_recovery(br.collect_recovery(str(tmp_path)))
+    assert status == br.PASS and "not speed-gated" in msg
+
+
+def test_check_recovery_measured_speed_trend(tmp_path):
+    br = _tools_import("bench_report")
+    _write(tmp_path / "RECOVERY_r01.json",
+           _rec_record(measured=True, qps=400.0))
+    _write(tmp_path / "BENCH_RECOVERY.json",
+           _rec_record(measured=True, qps=100.0))
+    status, msg = br.check_recovery(br.collect_recovery(str(tmp_path)))
+    assert status == br.REGRESS and "THROUGHPUT" in msg
+    _write(tmp_path / "BENCH_RECOVERY.json",
+           _rec_record(measured=True, qps=390.0))
+    status, msg = br.check_recovery(br.collect_recovery(str(tmp_path)))
+    assert status == br.PASS
+    out = br.recovery_trajectory(br.collect_recovery(str(tmp_path)))
+    assert "r01" in out and "0-loss" in out
+
+
+def test_committed_recovery_artifact_schema():
+    """The committed BENCH_RECOVERY.json must carry what the gate
+    reads: ok, zero_acked_loss, recovery time within its own bound,
+    and an honest measured stamp."""
+    root = os.path.join(os.path.dirname(__file__), "..")
+    path = os.path.join(root, "BENCH_RECOVERY.json")
+    if not os.path.exists(path):
+        pytest.skip("no BENCH_RECOVERY.json committed")
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["ok"] is True
+    assert rec["zero_acked_loss"] is True
+    assert isinstance(rec["measured"], bool)
+    assert rec["recovery_ms"] <= rec["recovery_ms_bound"]
+    assert rec["recovery_points"]
+    assert rec["wal_sync"] in ("always", "batch", "none")
+    br = _tools_import("bench_report")
+    assert "BENCH_RECOVERY.json" in br.NAMED_ARTIFACTS

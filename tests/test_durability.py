@@ -1,0 +1,715 @@
+"""Durability-plane tests (ISSUE 12 tentpole).
+
+The WAL frame/segment/replay contract (torn-tail truncation, monotone
+LSNs, group-commit horizons), the checkpoint store's atomic two-phase
+commit + newest-valid fallback, the corruption fuzz matrix (every
+mangling of WAL segments / checkpoints / manifests recovers to the
+newest consistent state and never raises), end-to-end ``recover``
+parity against the pre-crash index, the ``ServingEngine(durable=True)``
+restart path, the durable=False no-new-work contract, the shared
+``core.diskio`` atomic-write helper + framed ``core.serialize`` bytes,
+the ``DriftLedger`` degraded-load counter — and the SIGKILL crash
+matrix: a subprocess killed at every durability fault site × kill
+point must recover with zero acked writes lost and no write half
+applied (tests/_crash_worker.py documents the evidence protocol).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.diskio import (atomic_write_bytes, atomic_write_text,
+                                  read_bytes)
+from raft_tpu.core.serialize import (mdspan_from_bytes, mdspan_to_bytes,
+                                     read_framed)
+from raft_tpu.mutable import (CheckpointStore, MutableIndex,
+                              apply_delete, apply_upsert,
+                              has_durable_state, recover, search_view,
+                              wal_replay)
+from raft_tpu.mutable.wal import (OP_DELETE, OP_UPSERT, WalWriter,
+                                  decode_delete, decode_upsert,
+                                  encode_delete, encode_frame,
+                                  encode_upsert)
+from raft_tpu.observability import get_registry
+
+rng = np.random.default_rng(12)
+
+# the crash worker lives next to this file (no tests package)
+_TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TESTS_DIR not in sys.path:
+    sys.path.insert(0, _TESTS_DIR)
+import _crash_worker  # noqa: E402
+
+#: the tiny shared geometry every mutable test in the suite uses —
+#: one compiled program set across the whole file
+GEOM = dict(T=256, Qb=32, g=2, passes=3)
+COMMON = dict(auto_compact=False, compact_threshold=10_000, **GEOM)
+
+
+def _counter_value(name, **labels):
+    total = 0.0
+    for m in get_registry().collect():
+        if m.name == name and all(
+                m.labels.get(k) == v for k, v in labels.items()):
+            total += m.value
+    return total
+
+
+def _live_state(idx):
+    with idx._cond:
+        rows, exts = idx._materialize_locked(idx._d_count)
+    return {int(e): rows[i].tobytes() for i, e in enumerate(exts)}
+
+
+def _base(m=64, d=8):
+    return rng.normal(size=(m, d)).astype(np.float32)
+
+
+# ------------------------------------------------------------------
+# diskio + serialize satellites
+def test_atomic_write_replaces_and_leaves_no_litter(tmp_path):
+    p = tmp_path / "x.bin"
+    atomic_write_bytes(str(p), b"one")
+    atomic_write_bytes(str(p), b"two")
+    assert p.read_bytes() == b"two"
+    assert [f for f in os.listdir(tmp_path)
+            if f.startswith(".atomic-")] == []
+    atomic_write_text(str(tmp_path / "t.txt"), "hello\n")
+    assert (tmp_path / "t.txt").read_text() == "hello\n"
+    assert read_bytes(str(tmp_path / "missing")) is None
+
+
+def test_atomic_write_failure_cleans_tmp(tmp_path):
+    p = tmp_path / "y.bin"
+    atomic_write_bytes(str(p), b"keep")
+
+    def boom(f):
+        raise RuntimeError("writer failed")
+
+    from raft_tpu.core.diskio import atomic_write
+
+    with pytest.raises(RuntimeError):
+        atomic_write(str(p), boom)
+    assert p.read_bytes() == b"keep"          # target untouched
+    assert [f for f in os.listdir(tmp_path)
+            if f.startswith(".atomic-")] == []
+
+
+def test_serialize_framed_round_trip_and_truncation():
+    arr = rng.normal(size=(5, 3)).astype(np.float32)
+    data = mdspan_to_bytes(arr)
+    out = mdspan_from_bytes(data).as_numpy()
+    assert np.array_equal(out, arr)
+    # sequential frames (the WAL payload shape)
+    two = data + mdspan_to_bytes(np.arange(4, dtype=np.int32))
+    a, off = read_framed(two)
+    b, end = read_framed(two, off)
+    assert np.array_equal(a.as_numpy(), arr)
+    assert np.array_equal(b.as_numpy(), np.arange(4, dtype=np.int32))
+    assert end == len(two)
+    # truncation surfaces as an HONEST ValueError, not an np.load error
+    with pytest.raises(ValueError, match="truncated framed"):
+        mdspan_from_bytes(data[:len(data) // 2])
+    with pytest.raises(ValueError, match="truncated framed"):
+        mdspan_from_bytes(data[:6])
+
+
+def test_serialize_unframed_fallback_reads_legacy_bytes():
+    import io
+
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)     # the pre-framing format
+    out = mdspan_from_bytes(buf.getvalue()).as_numpy()
+    assert np.array_equal(out, arr)
+
+
+# ------------------------------------------------------------------
+# WAL
+def test_wal_round_trip_and_lsn_order(tmp_path):
+    w = WalWriter(str(tmp_path), sync="batch")
+    ids = np.array([3, 5], np.int32)
+    rows = rng.normal(size=(2, 4)).astype(np.float32)
+    l1 = w.append(OP_UPSERT, encode_upsert(ids, rows))
+    l2 = w.append(OP_DELETE, encode_delete(np.array([9], np.int32)))
+    assert (l1, l2) == (1, 2)
+    assert w.durable_lsn == 0                 # batch: not yet committed
+    assert w.commit() == 2
+    w.close()
+    records, stats = wal_replay(str(tmp_path))
+    assert [r.lsn for r in records] == [1, 2]
+    rid, rrows = decode_upsert(records[0].payload)
+    assert np.array_equal(rid, ids) and np.array_equal(rrows, rows)
+    assert np.array_equal(decode_delete(records[1].payload),
+                          np.array([9], np.int32))
+    assert stats["stopped_early"] is False
+    assert stats["truncated_bytes"] == 0
+    # from_lsn filters the already-checkpointed prefix
+    tail, _ = wal_replay(str(tmp_path), from_lsn=1)
+    assert [r.lsn for r in tail] == [2]
+
+
+def test_wal_rotation_and_retirement(tmp_path):
+    w = WalWriter(str(tmp_path), sync="none", segment_bytes=1 << 10)
+    payload = encode_delete(np.arange(64, dtype=np.int32))
+    for _ in range(20):
+        w.append(OP_DELETE, payload)
+    w.commit()
+    segs = [f for f in os.listdir(tmp_path) if f.startswith("wal-")]
+    assert len(segs) > 1                       # rotated
+    records, _ = wal_replay(str(tmp_path))
+    assert [r.lsn for r in records] == list(range(1, 21))
+    # retire everything a (fictional) checkpoint at lsn 20 covers:
+    # every segment but the active one goes
+    removed = w.retire_through(20)
+    assert removed == len(segs) - 1
+    w.close()
+    records, _ = wal_replay(str(tmp_path))
+    # the surviving suffix is contiguous and ends at the last record
+    lsns = [r.lsn for r in records]
+    assert lsns and lsns[-1] == 20 and lsns[0] > 1
+    assert lsns == list(range(lsns[0], 21))
+
+
+def test_wal_sync_mode_env_and_validation(tmp_path, monkeypatch):
+    from raft_tpu.mutable.wal import sync_mode_default
+
+    monkeypatch.delenv("RAFT_TPU_WAL_SYNC", raising=False)
+    assert sync_mode_default() == "batch"
+    monkeypatch.setenv("RAFT_TPU_WAL_SYNC", "always")
+    assert sync_mode_default() == "always"
+    monkeypatch.setenv("RAFT_TPU_WAL_SYNC", "bogus")
+    assert sync_mode_default() == "batch"      # degrade, never raise
+    with pytest.raises(ValueError):
+        WalWriter(str(tmp_path), sync="fsync-maybe")
+
+
+def _write_frames(path, frames):
+    with open(path, "wb") as f:
+        for fr in frames:
+            f.write(fr)
+
+
+WAL_FUZZ_CASES = ("torn_tail", "truncated_frame", "bitflip_payload",
+                  "bitflip_crc", "zeroed_file", "garbage",
+                  "duplicate_lsn", "regressing_lsn")
+
+
+@pytest.mark.parametrize("case", WAL_FUZZ_CASES)
+def test_wal_corruption_fuzz_never_raises(tmp_path, case):
+    """Every mangling stops replay at the last consistent record —
+    never raises, never double-applies, truncation is counted."""
+    f1 = encode_frame(OP_DELETE, 1, encode_delete(np.array([1])))
+    f2 = encode_frame(OP_DELETE, 2, encode_delete(np.array([2])))
+    f3 = encode_frame(OP_DELETE, 3, encode_delete(np.array([3])))
+    path = str(tmp_path / "wal-0000000000000001.log")
+    if case == "torn_tail":
+        _write_frames(path, [f1, f2, f3[:len(f3) // 2]])
+        want = [1, 2]
+    elif case == "truncated_frame":
+        _write_frames(path, [f1, f2[:8]])
+        want = [1]
+    elif case == "bitflip_payload":
+        bad = bytearray(f2)
+        bad[24] ^= 0x40
+        _write_frames(path, [f1, bytes(bad), f3])
+        want = [1]
+    elif case == "bitflip_crc":
+        bad = bytearray(f2)
+        bad[-1] ^= 0x01
+        _write_frames(path, [f1, bytes(bad), f3])
+        want = [1]
+    elif case == "zeroed_file":
+        _write_frames(path, [b"\x00" * 128])
+        want = []
+    elif case == "garbage":
+        _write_frames(path, [os.urandom(200)])
+        want = []
+    elif case == "duplicate_lsn":
+        _write_frames(path, [f1, f2, f2, f3])
+        want = [1, 2]
+    else:                                      # regressing_lsn
+        _write_frames(path, [f1, f2, f1])
+        want = [1, 2]
+    records, stats = wal_replay(str(tmp_path), truncate=True)
+    assert [r.lsn for r in records] == want
+    assert stats["stopped_early"]
+    assert stats["truncated_bytes"] > 0
+    # the torn tail was physically truncated: a second replay is clean
+    # and an appender can continue from the boundary
+    records2, stats2 = wal_replay(str(tmp_path))
+    assert [r.lsn for r in records2] == want
+    assert stats2["truncated_bytes"] == 0
+    w = WalWriter(str(tmp_path), sync="none",
+                  next_lsn=(want[-1] if want else 0) + 1)
+    w.append(OP_DELETE, encode_delete(np.array([7])))
+    w.commit()
+    w.close()
+    records3, stats3 = wal_replay(str(tmp_path))
+    assert [r.lsn for r in records3] == want + [(want[-1] if want
+                                                 else 0) + 1]
+    assert stats3["stopped_early"] is False
+
+
+def test_wal_corrupt_middle_segment_drops_later_segments(tmp_path):
+    w = WalWriter(str(tmp_path), sync="none", segment_bytes=1 << 10)
+    payload = encode_delete(np.arange(64, dtype=np.int32))
+    for _ in range(20):
+        w.append(OP_DELETE, payload)
+    w.commit()
+    w.close()
+    segs = sorted(f for f in os.listdir(tmp_path)
+                  if f.startswith("wal-"))
+    assert len(segs) >= 3
+    # zero a MIDDLE segment: the consistent prefix ends there — later
+    # (intact) segments must NOT replay past the hole
+    mid = os.path.join(str(tmp_path), segs[1])
+    size = os.path.getsize(mid)
+    with open(mid, "wb") as f:
+        f.write(b"\x00" * size)
+    records, stats = wal_replay(str(tmp_path), truncate=True)
+    assert stats["stopped_early"]
+    lsns = [r.lsn for r in records]
+    assert lsns == list(range(1, len(lsns) + 1))   # a clean prefix
+    assert stats["truncated_bytes"] > 0
+
+
+# ------------------------------------------------------------------
+# checkpoints
+def _ck_write(store, lsn, gen, m=16, d=4, seed=0):
+    r = np.random.default_rng(seed)
+    rows = r.normal(size=(m, d)).astype(np.float32)
+    exts = np.arange(m, dtype=np.int32)
+    store.write(rows, exts, lsn=lsn, generation=gen)
+    return rows, exts
+
+
+def test_checkpoint_write_load_round_trip(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    rows, exts = _ck_write(store, lsn=5, gen=1)
+    ck = store.load()
+    assert ck is not None
+    assert ck.lsn == 5 and ck.generation == 1
+    assert np.array_equal(ck.rows, rows)
+    assert np.array_equal(ck.exts, exts)
+
+
+CKPT_FUZZ_CASES = ("bitflip_slab", "missing_slab", "garbage_manifest",
+                   "missing_manifest", "stale_pointer", "torn_pointer")
+
+
+@pytest.mark.parametrize("case", CKPT_FUZZ_CASES)
+def test_checkpoint_fuzz_falls_back_to_previous(tmp_path, case):
+    """Corrupting the NEWEST checkpoint (slab bit-flip, missing slab
+    file behind a valid manifest, garbage/missing manifest, stale or
+    torn CURRENT pointer) degrades the load to the previous valid
+    checkpoint — never raises, never serves unverified bytes."""
+    store = CheckpointStore(str(tmp_path))
+    rows_old, _ = _ck_write(store, lsn=3, gen=1, seed=1)
+    _ck_write(store, lsn=9, gen=2, seed=2)
+    dirs = sorted(d for d in os.listdir(tmp_path)
+                  if d.startswith("ckpt-"))
+    newest = os.path.join(str(tmp_path), dirs[-1])
+    if case == "bitflip_slab":
+        p = os.path.join(newest, "rows.msp")
+        data = bytearray(read_bytes(p))
+        data[len(data) // 2] ^= 0x10
+        with open(p, "wb") as f:
+            f.write(bytes(data))
+    elif case == "missing_slab":
+        os.unlink(os.path.join(newest, "rows.msp"))
+    elif case == "garbage_manifest":
+        with open(os.path.join(newest, "manifest.json"), "wb") as f:
+            f.write(os.urandom(64))
+    elif case == "missing_manifest":
+        os.unlink(os.path.join(newest, "manifest.json"))
+    elif case == "stale_pointer":
+        atomic_write_text(os.path.join(str(tmp_path), "CURRENT"),
+                          "ckpt-does-not-exist\n")
+        # the newest dir itself is also mangled so the scan must land
+        # on the OLD one
+        os.unlink(os.path.join(newest, "exts.msp"))
+    else:                                      # torn_pointer
+        with open(os.path.join(str(tmp_path), "CURRENT"), "wb") as f:
+            f.write(b"\xff\xfe garbage")
+        os.unlink(os.path.join(newest, "rows.msp"))
+    ck = store.load()
+    assert ck is not None
+    assert ck.lsn == 3 and ck.generation == 1
+    assert np.array_equal(ck.rows, rows_old)
+
+
+def test_checkpoint_all_corrupt_loads_none(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    _ck_write(store, lsn=3, gen=1)
+    for d in os.listdir(tmp_path):
+        full = os.path.join(str(tmp_path), d)
+        if os.path.isdir(full):
+            with open(os.path.join(full, "manifest.json"), "wb") as f:
+                f.write(b"not json")
+    assert store.load() is None
+
+
+def test_checkpoint_prune_keeps_fallback_watermark(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    for i, lsn in enumerate((2, 5, 9)):
+        _ck_write(store, lsn=lsn, gen=i, seed=i)
+    watermark = store.prune(keep=2)
+    # the RETAINED minimum — retiring WAL past it would strand the
+    # fallback checkpoint without its replay tail
+    assert watermark == 5
+    assert len(store.manifests()) == 2
+
+
+# ------------------------------------------------------------------
+# recover end-to-end
+def test_recover_matches_precrash_index(tmp_path):
+    Y = _base()
+    idx = MutableIndex(Y, durable_dir=str(tmp_path), wal_sync="batch",
+                       **COMMON)
+    apply_upsert(idx, [100, 101],
+                 rng.normal(size=(2, 8)).astype(np.float32))
+    apply_delete(idx, [0, 7])
+    apply_upsert(idx, [7], rng.normal(size=(1, 8)).astype(np.float32))
+    idx.close()
+    assert has_durable_state(str(tmp_path))
+    out = recover(str(tmp_path), attach=False, **COMMON)
+    assert out is not None
+    ridx, stats = out
+    assert stats["replayed_records"] == 3
+    assert _live_state(ridx) == _live_state(idx)
+    q = rng.normal(size=(3, 8)).astype(np.float32)
+    vi, ii = search_view(idx, q, 5)
+    vr, ir = search_view(ridx, q, 5)
+    assert np.array_equal(np.asarray(ii), np.asarray(ir))
+    assert np.allclose(np.asarray(vi), np.asarray(vr), atol=1e-5)
+
+
+def test_recover_rebounds_tail_with_fresh_checkpoint(tmp_path):
+    Y = _base()
+    idx = MutableIndex(Y, durable_dir=str(tmp_path), wal_sync="batch",
+                       **COMMON)
+    apply_upsert(idx, [200], rng.normal(size=(1, 8)).astype(np.float32))
+    apply_upsert(idx, [201], rng.normal(size=(1, 8)).astype(np.float32))
+    idx.close()
+    r1, st1 = recover(str(tmp_path), wal_sync="batch", **COMMON)
+    assert st1["replayed_records"] == 2
+    apply_delete(r1, [200])
+    r1.close()
+    # the post-recovery checkpoint rebounded the tail: only the ops
+    # AFTER it replay on the next recovery
+    r2, st2 = recover(str(tmp_path), attach=False, **COMMON)
+    assert st2["replayed_records"] == 1
+    assert 200 not in r2._lookup and 201 in r2._lookup
+
+
+def test_recover_after_compaction_checkpoint(tmp_path):
+    """The compactor's at-swap checkpoint bounds the tail: mutations
+    folded into the new base never replay again."""
+    Y = _base()
+    idx = MutableIndex(Y, durable_dir=str(tmp_path), wal_sync="batch",
+                       auto_compact=False, compact_threshold=16,
+                       delta_cap=64, **GEOM)
+    for i in range(4):
+        apply_upsert(idx, [300 + i],
+                     rng.normal(size=(1, 8)).astype(np.float32))
+    assert idx.compact(block=True)
+    apply_upsert(idx, [400], rng.normal(size=(1, 8)).astype(np.float32))
+    idx.close()
+    ridx, stats = recover(str(tmp_path), attach=False,
+                          auto_compact=False, compact_threshold=16,
+                          delta_cap=64, **GEOM)
+    assert stats["replayed_records"] == 1      # only the post-fold op
+    assert stats["checkpoint_generation"] >= 1
+    assert _live_state(ridx) == _live_state(idx)
+
+
+def test_recover_empty_dir_returns_none(tmp_path):
+    assert not has_durable_state(str(tmp_path))
+    assert recover(str(tmp_path), **COMMON) is None
+
+
+def test_recover_torn_wal_tail_truncates_and_serves(tmp_path):
+    Y = _base()
+    idx = MutableIndex(Y, durable_dir=str(tmp_path), wal_sync="batch",
+                       **COMMON)
+    apply_upsert(idx, [500], rng.normal(size=(1, 8)).astype(np.float32))
+    idx.close()
+    import glob as _glob
+
+    seg = sorted(_glob.glob(os.path.join(str(tmp_path), "wal",
+                                         "wal-*.log")))[-1]
+    with open(seg, "ab") as f:
+        f.write(b"\x01torn-half-frame")
+    ridx, stats = recover(str(tmp_path), attach=False, **COMMON)
+    assert stats["truncated_bytes"] > 0
+    assert 500 in ridx._lookup                 # the acked op survived
+
+
+def test_durable_off_no_plane_no_new_work(tmp_path):
+    """durable=False (the default): no durability plane, nothing on
+    disk, and the mutation path triggers no compile-cache misses
+    beyond the in-memory baseline's."""
+    from raft_tpu.core.resources import DeviceResources
+
+    Y = _base()
+    res = DeviceResources()
+    idx = MutableIndex(Y, res=res, **COMMON)
+    assert idx.durability is None
+    apply_upsert(idx, [600], rng.normal(size=(1, 8)).astype(np.float32))
+    misses0 = res.compile_cache.misses
+    apply_upsert(idx, [601], rng.normal(size=(1, 8)).astype(np.float32))
+    apply_delete(idx, [600])
+    assert res.compile_cache.misses == misses0
+    assert os.listdir(tmp_path) == []
+
+
+def test_wal_append_fault_leaves_index_unchanged():
+    """An injected wal_append error fails the mutation BEFORE any
+    state change — the index (and the log) stay consistent."""
+    from raft_tpu import resilience
+
+    import tempfile
+
+    d = tempfile.mkdtemp()
+    idx = MutableIndex(_base(), durable_dir=d, wal_sync="batch",
+                       **COMMON)
+    before = _live_state(idx)
+    seq0 = idx.seq
+    resilience.configure_faults("wal_append:error")
+    try:
+        with pytest.raises(resilience.InjectedDeviceError):
+            apply_upsert(idx, [700],
+                         rng.normal(size=(1, 8)).astype(np.float32))
+    finally:
+        resilience.clear_faults()
+    assert _live_state(idx) == before
+    assert idx.seq == seq0
+    idx.close()
+    ridx, _ = recover(d, attach=False, **COMMON)
+    assert _live_state(ridx) == before
+
+
+# ------------------------------------------------------------------
+# serving engine durable restart
+ENGINE_KW = dict(buckets=(8, 16), flush_interval_s=0.002,
+                 shadow_frac=0.0, **GEOM)
+
+
+def test_engine_durable_restart_recovers(tmp_path):
+    from raft_tpu.serving import ServingEngine
+
+    Y = _base()
+    d = str(tmp_path / "dur")
+    e1 = ServingEngine(Y, k=4, durable=True, durable_dir=d,
+                       compact_threshold=10_000, **ENGINE_KW)
+    e1.start()
+    e1.upsert([100, 101],
+              rng.normal(size=(2, 8)).astype(np.float32)).result(60)
+    e1.delete([0]).result(60)
+    q = rng.normal(size=(3, 8)).astype(np.float32)
+    v1, i1 = e1.query(q)
+    assert e1.stats().get("durability", {}).get("sync") == "batch"
+    e1.stop()
+
+    e2 = ServingEngine(Y, k=4, durable=True, durable_dir=d,
+                       compact_threshold=10_000, **ENGINE_KW)
+    assert e2.recovery is not None
+    assert e2.recovery["replayed_records"] == 2
+    e2.start()
+    v2, i2 = e2.query(q)
+    assert np.array_equal(np.asarray(i1), np.asarray(i2))
+    assert np.allclose(np.asarray(v1), np.asarray(v2), atol=1e-5)
+    st = e2.stats()
+    assert "recovery" in st and "durability" in st
+    e2.stop()
+
+
+def test_engine_durable_requires_dir(monkeypatch):
+    from raft_tpu.core.error import LogicError
+    from raft_tpu.serving import ServingEngine
+
+    monkeypatch.delenv("RAFT_TPU_DURABLE_DIR", raising=False)
+    with pytest.raises(LogicError, match="durable_dir"):
+        ServingEngine(_base(), k=4, durable=True, **ENGINE_KW)
+
+
+# ------------------------------------------------------------------
+# statusz panel + drift-ledger degraded loads (satellites)
+def test_statusz_renders_durability_panel(tmp_path):
+    import tools.statusz as statusz
+    from raft_tpu.serving import ServingEngine
+
+    d = str(tmp_path / "dur")
+    eng = ServingEngine(_base(), k=4, durable=True, durable_dir=d,
+                        compact_threshold=10_000, **ENGINE_KW)
+    eng.start()
+    eng.upsert([42], rng.normal(size=(1, 8)).astype(np.float32)
+               ).result(60)
+    page = statusz.render_statusz(engine=eng)
+    eng.stop()
+    assert "durability (WAL / checkpoints / recovery)" in page
+    assert "wal sync=batch" in page
+    assert "checkpoints 1" in page
+    # and the no-plane rendering never raises
+    page2 = statusz.render_statusz()
+    assert "no durability plane attached" in page2
+
+
+def test_drift_ledger_degraded_loads_counted(tmp_path):
+    from raft_tpu.observability.timeline import (DRIFT_DEGRADED,
+                                                 DriftLedger,
+                                                 _reset_degraded_warnings)
+
+    _reset_degraded_warnings()
+    # absent file: the normal cold state — NOT a degradation
+    before = _counter_value(DRIFT_DEGRADED)
+    led = DriftLedger.load(str(tmp_path / "missing.json"))
+    assert len(led) == 0
+    assert _counter_value(DRIFT_DEGRADED) == before
+    # unreadable: counted under its reason
+    p = tmp_path / "bad.json"
+    p.write_bytes(b"{torn")
+    DriftLedger.load(str(p))
+    assert _counter_value(DRIFT_DEGRADED, reason="unreadable") >= 1
+    # invalid payload: counted under its reason
+    p2 = tmp_path / "inv.json"
+    p2.write_text(json.dumps({"schema": 1, "entries": [1, 2]}))
+    DriftLedger.load(str(p2))
+    assert _counter_value(DRIFT_DEGRADED, reason="invalid") >= 1
+    # the save path is the shared atomic writer (no torn rename)
+    led2 = DriftLedger(path=str(tmp_path / "ok.json"))
+    led2.record("site.x", predicted_seconds=1.0, measured_seconds=1.1)
+    reloaded = DriftLedger.load(str(tmp_path / "ok.json"))
+    assert reloaded.latest("site.x") is not None
+
+
+# ------------------------------------------------------------------
+# the SIGKILL crash matrix
+_WORKER = os.path.join(os.path.dirname(__file__), "_crash_worker.py")
+
+CRASH_SITES = ("wal_append", "wal_fsync", "checkpoint_write",
+               "manifest_commit")
+#: kill points: nth call to the site inside the worker. Call 1 lands
+#: in/around the genesis checkpoint, later calls land mid-mutation and
+#: at the mid-run checkpoint (tests/_crash_worker.py's script).
+TIER1_CASES = [("wal_append", 3), ("wal_fsync", 4),
+               ("checkpoint_write", 1), ("manifest_commit", 2)]
+SLOW_CASES = [("wal_append", 1), ("wal_fsync", 1),
+              ("checkpoint_write", 2), ("manifest_commit", 1),
+              ("wal_append", 5), ("wal_fsync", 6)]
+
+
+def _read_jsonl(path):
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _apply_ops(idx, ops):
+    for op in ops:
+        if op["kind"] == "upsert":
+            rows = np.stack([_crash_worker.row_for(e)
+                             for e in op["ids"]])
+            apply_upsert(idx, op["ids"], rows)
+        else:
+            apply_delete(idx, op["ids"])
+
+
+def _run_crash_case(tmp_path, site, nth):
+    durable = tmp_path / "dur"
+    side = tmp_path / "side"
+    durable.mkdir()
+    side.mkdir()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("RAFT_TPU_FAULTS", None)
+    proc = subprocess.run(
+        [sys.executable, _WORKER, str(durable), site, str(nth),
+         str(side)], env=env, capture_output=True, text=True,
+        timeout=600)
+    killed = proc.returncode == -signal.SIGKILL
+    completed = "COMPLETED" in proc.stdout
+    assert killed or completed, (
+        f"worker neither completed nor died by SIGKILL "
+        f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}")
+    acked = _read_jsonl(str(side / "acked.jsonl"))
+    submitted = _read_jsonl(str(side / "submitted.jsonl"))
+    out = recover(str(durable), attach=False, **COMMON)
+    if out is None:
+        # by the genesis-checkpoint invariant nothing durable means
+        # nothing was ever acked
+        assert acked == [], "acked writes lost: no recoverable state"
+        return
+    ridx, stats = out
+    state = _live_state(ridx)
+    # the recovered state must equal base ⊕ exactly one prefix of the
+    # submitted stream (records are atomic: no half-applied op), and
+    # that prefix must cover every ACKED op (zero acked loss). The
+    # prefix may extend past the acks: a submitted-but-unacked record
+    # that reached the log is replayed in FULL, which the contract
+    # allows.
+    Y = _crash_worker.base_matrix()
+    oracle = MutableIndex(Y, **COMMON)
+    matched = None
+    if state == _live_state(oracle):
+        matched = 0
+    for n, op in enumerate(submitted, start=1):
+        _apply_ops(oracle, [op])
+        if state == _live_state(oracle):
+            matched = n
+    assert matched is not None, (
+        f"recovered state matches NO prefix of the submitted op "
+        f"stream (acked={len(acked)}, submitted={len(submitted)})")
+    assert matched >= len(acked), (
+        f"ACKED WRITE LOST: recovered prefix {matched} < "
+        f"{len(acked)} acked ops (site={site}@{nth})")
+    # and the search plane agrees bit-for-bit on ids with the oracle
+    # rebuilt at that prefix
+    oracle2 = MutableIndex(Y, **COMMON)
+    _apply_ops(oracle2, submitted[:matched])
+    q = np.random.default_rng(5).normal(size=(3, 8)).astype(np.float32)
+    vo, io_ = search_view(oracle2, q, 5)
+    vr, ir = search_view(ridx, q, 5)
+    assert np.array_equal(np.asarray(io_), np.asarray(ir))
+    assert np.allclose(np.asarray(vo), np.asarray(vr), atol=1e-5)
+
+
+@pytest.mark.parametrize("site,nth", TIER1_CASES,
+                         ids=[f"{s}@{n}" for s, n in TIER1_CASES])
+def test_crash_matrix(tmp_path, site, nth):
+    """SIGKILL at a durability fault site: recovery must lose zero
+    acked writes and half-apply nothing (one kill point per site in
+    tier-1; more kill points ride the @slow matrix)."""
+    _run_crash_case(tmp_path, site, nth)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site,nth", SLOW_CASES,
+                         ids=[f"{s}@{n}" for s, n in SLOW_CASES])
+def test_crash_matrix_extended(tmp_path, site, nth):
+    _run_crash_case(tmp_path, site, nth)
+
+
+def test_crash_sites_match_registry():
+    """The crash matrix kills at exactly the durability sites the
+    fault registry + static gate know about."""
+    from raft_tpu.resilience import KNOWN_SITES
+    import tools.check_instrumented as ci
+
+    for site in CRASH_SITES:
+        assert site in KNOWN_SITES
+    static = (set(ci.FAULT_SITES["raft_tpu/mutable/wal.py"])
+              | set(ci.FAULT_SITES["raft_tpu/mutable/checkpoint.py"]))
+    assert static == set(CRASH_SITES)

@@ -292,6 +292,34 @@ def _drive_compact_fold():
     return _mutable_small().compact(block=True)
 
 
+def _drive_wal(sync: str):
+    """Cheap route through the WAL sites: one append on a throwaway
+    writer (sync='always' routes the fsync seam on the same call)."""
+    import tempfile
+
+    from raft_tpu.mutable.wal import OP_DELETE, WalWriter, encode_delete
+
+    w = WalWriter(tempfile.mkdtemp(), sync=sync)
+    try:
+        return w.append(OP_DELETE, encode_delete(np.array([1])))
+    finally:
+        w.close()
+
+
+def _drive_checkpoint_write():
+    """Cheap route through the checkpoint sites: one tiny store write
+    (checkpoint_write fires before any byte lands, manifest_commit at
+    the two-phase pointer seam of the same call)."""
+    import tempfile
+
+    from raft_tpu.mutable.checkpoint import CheckpointStore
+
+    store = CheckpointStore(tempfile.mkdtemp())
+    return store.write(np.ones((4, 4), np.float32),
+                       np.arange(4, dtype=np.int32), lsn=1,
+                       generation=0)
+
+
 _serving_engine = None
 
 
@@ -378,6 +406,13 @@ def _always_raise_drivers():
         "mutate_ingest": _drive_mutate_ingest,
         "tombstone_apply": _drive_tombstone_apply,
         "compact_fold": _drive_compact_fold,
+        # durability plane (ISSUE 12): WAL append/fsync + checkpoint
+        # write/commit — the same four seams the SIGKILL crash matrix
+        # (tests/test_durability.py) takes to process death
+        "wal_append": lambda: _drive_wal("batch"),
+        "wal_fsync": lambda: _drive_wal("always"),
+        "checkpoint_write": _drive_checkpoint_write,
+        "manifest_commit": _drive_checkpoint_write,
         "sharded_dispatch": None,      # dedicated ladder tests below
         "merge_permute": None,
         "merge_allgather": None,

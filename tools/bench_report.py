@@ -58,6 +58,8 @@ ANN_GLOB = "ANN_r*.json"
 ANN_NAME = "BENCH_ANN.json"
 MUTATION_GLOB = "MUTATION_r*.json"
 MUTATION_NAME = "BENCH_MUTATION.json"
+RECOVERY_GLOB = "RECOVERY_r*.json"
+RECOVERY_NAME = "BENCH_RECOVERY.json"
 # recall@k may drop at most this much ABSOLUTE between rounds (recall
 # is platform-independent math, so the trend gates modeled rounds too —
 # only the ms columns are speed and measured-only)
@@ -79,7 +81,7 @@ DRIFT_BAND = 3.0
 NAMED_ARTIFACTS = ("SELECT_K_MATRIX.json", "PALLAS_SMOKE.json",
                    "TPU_FUZZ.json", "BUSBW_BENCH.json",
                    "BENCH_SERVING.json", "BENCH_ANN.json",
-                   "BENCH_MUTATION.json")
+                   "BENCH_MUTATION.json", "BENCH_RECOVERY.json")
 
 # cost-model fields Fixture.run emits into BENCH artifacts (PR 2+)
 COST_FIELDS = ("flops", "bytes_accessed", "arithmetic_intensity",
@@ -768,6 +770,167 @@ def mutation_trajectory(rounds: Sequence[Tuple[int, str,
     return "\n".join(lines) + "\n"
 
 
+def load_recovery(path: str) -> Optional[Dict]:
+    """Flat durability/recovery record (benchmarks/bench_recovery.py):
+    unwraps the driver's envelope like :func:`load_serving`. A record
+    must carry an ``ok`` verdict, the zero-acked-loss flag, or a
+    recovery time to count."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict):
+        return None
+    rec = data.get("parsed")
+    keys = ("ok", "zero_acked_loss", "recovery_ms")
+    if isinstance(rec, dict) and any(k in rec for k in keys):
+        merged = dict(data)
+        merged.update(rec)
+        return merged
+    if any(k in data for k in keys):
+        return data
+    return None
+
+
+def collect_recovery(directory: str
+                     ) -> List[Tuple[int, str, Optional[Dict]]]:
+    """(round, path, record) for every RECOVERY_r*.json, in round
+    order, plus the bare BENCH_RECOVERY.json (when present) as the
+    NEWEST entry — same convention as :func:`collect_serving`."""
+    out = []
+    for path in glob.glob(os.path.join(directory, RECOVERY_GLOB)):
+        m = re.search(r"RECOVERY_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        out.append((int(m.group(1)), path, load_recovery(path)))
+    out.sort(key=lambda t: t[0])
+    bare = os.path.join(directory, RECOVERY_NAME)
+    if os.path.exists(bare):
+        n = (out[-1][0] + 1) if out else 1
+        out.append((n, bare, load_recovery(bare)))
+    return out
+
+
+def check_recovery(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
+                   threshold: float = DEFAULT_THRESHOLD
+                   ) -> Tuple[str, str]:
+    """Gate the durability/crash-recovery evidence (BENCH_RECOVERY /
+    RECOVERY_r*):
+
+    - the newest parseable round must be ``ok`` (acked-write contract
+      held, recovered state matched the oracle);
+    - degraded rounds (nonzero resilience degradations) SKIP;
+    - **zero-acked-loss flag**: the round must carry
+      ``zero_acked_loss: true`` — a recovery artifact that lost an
+      acked write (or stopped stamping the flag) is THE regression
+      this plane exists to prevent; platform-independent, so modeled
+      rounds gate too;
+    - **recovery-time bound**: ``recovery_ms`` must stay within the
+      artifact's own ``recovery_ms_bound`` (the bench sets a
+      platform-appropriate ceiling — an unbounded recovery breaks the
+      restart-SLO story regardless of chip);
+    - **speed trend**: only MEASURED rounds gate durable-write
+      throughput (same ±threshold convention as the serving gate)."""
+    newest = None
+    for _, _, rec in reversed(rounds):
+        if rec is not None:
+            newest = rec
+            break
+    if newest is None:
+        return SKIP, "no recovery artifact to gate"
+    if newest.get("skipped"):
+        return SKIP, "latest recovery round skipped"
+    rd = newest.get("resilience_degradations")
+    if isinstance(rd, (int, float)) and rd > 0:
+        return SKIP, (
+            f"latest recovery round recorded {rd:g} degradation "
+            f"step(s) — a degraded run is history, never gated and "
+            f"never baseline material")
+    if not newest.get("ok", True):
+        return REGRESS, ("latest recovery round failed (ok=false) — "
+                         "the durability plane regressed")
+    if newest.get("zero_acked_loss") is not True:
+        return REGRESS, (
+            "RECOVERY ACKED-LOSS REGRESSION: the round does not carry "
+            "zero_acked_loss=true — an acked write was lost (or the "
+            "proof stopped being stamped), the exact contract the WAL "
+            "exists to keep")
+    rms = newest.get("recovery_ms")
+    bound = newest.get("recovery_ms_bound")
+    if isinstance(rms, (int, float)) and isinstance(bound,
+                                                    (int, float)):
+        if rms > bound:
+            return REGRESS, (
+                f"RECOVERY TIME REGRESSION: {rms:g} ms > the "
+                f"artifact's own bound {bound:g} ms — checkpoint + "
+                f"WAL-tail replay stopped being a bounded restart")
+    msgs = [f"recovery {rms:g} ms" if isinstance(rms, (int, float))
+            else "no recovery_ms",
+            "zero acked loss"]
+    ox = newest.get("durable_overhead_x")
+    if isinstance(ox, (int, float)):
+        msgs.append(f"durable overhead {ox:.2f}x")
+    if not newest.get("measured"):
+        return PASS, ("recovery ok: " + "; ".join(msgs)
+                      + " (modeled — not speed-gated)")
+    prev = None
+    for _, _, rec in reversed(rounds[:-1]):
+        if (rec is not None and rec.get("measured")
+                and not rec.get("skipped")
+                and isinstance(rec.get("throughput_qps"),
+                               (int, float))):
+            prev = rec
+            break
+    qps = newest.get("throughput_qps")
+    if prev is not None and isinstance(qps, (int, float)) \
+            and prev["throughput_qps"] > 0:
+        floor = prev["throughput_qps"] * (1.0 - threshold)
+        if qps < floor:
+            return REGRESS, (
+                f"RECOVERY THROUGHPUT REGRESSION: durable writes "
+                f"{qps:g} req/s < {floor:g} (previous measured "
+                f"{prev['throughput_qps']:g} − {threshold:.0%})")
+        msgs.append(f"{qps:g} vs {prev['throughput_qps']:g} req/s")
+    return PASS, "recovery ok: " + "; ".join(msgs)
+
+
+def recovery_trajectory(rounds: Sequence[Tuple[int, str,
+                                               Optional[Dict]]]) -> str:
+    """Durability series: recovery time, replayed-record tail,
+    durable-write overhead and the zero-acked-loss verdict per round."""
+    lines = [
+        "recovery trajectory (RECOVERY_r*.json + BENCH_RECOVERY.json)",
+        "============================================================"]
+    if not rounds:
+        return "\n".join(lines + ["(no recovery artifacts found)"]) \
+            + "\n"
+    cols = ("round", "ok", "0-loss", "rec ms", "replayed", "overhead x",
+            "req/s", "measured", "metric")
+    rows = []
+    for n, path, rec in rounds:
+        if rec is None:
+            rows.append((f"r{n:02d}", "-", "-", "-", "-", "-", "-", "-",
+                         f"<unparseable: {os.path.basename(path)}>"))
+            continue
+        rows.append((
+            f"r{n:02d}", _fmt(bool(rec.get("ok"))),
+            _fmt(rec.get("zero_acked_loss")),
+            _fmt(rec.get("recovery_ms")),
+            _fmt(rec.get("replayed_records")),
+            _fmt(rec.get("durable_overhead_x")),
+            _fmt(rec.get("throughput_qps")),
+            _fmt(rec.get("measured")) if "measured" in rec else "-",
+            normalize_metric(rec.get("metric", "recovery"))))
+    widths = [max(len(c), *(len(str(r[i])) for r in rows))
+              for i, c in enumerate(cols)]
+    lines.append("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    lines.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        lines.append("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+    return "\n".join(lines) + "\n"
+
+
 def load_drift_ledger(path: str) -> Optional[Dict]:
     """DRIFT_LEDGER.json → {site: [entries...]}; None for a missing or
     unreadable ledger (the no-op case — the gate must not fail repos
@@ -1198,6 +1361,7 @@ def main(argv: Sequence[str] = None) -> int:
     srounds = collect_serving(args.dir)
     arounds = collect_ann(args.dir)
     murounds = collect_mutation(args.dir)
+    rrounds = collect_recovery(args.dir)
     baseline_path = args.baseline or os.path.join(args.dir, BASELINE_NAME)
     baseline = load_record(baseline_path)
     stale = artifact_staleness(args.dir, baseline)
@@ -1222,6 +1386,8 @@ def main(argv: Sequence[str] = None) -> int:
         print(f"bench_report --check [ann]: {astatus}: {amsg}")
         mustatus, mumsg = check_mutation(murounds, args.threshold)
         print(f"bench_report --check [mutation]: {mustatus}: {mumsg}")
+        rstatus, rmsg = check_recovery(rrounds, args.threshold)
+        print(f"bench_report --check [recovery]: {rstatus}: {rmsg}")
         # multichip: the bare benchmark artifact (written by
         # benchmarks/bench_sharded.py) is the freshest carrier of the
         # quantized block — driver rounds lag it by one round
@@ -1260,8 +1426,8 @@ def main(argv: Sequence[str] = None) -> int:
         # regression in ANY trend fails; missing baseline only when
         # nothing regressed
         rcs = (codes[status], codes[mstatus], codes[sstatus],
-               codes[astatus], codes[mustatus], codes[qstatus],
-               codes[qlstatus], codes[dstatus])
+               codes[astatus], codes[mustatus], codes[rstatus],
+               codes[qstatus], codes[qlstatus], codes[dstatus])
         return 1 if 1 in rcs else max(rcs)
 
     if args.json:
@@ -1280,6 +1446,9 @@ def main(argv: Sequence[str] = None) -> int:
             "mutation_rounds": [
                 {"round": n, "path": os.path.basename(path),
                  "record": rec} for n, path, rec in murounds],
+            "recovery_rounds": [
+                {"round": n, "path": os.path.basename(path),
+                 "record": rec} for n, path, rec in rrounds],
             "named_artifacts": stale,
             "baseline": baseline,
             "drift_ledger": load_drift_ledger(
@@ -1298,6 +1467,8 @@ def main(argv: Sequence[str] = None) -> int:
     sys.stdout.write(ann_trajectory(arounds))
     sys.stdout.write("\n")
     sys.stdout.write(mutation_trajectory(murounds))
+    sys.stdout.write("\n")
+    sys.stdout.write(recovery_trajectory(rrounds))
     sys.stdout.write("\n")
     sys.stdout.write(staleness_section(stale))
     return 0

@@ -125,6 +125,13 @@ FAULT_SITES: Dict[str, Sequence[str]] = {
     # serving (tests/test_resilience.py)
     "raft_tpu/mutable/index.py": ("mutate_ingest", "tombstone_apply",
                                   "compact_fold"),
+    # durability plane (ISSUE 12): the WAL append/fsync pair and the
+    # checkpoint write / pointer-commit pair — the four seams the
+    # SIGKILL crash matrix (tests/test_durability.py) kills at; an
+    # uninjectable durability path cannot carry a recovery proof
+    "raft_tpu/mutable/wal.py": ("wal_append", "wal_fsync"),
+    "raft_tpu/mutable/checkpoint.py": ("checkpoint_write",
+                                       "manifest_commit"),
 }
 
 # timeline-event gate: every hot-path module and every fault-site
@@ -214,6 +221,12 @@ EVENT_SITES: Dict[str, Sequence[str]] = {
     "raft_tpu/mutable/index.py": ("instrument", "fault_point",
                                   "emit_mutation", "record_pending"),
     "raft_tpu/mutable/layout.py": ("emit_marker",),
+    # the durability plane: WAL segment lifecycle rides markers,
+    # checkpoint commits + recoveries ride the mutation stream — a
+    # crash recovery invisible in the flight timeline cannot be
+    # audited post-mortem
+    "raft_tpu/mutable/wal.py": ("fault_point", "emit_marker"),
+    "raft_tpu/mutable/checkpoint.py": ("fault_point", "emit_mutation"),
 }
 
 #: quality-telemetry gate (ISSUE 10): every module with a certificate /

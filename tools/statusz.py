@@ -132,6 +132,41 @@ def render_statusz(registry=None, recorder=None, engine=None,
                               else f"  {key:<18} {v}\n")
         except Exception as e:
             out.write(f"(engine stats unavailable: {e})\n")
+    # ---- durability & recovery ----------------------------------------
+    out.write("\ndurability (WAL / checkpoints / recovery)\n")
+    out.write("-----------------------------------------\n")
+    try:
+        plane = None
+        if engine is not None:
+            mut = getattr(engine, "mutable", None)
+            plane = getattr(mut, "durability", None) if mut else None
+        if plane is not None:
+            st = plane.stats()
+            out.write(f"wal sync={st.get('sync')}  "
+                      f"last_lsn={st.get('last_lsn')}  "
+                      f"durable_lsn={st.get('durable_lsn')}  "
+                      f"segments={st.get('segments')}\n")
+            out.write(f"checkpoints {st.get('checkpoints', 0)} "
+                      f"(newest lsn={st.get('checkpoint_lsn', '-')}, "
+                      f"gen={st.get('checkpoint_generation', '-')})\n")
+        else:
+            out.write("(no durability plane attached — "
+                      "durable=False)\n")
+        from raft_tpu.mutable.checkpoint import last_recovery
+
+        rec_info = last_recovery()
+        if rec_info is not None:
+            out.write(f"last recovery   {rec_info['seconds'] * 1e3:.1f}"
+                      f" ms: {rec_info['replayed_records']} record(s) "
+                      f"replayed over checkpoint "
+                      f"lsn={rec_info['checkpoint_lsn']}, "
+                      f"{rec_info['truncated_bytes']} torn byte(s) "
+                      f"truncated\n")
+        else:
+            out.write("last recovery   (none this process)\n")
+    except Exception as e:
+        out.write(f"(durability section unavailable: {e})\n")
+
     out.write("\ndegradations\n------------\n")
     try:
         from raft_tpu.resilience import degradation_count
