@@ -1,0 +1,48 @@
+"""graftlint — whole-program AST analysis for the raft_tpu tree.
+
+The RAFT heritage ships clang-tidy + pre-commit as first-class
+infrastructure; this package is the TPU-native equivalent: a module
+loader + call-graph builder over the ``raft_tpu/`` packages, a pass
+registry with a finding/severity model, and a baseline-suppression
+file, fronted by ``tools/graftlint.py`` and wired into tier-1.
+
+Flagship passes
+---------------
+``trace-purity``
+    Computes the set of functions reachable from ``jit`` /
+    ``shard_map`` / ``pallas_call`` / ``_aot_call`` entry points and
+    flags host-sync and retrace hazards inside them (``.item()``,
+    ``float()/int()`` on traced values, ``np.asarray``,
+    ``.block_until_ready()``, ``time.*``/RNG calls, ``os.environ``
+    reads, unhashable values flowing into static compile-cache keys).
+
+``lock-discipline``
+    Extracts the lock-acquisition graph from the threaded planes and
+    reports lock-order inversions, blocking calls (``fsync``, joins,
+    waits, host syncs) while holding a lock, and module-level mutable
+    state written from two or more thread roots with no lock in scope.
+
+``registry``
+    Derives fault sites, timeline-emitter kinds, quality sites, env
+    knobs, and instrumented hot paths *from source* and diffs them
+    against ``faults.KNOWN_SITES``, ``flight.KNOWN_EVENT_KINDS``, the
+    ``core/env.py`` knob registry, the README env-knob table, and
+    ``tools/check_instrumented.py``'s curated tables — a new subsystem
+    can never ship half-registered.
+
+The package is deliberately stdlib-only (``ast`` + ``os``): the tools
+load it standalone (no ``raft_tpu``/jax import) via
+``importlib``, so the gate runs anywhere the source tree exists.
+"""
+
+from .framework import (AnalysisPass, Finding, all_passes,  # noqa: F401
+                        run_passes)
+from .baseline import Baseline  # noqa: F401
+from .loader import Program, load_program  # noqa: F401
+from .callgraph import CallGraph, build_call_graph  # noqa: F401
+from . import registry  # noqa: F401  (derived-registry surface for tools)
+
+__all__ = [
+    "AnalysisPass", "Finding", "Baseline", "Program", "CallGraph",
+    "load_program", "build_call_graph", "run_passes", "all_passes",
+]

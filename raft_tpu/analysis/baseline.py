@@ -1,0 +1,88 @@
+"""Baseline suppression for graftlint findings.
+
+The baseline file (``tools/graftlint_baseline.json``) is the list of
+*accepted* findings — every entry MUST carry a human reason string, so
+a suppression is a documented decision, never a silent one. Matching
+is by :attr:`Finding.fingerprint` (pass:rule:file:anchor — no line
+numbers), so unrelated edits don't churn the file.
+
+Apply semantics (pinned by tests/test_analysis.py):
+
+- a finding whose fingerprint is in the baseline → suppressed;
+- a finding NOT in the baseline → unsuppressed (fails the gate);
+- a baseline entry matching no finding → *stale*, reported as a
+  warning (clean it up) but never a gate failure;
+- an entry with an empty reason → rejected at load (the file is part
+  of the contract, not an escape hatch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from .framework import Finding
+
+DEFAULT_BASELINE_REL = "tools/graftlint_baseline.json"
+SCHEMA = 1
+
+
+@dataclasses.dataclass
+class Baseline:
+    entries: Dict[str, str]     # fingerprint → reason
+    path: str = ""
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        """Load (missing file = empty baseline). Malformed files and
+        reason-less entries raise — a broken baseline must never make
+        the gate silently permissive."""
+        if not os.path.exists(path):
+            return cls(entries={}, path=path)
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+        if (not isinstance(payload, dict)
+                or payload.get("schema") != SCHEMA
+                or not isinstance(payload.get("suppressions"), list)):
+            raise ValueError(
+                f"graftlint baseline {path}: expected "
+                f"{{schema: {SCHEMA}, suppressions: [...]}}")
+        entries: Dict[str, str] = {}
+        for e in payload["suppressions"]:
+            fp = e.get("fingerprint")
+            reason = (e.get("reason") or "").strip()
+            if not fp or not reason:
+                raise ValueError(
+                    f"graftlint baseline {path}: every suppression "
+                    f"needs a fingerprint AND a non-empty reason "
+                    f"(offending entry: {e!r})")
+            entries[fp] = reason
+        return cls(entries=entries, path=path)
+
+    def save(self, path: str = "") -> None:
+        path = path or self.path
+        payload = {
+            "schema": SCHEMA,
+            "suppressions": [
+                {"fingerprint": fp, "reason": reason}
+                for fp, reason in sorted(self.entries.items())],
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+
+    def apply(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[str]]:
+        """→ (unsuppressed, suppressed, stale fingerprints)."""
+        unsuppressed, suppressed = [], []
+        seen = set()
+        for f in findings:
+            if f.fingerprint in self.entries:
+                suppressed.append(f)
+                seen.add(f.fingerprint)
+            else:
+                unsuppressed.append(f)
+        stale = sorted(set(self.entries) - seen)
+        return unsuppressed, suppressed, stale

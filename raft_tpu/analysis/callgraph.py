@@ -1,0 +1,181 @@
+"""Whole-program call graph over a parsed :class:`~.loader.Program`.
+
+Name-based, deliberately conservative: an edge exists when the callee
+expression resolves statically — plain names through the import symbol
+table and lexical nesting, ``self.meth()``/``cls.meth()`` within the
+enclosing class, and ``mod.fn()`` through imported-module attributes.
+Dynamic dispatch (arbitrary ``obj.method()``) is recorded as an
+*external* call under its canonicalized dotted name (import aliases
+resolved, e.g. ``onp.asarray`` → ``numpy.asarray``) so hazard passes
+can still match it; it never creates a reachability edge.
+
+Qualnames are ``"pkg.mod:Class.fn"`` (module ``:`` in-module path).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .loader import ModuleInfo, Program, dotted
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qual: str                  # "pkg.mod:Class.fn"
+    module: ModuleInfo
+    node: ast.AST              # FunctionDef / AsyncFunctionDef
+    path: Tuple[str, ...]      # in-module path components
+    cls: Optional[str]         # innermost enclosing class (in-module
+    #                            dotted path), None for free functions
+
+    @property
+    def name(self) -> str:
+        return self.path[-1]
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+@dataclasses.dataclass
+class CallSite:
+    caller: str                       # qualname
+    node: ast.Call
+    resolved: Optional[str] = None    # callee qualname when static
+    external: Optional[str] = None    # canonical dotted name otherwise
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+class CallGraph:
+    def __init__(self, program: Program):
+        self.program = program
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.calls: Dict[str, List[CallSite]] = {}
+        self.edges: Dict[str, Set[str]] = {}
+
+    # -- resolution --------------------------------------------------
+    def resolve(self, info: ModuleInfo, scope: Tuple[str, ...],
+                name: str, cls: Optional[str] = None
+                ) -> Optional[str]:
+        """Resolve a dotted ``name`` referenced from function scope
+        ``scope`` of module ``info`` to a function qualname, or None."""
+        head, _, rest = name.partition(".")
+        # self.meth / cls.meth → the enclosing class's method
+        if head in ("self", "cls") and cls is not None and rest:
+            cand = f"{info.name}:{cls}.{rest}"
+            if cand in self.functions:
+                return cand
+            return None
+        if not rest:
+            # lexical nesting: innermost enclosing prefix wins
+            for i in range(len(scope), -1, -1):
+                prefix = ".".join(scope[:i])
+                cand = (f"{info.name}:{prefix}.{name}" if prefix
+                        else f"{info.name}:{name}")
+                if cand in self.functions:
+                    return cand
+        target = info.symbols.get(head)
+        if target is None:
+            return None
+        full = f"{target}.{rest}" if rest else target
+        # longest module prefix of `full` that exists in the program
+        parts = full.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = self.program.get(".".join(parts[:i]))
+            if mod is not None:
+                cand = f"{mod.name}:{'.'.join(parts[i:])}"
+                return cand if cand in self.functions else None
+        return None
+
+    def canonical(self, info: ModuleInfo, name: str) -> str:
+        """Dotted name with its import-alias head resolved (``onp.x``
+        → ``numpy.x``); unknown heads pass through unchanged."""
+        head, _, rest = name.partition(".")
+        target = info.symbols.get(head)
+        if target is None:
+            return name
+        return f"{target}.{rest}" if rest else target
+
+    # -- reachability -------------------------------------------------
+    def reachable(self, roots) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.functions]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            stack.extend(self.edges.get(q, ()))
+        return seen
+
+    def callers_of(self, qual: str) -> Set[str]:
+        return {c for c, outs in self.edges.items() if qual in outs}
+
+    def iter_calls(self, qual: str) -> Iterator[CallSite]:
+        return iter(self.calls.get(qual, ()))
+
+
+def _collect_functions(graph: CallGraph, info: ModuleInfo) -> None:
+    def _walk(node: ast.AST, path: Tuple[str, ...],
+              cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                p = path + (child.name,)
+                qual = f"{info.name}:{'.'.join(p)}"
+                graph.functions[qual] = FunctionInfo(
+                    qual=qual, module=info, node=child, path=p, cls=cls)
+                _walk(child, p, cls)
+            elif isinstance(child, ast.ClassDef):
+                p = path + (child.name,)
+                _walk(child, p, ".".join(p))
+            else:
+                _walk(child, path, cls)
+    _walk(info.tree, (), None)
+
+
+def _collect_calls(graph: CallGraph, fn: FunctionInfo) -> None:
+    """Call sites lexically inside ``fn`` but NOT inside a nested def
+    (those belong to the nested function)."""
+    sites: List[CallSite] = []
+
+    def _walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                name = dotted(child.func)
+                if name is None:
+                    site = CallSite(fn.qual, child,
+                                    external="<dynamic>")
+                else:
+                    resolved = graph.resolve(fn.module, fn.path, name,
+                                             cls=fn.cls)
+                    if resolved is not None:
+                        site = CallSite(fn.qual, child,
+                                        resolved=resolved)
+                    else:
+                        site = CallSite(
+                            fn.qual, child,
+                            external=graph.canonical(fn.module, name))
+                sites.append(site)
+            _walk(child)
+
+    _walk(fn.node)
+    graph.calls[fn.qual] = sites
+    graph.edges[fn.qual] = {s.resolved for s in sites
+                            if s.resolved is not None}
+
+
+def build_call_graph(program: Program) -> CallGraph:
+    graph = CallGraph(program)
+    for info in program:
+        _collect_functions(graph, info)
+    for fn in list(graph.functions.values()):
+        _collect_calls(graph, fn)
+    return graph

@@ -1,0 +1,554 @@
+"""Lock-discipline pass over the threaded planes.
+
+Lock identities are discovered statically: ``self._x =
+threading.Lock()/RLock()/Condition(...)`` inside a class (identity
+``"pkg.mod:Class._x"``) and module-level ``X = threading.Lock()``
+(identity ``"pkg.mod:X"``). Acquisitions are ``with <lock>:`` blocks
+plus explicit ``.acquire()`` calls; a ``<lock>.release()`` inside a
+``with`` body *suspends* the held region until a matching
+``.acquire()`` (the drop-the-lock-around-the-slow-part idiom in
+``MutableIndex._ensure_delta_space_locked``).
+
+Three rule families:
+
+``lock-order-inversion``
+    The whole-program acquisition graph (lock A held while acquiring
+    B — directly or through any statically-resolvable call chain)
+    contains a cycle. Self-edges on re-entrant locks (``RLock``,
+    ``Condition`` — its default lock is an RLock) are legal;
+    a self-edge on a plain ``Lock`` is reported as
+    ``self-deadlock``.
+
+``blocking-under-lock``
+    A blocking call — ``fsync``/``fdatasync``, ``sleep``, thread
+    ``.join()``, ``.result()``, device syncs
+    (``block_until_ready``/``synchronize``/``sync_stream``/
+    ``barrier``), or an ``Event.wait``/``Queue`` wait on an object
+    other than the held lock — executes while a lock is held, directly
+    or through a resolvable call chain. ``Condition.wait`` on the held
+    condition itself is exempt (it releases the lock).
+
+``unlocked-shared-state``
+    A module-level name is written (``global`` declaration + store)
+    from two or more distinct thread roots (``threading.Thread``
+    targets, ``Timer`` callbacks, ``run()`` methods of Thread
+    subclasses — plus everything else as the implicit main root) with
+    no lock held at any writing site.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import CallGraph, FunctionInfo
+from .framework import AnalysisPass, Finding, register_pass
+from .loader import Program, dotted
+
+_LOCK_CTORS = {"Lock": False, "RLock": True, "Condition": True,
+               "Semaphore": False, "BoundedSemaphore": False}
+
+#: canonical call names (or bare attribute names) that block the
+#: calling thread; attribute entries match any receiver
+_BLOCKING_CALLS = {"os.fsync": "fsync", "os.fdatasync": "fdatasync",
+                   "time.sleep": "sleep",
+                   # disk scans / deletes: a directory walk under a
+                   # hot-path lock stalls every waiter behind the disk
+                   "glob.glob": "glob", "os.listdir": "listdir",
+                   "os.scandir": "scandir", "os.unlink": "unlink",
+                   "os.remove": "remove",
+                   "os.path.getsize": "getsize"}
+_BLOCKING_ATTRS = {"fsync", "fdatasync", "join", "result",
+                   "block_until_ready", "synchronize", "sync_stream",
+                   "barrier"}
+
+
+def _join_is_string_join(node: ast.Call, canon: Optional[str]) -> bool:
+    """``", ".join(...)`` / ``os.path.join`` — not a thread join."""
+    if canon is not None and canon.startswith("os.path."):
+        return True
+    recv = node.func.value if isinstance(node.func,
+                                         ast.Attribute) else None
+    return isinstance(recv, ast.Constant)
+#: ``.wait(...)`` blocks too — but not on the held lock itself
+#: (Condition.wait releases it while sleeping)
+_WAIT_ATTR = "wait"
+
+
+@dataclasses.dataclass(frozen=True)
+class LockInfo:
+    ident: str          # "pkg.mod:Class._x" or "pkg.mod:X"
+    reentrant: bool
+    rel: str
+    line: int
+
+
+@dataclasses.dataclass
+class _Acquire:
+    lock: str
+    node: ast.AST       # the with-item / acquire call
+
+
+def _ctor_kind(call: ast.expr, canonical) -> Optional[bool]:
+    """→ reentrant flag when ``call`` constructs a lock, else None.
+    ``Condition(lock)`` inherits the wrapped lock's reentrancy when
+    statically visible; the bare default is an RLock."""
+    if not isinstance(call, ast.Call):
+        return None
+    name = dotted(call.func)
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if last not in _LOCK_CTORS:
+        return None
+    canon = canonical(call.func) or name
+    if not (canon.startswith("threading.") or "." not in canon):
+        return None
+    if last == "Condition" and call.args:
+        inner = _ctor_kind(call.args[0], canonical)
+        if inner is not None:
+            return inner
+    return _LOCK_CTORS[last]
+
+
+class LockDisciplinePass(AnalysisPass):
+    name = "lock-discipline"
+
+    # -- discovery ----------------------------------------------------
+    def _find_locks(self, program: Program, graph: CallGraph
+                    ) -> Dict[str, LockInfo]:
+        locks: Dict[str, LockInfo] = {}
+        for info in program:
+            canonical = lambda e, _m=info: (  # noqa: E731
+                graph.canonical(_m, dotted(e)) if dotted(e) else None)
+            # module-level: X = threading.Lock()
+            for node in info.tree.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    kind = _ctor_kind(node.value, canonical)
+                    if kind is not None:
+                        ident = f"{info.name}:{node.targets[0].id}"
+                        locks[ident] = LockInfo(ident, kind, info.rel,
+                                                node.lineno)
+            # instance attributes: self._x = threading.Lock() anywhere
+            # inside a class body (usually __init__)
+            for fn in graph.functions.values():
+                if fn.module is not info or fn.cls is None:
+                    continue
+                for node in ast.walk(fn.node):
+                    if not (isinstance(node, ast.Assign)
+                            and len(node.targets) == 1):
+                        continue
+                    t = node.targets[0]
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    kind = _ctor_kind(node.value, canonical)
+                    if kind is not None:
+                        ident = f"{info.name}:{fn.cls}.{t.attr}"
+                        locks[ident] = LockInfo(ident, kind, info.rel,
+                                                node.lineno)
+        return locks
+
+    def _lock_of(self, fn: FunctionInfo, expr: ast.expr,
+                 locks: Dict[str, LockInfo]) -> Optional[str]:
+        """Resolve a ``with``-item / receiver expression to a known
+        lock identity (``self._x``, bare module-level name, or a
+        ``mod.X`` attribute chain)."""
+        name = dotted(expr)
+        if name is None:
+            return None
+        if name.startswith("self.") and fn.cls is not None:
+            ident = f"{fn.module.name}:{fn.cls}.{name[5:]}"
+            return ident if ident in locks else None
+        if "." not in name:
+            ident = f"{fn.module.name}:{name}"
+            return ident if ident in locks else None
+        head, _, rest = name.rpartition(".")
+        target = fn.module.symbols.get(head.split(".")[0])
+        if target is not None:
+            ident = f"{target}:{rest}"
+            if ident in locks:
+                return ident
+        # attribute on an arbitrary object: match by UNIQUE attr name
+        # across all class locks (self-alias through a local var stays
+        # invisible otherwise); ambiguity = no match, stay conservative
+        attr = name.rsplit(".", 1)[-1]
+        cands = [i for i in locks if i.rsplit(".", 1)[-1] == attr
+                 and ":" in i and "." in i.split(":")[1]]
+        return cands[0] if len(cands) == 1 else None
+
+    # -- per-function summaries --------------------------------------
+    def _analyze_function(self, fn: FunctionInfo,
+                          locks: Dict[str, LockInfo]):
+        """Linear statement walk tracking the held-lock stack.
+        Returns (acquire_edges, direct_acquires, held_calls,
+        held_blocking, unlocked_global_writes, locked_global_writes):
+
+        - ``acquire_edges``: (held, acquired, node) observed directly;
+        - ``direct_acquires``: locks this function acquires with NO
+          lock already held (its contribution to callers' edges);
+        - ``held_calls``: (held_lock, call_site) for interprocedural
+          propagation;
+        - ``held_blocking``: (held_lock, rule, node, detail) direct
+          blocking calls under a held lock;
+        - global writes partitioned by whether any lock was held;
+        - ``blocks_any``: (detail, recv_lock-or-None) for every
+          blocking call in the function REGARDLESS of held state —
+          the summary callers propagate (they may hold a lock around
+          a call into this function).
+        """
+        edges: List[Tuple[str, str, ast.AST]] = []
+        direct: Set[str] = set()
+        held_calls: List[Tuple[str, ast.Call]] = []
+        blocking: List[Tuple[str, str, ast.AST, str]] = []
+        gl_unlocked: List[Tuple[str, ast.AST]] = []
+        gl_locked: List[Tuple[str, ast.AST]] = []
+        globals_declared: Set[str] = set()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+
+        graph_canonical = self._graph.canonical
+        canonical = lambda e, _m=fn.module: (  # noqa: E731
+            graph_canonical(_m, dotted(e)) if dotted(e) else None)
+
+        def _on_acquire(held: Sequence[str], lock: str,
+                        node: ast.AST) -> None:
+            if held:
+                edges.append((held[-1], lock, node))
+            else:
+                direct.add(lock)
+
+        def _walk(body: Sequence[ast.stmt], held: List[str]) -> None:
+            suspended: List[str] = []
+            for stmt in body:
+                self._walk_stmt(stmt, held, suspended, fn, locks,
+                                canonical, _on_acquire, held_calls,
+                                blocking, gl_unlocked, gl_locked,
+                                globals_declared, _walk)
+            # a suspended lock not re-acquired by function end is a
+            # modeling gap, not a finding — restore silently
+            held.extend(suspended)
+
+        _walk(fn.node.body, [])
+        blocks_any = self._direct_blocking_any(fn, locks, canonical)
+        return (edges, direct, held_calls, blocking, gl_unlocked,
+                gl_locked, blocks_any)
+
+    def _direct_blocking_any(self, fn: FunctionInfo,
+                             locks: Dict[str, LockInfo], canonical
+                             ) -> Set[Tuple[str, Optional[str]]]:
+        """Blocking calls lexically in ``fn`` (nested defs excluded),
+        with the receiver lock resolved for ``.wait()`` so callers can
+        exempt a wait on the very lock they hold (Condition.wait
+        releases it)."""
+        out: Set[Tuple[str, Optional[str]]] = set()
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn.node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            canon = canonical(node.func)
+            if canon in _BLOCKING_CALLS:
+                out.add((canon, None))
+            elif isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr == "join" \
+                        and _join_is_string_join(node, canon):
+                    continue
+                if attr in _BLOCKING_ATTRS:
+                    out.add((f".{attr}()", None))
+                elif attr == _WAIT_ATTR:
+                    out.add((".wait()",
+                             self._lock_of(fn, node.func.value,
+                                           locks)))
+        return out
+
+    def _walk_stmt(self, stmt, held, suspended, fn, locks, canonical,
+                   on_acquire, held_calls, blocking, gl_unlocked,
+                   gl_locked, globals_declared, walk_body) -> None:
+        # nested defs get their own summaries — do not descend
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.With):
+            inner = list(held)
+            acquired_here: List[str] = []
+            for item in stmt.items:
+                lock = self._lock_of(fn, item.context_expr, locks)
+                if lock is not None:
+                    on_acquire(inner, lock, item.context_expr)
+                    inner.append(lock)
+                    acquired_here.append(lock)
+                else:
+                    self._scan_expr(item.context_expr, inner, fn,
+                                    locks, canonical, held_calls,
+                                    blocking, on_acquire)
+            walk_body(stmt.body, inner)
+            # locks released at block exit; anything the body acquired
+            # beyond `inner` (explicit .acquire) stays with the caller
+            for lock in inner:
+                if lock not in held and lock not in acquired_here \
+                        and lock not in suspended:
+                    held.append(lock)
+            return
+        if isinstance(stmt, (ast.If, ast.While, ast.For)):
+            self._scan_expr(getattr(stmt, "test", None)
+                            or getattr(stmt, "iter", None),
+                            held, fn, locks, canonical, held_calls,
+                            blocking, on_acquire)
+            walk_body(stmt.body, held)
+            walk_body(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.Try):
+            walk_body(stmt.body, held)
+            for h in stmt.handlers:
+                walk_body(h.body, held)
+            walk_body(stmt.orelse, held)
+            walk_body(stmt.finalbody, held)
+            return
+        # release/acquire suspension inside a with-body
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Call):
+            call = stmt.value
+            if isinstance(call.func, ast.Attribute):
+                recv = self._lock_of(fn, call.func.value, locks)
+                if recv is not None and call.func.attr == "release" \
+                        and recv in held:
+                    held.remove(recv)
+                    suspended.append(recv)
+                elif recv is not None and call.func.attr == "acquire":
+                    if recv in suspended:
+                        suspended.remove(recv)
+                        held.append(recv)
+                    else:
+                        on_acquire(held, recv, call)
+                        held.append(recv)
+                    return
+        # global writes
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id in globals_declared:
+                (gl_locked if held else gl_unlocked).append(
+                    (t.id, stmt))
+        # generic expression scan (calls, nested acquires)
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.expr):
+                self._scan_expr(sub, held, fn, locks, canonical,
+                                held_calls, blocking, on_acquire)
+
+    def _scan_expr(self, expr, held, fn, locks, canonical, held_calls,
+                   blocking, on_acquire) -> None:
+        if expr is None:
+            return
+        stack: List[ast.AST] = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            canon = canonical(node.func) if name else None
+            if held:
+                held_calls.append((held[-1], node))
+                detail = None
+                if canon in _BLOCKING_CALLS:
+                    detail = canon
+                elif isinstance(node.func, ast.Attribute):
+                    attr = node.func.attr
+                    if attr == "join" \
+                            and _join_is_string_join(node, canon):
+                        pass
+                    elif attr in _BLOCKING_ATTRS:
+                        detail = f".{attr}()"
+                    elif attr == _WAIT_ATTR:
+                        recv = self._lock_of(fn, node.func.value,
+                                             locks)
+                        if recv is None or recv not in held:
+                            detail = ".wait()"
+                if detail is not None:
+                    blocking.append((held[-1], "blocking-under-lock",
+                                     node, detail))
+            # explicit acquire as a sub-expression
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire":
+                recv = self._lock_of(fn, node.func.value, locks)
+                if recv is not None and recv not in held:
+                    on_acquire(held, recv, node)
+
+    # -- thread roots -------------------------------------------------
+    def _thread_roots(self, program: Program, graph: CallGraph
+                      ) -> Dict[str, Set[str]]:
+        """root qualname → reachable functions, one entry per
+        discovered thread entry point."""
+        roots: Set[str] = set()
+        for fn in graph.functions.values():
+            for site in graph.iter_calls(fn.qual):
+                last = (site.external or site.resolved
+                        or "").rsplit(".", 1)[-1]
+                if last.split(":")[-1] not in ("Thread", "Timer"):
+                    continue
+                target = None
+                for kw in site.node.keywords:
+                    if kw.arg in ("target", "function"):
+                        target = kw.value
+                if target is None:
+                    continue
+                name = dotted(target)
+                if name is None:
+                    continue
+                q = graph.resolve(fn.module, fn.path, name, cls=fn.cls)
+                if q is not None:
+                    roots.add(q)
+            # Thread subclasses: run() is a root
+            if fn.cls is not None and fn.name == "run":
+                roots.add(fn.qual)
+        return {r: graph.reachable([r]) for r in sorted(roots)}
+
+    # -- run -----------------------------------------------------------
+    def run(self, program: Program, graph: CallGraph) -> List[Finding]:
+        self._graph = graph
+        locks = self._find_locks(program, graph)
+        findings: List[Finding] = []
+
+        summaries = {}
+        for qual, fn in graph.functions.items():
+            summaries[qual] = self._analyze_function(fn, locks)
+
+        # fixpoint: locks a call may acquire / blocking ops it may
+        # reach (transitively — the fsync usually sits in a helper the
+        # lock-holder calls, not under the ``with`` itself)
+        acq_during: Dict[str, Set[str]] = {
+            q: set(s[1]) | {e[1] for e in s[0]}
+            for q, s in summaries.items()}
+        blk_any: Dict[str, Set[Tuple[str, Optional[str]]]] = {
+            q: set(s[6]) for q, s in summaries.items()}
+        changed = True
+        while changed:
+            changed = False
+            for q in summaries:
+                for callee in graph.edges.get(q, ()):
+                    if callee == q:
+                        continue
+                    na = acq_during[callee] - acq_during[q]
+                    nb = blk_any[callee] - blk_any[q]
+                    if na:
+                        acq_during[q] |= na
+                        changed = True
+                    if nb:
+                        blk_any[q] |= nb
+                        changed = True
+
+        # acquisition graph: direct edges + held-call propagation
+        graph_edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        for qual, summary in sorted(summaries.items()):
+            edges, _d, held_calls = summary[0], summary[1], summary[2]
+            fn = graph.functions[qual]
+            for held, acquired, node in edges:
+                graph_edges.setdefault(
+                    (held, acquired),
+                    (fn.module.rel, node.lineno, qual))
+            for held, call in held_calls:
+                name = dotted(call.func)
+                q2 = (graph.resolve(fn.module, fn.path, name,
+                                    cls=fn.cls) if name else None)
+                if q2 is None:
+                    continue
+                for acquired in sorted(acq_during.get(q2, ())):
+                    graph_edges.setdefault(
+                        (held, acquired),
+                        (fn.module.rel, call.lineno, qual))
+                for detail, recv in sorted(
+                        blk_any.get(q2, ()),
+                        key=lambda t: (t[0], t[1] or "")):
+                    if recv is not None and recv == held:
+                        continue  # Condition.wait on the held lock
+                    findings.append(self.finding(
+                        "blocking-under-lock", fn.module.rel,
+                        call.lineno,
+                        f"call chain from {qual} (via "
+                        f"{q2.split(':')[-1]}) reaches blocking "
+                        f"{detail} while holding {held}",
+                        where=f"{qual}->{q2.split(':')[-1]}#{detail}"
+                        f"@{held}"))
+
+        # direct blocking findings
+        for qual, summary in sorted(summaries.items()):
+            blocking = summary[3]
+            fn = graph.functions[qual]
+            for held, rule, node, detail in blocking:
+                findings.append(self.finding(
+                    rule, fn.module.rel, node.lineno,
+                    f"blocking {detail} while holding {held} "
+                    f"(in {qual})",
+                    where=f"{qual}#{detail}@{held}"))
+
+        # cycles (pairwise inversions + self-deadlock on plain locks)
+        for (a, b), (rel, line, qual) in sorted(graph_edges.items()):
+            if a == b:
+                if a in locks and not locks[a].reentrant:
+                    findings.append(self.finding(
+                        "self-deadlock", rel, line,
+                        f"non-reentrant lock {a} re-acquired while "
+                        f"already held (in {qual})",
+                        where=f"{a}#self"))
+                continue
+            if (b, a) in graph_edges and a < b:
+                rel2, line2, qual2 = graph_edges[(b, a)]
+                findings.append(self.finding(
+                    "lock-order-inversion", rel, line,
+                    f"lock order inversion: {a} → {b} here, but "
+                    f"{b} → {a} at {rel2}:{line2} ({qual2}) — a "
+                    f"two-thread interleaving deadlocks",
+                    where=f"{a}<->{b}"))
+
+        # unlocked shared module state across thread roots
+        root_sets = self._thread_roots(program, graph)
+        writers: Dict[Tuple[str, str], List[Tuple[str, str, int, bool]]] = {}
+        for qual, summary in summaries.items():
+            gl_unlocked, gl_locked = summary[4], summary[5]
+            fn = graph.functions[qual]
+            for name, node in gl_unlocked:
+                writers.setdefault((fn.module.name, name), []).append(
+                    (qual, fn.module.rel, node.lineno, False))
+            for name, node in gl_locked:
+                writers.setdefault((fn.module.name, name), []).append(
+                    (qual, fn.module.rel, node.lineno, True))
+        for (mod, name), sites in sorted(writers.items()):
+            roots_hit = set()
+            for qual, _rel, _line, _locked in sites:
+                hit = [r for r, reach in root_sets.items()
+                       if qual in reach]
+                roots_hit.update(hit or ["<main>"])
+            if len(roots_hit) < 2:
+                continue
+            unlocked = [s for s in sites if not s[3]]
+            for qual, rel, line, _locked in sorted(unlocked):
+                findings.append(self.finding(
+                    "unlocked-shared-state", rel, line,
+                    f"module global `{name}` written from {qual} "
+                    f"with no lock held, and the write is reachable "
+                    f"from {len(roots_hit)} thread roots "
+                    f"({', '.join(sorted(roots_hit)[:3])}…)",
+                    where=f"{mod}.{name}@{qual}"))
+        # the gate covers the library tree; bench drivers thread too
+        # but are not production surface
+        return [f for f in findings if f.rel.startswith("raft_tpu/")]
+
+
+register_pass(LockDisciplinePass)

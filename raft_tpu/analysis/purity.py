@@ -1,0 +1,380 @@
+"""Trace-purity pass: host-sync / retrace hazards inside traced code.
+
+Entry points — the functions JAX will trace — are found statically:
+
+- ``jax.jit(f)`` / ``jit(f)`` calls and ``@jit`` /
+  ``@functools.partial(jax.jit, ...)`` decorators;
+- ``jax.shard_map(f, ...)`` (incl. nested ``jit(shard_map(f))``);
+- ``pl.pallas_call(kernel, ...)`` kernels;
+- ``_aot_call(res, name, statics, fn, ...)`` — the runtime AOT entry
+  (``fn`` is the traced callable, ``statics`` the compile-cache key).
+
+The traced set is closed transitively over the call graph, plus a
+fixpoint over control-flow combinators (``lax.scan`` / ``fori_loop`` /
+``cond`` / ``vmap`` …): a function-valued argument to a combinator
+called from traced code is itself traced. Bodies passed to the host
+escapes (``pure_callback`` / ``io_callback`` / ``debug_callback``)
+intentionally run on host and are exempt.
+
+Hazards flagged inside the traced set:
+
+=====================  ================================================
+rule                   meaning
+=====================  ================================================
+host-sync-item         ``.item()`` / ``.tolist()`` on a traced value —
+                       a device sync per call
+host-sync-block        ``.block_until_ready()`` inside traced code
+host-np-in-trace       ``np.asarray``/``np.array``/… on an expression
+                       involving a traced argument (host transfer)
+host-cast-in-trace     ``float()``/``int()``/``bool()`` on an
+                       expression involving a traced argument
+                       (ConcretizationTypeError or a silent sync)
+host-time-in-trace     ``time.*`` — trace-time constant, NOT runtime
+                       time; retraces bake a new value
+host-rng-in-trace      ``random.*`` / ``np.random.*`` — host RNG baked
+                       at trace time (use ``jax.random``)
+env-read-in-trace      ``os.environ`` read — trace-time constant that
+                       silently ignores later env changes
+unhashable-static-key  list/dict/set flowing into the ``statics``
+                       compile-cache key of ``_aot_call`` — the
+                       post-warmup-compile-miss gate, made static
+=====================  ================================================
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, FunctionInfo
+from .framework import AnalysisPass, Finding, register_pass
+from .loader import ModuleInfo, Program, dotted
+
+#: wrappers whose function argument is ALWAYS traced
+TRACE_WRAPPERS = ("jit", "shard_map", "pallas_call")
+#: combinators whose function arguments are traced when the CALL SITE
+#: is already inside traced code
+COMBINATORS = ("fori_loop", "scan", "while_loop", "cond", "switch",
+               "map", "vmap", "pmap", "checkpoint", "remat",
+               "associative_scan", "custom_jvp", "custom_vjp")
+#: host escapes: their callables intentionally run host-side
+HOST_ESCAPES = ("pure_callback", "io_callback", "debug_callback",
+                "callback", "host_callback")
+#: the runtime AOT entry: positional index of the traced callable and
+#: of the compile-cache statics tuple in ``_aot_call(res, name,
+#: statics, fn, *args)``
+AOT_ENTRY, AOT_FN_ARG, AOT_STATICS_ARG = "_aot_call", 3, 2
+
+_SYNC_ATTRS = {"item": "host-sync-item", "tolist": "host-sync-item",
+               "block_until_ready": "host-sync-block"}
+_NP_CONVERSIONS = {"numpy.asarray", "numpy.array",
+                   "numpy.ascontiguousarray", "numpy.asfortranarray",
+                   "numpy.copy"}
+_TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+               "time.sleep", "time.process_time", "time.time_ns",
+               "time.perf_counter_ns", "time.monotonic_ns"}
+_CASTS = {"float", "int", "bool"}
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _is_entry(name: Optional[str]) -> Optional[str]:
+    """canonical dotted callee → wrapper kind, or None."""
+    if name is None:
+        return None
+    last = _last(name)
+    if last in TRACE_WRAPPERS:
+        return last
+    if last == AOT_ENTRY:
+        return AOT_ENTRY
+    return None
+
+
+def _unwrap_fn_exprs(call: ast.Call, kind: str,
+                     canonical) -> List[ast.expr]:
+    """The function-valued expressions an entry call traces. Nested
+    wrappers unwrap (``jit(shard_map(f, ...))`` → ``f``)."""
+    if kind == AOT_ENTRY:
+        args = call.args[AOT_FN_ARG:AOT_FN_ARG + 1]
+    elif _last(canonical(call.func) or "") == "partial":
+        args = call.args[1:2]
+    else:
+        args = call.args[:1]
+    out: List[ast.expr] = []
+    for a in args:
+        while isinstance(a, ast.Call):
+            name = canonical(a.func)
+            inner = _is_entry(name)
+            if inner is None and _last(name or "") not in COMBINATORS \
+                    and _last(name or "") != "partial":
+                break
+            nxt = (a.args[AOT_FN_ARG] if inner == AOT_ENTRY
+                   and len(a.args) > AOT_FN_ARG else
+                   a.args[1] if _last(name or "") == "partial"
+                   and len(a.args) > 1 else
+                   a.args[0] if a.args else None)
+            if nxt is None:
+                break
+            a = nxt
+        out.append(a)
+    return out
+
+
+#: attribute chains that are STATIC under tracing (shape metadata) —
+#: ``int(x.shape[0])`` concretizes nothing
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize",
+                 "nbytes"}
+
+
+def _mentions_traced(node: ast.expr, names: Set[str]) -> bool:
+    """True when the expression mentions one of ``names`` OUTSIDE a
+    static metadata chain (``.shape``/``.ndim``/…, ``len(...)``)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            continue
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "len":
+            continue
+        if isinstance(n, ast.Name) and n.id in names:
+            return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _param_names(node: ast.AST) -> Set[str]:
+    a = node.args
+    params = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        params.append(a.vararg.arg)
+    if a.kwarg:
+        params.append(a.kwarg.arg)
+    return {p for p in params if p not in ("self", "cls")}
+
+
+class TracePurityPass(AnalysisPass):
+    name = "trace-purity"
+
+    # -- root discovery ----------------------------------------------
+    def _resolve_expr(self, graph: CallGraph, info: ModuleInfo,
+                      scope: Tuple[str, ...], cls: Optional[str],
+                      expr: ast.expr) -> Optional[str]:
+        name = dotted(expr)
+        if name is None:
+            return None
+        return graph.resolve(info, scope, name, cls=cls)
+
+    def _roots(self, program: Program, graph: CallGraph
+               ) -> Dict[str, str]:
+        """qualname → entry-kind for every statically-traced root."""
+        roots: Dict[str, str] = {}
+
+        def _add(qual: Optional[str], kind: str) -> None:
+            if qual is not None:
+                roots.setdefault(qual, kind)
+
+        # decorators: @jit / @jax.jit / @partial(jax.jit, ...)
+        for fn in graph.functions.values():
+            canon = lambda e, _m=fn.module: (  # noqa: E731
+                graph.canonical(_m, dotted(e)) if dotted(e) else None)
+            for dec in getattr(fn.node, "decorator_list", ()):
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = canon(target)
+                if name and _last(name) == "partial" \
+                        and isinstance(dec, ast.Call) and dec.args:
+                    name = canon(dec.args[0])
+                if name and _last(name) in TRACE_WRAPPERS:
+                    _add(fn.qual, _last(name))
+        # call expressions inside functions
+        for fn in graph.functions.values():
+            for site in graph.iter_calls(fn.qual):
+                name = (site.external if site.external else None)
+                if site.resolved and _last(site.resolved) == AOT_ENTRY:
+                    name = AOT_ENTRY
+                kind = _is_entry(name)
+                if kind is None:
+                    continue
+                for expr in _unwrap_fn_exprs(
+                        site.node, kind,
+                        lambda e, _m=fn.module: (
+                            graph.canonical(_m, dotted(e))
+                            if dotted(e) else None)):
+                    _add(self._resolve_expr(graph, fn.module, fn.path,
+                                            fn.cls, expr), kind)
+        # module-level entry calls (fn = jax.jit(_core) at import time)
+        for info in program:
+            for node in ast.walk(info.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                canonical = (graph.canonical(info, name)
+                             if name else None)
+                kind = _is_entry(canonical)
+                if kind is None:
+                    continue
+                for expr in _unwrap_fn_exprs(
+                        node, kind,
+                        lambda e, _m=info: (
+                            graph.canonical(_m, dotted(e))
+                            if dotted(e) else None)):
+                    n2 = dotted(expr)
+                    if n2:
+                        _add(graph.resolve(info, (), n2), kind)
+        return roots
+
+    def _traced_set(self, program: Program, graph: CallGraph,
+                    roots: Dict[str, str]) -> Set[str]:
+        """Transitive closure + combinator fixpoint."""
+        traced = graph.reachable(roots)
+        while True:
+            new: Set[str] = set()
+            for qual in traced:
+                fn = graph.functions[qual]
+                for site in graph.iter_calls(qual):
+                    name = site.external or ""
+                    if _last(name) not in COMBINATORS:
+                        continue
+                    for arg in site.node.args:
+                        q2 = self._resolve_expr(graph, fn.module,
+                                                fn.path, fn.cls, arg)
+                        if q2 is not None and q2 not in traced:
+                            new.add(q2)
+            if not new:
+                return traced
+            traced |= graph.reachable(new)
+
+    # -- hazard scan --------------------------------------------------
+    def _escape_spans(self, fn: FunctionInfo, graph: CallGraph
+                      ) -> List[ast.expr]:
+        """Argument expressions of host-escape calls — hazard scans
+        skip anything lexically inside them."""
+        out: List[ast.expr] = []
+        for site in graph.iter_calls(fn.qual):
+            if _last(site.external or "") in HOST_ESCAPES:
+                out.extend(site.node.args)
+        return out
+
+    def _scan(self, fn: FunctionInfo, graph: CallGraph,
+              kind: str, is_root: bool) -> List[Finding]:
+        findings: List[Finding] = []
+        # parameters are PROVABLY traced only in root functions (jit /
+        # shard_map / pallas operands); transitive callees may receive
+        # static config values, so the param-based cast/conversion
+        # rules stay root-only to keep the signal clean
+        params = _param_names(fn.node) if is_root else set()
+        skip_nodes = set()
+        for span in self._escape_spans(fn, graph):
+            skip_nodes.update(id(n) for n in ast.walk(span))
+
+        def _flag(rule: str, node: ast.AST, msg: str,
+                  anchor: str) -> None:
+            findings.append(self.finding(
+                rule, fn.module.rel, node.lineno,
+                f"{msg} inside traced code (reached from a {kind} "
+                f"entry via {fn.qual})",
+                where=f"{fn.qual}#{anchor}"))
+
+        for site in graph.iter_calls(fn.qual):
+            node = site.node
+            if id(node) in skip_nodes:
+                continue
+            name = site.external
+            if name is None:
+                continue
+            last = _last(name)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_ATTRS:
+                _flag(_SYNC_ATTRS[node.func.attr], node,
+                      f"`.{node.func.attr}()` forces a device→host "
+                      f"sync", node.func.attr)
+            elif name in _NP_CONVERSIONS and node.args \
+                    and _mentions_traced(node.args[0], params):
+                _flag("host-np-in-trace", node,
+                      f"`{name}` on a traced argument pulls the value "
+                      f"to host", last)
+            elif name in _TIME_CALLS:
+                _flag("host-time-in-trace", node,
+                      f"`{name}()` is a trace-time constant, not "
+                      f"runtime time", last)
+            elif (name.startswith("random.")
+                  or name.startswith("numpy.random.")):
+                _flag("host-rng-in-trace", node,
+                      f"`{name}()` bakes host randomness at trace "
+                      f"time (use jax.random)", last)
+            elif name in _CASTS and len(node.args) == 1 \
+                    and _mentions_traced(node.args[0], params):
+                _flag("host-cast-in-trace", node,
+                      f"`{name}()` on a traced argument concretizes "
+                      f"it", name)
+        # os.environ access (read or subscript — not only calls);
+        # nested defs carry their own scan, so skip their subtrees
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn.node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) or id(node) in skip_nodes:
+                continue
+            if isinstance(node, ast.Attribute) \
+                    and node.attr == "environ" \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "os":
+                _flag("env-read-in-trace", node,
+                      "`os.environ` read is a trace-time constant",
+                      "environ")
+            stack.extend(ast.iter_child_nodes(node))
+        return findings
+
+    def _static_key_hazards(self, graph: CallGraph) -> List[Finding]:
+        """list/dict/set literals flowing into the ``statics``
+        compile-cache key of an ``_aot_call`` — unhashable keys break
+        the compile cache (a miss per dispatch)."""
+        findings: List[Finding] = []
+        for fn in graph.functions.values():
+            for site in graph.iter_calls(fn.qual):
+                name = site.resolved or site.external or ""
+                if _last(name.split(":")[-1]) != AOT_ENTRY:
+                    continue
+                if len(site.node.args) <= AOT_STATICS_ARG:
+                    continue
+                statics = site.node.args[AOT_STATICS_ARG]
+                for sub in ast.walk(statics):
+                    if isinstance(sub, (ast.List, ast.Dict, ast.Set,
+                                        ast.ListComp, ast.SetComp,
+                                        ast.DictComp)):
+                        findings.append(self.finding(
+                            "unhashable-static-key", fn.module.rel,
+                            sub.lineno,
+                            f"unhashable {type(sub).__name__.lower()} "
+                            f"in the statics compile-cache key of "
+                            f"_aot_call — every dispatch would be a "
+                            f"compile miss (or a TypeError)",
+                            where=f"{fn.qual}#statics"))
+                        break
+        return findings
+
+    # -- entry ---------------------------------------------------------
+    def run(self, program: Program, graph: CallGraph) -> List[Finding]:
+        roots = self._roots(program, graph)
+        traced = self._traced_set(program, graph, roots)
+        findings: List[Finding] = []
+        for qual in sorted(traced):
+            fn = graph.functions[qual]
+            kind = roots.get(qual, "traced-callee")
+            findings.extend(self._scan(fn, graph, kind,
+                                       is_root=qual in roots))
+        findings.extend(self._static_key_hazards(graph))
+        # roots may live anywhere (bench drivers jit too) but findings
+        # gate the library tree only
+        return [f for f in findings if f.rel.startswith("raft_tpu/")]
+
+    # exposed for tests / the CLI's --explain
+    def traced_functions(self, program: Program,
+                         graph: CallGraph) -> Set[str]:
+        return self._traced_set(program, graph,
+                                self._roots(program, graph))
+
+
+register_pass(TracePurityPass)

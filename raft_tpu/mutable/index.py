@@ -73,6 +73,7 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from raft_tpu.core import env
 from raft_tpu.core.error import expects
 from raft_tpu.observability import instrument
 from raft_tpu.observability.quality import record_pending
@@ -102,18 +103,13 @@ _DELTA_G = 2
 
 
 def compact_threshold_default() -> int:
-    try:
-        return max(8, int(os.environ.get(COMPACT_THRESHOLD_ENV,
-                                         DEFAULT_COMPACT_THRESHOLD)))
-    except (TypeError, ValueError):
-        return DEFAULT_COMPACT_THRESHOLD
+    return max(8, env.get(COMPACT_THRESHOLD_ENV,
+                          DEFAULT_COMPACT_THRESHOLD))
 
 
 def delta_cap_default(threshold: int) -> int:
-    try:
-        raw = os.environ.get(DELTA_CAP_ENV, "").strip()
-        cap = int(raw) if raw else 2 * threshold
-    except (TypeError, ValueError):
+    cap = env.get(DELTA_CAP_ENV)
+    if cap is None:
         cap = 2 * threshold
     cap = max(cap, threshold, 8)
     return -(-cap // 8) * 8                       # 8-row quantum

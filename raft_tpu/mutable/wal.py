@@ -58,6 +58,7 @@ from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from raft_tpu.core import env
 from raft_tpu.core.serialize import mdspan_to_bytes, read_framed
 from raft_tpu.resilience import fault_point
 
@@ -87,7 +88,7 @@ def sync_mode_default() -> str:
     """``RAFT_TPU_WAL_SYNC`` resolved to a valid mode (default
     ``batch``; an unknown value degrades to the default with a logged
     warning — never raises at import/construction)."""
-    raw = os.environ.get(WAL_SYNC_ENV, "").strip().lower()
+    raw = (env.raw(WAL_SYNC_ENV) or "").lower()
     if not raw:
         return "batch"
     if raw in SYNC_MODES:
@@ -100,11 +101,7 @@ def sync_mode_default() -> str:
 
 
 def segment_bytes_default() -> int:
-    try:
-        mb = float(os.environ.get(WAL_SEGMENT_MB_ENV,
-                                  _DEFAULT_SEGMENT_MB))
-    except (TypeError, ValueError):
-        mb = float(_DEFAULT_SEGMENT_MB)
+    mb = env.get(WAL_SEGMENT_MB_ENV, float(_DEFAULT_SEGMENT_MB))
     return max(1 << 16, int(mb * (1 << 20)))
 
 
@@ -326,26 +323,31 @@ class WalWriter:
         """Delete whole segments whose every record has lsn ≤ the
         checkpoint ``watermark_lsn``; the active segment always stays.
         Returns how many were removed."""
+        # the directory scan + unlinks run OUTSIDE the append lock
+        # (graftlint blocking-under-lock): segment GC touches the disk
+        # and must never stall a mutation ack behind it. Lock-free is
+        # safe here: rotation only ADDS newer segments (the active one
+        # is always last and `paths[:-1]` never touches it), and a
+        # concurrent retire losing an unlink race stops at the OSError.
         removed = 0
-        with self._lock:
-            paths = _segment_paths(self.directory)
-            for i, path in enumerate(paths[:-1]):
-                # segment i ends just before segment i+1's first lsn
-                nxt = os.path.basename(paths[i + 1])
-                try:
-                    next_first = int(nxt[len("wal-"):-len(".log")])
-                except ValueError:
-                    break
-                if next_first - 1 > watermark_lsn:
-                    break
-                try:
-                    os.unlink(path)
-                    removed += 1
-                except OSError:
-                    break
-            self._gauge(WAL_SEGMENTS,
-                        len(_segment_paths(self.directory)),
-                        "Live WAL segment files")
+        paths = _segment_paths(self.directory)
+        for i, path in enumerate(paths[:-1]):
+            # segment i ends just before segment i+1's first lsn
+            nxt = os.path.basename(paths[i + 1])
+            try:
+                next_first = int(nxt[len("wal-"):-len(".log")])
+            except ValueError:
+                break
+            if next_first - 1 > watermark_lsn:
+                break
+            try:
+                os.unlink(path)
+                removed += 1
+            except OSError:
+                break
+        self._gauge(WAL_SEGMENTS,
+                    len(_segment_paths(self.directory)),
+                    "Live WAL segment files")
         if removed:
             try:
                 from raft_tpu.observability.timeline import emit_marker
@@ -366,12 +368,16 @@ class WalWriter:
                     self._f = None
 
     def stats(self) -> Dict:
+        # the segment count is a disk scan — taken OUTSIDE the append
+        # lock (graftlint blocking-under-lock) so a statusz poll on a
+        # slow disk can never stall the mutation ack path
+        segments = len(_segment_paths(self.directory))
         with self._lock:
             return {
                 "sync": self.sync,
                 "last_lsn": self._next_lsn - 1,
                 "durable_lsn": self._durable_lsn,
-                "segments": len(_segment_paths(self.directory)),
+                "segments": segments,
                 "segment_bytes": self.segment_bytes,
             }
 

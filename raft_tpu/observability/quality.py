@@ -66,6 +66,9 @@ FIXUP_ROWS = "raft_tpu_certificate_fixup_rows"
 RESCORE_POOL = "raft_tpu_rescore_pool_width"
 #: IVF chunks whose q8 certificate failure forced an exact f32-scan rerun
 IVF_RERUNS = "raft_tpu_ivf_cert_rerun_total"
+#: record_pending calls skipped because they executed under tracing
+#: (n_fail was a Tracer — see the guard in record_pending)
+TRACE_SKIPS = "raft_tpu_certificate_trace_skips_total"
 #: shadow-sampled requests re-scored against the oracle
 SHADOW_SAMPLES = "raft_tpu_serving_shadow_samples_total"
 #: shadow candidates dropped because the sampler queue was full
@@ -182,9 +185,34 @@ def record_pending(site: str, n_fail, n_queries: int,
     output of a program whose results the caller consumes anyway)."""
     if not quality_enabled():
         return
+    try:
+        from jax.core import Tracer
+
+        if isinstance(n_fail, Tracer):
+            # the recorder was reached AT TRACE TIME (a host wrapper
+            # traced whole, e.g. knn under fused_l2nn.knn_sharded's
+            # shard_map) — a tracer must never enter the pending ring:
+            # drain() cannot resolve it and used to drop the entry
+            # silently. Count the skip so the gap is visible.
+            _count_trace_skip(site)
+            return
+    except ImportError:       # no jax on this host: nothing traced
+        pass
     with _pending_lock:
         _pending.append((site, n_fail, int(n_queries),
                          pool_width, tuple(fix_tiers), dict(meta)))
+
+
+def _count_trace_skip(site: str) -> None:
+    try:
+        from raft_tpu.observability import get_registry
+
+        get_registry().counter(
+            TRACE_SKIPS, {"site": site},
+            help="Certificate stats skipped because the recorder ran "
+                 "under tracing (tracer n_fail)").inc()
+    except Exception:
+        pass
 
 
 def drain() -> int:

@@ -57,6 +57,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from raft_tpu.core import env
 from raft_tpu.core.error import DeviceError, OutOfMemoryError
 
 FAULT_KINDS = ("oom", "error", "timeout", "hang", "corrupt", "nan")
@@ -259,9 +260,9 @@ def active() -> bool:
 
 
 def _load_env() -> None:
-    spec = os.environ.get("RAFT_TPU_FAULTS", "")
-    seed = os.environ.get("RAFT_TPU_FAULTS_SEED")
-    if not spec.strip():
+    spec = env.raw("RAFT_TPU_FAULTS") or ""
+    seed = env.raw("RAFT_TPU_FAULTS_SEED")
+    if not spec:
         return
     try:
         _install(parse_faults(spec), int(seed) if seed else None)
@@ -295,7 +296,7 @@ def _hang(site: str) -> None:
     keeps an unguarded hang from freezing a suite forever."""
     from raft_tpu.core import interruptible
 
-    max_s = float(os.environ.get("RAFT_TPU_FAULT_HANG_MAX_S", "30"))
+    max_s = env.get("RAFT_TPU_FAULT_HANG_MAX_S")
     t0 = time.monotonic()
     while time.monotonic() - t0 < max_s:
         interruptible.yield_()

@@ -45,6 +45,7 @@ import threading
 import time
 from typing import Callable, Dict, Iterator, Optional, Tuple
 
+from raft_tpu.core import env
 from raft_tpu.core.error import (DeadlineExceededError, DeviceError,
                                  OutOfMemoryError, device_errors)
 
@@ -101,13 +102,10 @@ class PolicyTable:
         pol = self._policies.get(site)
         if pol is None:
             pol = self._policies.get(site.split(".")[0], DEFAULT_POLICY)
-        cap = os.environ.get("RAFT_TPU_RETRY_MAX")
+        cap = env.get("RAFT_TPU_RETRY_MAX")
         if cap is not None:
-            try:
-                pol = dataclasses.replace(pol,
-                                          max_retries=max(0, int(cap)))
-            except (TypeError, ValueError):
-                pass
+            pol = dataclasses.replace(pol,
+                                      max_retries=max(0, int(cap)))
         return pol
 
 

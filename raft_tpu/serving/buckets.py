@@ -28,6 +28,8 @@ Env knobs:
 from __future__ import annotations
 
 import os
+
+from raft_tpu.core import env
 from typing import Optional, Sequence, Tuple
 
 #: quantum every bucket rounds up to (the fused kernel's query sublanes)
@@ -74,7 +76,7 @@ def bucket_ladder(qb: int, spec: Optional[str] = None) -> Tuple[int, ...]:
     ascending, multiples of :data:`ROW_QUANTUM`, ≤ :data:`MAX_BUCKETS`
     rungs — falling back to :func:`default_bucket_ladder` on anything
     unusable."""
-    spec = os.environ.get(BUCKETS_ENV, "") if spec is None else spec
+    spec = (env.raw(BUCKETS_ENV) or "") if spec is None else spec
     spec = spec.strip()
     if not spec:
         return default_bucket_ladder(qb)

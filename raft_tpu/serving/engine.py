@@ -84,7 +84,7 @@ from typing import Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from raft_tpu.core import interruptible
+from raft_tpu.core import env, interruptible
 from raft_tpu.core.error import (DeadlineExceededError, LogicError,
                                  RaftException, expects)
 from raft_tpu.core.resources import ensure_resources
@@ -306,8 +306,7 @@ class ServingEngine:
         # bf16-streamed brute, f32 IVF slab; env RAFT_TPU_DB_DTYPE
         # sets the fleet default without a code change)
         if db_dtype is None:
-            env_dt = os.environ.get("RAFT_TPU_DB_DTYPE", "").strip()
-            db_dtype = env_dt or None
+            db_dtype = env.raw("RAFT_TPU_DB_DTYPE")
         self._db_dtype = db_dtype
         self._build_kw = dict(passes=passes, metric=metric, T=T, Qb=Qb,
                               g=g, grid_order=grid_order,
@@ -330,8 +329,7 @@ class ServingEngine:
             if durable_dir is None:
                 from raft_tpu.mutable.checkpoint import DURABLE_DIR_ENV
 
-                durable_dir = (os.environ.get(DURABLE_DIR_ENV, "").strip()
-                               or None)
+                durable_dir = env.raw(DURABLE_DIR_ENV)
             expects(durable_dir is not None,
                     "serving: durable=True needs durable_dir= (or "
                     "RAFT_TPU_DURABLE_DIR)")
@@ -411,26 +409,13 @@ class ServingEngine:
             self._ladder = bucket_ladder(
                 qb_hint, ",".join(str(int(b)) for b in buckets))
         if flush_interval_s is None:
-            try:
-                flush_interval_s = float(
-                    os.environ.get(FLUSH_MS_ENV, "2")) / 1e3
-            except (TypeError, ValueError):
-                flush_interval_s = 2e-3
+            flush_interval_s = env.get(FLUSH_MS_ENV) / 1e3
         self._flush_interval_s = max(1e-4, float(flush_interval_s))
         if max_queue_rows is None:
-            try:
-                max_queue_rows = int(os.environ.get(QUEUE_CAP_ENV,
-                                                    "4096"))
-            except (TypeError, ValueError):
-                max_queue_rows = 4096
+            max_queue_rows = env.get(QUEUE_CAP_ENV)
         self._max_queue_rows = max(self._ladder[-1], int(max_queue_rows))
         if default_deadline_s is None:
-            env = os.environ.get(DEADLINE_ENV, "").strip()
-            if env:
-                try:
-                    default_deadline_s = float(env)
-                except (TypeError, ValueError):
-                    default_deadline_s = None
+            default_deadline_s = env.get(DEADLINE_ENV)
         self._default_deadline_s = default_deadline_s
 
         self._cond = threading.Condition()

@@ -1,0 +1,470 @@
+"""graftlint (raft_tpu.analysis) — ISSUE 13.
+
+Per-pass good/bad fixture snippets (traced ``.item()``, retrace-key
+hazard, lock-order inversion pair, sync-under-lock, registry drift),
+the baseline round-trip (suppressed stays suppressed, new finding
+fails, stale entry reported, reason mandatory), the derived-registry
+equality pins with tools/check_instrumented.py, the env-knob
+code ⊆ registry ⊆ README chain, the bench_report ``[lint]`` gate
+matrix, and the tier-1 whole-repo-is-clean gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+from raft_tpu import analysis
+from raft_tpu.analysis import registry as areg
+from raft_tpu.core import env
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _tools_import(name):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def _write(root, rel, text):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(text)
+    return path
+
+
+def _run(root, pass_name):
+    out = analysis.run_passes(str(root), names=[pass_name])
+    return out[pass_name]
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------- trace-purity
+PURITY_BAD = """\
+import os
+import time
+import jax
+
+
+def core(x):
+    v = x.sum().item()
+    t = float(x)
+    if os.environ.get("SOME_FLAG"):
+        v = v + 1
+    time.perf_counter()
+    return v + t
+
+
+fn = jax.jit(core)
+"""
+
+PURITY_TRANSITIVE = """\
+import jax
+
+
+def helper(y):
+    return y.max().item()
+
+
+def core(x):
+    return helper(x)
+
+
+fn = jax.jit(core)
+"""
+
+PURITY_GOOD = """\
+import jax
+import jax.numpy as jnp
+
+
+def core(x):
+    n = int(x.shape[0])        # static metadata — NOT a hazard
+    return jnp.sum(x) / n
+
+
+fn = jax.jit(core)
+
+
+def wrapper(x):
+    # host side: .item() OUTSIDE the traced set is legal
+    return fn(x).item()
+"""
+
+PURITY_KEY_HAZARD = """\
+def run(res, x, opts):
+    return _aot_call(res, "entry", (x.shape, [1, 2]), lambda v: v, x)
+"""
+
+
+def test_purity_flags_traced_hazards(tmp_path):
+    _write(tmp_path, "raft_tpu/mod.py", PURITY_BAD)
+    rules = _rules(_run(tmp_path, "trace-purity"))
+    assert "host-sync-item" in rules
+    assert "host-cast-in-trace" in rules
+    assert "env-read-in-trace" in rules
+    assert "host-time-in-trace" in rules
+
+
+def test_purity_transitive_reachability(tmp_path):
+    # the hazard sits in a CALLEE of the jitted root
+    _write(tmp_path, "raft_tpu/mod.py", PURITY_TRANSITIVE)
+    findings = _run(tmp_path, "trace-purity")
+    assert _rules(findings) == {"host-sync-item"}
+    assert "helper" in findings[0].message
+
+
+def test_purity_clean_on_good_fixture(tmp_path):
+    _write(tmp_path, "raft_tpu/mod.py", PURITY_GOOD)
+    assert _run(tmp_path, "trace-purity") == []
+
+
+def test_purity_retrace_key_hazard(tmp_path):
+    _write(tmp_path, "raft_tpu/mod.py", PURITY_KEY_HAZARD)
+    findings = _run(tmp_path, "trace-purity")
+    assert _rules(findings) == {"unhashable-static-key"}
+
+
+# -------------------------------------------------------- lock-discipline
+LOCKS_INVERSION = """\
+import threading
+
+A = threading.Lock()
+B = threading.Lock()
+
+
+def f1():
+    with A:
+        with B:
+            pass
+
+
+def f2():
+    with B:
+        with A:
+            pass
+"""
+
+LOCKS_SYNC_UNDER_LOCK = """\
+import os
+import threading
+
+L = threading.Lock()
+
+
+def flush(fd):
+    os.fsync(fd)
+
+
+def hot(fd):
+    with L:
+        flush(fd)          # blocking fsync via a call chain
+"""
+
+LOCKS_GOOD = """\
+import os
+import threading
+
+L = threading.Lock()
+
+
+def hot(fd):
+    with L:
+        x = 1
+    os.fsync(fd)           # outside the lock: fine
+
+
+class W:
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def waiter(self):
+        with self._cond:
+            self._cond.wait(0.1)   # releases the held lock: exempt
+"""
+
+LOCKS_SHARED_STATE = """\
+import threading
+
+COUNT = 0
+
+
+def worker():
+    global COUNT
+    COUNT = COUNT + 1
+
+
+def start():
+    t = threading.Thread(target=worker)
+    t.start()
+
+
+def host_side():
+    global COUNT
+    COUNT = 5
+"""
+
+
+def test_locks_inversion_pair(tmp_path):
+    _write(tmp_path, "raft_tpu/mod.py", LOCKS_INVERSION)
+    findings = _run(tmp_path, "lock-discipline")
+    assert _rules(findings) == {"lock-order-inversion"}
+
+
+def test_locks_blocking_call_chain(tmp_path):
+    _write(tmp_path, "raft_tpu/mod.py", LOCKS_SYNC_UNDER_LOCK)
+    findings = _run(tmp_path, "lock-discipline")
+    assert _rules(findings) == {"blocking-under-lock"}
+    assert "os.fsync" in findings[0].message
+
+
+def test_locks_clean_on_good_fixture(tmp_path):
+    _write(tmp_path, "raft_tpu/mod.py", LOCKS_GOOD)
+    assert _run(tmp_path, "lock-discipline") == []
+
+
+def test_locks_unlocked_shared_state(tmp_path):
+    _write(tmp_path, "raft_tpu/mod.py", LOCKS_SHARED_STATE)
+    findings = _run(tmp_path, "lock-discipline")
+    assert "unlocked-shared-state" in _rules(findings)
+
+
+# ---------------------------------------------------------------- registry
+def _registry_fixture(tmp_path):
+    _write(tmp_path, "raft_tpu/resilience/faults.py",
+           'KNOWN_SITES = {"good_site": ("error",),\n'
+           '               "never_armed": ("error",)}\n')
+    _write(tmp_path, "raft_tpu/observability/flight.py",
+           'KNOWN_EVENT_KINDS = ("span", "fault", "marker")\n')
+    _write(tmp_path, "raft_tpu/observability/timeline.py",
+           "def emit_marker(name):\n"
+           "    rec.record('marker', name)\n")
+    _write(tmp_path, "raft_tpu/core/env.py",
+           'def _knob(*a, **k):\n    pass\n'
+           '_knob("RAFT_TPU_DOCUMENTED", "str", None, "d")\n'
+           '_knob("RAFT_TPU_UNDOCUMENTED", "str", None, "d")\n')
+    _write(tmp_path, "README.md",
+           "## Environment knobs\n\n"
+           "| `RAFT_TPU_DOCUMENTED` | doc |\n"
+           "| `RAFT_TPU_GHOST` | stale row |\n")
+    _write(tmp_path, "tools/check_instrumented.py",
+           "HOT_PATHS = {}\nQUALITY_SITES = {}\n")
+    _write(tmp_path, "raft_tpu/mod.py",
+           "from raft_tpu.observability import instrument\n"
+           "def fault_point(s):\n    pass\n"
+           "def use():\n"
+           "    fault_point('good_site')\n"
+           "    fault_point('rogue_site')\n"
+           "KNOB = 'RAFT_TPU_ROGUE'\n"
+           "@instrument\n"
+           "def hot(x):\n    return x\n")
+
+
+def test_registry_drift_matrix(tmp_path):
+    _registry_fixture(tmp_path)
+    rules = _rules(_run(tmp_path, "registry"))
+    assert "unregistered-fault-site" in rules   # rogue_site
+    assert "orphan-fault-site" in rules         # never_armed
+    assert "unregistered-env-knob" in rules     # RAFT_TPU_ROGUE
+    assert "undocumented-env-knob" in rules     # RAFT_TPU_UNDOCUMENTED
+    assert "stale-readme-knob" in rules         # RAFT_TPU_GHOST
+    assert "unregistered-hot-path" in rules     # hot() not in HOT_PATHS
+
+
+def test_registry_specific_names(tmp_path):
+    _registry_fixture(tmp_path)
+    findings = _run(tmp_path, "registry")
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert any("rogue_site" in f.message
+               for f in by_rule["unregistered-fault-site"])
+    assert any("never_armed" in f.message
+               for f in by_rule["orphan-fault-site"])
+    assert any("RAFT_TPU_GHOST" in f.message
+               for f in by_rule["stale-readme-knob"])
+
+
+# ------------------------------------------------------ baseline round-trip
+def test_baseline_round_trip(tmp_path):
+    _write(tmp_path, "raft_tpu/mod.py", PURITY_TRANSITIVE)
+    findings = _run(tmp_path, "trace-purity")
+    assert len(findings) == 1
+    bpath = tmp_path / "baseline.json"
+    bl = analysis.Baseline(
+        entries={findings[0].fingerprint: "accepted for the test"},
+        path=str(bpath))
+    bl.save()
+    bl2 = analysis.Baseline.load(str(bpath))
+    un, sup, stale = bl2.apply(findings)
+    assert un == [] and len(sup) == 1 and stale == []
+    # a NEW finding (different fingerprint) is NOT suppressed
+    _write(tmp_path, "raft_tpu/mod2.py", PURITY_BAD)
+    both = _run(tmp_path, "trace-purity")
+    un, sup, stale = bl2.apply(both)
+    assert len(sup) == 1 and len(un) == len(both) - 1 and un
+    # removing the suppressed finding leaves a STALE entry (reported,
+    # not fatal)
+    un, sup, stale = bl2.apply([f for f in both
+                                if f.fingerprint not in bl2.entries])
+    assert stale == [findings[0].fingerprint]
+
+
+def test_baseline_reasons_are_mandatory(tmp_path):
+    bpath = tmp_path / "baseline.json"
+    bpath.write_text(json.dumps({
+        "schema": 1,
+        "suppressions": [{"fingerprint": "x", "reason": "  "}]}))
+    with pytest.raises(ValueError, match="reason"):
+        analysis.Baseline.load(str(bpath))
+    bpath.write_text(json.dumps({"schema": 99, "suppressions": []}))
+    with pytest.raises(ValueError, match="schema"):
+        analysis.Baseline.load(str(bpath))
+    # missing file = empty baseline, not an error
+    assert analysis.Baseline.load(str(tmp_path / "none.json")).entries \
+        == {}
+
+
+def test_fingerprints_are_line_independent(tmp_path):
+    _write(tmp_path, "raft_tpu/mod.py", PURITY_TRANSITIVE)
+    before = _run(tmp_path, "trace-purity")
+    # shift every line down; the fingerprint must not move
+    _write(tmp_path, "raft_tpu/mod.py",
+           "# comment\n# comment\n" + PURITY_TRANSITIVE)
+    after = _run(tmp_path, "trace-purity")
+    assert [f.fingerprint for f in before] == \
+        [f.fingerprint for f in after]
+    assert before[0].line != after[0].line
+
+
+# ------------------------------------------- derived-registry equality pins
+def test_fault_sites_pinned_equal_to_derivation():
+    """check_instrumented consumes the derived registries — the two
+    tools can never disagree about what a site is."""
+    ci = _tools_import("check_instrumented")
+    regs = areg.derive_registries(_REPO)
+    assert dict(ci.FAULT_SITES) == dict(regs.fault_sites)
+    assert dict(ci.EMITTER_KINDS) == dict(regs.emitter_kinds)
+
+
+def test_emitter_kinds_match_runtime_vocabulary():
+    from raft_tpu.observability.flight import KNOWN_EVENT_KINDS
+
+    regs = areg.derive_registries(_REPO)
+    assert set(regs.emitter_kinds.values()) <= set(KNOWN_EVENT_KINDS)
+    assert regs.known_event_kinds == set(KNOWN_EVENT_KINDS)
+
+
+def test_known_sites_match_runtime_registry():
+    from raft_tpu.resilience import KNOWN_SITES
+
+    regs = areg.derive_registries(_REPO)
+    assert regs.known_sites is not None
+    assert set(regs.known_sites) == set(KNOWN_SITES)
+    ci = _tools_import("check_instrumented")
+    assert ci.check_fault_registry() == []
+
+
+def test_env_chain_code_registry_readme():
+    """code ⊆ core/env.KNOBS ⊆ README env-knob table (the satellite's
+    pinned chain) — and every knob read in code is declared."""
+    regs = areg.derive_registries(_REPO)
+    assert regs.env_registry is not None
+    assert regs.readme_knobs is not None
+    assert set(regs.env_knobs) <= regs.env_registry
+    assert regs.env_registry <= regs.readme_knobs
+    assert regs.readme_knobs <= regs.env_registry   # no stale rows
+    # the registry module itself agrees with the static parse
+    assert regs.env_registry == set(env.KNOBS)
+
+
+# ------------------------------------------------------------- core/env.py
+def test_env_typed_accessors(monkeypatch):
+    assert env.get("RAFT_TPU_SERVING_FLUSH_MS") == 2.0
+    monkeypatch.setenv("RAFT_TPU_SERVING_FLUSH_MS", "7.5")
+    assert env.get("RAFT_TPU_SERVING_FLUSH_MS") == 7.5
+    monkeypatch.setenv("RAFT_TPU_SERVING_FLUSH_MS", "junk")
+    assert env.get("RAFT_TPU_SERVING_FLUSH_MS") == 2.0  # tolerant
+    monkeypatch.setenv("RAFT_TPU_WAL_SYNC", "ALWAYS")
+    assert env.get("RAFT_TPU_WAL_SYNC") == "always"     # enum lowers
+    monkeypatch.setenv("RAFT_TPU_WAL_SYNC", "bogus")
+    assert env.get("RAFT_TPU_WAL_SYNC") == "batch"      # enum fallback
+    # bool: set-to-non-empty == True (the historical contract)
+    monkeypatch.setenv("RAFT_TPU_DISABLE_TRACING", "0")
+    assert env.get("RAFT_TPU_DISABLE_TRACING") is True
+    monkeypatch.setenv("RAFT_TPU_DISABLE_TRACING", "")
+    assert env.get("RAFT_TPU_DISABLE_TRACING") is False
+    monkeypatch.setenv("RAFT_TPU_DELTA_CAP", "  48  ")
+    assert env.get("RAFT_TPU_DELTA_CAP") == 48
+    assert env.raw("RAFT_TPU_DURABLE_DIR") is None
+
+
+def test_env_unknown_knob_raises():
+    with pytest.raises(KeyError):
+        env.get("RAFT_TPU_NO_SUCH_KNOB")
+    with pytest.raises(KeyError):
+        env.raw("RAFT_TPU_NO_SUCH_KNOB")
+
+
+# ------------------------------------------------------ bench_report [lint]
+def _ok_report():
+    return {"schema": 1, "ok": True, "commit": "abc1234",
+            "unsuppressed_errors": 0, "unsuppressed_warnings": 1,
+            "suppressed": 11, "stale_baseline_entries": [],
+            "passes": {"trace-purity": {"unsuppressed_errors": 0}}}
+
+
+def test_bench_report_lint_gate_matrix(tmp_path):
+    br = _tools_import("bench_report")
+    status, msg = br.check_lint(_ok_report())
+    assert status == br.PASS and "11 baselined" in msg
+    bad = _ok_report()
+    bad["ok"], bad["unsuppressed_errors"] = False, 3
+    bad["passes"]["trace-purity"]["unsuppressed_errors"] = 3
+    status, msg = br.check_lint(bad)
+    assert status == br.REGRESS and "3 unsuppressed" in msg
+    status, msg = br.check_lint(None)
+    assert status == br.SKIP and "graftlint" in msg
+    status, _ = br.check_lint({"schema": 1, "ok": True})
+    assert status == br.REGRESS          # malformed: no counts
+    assert "LINT_REPORT.json" in br.NAMED_ARTIFACTS
+
+
+def test_committed_lint_report_passes_gate():
+    br = _tools_import("bench_report")
+    rec = br.load_lint(os.path.join(_REPO, "LINT_REPORT.json"))
+    assert rec is not None, "LINT_REPORT.json must be committed"
+    status, msg = br.check_lint(rec)
+    assert status == br.PASS, msg
+
+
+# -------------------------------------------------------- tier-1 repo gate
+def test_whole_repo_is_lint_clean():
+    """THE gate: graftlint over the real tree, against the committed
+    baseline — zero unsuppressed error findings. A new hazard either
+    gets fixed or gets a reasoned suppression; it cannot ride along."""
+    gl = _tools_import("graftlint")
+    report, errors, _warnings, stale, baseline = gl.run_lint(_REPO)
+    assert errors == [], "\n".join(
+        f"{f.rel}:{f.line}: {f.rule}: {f.message}" for f in errors)
+    assert report["ok"] is True
+    # every suppression carries a reason and still matches a finding
+    assert stale == [], f"stale baseline entries: {stale}"
+    assert all(r.strip() for r in baseline.entries.values())
+
+
+def test_pass_registry_lists_flagship_passes():
+    assert set(analysis.all_passes()) >= {"trace-purity",
+                                          "lock-discipline",
+                                          "registry"}
+    with pytest.raises(KeyError):
+        analysis.run_passes(_REPO, names=["no-such-pass"])

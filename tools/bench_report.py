@@ -81,7 +81,12 @@ DRIFT_BAND = 3.0
 NAMED_ARTIFACTS = ("SELECT_K_MATRIX.json", "PALLAS_SMOKE.json",
                    "TPU_FUZZ.json", "BUSBW_BENCH.json",
                    "BENCH_SERVING.json", "BENCH_ANN.json",
-                   "BENCH_MUTATION.json", "BENCH_RECOVERY.json")
+                   "BENCH_MUTATION.json", "BENCH_RECOVERY.json",
+                   "LINT_REPORT.json")
+
+#: graftlint machine report (tools/graftlint.py --json): the [lint]
+#: gate — nonzero unsuppressed error findings REGRESS the check
+LINT_NAME = "LINT_REPORT.json"
 
 # cost-model fields Fixture.run emits into BENCH artifacts (PR 2+)
 COST_FIELDS = ("flops", "bytes_accessed", "arithmetic_intensity",
@@ -997,6 +1002,53 @@ def check_drift(entries: Optional[Dict], band: float = DRIFT_BAND
                      if modeled_only else ""))
 
 
+def load_lint(path: str) -> Optional[Dict]:
+    """LINT_REPORT.json, or None when missing/unreadable (the gate
+    then SKIPs with a pointer — an unreadable report never passes
+    silently as clean)."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def check_lint(record: Optional[Dict]) -> Tuple[str, str]:
+    """Gate the graftlint report (ISSUE 13): the committed
+    LINT_REPORT.json must carry ``ok: true`` and zero unsuppressed
+    error findings — a finding either gets FIXED or gets a reasoned
+    baseline entry; it never rides along silently. Suppressed counts
+    are reported for visibility (a growing baseline is reviewable
+    drift, not a gate failure)."""
+    if record is None:
+        return SKIP, (f"no {LINT_NAME} — run `python tools/"
+                      f"graftlint.py --json` to generate it")
+    errs = record.get("unsuppressed_errors")
+    if not isinstance(errs, int):
+        return REGRESS, (f"{LINT_NAME} is malformed (no "
+                         f"unsuppressed_errors count) — regenerate it")
+    if errs > 0 or not record.get("ok", False):
+        by_pass = {name: blk.get("unsuppressed_errors", 0)
+                   for name, blk in (record.get("passes") or {}).items()
+                   if isinstance(blk, dict)}
+        detail = ", ".join(f"{k}: {v}" for k, v in sorted(
+            by_pass.items()) if v)
+        return REGRESS, (
+            f"LINT: {errs} unsuppressed finding(s)"
+            + (f" ({detail})" if detail else "")
+            + " — fix them or add reasoned baseline entries "
+              "(tools/graftlint_baseline.json)")
+    suppressed = record.get("suppressed", 0)
+    warnings = record.get("unsuppressed_warnings", 0)
+    stale = len(record.get("stale_baseline_entries") or ())
+    passes = ", ".join(sorted((record.get("passes") or {})))
+    return PASS, (f"lint clean ({passes}; {suppressed} baselined, "
+                  f"{warnings} warning(s), {stale} stale baseline "
+                  f"entr{'y' if stale == 1 else 'ies'}; commit "
+                  f"{record.get('commit', '?')})")
+
+
 def _git_commit_time(directory: str, ref: str) -> Optional[int]:
     import subprocess
 
@@ -1418,6 +1470,9 @@ def main(argv: Sequence[str] = None) -> int:
         dstatus, dmsg = check_drift(load_drift_ledger(ledger_path),
                                     args.drift_band)
         print(f"bench_report --check [drift]: {dstatus}: {dmsg}")
+        lstatus, lmsg = check_lint(
+            load_lint(os.path.join(args.dir, LINT_NAME)))
+        print(f"bench_report --check [lint]: {lstatus}: {lmsg}")
         for e in stale:
             if e.get("status") == "STALE":
                 print(f"bench_report --check: note: {e['artifact']} is "
@@ -1427,7 +1482,8 @@ def main(argv: Sequence[str] = None) -> int:
         # nothing regressed
         rcs = (codes[status], codes[mstatus], codes[sstatus],
                codes[astatus], codes[mustatus], codes[rstatus],
-               codes[qstatus], codes[qlstatus], codes[dstatus])
+               codes[qstatus], codes[qlstatus], codes[dstatus],
+               codes[lstatus])
         return 1 if 1 in rcs else max(rcs)
 
     if args.json:
@@ -1450,6 +1506,7 @@ def main(argv: Sequence[str] = None) -> int:
                 {"round": n, "path": os.path.basename(path),
                  "record": rec} for n, path, rec in rrounds],
             "named_artifacts": stale,
+            "lint": load_lint(os.path.join(args.dir, LINT_NAME)),
             "baseline": baseline,
             "drift_ledger": load_drift_ledger(
                 args.drift_ledger

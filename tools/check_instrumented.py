@@ -22,6 +22,17 @@ Extend HOT_PATHS when a new primitive ships — forgetting to is exactly
 the regression this check exists to catch: a hot path that silently
 ships unobserved.
 
+Since ISSUE 13, the MIRROR tables (FAULT_SITES, EMITTER_KINDS) are no
+longer hand-pinned: they are DERIVED from source by
+``raft_tpu.analysis.registry`` (graftlint's registry pass) and imported
+here, so this tool and graftlint can never disagree about what a
+"site" is — equality is pinned by tests/test_analysis.py. The curated
+tables that remain (HOT_PATHS, COST_CAPTURE_SITES, EVENT_SITES,
+QUALITY_SITES, KERNEL_VARIANTS) are *policy* — what MUST be covered —
+and graftlint diffs them against the derived ground truth in the
+reverse direction (an @instrument function missing from HOT_PATHS is
+a lint error).
+
 Usage: ``python tools/check_instrumented.py`` (exit 0 = clean).
 """
 
@@ -31,6 +42,11 @@ import ast
 import os
 import sys
 from typing import Dict, List, Optional, Sequence, Tuple
+
+try:                      # imported as tools.check_instrumented
+    from tools.graftlint import load_analysis
+except ImportError:       # imported with tools/ on sys.path
+    from graftlint import load_analysis
 
 # module (repo-relative) → functions that must be instrumented
 HOT_PATHS: Dict[str, Sequence[str]] = {
@@ -86,53 +102,20 @@ SHARDED_MERGE_SITES: Dict[str, Sequence[str]] = {
 # comms.py must register these collective labels with _count(...)
 COUNTED_COLLECTIVES = ("collective_permute", "device_send")
 
-# module (repo-relative) → fault-injection sites it must carry: a call
-# ``fault_point("<site>")`` with the literal site name (see
-# raft_tpu/resilience/faults.py). EVERY module in HOT_PATHS must appear
-# here with ≥ 1 site — a hot path that cannot be fault-injected cannot
-# be tested under failure, which is exactly the regression this gate
-# exists to catch. Site names must also exist in faults.KNOWN_SITES
-# (pinned by tests/test_resilience.py).
-FAULT_SITES: Dict[str, Sequence[str]] = {
-    "raft_tpu/runtime/entry_points.py": ("aot_compile", "aot_dispatch"),
-    "raft_tpu/distance/knn_sharded.py": ("sharded_dispatch",
-                                         "merge_permute",
-                                         "merge_allgather",
-                                         "quantize_index"),
-    "raft_tpu/distance/knn_fused.py": ("knn_fused", "tune_table_read",
-                                       "quantize_index"),
-    "raft_tpu/matrix/select_k.py": ("select_k",),
-    "raft_tpu/matrix/select_k_chunked.py": ("select_k_chunked",),
-    "raft_tpu/matrix/select_k_slotted.py": ("select_k_slotted",),
-    "raft_tpu/distance/pairwise.py": ("pairwise_distance",),
-    "raft_tpu/distance/fused_l2nn.py": ("fused_l2nn",),
-    "raft_tpu/sparse/tiled.py": ("tile_csr",),
-    "raft_tpu/sparse/sharded.py": ("spmv_sharded",),
-    "raft_tpu/solver/linear_assignment.py": ("solve_lap",),
-    "raft_tpu/tune/fused.py": ("autotune_fused",),
-    "raft_tpu/tune/sharded.py": ("autotune_sharded",
-                                 "tune_table_read"),
-    "raft_tpu/sparse/plan_cache.py": ("plan_cache_read",),
-    "raft_tpu/comms/host_comms.py": ("host_collective", "host_barrier",
-                                     "host_sync"),
-    "raft_tpu/serving/engine.py": ("serving_enqueue", "serving_flush"),
-    "raft_tpu/serving/snapshot.py": ("serving_snapshot",),
-    "raft_tpu/cluster/kmeans.py": ("kmeans_fit", "kmeans_iteration"),
-    "raft_tpu/ann/ivf_flat.py": ("ivf_build", "ivf_search",
-                                 "quantize_index"),
-    # mutable indexes (raft_tpu.mutable): ingest / tombstone / fold —
-    # a mid-compaction crash must provably keep the old snapshot
-    # serving (tests/test_resilience.py)
-    "raft_tpu/mutable/index.py": ("mutate_ingest", "tombstone_apply",
-                                  "compact_fold"),
-    # durability plane (ISSUE 12): the WAL append/fsync pair and the
-    # checkpoint write / pointer-commit pair — the four seams the
-    # SIGKILL crash matrix (tests/test_durability.py) kills at; an
-    # uninjectable durability path cannot carry a recovery proof
-    "raft_tpu/mutable/wal.py": ("wal_append", "wal_fsync"),
-    "raft_tpu/mutable/checkpoint.py": ("checkpoint_write",
-                                       "manifest_commit"),
-}
+# module (repo-relative) → fault-injection sites it carries. DERIVED
+# from source (every literal ``fault_point("<site>")`` call) by
+# graftlint's registry derivation — this tool IMPORTS the ground truth
+# instead of redeclaring it, so the two can never disagree about what
+# a site is. The policy checks on top: every HOT_PATHS module must
+# carry ≥ 1 site (check_fault_sites) and the derived site names must
+# agree with faults.KNOWN_SITES in BOTH directions
+# (check_fault_registry; also pinned at runtime by
+# tests/test_resilience.py).
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DERIVED = load_analysis(_REPO_ROOT).registry.derive_registries(
+    _REPO_ROOT)
+
+FAULT_SITES: Dict[str, Sequence[str]] = dict(_DERIVED.fault_sites)
 
 # timeline-event gate: every hot-path module and every fault-site
 # module must emit flight-recorder events — a hot path invisible in a
@@ -144,32 +127,12 @@ FAULT_SITES: Dict[str, Sequence[str]] = {
 # to the flight event kind it produces; the checker statically asserts
 # every kind exists in flight.KNOWN_EVENT_KINDS (parsed from the
 # source), and tests/test_flight.py pins the same fact at runtime.
-EMITTER_KINDS: Dict[str, str] = {
-    "instrument": "span",
-    "span": "span",
-    "emit_span": "span",
-    "fault_point": "fault",
-    "emit_fault": "fault",
-    "record_collective": "collective",
-    "emit_collective": "collective",
-    "emit_compile": "compile",
-    "emit_dispatch": "dispatch",
-    "emit_retry": "retry",
-    "emit_degradation": "degradation",
-    "emit_deadline": "deadline",
-    "emit_error": "error",
-    "emit_benchmark": "benchmark",
-    "record_drift": "drift",
-    "emit_marker": "marker",
-    "emit_serving": "serving",
-    "emit_quality": "quality",
-    "emit_flow": "flow",
-    "emit_mutation": "mutation",
-    # quality-plane recorders: both route nonzero failure batches
-    # through emit_quality (observability/quality.py)
-    "record_certificate": "quality",
-    "record_pending": "quality",
-}
+# emitter → flight event kind. DERIVED: every ``emit_*``/``record_*``
+# helper in observability/timeline.py paired with the literal kind its
+# body records, plus analysis.registry.ALIAS_EMITTERS (the bridges —
+# @instrument → span, fault_point → fault, quality recorders →
+# quality — whose kind cannot be read off a timeline literal).
+EMITTER_KINDS: Dict[str, str] = dict(_DERIVED.emitter_kinds)
 
 EVENT_SITES: Dict[str, Sequence[str]] = {
     # every HOT_PATHS module: spans via @instrument + fault events
@@ -265,9 +228,6 @@ KERNEL_VARIANTS: Dict[str, Tuple[Sequence[str], str]] = {
          "fused_l2_group_topk_packed_dbuf_q8"),
         "raft_tpu/distance/knn_fused.py"),
 }
-
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
 
 def _decorator_is_instrument(dec: ast.expr) -> bool:
     """True for @instrument, @instrument(...), @observability.instrument,
@@ -409,6 +369,35 @@ def check_fault_sites(root: str = _REPO_ROOT,
                     f"{rel}: no fault_point({site!r}) call — the hot "
                     f"path would ship uninjectable (see "
                     f"raft_tpu/resilience/faults.py)")
+    return errors
+
+
+def check_fault_registry(root: str = _REPO_ROOT) -> List[str]:
+    """Bidirectional agreement between the sites armed in source and
+    ``faults.KNOWN_SITES`` (shared derivation with graftlint's
+    registry pass): an armed-but-unregistered site would never get
+    matrix coverage; a registered-but-never-armed site is a dead
+    registry entry."""
+    derived = (_DERIVED if os.path.abspath(root) == _REPO_ROOT
+               else load_analysis().registry.derive_registries(root))
+    known = derived.known_sites
+    if known is None:
+        return ["raft_tpu/resilience/faults.py: KNOWN_SITES dict "
+                "literal not found — the fault-site registry is gone"]
+    errors: List[str] = []
+    used = set()
+    for rel, sites in sorted(derived.fault_sites.items()):
+        for s in sites:
+            used.add(s)
+            if s not in known:
+                errors.append(
+                    f"{rel}: fault_point({s!r}) is armed but not "
+                    f"registered in faults.KNOWN_SITES — the "
+                    f"injection matrix would never test it")
+    for s in sorted(set(known) - used):
+        errors.append(
+            f"raft_tpu/resilience/faults.py: KNOWN_SITES[{s!r}] is "
+            f"never armed by any fault_point — dead registry entry")
     return errors
 
 
@@ -642,6 +631,7 @@ def check(root: str = _REPO_ROOT,
         errors.extend(check_kernel_variants(root))
         errors.extend(check_sharded_merge(root))
         errors.extend(check_fault_sites(root))
+        errors.extend(check_fault_registry(root))
         errors.extend(check_event_sites(root))
         errors.extend(check_serving_coverage(root))
         errors.extend(check_quality_sites(root))

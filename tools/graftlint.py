@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""graftlint — whole-program AST lint gate for the raft_tpu tree.
+
+Front door for :mod:`raft_tpu.analysis` (module loader + call graph +
+pass registry). Three flagship passes: ``trace-purity`` (host-sync /
+retrace hazards reachable from jit/shard_map/pallas_call/_aot_call
+entry points), ``lock-discipline`` (lock-order inversions, blocking
+calls under a held lock, unlocked cross-thread module state) and
+``registry`` (fault sites / event kinds / hot paths / env knobs
+derived from source and diffed against every declared registry).
+
+Findings are gated against the baseline-suppression file
+(``tools/graftlint_baseline.json``): every suppression carries a
+mandatory reason string. Exit 0 = no unsuppressed error findings.
+
+Usage::
+
+    python tools/graftlint.py                  # lint, human output
+    python tools/graftlint.py --json           # + write LINT_REPORT.json
+    python tools/graftlint.py --passes registry
+    python tools/graftlint.py --suggest-baseline  # suppression stubs
+
+The analysis package is loaded standalone (no ``raft_tpu`` /jax
+import — pure stdlib AST), so the gate runs anywhere the source
+tree exists; it is wired into tier-1 via tests/test_analysis.py and
+into ``bench_report --check`` via the ``[lint]`` gate over
+``LINT_REPORT.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+from typing import Optional, Sequence
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPORT_NAME = "LINT_REPORT.json"
+REPORT_SCHEMA = 1
+
+
+def load_analysis(root: str = _REPO_ROOT):
+    """Import ``raft_tpu/analysis`` as the standalone package
+    ``raft_tpu_analysis`` — same files, but without executing
+    ``raft_tpu/__init__.py`` (which imports jax). Tools stay runnable
+    on a bare checkout; tests import ``raft_tpu.analysis`` normally
+    and the two resolve to identical sources."""
+    name = "raft_tpu_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(root, "raft_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _git_commit(root: str) -> str:
+    try:
+        r = subprocess.run(["git", "-C", root, "rev-parse", "--short",
+                            "HEAD"], capture_output=True, text=True,
+                           timeout=10)
+        if r.returncode == 0:
+            return r.stdout.strip()
+    except Exception:
+        pass
+    return "unknown"
+
+
+def run_lint(root: str = _REPO_ROOT,
+             passes: Optional[Sequence[str]] = None,
+             baseline_path: Optional[str] = None):
+    """→ (report dict, unsuppressed-error findings, baseline).
+    The report is exactly what ``--json`` writes."""
+    analysis = load_analysis(root)
+    baseline_path = baseline_path or os.path.join(
+        root, "tools", "graftlint_baseline.json")
+    baseline = analysis.Baseline.load(baseline_path)
+    by_pass = analysis.run_passes(root, names=passes)
+
+    all_findings = [f for fs in by_pass.values() for f in fs]
+    unsuppressed, suppressed, stale = baseline.apply(all_findings)
+    errors = [f for f in unsuppressed if f.severity == "error"]
+    warnings = [f for f in unsuppressed if f.severity != "error"]
+
+    sup_fps = {f.fingerprint for f in suppressed}
+    pass_blocks = {}
+    for name, fs in sorted(by_pass.items()):
+        un = [f for f in fs if f.fingerprint not in sup_fps]
+        rules = {}
+        for f in un:
+            rules[f.rule] = rules.get(f.rule, 0) + 1
+        pass_blocks[name] = {
+            "findings": len(fs),
+            "suppressed": len(fs) - len(un),
+            "unsuppressed": len(un),
+            "unsuppressed_errors": sum(1 for f in un
+                                       if f.severity == "error"),
+            "rules": dict(sorted(rules.items())),
+        }
+    report = {
+        "schema": REPORT_SCHEMA,
+        "tool": "graftlint",
+        "commit": _git_commit(root),
+        "ok": not errors,
+        "passes": pass_blocks,
+        "total_findings": len(all_findings),
+        "suppressed": len(suppressed),
+        "unsuppressed_errors": len(errors),
+        "unsuppressed_warnings": len(warnings),
+        "stale_baseline_entries": stale,
+        "baseline_entries": len(baseline.entries),
+        "findings": [
+            {"pass": f.pass_name, "rule": f.rule, "file": f.rel,
+             "line": f.line, "severity": f.severity,
+             "message": f.message, "fingerprint": f.fingerprint}
+            for f in sorted(unsuppressed,
+                            key=lambda f: (f.pass_name, f.rel, f.line,
+                                           f.rule, f.where))],
+    }
+    return report, errors, warnings, stale, baseline
+
+
+def main(argv: Sequence[str] = None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        prog="graftlint")
+    p.add_argument("--root", default=_REPO_ROOT,
+                   help="repo root to lint (default: this checkout)")
+    p.add_argument("--passes", default=None,
+                   help="comma-separated pass subset (default: all)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline-suppression file (default: "
+                        "tools/graftlint_baseline.json)")
+    p.add_argument("--json", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help=f"write the machine report (default path: "
+                        f"<root>/{REPORT_NAME})")
+    p.add_argument("--list-passes", action="store_true",
+                   help="print the registered pass names and exit")
+    p.add_argument("--suggest-baseline", action="store_true",
+                   help="print suppression stubs for every "
+                        "unsuppressed finding (fill in the reasons!)")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="summary line only")
+    args = p.parse_args(argv)
+
+    analysis = load_analysis(args.root)
+    if args.list_passes:
+        for name in analysis.all_passes():
+            print(name)
+        return 0
+
+    passes = (args.passes.split(",") if args.passes else None)
+    report, errors, warnings, stale, _baseline = run_lint(
+        args.root, passes=passes, baseline_path=args.baseline)
+
+    if args.suggest_baseline:
+        stubs = [{"fingerprint": f["fingerprint"],
+                  "reason": "<why this is acceptable>"}
+                 for f in report["findings"]]
+        print(json.dumps({"schema": 1, "suppressions": stubs},
+                         indent=1))
+        return 0
+
+    if not args.quiet:
+        for f in report["findings"]:
+            sev = "" if f["severity"] == "error" else " [warning]"
+            print(f"graftlint: {f['file']}:{f['line']}: "
+                  f"{f['rule']}{sev}: {f['message']}",
+                  file=sys.stderr)
+        for fp in stale:
+            print(f"graftlint: stale baseline entry (no matching "
+                  f"finding — clean it up): {fp}", file=sys.stderr)
+    counts = ", ".join(
+        f"{name}: {blk['unsuppressed']} unsuppressed"
+        f" ({blk['suppressed']} baselined)"
+        for name, blk in report["passes"].items())
+    verdict = "OK" if report["ok"] else "FAIL"
+    print(f"graftlint: {verdict} — {counts}; "
+          f"{report['unsuppressed_errors']} gating errors, "
+          f"{report['unsuppressed_warnings']} warnings, "
+          f"{len(stale)} stale baseline entries")
+
+    if args.json is not None:
+        path = args.json or os.path.join(args.root, REPORT_NAME)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"graftlint: wrote {path}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
