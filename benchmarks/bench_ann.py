@@ -85,7 +85,8 @@ def main(argv=None) -> int:
 
     import jax
 
-    from raft_tpu.ann import build_ivf_flat, search_ivf_flat
+    from raft_tpu.ann import (build_ivf_flat, resolve_fine_scan,
+                              search_ivf_flat)
     from raft_tpu.core import DeviceResources
     from raft_tpu.distance.fused_l2nn import knn
     from raft_tpu.observability.costmodel import ivf_traffic_model
@@ -127,7 +128,17 @@ def main(argv=None) -> int:
     degenerate_exact = True
     for L in lists:
         idx = build_ivf_flat(res, X, n_lists=L, max_iter=8, seed=3)
+        sizes = np.asarray(idx.sizes)
+        padded = np.asarray(idx.padded_sizes)
         for P in _probe_schedule(L):
+            # the fine-scan schedule the chooser resolves for this
+            # point (the cost-model crossover on the ACTUAL list-size
+            # histogram — ISSUE 14), stamped next to BOTH schedules'
+            # modeled bytes so the frontier records the gather/stream
+            # gap whichever one runs
+            chosen = resolve_fine_scan(idx, nq, k, min(P, L),
+                                       idx.probe_window) \
+                if P < L else "exact"
             t0 = time.perf_counter()
             v, i = search_ivf_flat(res, idx, Q, k, n_probes=P)
             i = np.asarray(i)
@@ -143,9 +154,9 @@ def main(argv=None) -> int:
                     errors.append(
                         f"degenerate point L={L} not oracle-exact")
             model = ivf_traffic_model(nq, m, d, k, L, min(P, L),
-                                      idx.probe_window, idx.slab_rows)
-            # ACTUAL probed fraction (real rows, not padded windows)
-            sizes = np.asarray(idx.sizes)
+                                      idx.probe_window, idx.slab_rows,
+                                      list_sizes=sizes,
+                                      padded_sizes=padded)
             frontier.append({
                 "n_lists": L,
                 "n_probes": P,
@@ -156,7 +167,12 @@ def main(argv=None) -> int:
                 "modeled_speedup": round(model["modeled_speedup"], 2),
                 "modeled_effective_gbps": round(
                     spec.hbm_bw * model["modeled_speedup"] / 1e9, 1),
-                "gather_overread": round(model["gather_overread"], 1),
+                "gather_overread": round(model["gather_overread"], 2),
+                "fine_scan": chosen,
+                "model_stream_bytes": round(
+                    model["fine_stream_bytes"]),
+                "model_gather_bytes": round(
+                    model["fine_gather_bytes"]),
                 "search_ms": round(ms, 2),
                 "list_size_min": int(sizes.min()),
                 "list_size_max": int(sizes.max()),
@@ -181,10 +197,15 @@ def main(argv=None) -> int:
         q8_exact = all(set(qe[q]) == oracle_sets[q] for q in range(nq))
         model8 = ivf_traffic_model(nq, m, d, k, L, Pq,
                                    idx8.probe_window, idx8.slab_rows,
-                                   db_dtype="int8")
+                                   db_dtype="int8",
+                                   list_sizes=np.asarray(idx8.sizes),
+                                   padded_sizes=np.asarray(
+                                       idx8.padded_sizes))
         quantized = {
             "db_dtype": "int8",
             "n_lists": L, "n_probes": Pq,
+            "fine_scan": resolve_fine_scan(idx8, nq, k, Pq,
+                                           idx8.probe_window),
             "quantized_gather_ratio": round(
                 model8["quantized_gather_ratio"], 4),
             "degenerate_exact": bool(q8_exact),

@@ -11,15 +11,21 @@ and recall@k vs the bit-exact brute-force oracle becomes a tracked
 artifact next to GB/s (BENCH_ANN.json).)
 """
 
-from raft_tpu.ann.ivf_flat import (DEFAULT_ROW_QUANTUM, IvfFlatIndex,
-                                   ShardedIvfIndex, build_ivf_flat,
-                                   search_ivf_flat, shard_ivf_lists)
+from raft_tpu.ann.ivf_flat import (DEFAULT_ROW_QUANTUM, FINE_SCANS,
+                                   IvfFlatIndex, ShardedIvfIndex,
+                                   build_ivf_flat, build_list_schedule,
+                                   resolve_fine_scan, search_ivf_flat,
+                                   shard_ivf_lists, warm_fine_scan)
 
 __all__ = [
     "DEFAULT_ROW_QUANTUM",
+    "FINE_SCANS",
     "IvfFlatIndex",
     "ShardedIvfIndex",
     "build_ivf_flat",
+    "build_list_schedule",
+    "resolve_fine_scan",
     "search_ivf_flat",
     "shard_ivf_lists",
+    "warm_fine_scan",
 ]

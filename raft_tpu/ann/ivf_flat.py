@@ -61,7 +61,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.core.error import expects
+from raft_tpu.core import env
+from raft_tpu.core.error import DeadlineExceededError, expects
 from raft_tpu.core.resources import ensure_resources
 from raft_tpu.observability import instrument
 from raft_tpu.observability.flight import get_flight_recorder
@@ -69,6 +70,7 @@ from raft_tpu.observability.quality import (record_certificate,
                                             record_pending)
 from raft_tpu.observability.timeline import emit_marker
 from raft_tpu.resilience import fault_point
+from raft_tpu.resilience.policy import record_degradation
 
 #: inverted-list row quantum: every list pads to a multiple of this
 #: (the fused pipeline's 8-row sublane multiple — a slab built at this
@@ -105,6 +107,18 @@ IVF_DB_DTYPES = ("f32", "int8")
 #: rescue-pool oversampling of the quantized fine scan (candidates
 #: exact-rescored per query beyond k)
 _IVF_RESCORE_PAD = 32
+
+#: fine-scan schedules: "query" = per-query probe-window gather (the
+#: PR-8 XLA path), "list" = list-major stream-once Pallas kernels
+#: (each probed list read ONCE per query chunk for all queries probing
+#: it), "auto" = the resolve_fine_scan cost-model crossover on the
+#: index's actual probed-list histogram. Env: RAFT_TPU_IVF_FINE_SCAN.
+FINE_SCANS = ("auto", "query", "list")
+
+#: list-major envelope: k must leave headroom inside the 2×128-slot
+#: candidate pool or the completeness certificate would fail every
+#: query straight into the query-major rerun
+_LIST_K_MAX = 96
 
 # compiled sharded-search programs, keyed by full static geometry
 # (same pattern as knn_sharded._SHARDED_FUSED_CACHE)
@@ -159,6 +173,9 @@ class IvfFlatIndex:
         self._np_sizes = np.asarray(sizes)
         self._np_padded = np.asarray(padded_sizes)
         self._fused_ops = None
+        # lazy per-list host/device geometry for the list-major fine
+        # scan (per-list scale + Eq + max row norms)
+        self._list_host = None
 
     @property
     def n_lists(self) -> int:
@@ -387,6 +404,402 @@ def _fine_scan_q8(x, slab, slab_q, row_scale, ids, yy_q, starts, psizes,
     return vals, out_ids, certified
 
 
+# ----------------------------------------- list-major fine scan
+# (ISSUE 14: stream each probed list ONCE per query chunk for every
+# query probing it — the inverted-index batching trade, run through
+# the ops.fine_scan_pallas kernel family. Ids stay bit-identical to
+# the query-major oracle: pooled candidates are exact-rescored with
+# the query-major scorer's own formula, reordered into its probe-slot
+# candidate order (so ties break identically), and a per-query
+# completeness certificate reruns any uncovered query query-major.)
+
+class _ListSchedule:
+    """Host-built list-major schedule for one query chunk: the
+    transposed probe table. ``sched [4, Lp]`` int32 rows are (clamped
+    window start, real list length, list offset within the window,
+    list id); Lp is padded to the 8-list cell quantum with the cell
+    count rounded to a power of two (capped at the index's own cell
+    count), so one compiled program serves a whole probes sweep. The
+    [L_probed, q_max] query-group table (q_max padded to 8) + its
+    never-wins mask ride along for the cost model and tests — the
+    kernel itself consumes the resident probe table directly."""
+
+    __slots__ = ("sched", "scale_l", "n_lists_probed", "q_max",
+                 "group", "group_mask", "stream_rows")
+
+    def __init__(self, sched, scale_l, n_lists_probed, q_max, group,
+                 group_mask, stream_rows):
+        self.sched = sched
+        self.scale_l = scale_l
+        self.n_lists_probed = n_lists_probed
+        self.q_max = q_max
+        self.group = group
+        self.group_mask = group_mask
+        self.stream_rows = stream_rows
+
+
+def _list_cells(n_probed: int, n_lists: int) -> int:
+    """Schedule cell count: probed lists bucket into 8-list cells,
+    rounded up to a power of two (compile-cache stability across
+    batches) and capped at the whole index's cell count."""
+    from raft_tpu.ops.fine_scan_pallas import LISTS_PER_CELL
+
+    cells = max(1, -(-n_probed // LISTS_PER_CELL))
+    cap = max(1, -(-n_lists // LISTS_PER_CELL))
+    return min(1 << (cells - 1).bit_length(), cap)
+
+
+def build_list_schedule(index: IvfFlatIndex, probes_np) -> _ListSchedule:
+    """Invert a chunk's per-query probe lists [nq, P] into the
+    per-list query-group schedule (see :class:`_ListSchedule`).
+    Host-side numpy — the probe table is tiny next to the slab."""
+    from raft_tpu.ops.fine_scan_pallas import (LISTS_PER_CELL,
+                                               pad_window)
+
+    probes_np = np.asarray(probes_np)
+    nq, P = probes_np.shape
+    plist = np.unique(probes_np.ravel())
+    plist = plist[plist >= 0].astype(np.int64)
+    Lp = int(plist.size)
+    Wk = pad_window(index.probe_window)
+    R = index.slab_rows
+    Lp_pad = _list_cells(Lp, index.n_lists) * LISTS_PER_CELL
+    sched = np.zeros((4, Lp_pad), np.int32)
+    sched[3, :] = -1
+    starts = index._np_offsets[plist].astype(np.int64)
+    clamped = np.clip(np.minimum(starts, R - Wk), 0, None)
+    sched[0, :Lp] = clamped
+    sched[1, :Lp] = index._np_sizes[plist]
+    sched[2, :Lp] = starts - clamped
+    sched[3, :Lp] = plist
+    scale_l = np.ones(Lp_pad, np.float32)
+    if index.db_dtype == "int8":
+        scale_l[:Lp] = _list_host(index)["scale"][plist]
+    # the transposed [L_probed, q_max] query-group table: group g holds
+    # the query indices probing plist[g], padded to the 8-row quantum
+    # with the never-wins mask marking real entries
+    inv = {int(l): g for g, l in enumerate(plist)}
+    members: list = [[] for _ in range(Lp)]
+    for q in range(nq):
+        for l in probes_np[q]:
+            if l >= 0:
+                members[inv[int(l)]].append(q)
+    q_max = -(-max((len(m) for m in members), default=1) // 8) * 8
+    group = np.zeros((max(Lp, 1), q_max), np.int32)
+    gmask = np.zeros((max(Lp, 1), q_max), bool)
+    for g, m in enumerate(members):
+        group[g, :len(m)] = m
+        gmask[g, :len(m)] = True
+    stream_rows = int(index._np_padded[plist].sum())
+    return _ListSchedule(sched, scale_l, Lp, int(q_max), group, gmask,
+                         stream_rows)
+
+
+def _list_host(index: IvfFlatIndex) -> dict:
+    """Lazy per-list host geometry for the list-major path: the
+    symmetric int8 scale, the Eq quantization bound and the max
+    (dequantized) row norm of each list — certificate inputs gathered
+    per probe at search time. Computed once per index."""
+    if index._list_host is not None:
+        return index._list_host
+    offs = index._np_offsets
+    L = index.n_lists
+    padded = index._np_padded
+    yy = np.asarray(index.yy_q if index.db_dtype == "int8"
+                    else index.yy_slab)
+    yy_lmax = np.zeros(L, np.float32)
+    for l in range(L):
+        w = int(padded[l])
+        if w:
+            yy_lmax[l] = yy[int(offs[l]):int(offs[l]) + w].max()
+    host = {"yy_lmax": jnp.asarray(yy_lmax)}
+    if index.db_dtype == "int8":
+        scale = np.asarray(index.row_scale)
+        eq = np.asarray(index.eq_rows)
+        scale_list = np.ones(L, np.float32)
+        eq_list = np.zeros(L, np.float32)
+        for l in range(L):
+            if int(padded[l]):
+                scale_list[l] = scale[int(offs[l])]
+                eq_list[l] = eq[int(offs[l])]
+        host["scale"] = scale_list
+        host["eq_list"] = jnp.asarray(eq_list)
+    index._list_host = host
+    return host
+
+
+def _pool_finish(x, xx, rows, slab, ids, yy_slab, starts_qm, psizes,
+                 k: int, P: int, W: int):
+    """Exact-rescore the pooled candidate rows with the query-major
+    scorer's own formula (bitwise the values :func:`_fine_scan`
+    computes for the same rows), reorder them into the query-major
+    candidate order — probe slot × window column, so ``top_k``'s
+    lowest-index tie-breaking picks the same winners — and select
+    top-k."""
+    valid = rows >= 0
+    rc = jnp.maximum(rows, 0)
+    yc = jnp.take(slab, rc, axis=0)                    # [nq, C2, d]
+    d2 = (xx + jnp.take(yy_slab, rc)
+          - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
+                             precision=jax.lax.Precision.HIGHEST))
+    d2 = jnp.where(valid, jnp.maximum(d2, 0.0), jnp.inf)
+    # canonical query-major position of each pooled row: its probe
+    # slot p and column within that window
+    w = rows[:, :, None] - starts_qm[:, None, :]       # [nq, C2, P]
+    match = ((w >= 0) & (w < psizes[:, None, :])
+             & valid[:, :, None])
+    slot = jnp.argmax(match, axis=2).astype(jnp.int32)
+    col = jnp.take_along_axis(w, slot[:, :, None], axis=2)[:, :, 0]
+    key = jnp.where(jnp.any(match, axis=2),
+                    slot * W + col.astype(jnp.int32), P * W)
+    order = jnp.argsort(key, axis=1)
+    d2s = jnp.take_along_axis(d2, order, axis=1)
+    rs = jnp.take_along_axis(rows, order, axis=1)
+    cid = jnp.where(rs >= 0, jnp.take(ids, jnp.maximum(rs, 0)), -1)
+    neg, pos = jax.lax.top_k(-d2s, k)
+    vals = -neg
+    out_ids = jnp.take_along_axis(cid, pos, axis=1)
+    return vals, jnp.where(jnp.isfinite(vals), out_ids, -1)
+
+
+def _pad_kernel_operands(x, probes):
+    """Query block + probe table padded to the kernel envelope: rows
+    to the 8-sublane quantum (pad probes −2 — matches no list id, so
+    pad queries pool nothing) and the probe table to the 128-lane
+    tile."""
+    nq, P = probes.shape
+    nqp = -(-nq // 8) * 8
+    xp = jnp.concatenate(
+        [x, jnp.zeros((nqp - nq, x.shape[1]), jnp.float32)]) \
+        if nqp > nq else x
+    pp = jnp.full((nqp, 128), -2, jnp.int32)
+    pp = jax.lax.dynamic_update_slice(pp, probes.astype(jnp.int32),
+                                      (0, 0))
+    return xp, pp, nqp
+
+
+def _kernel_envelope(bound, theta, widen):
+    """certified ⇔ no probed row outside the pool can beat the exact
+    k-th value: every excluded row scored ≥ its slot's 3rd-min ≥
+    ``bound``; an +inf bound means every slot kept all its rows (the
+    pool is trivially complete)."""
+    return bound >= theta + widen
+
+
+@partial(jax.jit, static_argnames=("k", "P", "W", "Wk"))
+def _fine_scan_list(x, sched, probes, slab, ids, yy_slab, starts_qm,
+                    psizes, yy_lmax, k: int, P: int, W: int, Wk: int):
+    """List-major fine scan over the f32 slab (see the block comment):
+    kernel pools → exact rescore + canonical reorder → certificate.
+    Returns (vals, ids, certified) like :func:`_fine_scan_q8` — the
+    caller reruns failed queries query-major, so ids never drift."""
+    from raft_tpu.ops.fine_scan_pallas import fine_scan_list_major
+
+    nq, d = x.shape
+    xx = jnp.sum(x * x, axis=1, keepdims=True)
+    xp, pp, nqp = _pad_kernel_operands(x, probes)
+    xxp = jnp.concatenate(
+        [xx, jnp.zeros((nqp - nq, 1), jnp.float32)]) if nqp > nq else xx
+    a1, i1, a2, i2, a3 = fine_scan_list_major(sched, xp, xxp, pp, slab,
+                                              Wk=Wk)
+    rows = jnp.concatenate([i1[:nq], i2[:nq]], axis=1)   # [nq, 256]
+    vals, out_ids = _pool_finish(x, xx, rows, slab, ids, yy_slab,
+                                 starts_qm, psizes, k, P, W)
+    theta = vals[:, k - 1]
+    bound = jnp.min(a3[:nq], axis=1)
+    # kernel-precision envelope: bf16 hi/lo cross term + the in-kernel
+    # MXU-contracted row norms (2⁻¹⁶-grade splits) + f32 accumulation
+    yymax = jnp.max(jnp.take(yy_lmax, probes), axis=1)
+    span = (jnp.sqrt(xx[:, 0]) + jnp.sqrt(yymax)) ** 2
+    widen = (2.0 ** -13 + d * 2.0 ** -22) * span
+    certified = _kernel_envelope(bound, theta, widen)
+    return vals, out_ids, certified
+
+
+@partial(jax.jit, static_argnames=("k", "P", "W", "Wk"))
+def _fine_scan_list_q8(x, sched, scale_l, probes, slab_q, slab, ids,
+                       yy_slab, yy_lmax, eq_list, starts_qm, psizes,
+                       k: int, P: int, W: int, Wk: int):
+    """INT8 list-major fine scan: streams the quantized slab (~¼ the
+    probed bytes) through :func:`ops.fine_scan_pallas.
+    fine_scan_list_major_q8` with per-list dequant-in-register scales,
+    then the same exact-rescore/reorder/certificate pipeline — the
+    certificate additionally widens by the probed lists' recorded Eq
+    bound exactly like the query-major :func:`_fine_scan_q8`."""
+    from raft_tpu.ops.fine_scan_pallas import fine_scan_list_major_q8
+
+    nq, d = x.shape
+    xx = jnp.sum(x * x, axis=1, keepdims=True)
+    xp, pp, nqp = _pad_kernel_operands(x, probes)
+    xxp = jnp.concatenate(
+        [xx, jnp.zeros((nqp - nq, 1), jnp.float32)]) if nqp > nq else xx
+    a1, i1, a2, i2, a3 = fine_scan_list_major_q8(
+        sched, scale_l, xp, xxp, pp, slab_q, Wk=Wk)
+    rows = jnp.concatenate([i1[:nq], i2[:nq]], axis=1)
+    vals, out_ids = _pool_finish(x, xx, rows, slab, ids, yy_slab,
+                                 starts_qm, psizes, k, P, W)
+    theta = vals[:, k - 1]
+    bound = jnp.min(a3[:nq], axis=1)
+    yymax = jnp.max(jnp.take(yy_lmax, probes), axis=1)
+    eq_w = jnp.max(jnp.take(eq_list, probes), axis=1)
+    span = (jnp.sqrt(xx[:, 0]) + jnp.sqrt(yymax)) ** 2
+    e_k = (2.0 ** -13 + d * 2.0 ** -22) * span
+    sq_t = jnp.sqrt(jnp.maximum(theta, 0.0))
+    widen = 2.0 * sq_t * eq_w + eq_w * eq_w + e_k
+    certified = _kernel_envelope(bound, theta, widen)
+    return vals, out_ids, certified
+
+
+def resolve_fine_scan(index: IvfFlatIndex, nq: int, k: int, P: int,
+                      W: int, requested: Optional[str] = None,
+                      probes_np=None, chunk: Optional[int] = None
+                      ) -> str:
+    """EFFECTIVE fine-scan schedule for a call — decided (and logged)
+    in the non-jitted wrapper like ``resolve_grid_order``. ``None``
+    reads ``RAFT_TPU_IVF_FINE_SCAN`` (default ``auto``).
+
+    Envelope (outside it every request runs query-major, with a
+    logged downgrade for an explicit ``list``): the slab must cover
+    one kernel window, k the candidate pool, the probe count the
+    128-lane probe table, the cell fit the scoped-VMEM budget, and on
+    real TPUs the feature width must be lane-aligned.
+
+    ``auto`` consults the schema-5 ``fine_scan`` tune-table column
+    (:func:`raft_tpu.tune.ivf.fine_scan_config`) first, then falls to
+    the cost-model crossover on the index's ACTUAL probed-list-size
+    histogram (:func:`~raft_tpu.observability.costmodel.
+    choose_fine_scan` over :func:`~raft_tpu.observability.costmodel.
+    ivf_traffic_model`)."""
+    from raft_tpu.observability.costmodel import (DB_DTYPE_BYTES,
+                                                  FINE_SCAN_MARGIN,
+                                                  choose_fine_scan,
+                                                  ivf_traffic_model)
+    from raft_tpu.ops.fine_scan_pallas import (fine_scan_vmem_footprint,
+                                               pad_window)
+    from raft_tpu.ops.fused_l2_topk_pallas import vmem_budget
+    from raft_tpu.ops.utils import interpret_mode
+
+    req = requested if requested is not None \
+        else env.get("RAFT_TPU_IVF_FINE_SCAN")
+    if req not in FINE_SCANS:
+        raise ValueError(f"fine_scan must be one of {FINE_SCANS}, "
+                         f"got {req!r}")
+    if req == "query":
+        return "query"
+    Wk = pad_window(W)
+    d = index.d_orig
+    quant = index.db_dtype == "int8"
+    nqp = -(-min(nq, chunk or nq) // 8) * 8
+    reason = None
+    if index.slab_rows < Wk:
+        reason = (f"slab rows {index.slab_rows} < kernel window {Wk}")
+    elif k > _LIST_K_MAX:
+        reason = f"k={k} > {_LIST_K_MAX} exceeds the candidate pool"
+    elif P > 128:
+        reason = f"n_probes={P} > 128 exceeds the probe table"
+    elif fine_scan_vmem_footprint(Wk, nqp, d, quant) > vmem_budget():
+        reason = "cell footprint over the scoped-VMEM budget"
+    elif not interpret_mode() and d % 128:
+        reason = f"d={d} is not lane-aligned on a real TPU"
+    if reason is not None:
+        if req == "list":
+            from raft_tpu.core.logger import log_warn
+
+            log_warn("fine_scan='list' outside the list-major envelope "
+                     "(%s) — using 'query' for this call", reason)
+        return "query"
+    if req == "list":
+        return "list"
+    # auto — tuned table first, then the cost-model crossover
+    from raft_tpu.tune.ivf import fine_scan_config
+
+    tuned = fine_scan_config(index.n_lists, P)
+    if tuned in ("query", "list"):
+        return tuned
+    sizes = index._np_sizes
+    padded = index._np_padded
+    if probes_np is not None:
+        # the actual probe table: exact per-chunk union of probed
+        # lists vs the exact gather, same margin as the model path
+        probes_np = np.asarray(probes_np)
+        step = max(1, int(chunk or nq))
+        bpe = DB_DTYPE_BYTES[index.db_dtype
+                             if quant else "f32"]
+        per_row = d * bpe + 8 + (8 if quant else 0)
+        stream = 0.0
+        for s in range(0, probes_np.shape[0], step):
+            u = np.unique(probes_np[s:s + step].ravel())
+            stream += float(padded[u[u >= 0]].sum()) * per_row
+        stream += float(nq) * min(256, P * W) * d * 4.0
+        gather = float(nq) * P * W * per_row
+        if quant:
+            gather += float(nq) * min(k + _IVF_RESCORE_PAD, P * W) \
+                * d * 4.0
+        return "list" if gather > FINE_SCAN_MARGIN * max(stream, 1.0) \
+            else "query"
+    model = ivf_traffic_model(
+        nq, index.n_rows, d, k, index.n_lists, P, W, index.slab_rows,
+        db_dtype=index.db_dtype if quant else "f32",
+        list_sizes=sizes, padded_sizes=padded)
+    return choose_fine_scan(model)
+
+
+def warm_fine_scan(res, index: IvfFlatIndex, nq: int, k: int,
+                   n_probes: int) -> int:
+    """Pre-compile BOTH fine-scan schedules a serving bucket of ``nq``
+    queries can reach: the query-major gather programs (through the
+    public wrapper, so its chunking/rerun programs warm too) and one
+    list-major program per power-of-two schedule-cell rung — the only
+    geometry axis that varies with batch content; everything else is
+    frozen by the index. Called from the snapshot warmup so a live
+    request can never pay a compile whichever way the
+    :func:`resolve_fine_scan` crossover lands. Returns the list-major
+    rung count (0 = the bucket is outside the list-major envelope)."""
+    from raft_tpu.ops.fine_scan_pallas import (LISTS_PER_CELL,
+                                               pad_window)
+
+    P = min(max(1, int(n_probes)), index.n_lists)
+    if P >= index.n_lists or nq < 1:
+        return 0            # the degenerate-exact plane — one schedule
+    W = index.probe_window
+    Wk = pad_window(W)
+    d = index.d_orig
+    x0 = np.zeros((nq, d), np.float32)
+    out = search_ivf_flat(res, index, x0, k, n_probes=P,
+                          fine_scan="query")
+    jax.block_until_ready(out)
+    if resolve_fine_scan(index, nq, k, P, W, "list") != "list":
+        return 0
+    chunk = max(8, _FINE_TILE // max(1, P * W * max(d, 1)))
+    sizes = sorted({min(nq, chunk), nq % chunk or min(nq, chunk)})
+    cap = max(1, -(-index.n_lists // LISTS_PER_CELL))
+    rungs = sorted({min(1 << b, cap)
+                    for b in range(cap.bit_length() + 1)})
+    host = _list_host(index)
+    for nq_c in sizes:
+        xc = jnp.zeros((nq_c, d), jnp.float32)
+        probes0 = jnp.zeros((nq_c, P), jnp.int32)
+        starts0 = jnp.zeros((nq_c, P), jnp.int32)
+        psz0 = jnp.zeros((nq_c, P), jnp.int32)
+        for cells in rungs:
+            Lp = cells * LISTS_PER_CELL
+            sched = np.zeros((4, Lp), np.int32)
+            sched[3, :] = -1
+            if index.db_dtype == "int8":
+                out = _fine_scan_list_q8(
+                    xc, jnp.asarray(sched), jnp.ones(Lp, jnp.float32),
+                    probes0, index.slab_q, index.slab, index.ids,
+                    index.yy_slab, host["yy_lmax"], host["eq_list"],
+                    starts0, psz0, k=k, P=P, W=W, Wk=Wk)
+            else:
+                out = _fine_scan_list(
+                    xc, jnp.asarray(sched), probes0, index.slab,
+                    index.ids, index.yy_slab, starts0, psz0,
+                    host["yy_lmax"], k=k, P=P, W=W, Wk=Wk)
+            jax.block_until_ready(out)
+    return len(rungs)
+
+
 def _coarse_probe(res, centroids, x, n_probes: int):
     """Top-``n_probes`` nearest coarse centroids per query through the
     existing fused-L2 top-k machinery (the streamed sweep — centroid
@@ -470,10 +883,116 @@ def _exact_search(res, index: IvfFlatIndex, x, k: int):
 
 
 # ------------------------------------------------------------ search
+def _query_major_chunk(index: IvfFlatIndex, xs, st, ps, k: int,
+                       P: int, W: int):
+    """One query-major chunk: the per-query probe-window gather scan
+    (f32, or the certified int8 gather with its f32 rerun) — the PR-8
+    path, now shared by the query-major schedule and the list-major
+    certificate-failure rerun."""
+    if index.db_dtype != "int8":
+        return _fine_scan(xs, index.slab, index.ids, index.yy_slab,
+                          st, ps, k=k, P=P, W=W)
+    C = min(k + _IVF_RESCORE_PAD, P * W)
+    vals, ids_c, ok = _fine_scan_q8(
+        xs, index.slab, index.slab_q, index.row_scale, index.ids,
+        index.yy_q, st, ps, k=k, P=P, W=W, C=C,
+        eq_rows=index.eq_rows)
+    n_fail = int(jnp.sum(~ok))
+    # quality telemetry: this path ALREADY syncs (the int() above
+    # decides the rerun), so the counters cost nothing extra —
+    # the IVF slice of the certificate/fixup evidence plane
+    record_certificate("ann.search_ivf_flat",
+                       n_queries=int(xs.shape[0]), n_fail=n_fail,
+                       pool_width=C, fixup_rows=n_fail or None,
+                       rerun=bool(n_fail), db_dtype="int8",
+                       n_probes=P)
+    if n_fail:
+        # quantization certificate failed for some queries: the
+        # true top-k may extend past the rescored pool — rerun the
+        # chunk through the exact f32 scan and keep certified rows
+        # from the quantized pass (bytes saved stand; correctness
+        # never rides on the margin)
+        emit_marker("ivf_q8_fallback", n_fail=n_fail,
+                    nq=int(xs.shape[0]))
+        fv, fi = _fine_scan(xs, index.slab, index.ids,
+                            index.yy_slab, st, ps, k=k, P=P, W=W)
+        okc = ok[:, None]
+        vals = jnp.where(okc, vals, fv)
+        ids_c = jnp.where(okc, ids_c, fi)
+    return vals, ids_c
+
+
+def _search_list_major(res, index: IvfFlatIndex, x, probes,
+                       probes_host, starts, psizes, k: int, P: int,
+                       W: int, chunk: int):
+    """The list-major driver: per chunk, invert the probe table into
+    the list schedule, run the stream-once kernel, and rerun any
+    certificate-failing chunk rows through the query-major scan — the
+    returned ids are bit-identical to the query-major oracle either
+    way."""
+    from raft_tpu.ops.fine_scan_pallas import pad_window
+
+    Wk = pad_window(W)
+    host = _list_host(index)
+    quant = index.db_dtype == "int8"
+    nq = x.shape[0]
+
+    def run_chunk(s0: int, s1: int):
+        xs, pr = x[s0:s1], probes[s0:s1]
+        st, ps = starts[s0:s1], psizes[s0:s1]
+        sched = build_list_schedule(index, probes_host[s0:s1])
+        if s0 == 0:
+            emit_marker("ivf_fine_scan_schedule", schedule="list",
+                        lists_probed=sched.n_lists_probed,
+                        q_max=sched.q_max,
+                        cells=sched.sched.shape[1] // 8,
+                        stream_rows=sched.stream_rows,
+                        db_dtype=index.db_dtype)
+        if quant:
+            vals, ids_c, ok = _fine_scan_list_q8(
+                xs, jnp.asarray(sched.sched),
+                jnp.asarray(sched.scale_l), pr, index.slab_q,
+                index.slab, index.ids, index.yy_slab,
+                host["yy_lmax"], host["eq_list"], st, ps,
+                k=k, P=P, W=W, Wk=Wk)
+        else:
+            vals, ids_c, ok = _fine_scan_list(
+                xs, jnp.asarray(sched.sched), pr, index.slab,
+                index.ids, index.yy_slab, st, ps, host["yy_lmax"],
+                k=k, P=P, W=W, Wk=Wk)
+        n_fail = int(jnp.sum(~ok))
+        # same host sync the q8 gather path already pays — the
+        # list-major slice of the certificate/fixup evidence plane
+        record_certificate("ann.search_ivf_flat",
+                           n_queries=int(xs.shape[0]), n_fail=n_fail,
+                           pool_width=256, fixup_rows=n_fail or None,
+                           rerun=bool(n_fail),
+                           db_dtype=index.db_dtype, fine_scan="list")
+        if n_fail:
+            # pool-completeness certificate failed: the true top-k
+            # (or one of its ties) may hide outside the 256-slot pool
+            # — rerun the chunk query-major and keep certified rows
+            emit_marker("ivf_list_fallback", n_fail=n_fail,
+                        nq=int(xs.shape[0]))
+            fv, fi = _query_major_chunk(index, xs, st, ps, k, P, W)
+            okc = ok[:, None]
+            vals = jnp.where(okc, vals, fv)
+            ids_c = jnp.where(okc, ids_c, fi)
+        return vals, ids_c
+
+    if nq <= chunk:
+        return run_chunk(0, nq)
+    outs = [run_chunk(s, min(s + chunk, nq))
+            for s in range(0, nq, chunk)]
+    return (jnp.concatenate([o[0] for o in outs]),
+            jnp.concatenate([o[1] for o in outs]))
+
+
 @instrument("ann.search_ivf_flat")
 def search_ivf_flat(res, index, queries, k: int,
                     n_probes: Optional[int] = None,
-                    merge: str = "auto"
+                    merge: str = "auto",
+                    fine_scan: Optional[str] = None
                     ) -> Tuple[jax.Array, jax.Array]:
     """Approximate top-k against an IVF-Flat index.
 
@@ -486,6 +1005,19 @@ def search_ivf_flat(res, index, queries, k: int,
     ``index`` is an :class:`IvfFlatIndex` or a :class:`ShardedIvfIndex`
     (:func:`shard_ivf_lists` — whole lists over the mesh, per-shard
     local top-k + the PR-4 rank-ordered merge picked by ``merge``).
+
+    ``fine_scan`` picks the fine-scan schedule (:data:`FINE_SCANS`;
+    ``None`` reads ``RAFT_TPU_IVF_FINE_SCAN``, default ``auto``):
+    ``query`` gathers each query's probe windows independently,
+    ``list`` streams each probed list ONCE per query chunk for all the
+    queries probing it (the ``ops.fine_scan_pallas`` kernels — f32 ids
+    certified bit-identical to the query-major scan; int8 id sets
+    identical, ties canonicalized to f32 position order), ``auto`` runs
+    the :func:`resolve_fine_scan` cost-model crossover on the index's
+    actual probed-list histogram. A failing list-major dispatch
+    degrades back to query-major with a logged degradation (fault
+    site ``fine_scan_list``). The sharded path keeps the query-major
+    shard-local scan.
 
     ``n_probes ≥ n_lists`` (or ``k`` beyond the probed capacity)
     degrades to EXACT search with a logged reason — the certified
@@ -558,45 +1090,39 @@ def search_ivf_flat(res, index, queries, k: int,
     except Exception:
         pass
 
-    quant = index.db_dtype == "int8"
-    C = min(k + _IVF_RESCORE_PAD, P * W)
+    # fine-scan schedule: env/arg request resolved against the
+    # list-major envelope + the cost-model crossover on the ACTUAL
+    # probe table (resolve_fine_scan). A list-major failure — real or
+    # injected at the fine_scan_list site — degrades back to the
+    # query-major scan for this call, with identical ids.
+    req = fine_scan if fine_scan is not None \
+        else env.get("RAFT_TPU_IVF_FINE_SCAN")
+    probes_host = np.asarray(probes) if req != "query" else None
+    schedule = resolve_fine_scan(index, nq, k, P, W, req,
+                                 probes_np=probes_host, chunk=chunk)
+    if schedule == "list":
+        try:
+            fault_point("fine_scan_list")
+            return _search_list_major(res, index, x, probes,
+                                      probes_host, starts, psizes,
+                                      k, P, W, chunk)
+        except DeadlineExceededError:
+            raise               # the caller's global budget — never eaten
+        except Exception as e:
+            from raft_tpu.core.logger import log_warn
 
-    def scan_chunk(xs, st, ps):
-        if not quant:
-            return _fine_scan(xs, index.slab, index.ids, index.yy_slab,
-                              st, ps, k=k, P=P, W=W)
-        vals, ids_c, ok = _fine_scan_q8(
-            xs, index.slab, index.slab_q, index.row_scale, index.ids,
-            index.yy_q, st, ps, k=k, P=P, W=W, C=C,
-            eq_rows=index.eq_rows)
-        n_fail = int(jnp.sum(~ok))
-        # quality telemetry: this path ALREADY syncs (the int() above
-        # decides the rerun), so the counters cost nothing extra —
-        # the IVF slice of the certificate/fixup evidence plane
-        record_certificate("ann.search_ivf_flat",
-                           n_queries=int(xs.shape[0]), n_fail=n_fail,
-                           pool_width=C, fixup_rows=n_fail or None,
-                           rerun=bool(n_fail), db_dtype="int8",
-                           n_probes=P)
-        if n_fail:
-            # quantization certificate failed for some queries: the
-            # true top-k may extend past the rescored pool — rerun the
-            # chunk through the exact f32 scan and keep certified rows
-            # from the quantized pass (bytes saved stand; correctness
-            # never rides on the margin)
-            emit_marker("ivf_q8_fallback", n_fail=n_fail,
-                        nq=int(xs.shape[0]))
-            fv, fi = _fine_scan(xs, index.slab, index.ids,
-                                index.yy_slab, st, ps, k=k, P=P, W=W)
-            okc = ok[:, None]
-            vals = jnp.where(okc, vals, fv)
-            ids_c = jnp.where(okc, ids_c, fi)
-        return vals, ids_c
+            record_degradation("fine_scan_list", "query")
+            emit_marker("fine_scan_degrade",
+                        reason=f"{type(e).__name__}: {e}"[:160])
+            log_warn("list-major fine scan failed (%s: %s) — "
+                     "degrading to the query-major scan for this "
+                     "call", type(e).__name__, e)
 
     if nq <= chunk:
-        return scan_chunk(x, starts, psizes)
-    outs = [scan_chunk(x[s:s + chunk], starts[s:s + chunk],
-                       psizes[s:s + chunk])
+        return _query_major_chunk(index, x, starts, psizes, k, P, W)
+    outs = [_query_major_chunk(index, x[s:s + chunk],
+                               starts[s:s + chunk],
+                               psizes[s:s + chunk], k, P, W)
             for s in range(0, nq, chunk)]
     return (jnp.concatenate([o[0] for o in outs]),
             jnp.concatenate([o[1] for o in outs]))
@@ -612,7 +1138,8 @@ class ShardedIvfIndex:
 
     def __init__(self, base: IvfFlatIndex, mesh, axis: str,
                  slab_s, ids_s, yy_s, starts_g, psizes_g,
-                 lists_per: int, rows_per: int):
+                 lists_per: int, rows_per: int, slab_qs=None,
+                 scale_s=None, yyq_s=None, eq_s=None):
         self.base = base
         self.mesh, self.axis = mesh, axis
         self.slab_s = slab_s        # [p·rows_per, d] sharded P(axis)
@@ -622,6 +1149,14 @@ class ShardedIvfIndex:
         self.psizes_g = psizes_g    # [Lg] padded sizes (0 = empty)
         self.lists_per = lists_per
         self.rows_per = rows_per
+        # int8 sidecar, sharded in the same block layout as the f32
+        # slab (PR-9 parity gap closed: the shard-local fine scan
+        # streams the quantized rows, certifies, and exact-rescoring
+        # rides the f32 slab that is already resident per shard)
+        self.slab_qs = slab_qs      # [p·rows_per, d] int8 or None
+        self.scale_s = scale_s      # [p·rows_per] f32 per-row scale
+        self.yyq_s = yyq_s          # [p·rows_per] ‖ŷ‖²
+        self.eq_s = eq_s            # [p·rows_per] per-row Eq bound
 
     @property
     def n_shards(self) -> int:
@@ -680,6 +1215,34 @@ def shard_ivf_lists(index: IvfFlatIndex, mesh, axis: str = "x"
                 ids_g[dst:dst + w] = ids[src:src + w]
                 yy_g[dst:dst + w] = yy[src:src + w]
             cursor += w
+    q8_kw = {}
+    if index.db_dtype == "int8":
+        # the PR-9 sidecar, laid out in the SAME per-shard block
+        # geometry (gathered from the base arrays, not recomputed —
+        # the sharded and unsharded quantized scans must score the
+        # same ŷ bit-for-bit)
+        slab_q = np.asarray(index.slab_q)
+        scale = np.asarray(index.row_scale)
+        yyq = np.asarray(index.yy_q)
+        eqr = np.asarray(index.eq_rows)
+        slab_qg = np.zeros((p * S, d), np.int8)
+        scale_g = np.ones(p * S, np.float32)
+        yyq_g = np.zeros(p * S, np.float32)
+        eq_g = np.zeros(p * S, np.float32)
+        for r in range(p):
+            cursor = 0
+            for gl in range(r * Ll, min((r + 1) * Ll, L)):
+                w = int(padded[gl])
+                if w:
+                    src = int(offsets[gl])
+                    dst = r * S + cursor
+                    slab_qg[dst:dst + w] = slab_q[src:src + w]
+                    scale_g[dst:dst + w] = scale[src:src + w]
+                    yyq_g[dst:dst + w] = yyq[src:src + w]
+                    eq_g[dst:dst + w] = eqr[src:src + w]
+                cursor += w
+        q8_kw = dict(slab_qs=slab_qg, scale_s=scale_g, yyq_s=yyq_g,
+                     eq_s=eq_g)
     sh = NamedSharding(mesh, P(axis))
     return ShardedIvfIndex(
         index, mesh, axis,
@@ -688,7 +1251,9 @@ def shard_ivf_lists(index: IvfFlatIndex, mesh, axis: str = "x"
         yy_s=jax.device_put(yy_g, sh),
         starts_g=jnp.asarray(starts_g),
         psizes_g=jnp.asarray(psizes_g),
-        lists_per=Ll, rows_per=S)
+        lists_per=Ll, rows_per=S,
+        **{key: jax.device_put(val, sh)
+           for key, val in q8_kw.items()})
 
 
 def _search_sharded(res, index: ShardedIvfIndex, x, probes, k: int,
@@ -732,31 +1297,90 @@ def _search_sharded(res, index: ShardedIvfIndex, x, probes, k: int,
                 jnp.concatenate([o[1] for o in outs]))
 
     Ll, S = index.lists_per, index.rows_per
-    key = (mesh, axis, k, P, W, S, Ll, merge_eff, d, nq)
+    quant = index.base.db_dtype == "int8" and index.slab_qs is not None
+    repl = replicated(mesh)
+    common = (jax.device_put(x, repl), jax.device_put(probes, repl),
+              jax.device_put(index.starts_g, repl),
+              jax.device_put(index.psizes_g, repl))
+
+    def _f32_fn():
+        key = (mesh, axis, k, P, W, S, Ll, merge_eff, d, nq, "f32")
+        fn = _SHARDED_IVF_CACHE.get(key)
+        if fn is None:
+            comms = MeshComms(axis, size=p)
+            merge_fn = {"allgather": _merge_allgather,
+                        "tournament": _merge_tournament}[merge_eff]
+
+            def shard_fn(slab_l, ids_l, yy_l, xq, pr, starts_g, psz_g):
+                r = jax.lax.axis_index(axis).astype(jnp.int32)
+                owned = (pr >= r * Ll) & (pr < (r + 1) * Ll)
+                starts = jnp.take(starts_g, pr)
+                psz = jnp.where(owned, jnp.take(psz_g, pr), 0)
+                vals, gids = _fine_scan(xq, slab_l, ids_l, yy_l,
+                                        starts, psz, k=k, P=P, W=W)
+                return merge_fn(comms, p, k, vals, gids)
+
+            fn = jax.jit(jax.shard_map(
+                shard_fn, mesh=mesh,
+                in_specs=(Pspec(axis), Pspec(axis), Pspec(axis),
+                          Pspec(), Pspec(), Pspec(), Pspec()),
+                out_specs=(Pspec(), Pspec()), check_vma=False))
+            _SHARDED_IVF_CACHE[key] = fn
+        return fn
+
+    if not quant:
+        return _f32_fn()(index.slab_s, index.ids_s, index.yy_s,
+                         *common)
+
+    # int8 shard-local fine scan (the PR-9 sharded parity gap): each
+    # shard streams ITS quantized rows (~¼ the probed bytes), prunes
+    # to the certified pool, exact-rescoring from its resident f32
+    # slab — certificates come out per shard ([p, nq] over the axis),
+    # and any query a shard could not certify reruns the whole chunk
+    # through the f32 program, so merged ids never degrade.
+    C = min(k + _IVF_RESCORE_PAD, P * W)
+    key = (mesh, axis, k, P, W, S, Ll, merge_eff, d, nq, "int8")
     fn = _SHARDED_IVF_CACHE.get(key)
     if fn is None:
         comms = MeshComms(axis, size=p)
         merge_fn = {"allgather": _merge_allgather,
                     "tournament": _merge_tournament}[merge_eff]
 
-        def shard_fn(slab_l, ids_l, yy_l, xq, pr, starts_g, psz_g):
+        def shard_fn_q8(slab_l, slabq_l, scale_l, yyq_l, eq_l, ids_l,
+                        xq, pr, starts_g, psz_g):
             r = jax.lax.axis_index(axis).astype(jnp.int32)
             owned = (pr >= r * Ll) & (pr < (r + 1) * Ll)
             starts = jnp.take(starts_g, pr)
             psz = jnp.where(owned, jnp.take(psz_g, pr), 0)
-            vals, gids = _fine_scan(xq, slab_l, ids_l, yy_l, starts,
-                                    psz, k=k, P=P, W=W)
-            return merge_fn(comms, p, k, vals, gids)
+            vals, gids, ok = _fine_scan_q8(
+                xq, slab_l, slabq_l, scale_l, ids_l, yyq_l, starts,
+                psz, k=k, P=P, W=W, C=C, eq_rows=eq_l)
+            mv, mi = merge_fn(comms, p, k, vals, gids)
+            return mv, mi, ok[None, :]
 
         fn = jax.jit(jax.shard_map(
-            shard_fn, mesh=mesh,
-            in_specs=(Pspec(axis), Pspec(axis), Pspec(axis),
-                      Pspec(), Pspec(), Pspec(), Pspec()),
-            out_specs=(Pspec(), Pspec()), check_vma=False))
+            shard_fn_q8, mesh=mesh,
+            in_specs=(Pspec(axis),) * 6
+            + (Pspec(), Pspec(), Pspec(), Pspec()),
+            out_specs=(Pspec(), Pspec(), Pspec(axis)),
+            check_vma=False))
         _SHARDED_IVF_CACHE[key] = fn
 
-    repl = replicated(mesh)
-    return fn(index.slab_s, index.ids_s, index.yy_s,
-              jax.device_put(x, repl), jax.device_put(probes, repl),
-              jax.device_put(index.starts_g, repl),
-              jax.device_put(index.psizes_g, repl))
+    vals, gids, ok_p = fn(index.slab_s, index.slab_qs, index.scale_s,
+                          index.yyq_s, index.eq_s, index.ids_s,
+                          *common)
+    ok = np.asarray(ok_p).all(axis=0)                       # [nq]
+    n_fail = int((~ok).sum())
+    record_certificate("ann.search_ivf_flat", n_queries=nq,
+                       n_fail=n_fail, pool_width=C,
+                       fixup_rows=n_fail or None, rerun=bool(n_fail),
+                       db_dtype="int8", sharded=True)
+    if n_fail:
+        emit_marker("ivf_q8_fallback", n_fail=n_fail, nq=nq,
+                    sharded=True)
+        fv, fi = _f32_fn()(index.slab_s, index.ids_s, index.yy_s,
+                           *common)
+        okd = jnp.asarray(ok)[:, None]
+        vals = jnp.where(okd, vals, fv)
+        gids = jnp.where(okd, gids, fi)
+    return vals, gids

@@ -133,6 +133,10 @@ _knob("RAFT_TPU_IVF_ROW_QUANTUM", "int", 8,
       "IVF-Flat inverted-list pad quantum")
 _knob("RAFT_TPU_ANN_NPROBES", "int", None,
       "fleet default n_probes for search_ivf_flat (read per call)")
+_knob("RAFT_TPU_IVF_FINE_SCAN", "enum", "auto",
+      "IVF fine-scan schedule: query-major gather, list-major "
+      "stream-once kernels, or the cost-model crossover",
+      choices=("auto", "query", "list"))
 
 # -- mutable indexes / durability --------------------------------------
 _knob("RAFT_TPU_COMPACT_THRESHOLD", "int", 1024,

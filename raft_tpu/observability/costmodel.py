@@ -331,9 +331,31 @@ def fused_traffic_record(Q: int, m: int, d: int, k: int,
         bytes_accessed=model["total_bytes"])
 
 
+#: list-major wins the fine-scan crossover only past this modeled
+#: gather/stream ratio — margin for the schedule build, the pool
+#: rescore and the masked-MXU work the bytes model does not price
+FINE_SCAN_MARGIN = 1.25
+
+#: per-query candidate pool the list-major kernels exact-rescore
+#: (2 × 128 lane-class slots — ops.fine_scan_pallas.POOL_WIDTH)
+_LIST_POOL = 256
+
+
+def choose_fine_scan(model: Dict) -> str:
+    """The cost-model half of ``resolve_fine_scan``: ``"list"`` when
+    the query-major gather re-reads enough shared probed bytes to beat
+    the list-major stream by :data:`FINE_SCAN_MARGIN`, else
+    ``"query"``. Takes an :func:`ivf_traffic_model` result."""
+    gather = model.get("fine_gather_bytes", 0.0)
+    stream = model.get("fine_stream_bytes", 0.0)
+    return "list" if gather > FINE_SCAN_MARGIN * max(stream, 1.0) \
+        else "query"
+
+
 def ivf_traffic_model(nq: int, m: int, d: int, k: int, n_lists: int,
                       n_probes: int, probe_window: int,
-                      slab_rows: int, db_dtype: str = "f32") -> Dict:
+                      slab_rows: int, db_dtype: str = "f32",
+                      list_sizes=None, padded_sizes=None) -> Dict:
     """Analytic HBM traffic of one IVF-Flat search batch
     (:mod:`raft_tpu.ann`) next to the brute-force bytes it displaces —
     the model behind BENCH_ANN.json's speed/recall frontier.
@@ -343,15 +365,22 @@ def ivf_traffic_model(nq: int, m: int, d: int, k: int, n_lists: int,
       fraction of database bytes a query touches (the knob recall is
       traded against);
     - ``fine_stream_bytes``: the LIST-MAJOR schedule — every probed
-      list streams from HBM once per query batch (the IVF analog of
-      PR-3's db-major grid re-order), so database-side traffic is
-      ``probed_frac`` of the slab. This is the bytes model the
-      frontier is ranked by;
-    - ``fine_gather_bytes``: what the CURRENT query-major XLA gather
-      path reads — each query re-fetches its own probe windows, the
-      exact nq× re-read pathology the PR-3 work removed from brute
-      force (the committed frontier carries both numbers so the gap
-      IS the named follow-up: a list-major fine-scan kernel);
+      list streams from HBM once per query chunk (the IVF analog of
+      PR-3's db-major grid re-order; ``ann.ivf_flat`` runs it through
+      the ``ops.fine_scan_pallas`` kernels), plus the per-query
+      candidate-pool exact rescore that schedule pays
+      (``list_rescore_bytes``). With ``list_sizes``/``padded_sizes``
+      (the index's ACTUAL list-size histogram) the streamed-list
+      expectation uses size-biased probe probabilities and the
+      per-chunk union of probed lists — balanced k-means reduces but
+      does not eliminate skew, and the :func:`choose_fine_scan`
+      crossover depends on it; without them the legacy uniform
+      mean-window model applies;
+    - ``fine_gather_bytes``: what the query-major XLA gather schedule
+      reads — each query re-fetches its own probe windows, the exact
+      nq× re-read pathology the PR-3 work removed from brute force
+      (the committed frontier carries both numbers; their ratio is
+      ``gather_overread``, the factor the list-major kernel removes);
     - ``brute_bytes``: the stream-once fused pipeline's y traffic for
       the same batch (database streamed ONCE per _Q_CHUNK query chunk,
       bf16 hi+lo — the baseline this tier must beat);
@@ -375,14 +404,43 @@ def ivf_traffic_model(nq: int, m: int, d: int, k: int, n_lists: int,
     bpe = DB_DTYPE_BYTES[db_dtype]
     per_row_f32 = d_eff * 4 + 4 + 4
     per_row = d_eff * bpe + 4 + 4 + (8 if db_dtype == "int8" else 0)
-    probed_frac = min(1.0, float(n_probes) * probe_window
-                      / max(1, slab_rows))
     out_bytes = float(nq) * k * 8
     chunks = max(1, -(-nq // _Q_CHUNK))
+    nq_chunk = max(1, -(-nq // chunks))
+    if list_sizes is not None:
+        # the ACTUAL histogram: probe probability is size-biased (a
+        # query lands on a list roughly in proportion to its share of
+        # the rows — the balanced trainer narrows but never flattens
+        # the distribution), probed rows per query are the size-biased
+        # expected padded window, and the list-major stream is the
+        # expected per-chunk UNION of probed lists
+        sizes = [max(0.0, float(s)) for s in list_sizes]
+        padded = ([max(0.0, float(s)) for s in padded_sizes]
+                  if padded_sizes is not None
+                  else [-(-s // 8) * 8 for s in sizes])
+        tot = max(1.0, sum(sizes))
+        probed_rows = n_probes * sum(
+            s * w for s, w in zip(sizes, padded)) / tot
+        probed_frac = min(1.0, probed_rows / max(1, slab_rows))
+        stream_rows = 0.0
+        for s, w in zip(sizes, padded):
+            p_l = min(1.0, float(n_probes) * s / tot)
+            stream_rows += (1.0 - (1.0 - p_l) ** nq_chunk) * w
+        stream_rows = min(stream_rows, float(slab_rows))
+    else:
+        probed_frac = min(1.0, float(n_probes) * probe_window
+                          / max(1, slab_rows))
+        stream_rows = probed_frac * max(slab_rows, 1)
     rescore_bytes = (float(nq) * min(k + 32, n_probes * probe_window)
                      * d_eff * 4 if db_dtype == "int8" else 0.0)
-    fine_stream_bytes = (float(chunks) * probed_frac
-                         * max(slab_rows, 1) * per_row) + rescore_bytes
+    # the list-major schedule always exact-rescores its pooled
+    # candidates from the f32 slab (that is what keeps its ids
+    # bit-identical to the query-major oracle)
+    list_rescore_bytes = (float(nq)
+                          * min(_LIST_POOL, n_probes * probe_window)
+                          * d_eff * 4)
+    fine_stream_bytes = (float(chunks) * stream_rows * per_row
+                         + list_rescore_bytes)
     fine_gather_bytes = (float(nq) * n_probes * probe_window * per_row
                          + rescore_bytes)
     total_stream = coarse_bytes + fine_stream_bytes + out_bytes
@@ -397,6 +455,7 @@ def ivf_traffic_model(nq: int, m: int, d: int, k: int, n_lists: int,
         "fine_stream_bytes": fine_stream_bytes,
         "fine_gather_bytes": fine_gather_bytes,
         "rescore_bytes": rescore_bytes,
+        "list_rescore_bytes": list_rescore_bytes,
         "out_bytes": out_bytes,
         "total_bytes": total_stream,
         "total_gather_bytes": total_gather,

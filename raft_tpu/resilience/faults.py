@@ -99,9 +99,14 @@ KNOWN_SITES: Dict[str, Tuple[str, ...]] = {
     "kmeans_iteration": ("error",),
     "ivf_build": ("oom", "error"),
     "ivf_search": ("oom", "error"),
+    # the list-major fine-scan dispatch (ISSUE 14): a failure here —
+    # real or injected — must DEGRADE to the query-major scan with a
+    # logged degradation and identical returned ids, never surface
+    "fine_scan_list": ("error", "oom"),
     # tuners + persistent stores
     "autotune_fused": ("error",),
     "autotune_sharded": ("error",),
+    "autotune_fine_scan": ("error",),
     "tune_table_read": ("corrupt",),
     "plan_cache_read": ("corrupt",),
     # host-side comms

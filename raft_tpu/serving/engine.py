@@ -571,6 +571,19 @@ class ServingEngine:
             x0 = np.zeros((b, self.d), np.float32)
             vals, ids = self._plane(snap, x0)
             interruptible.synchronize(vals, ids)
+            if self._algorithm == "ivf_flat" and self._mutable is None:
+                # the IVF fine scan has TWO schedules (ISSUE 14): the
+                # bucket warmup above compiled whichever one the
+                # synthetic probe pattern resolved to; pre-compile the
+                # list-major programs for every schedule-cell rung this
+                # bucket can reach, so a live batch whose probe pattern
+                # flips the resolve_fine_scan crossover (or lands on a
+                # different cell rung) never pays a compile
+                from raft_tpu.ann.ivf_flat import warm_fine_scan
+
+                warm_fine_scan(
+                    self.res, snap.index, b, self.k,
+                    self._n_probes or snap.index.n_probes_default)
             emit_serving("warmup", bucket=b, generation=snap.generation)
         self._stats["warmed_buckets"] = len(self._ladder)
         self._stats["warmup_compiles"] += (

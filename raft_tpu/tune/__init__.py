@@ -9,14 +9,19 @@ consume)."""
 from raft_tpu.tune.fused import (TUNE_SCHEMA_VERSION, autotune_fused,
                                  candidate_space, validate_tune_table,
                                  write_tune_table)
+from raft_tpu.tune.ivf import (autotune_fine_scan, fine_scan_config,
+                               fine_scan_rows)
 from raft_tpu.tune.sharded import (autotune_sharded, sharded_config,
                                    sharded_candidate_space,
                                    sharded_time_model)
 
 __all__ = [
     "TUNE_SCHEMA_VERSION",
+    "autotune_fine_scan",
     "autotune_fused",
     "autotune_sharded",
+    "fine_scan_config",
+    "fine_scan_rows",
     "candidate_space",
     "sharded_candidate_space",
     "sharded_config",

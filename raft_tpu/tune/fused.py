@@ -43,12 +43,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from raft_tpu.observability import instrument
 from raft_tpu.resilience import fault_point
 
-# schema 4 (this build): rows/winners carry ``db_dtype`` and winners
-# are also keyed per-(passes, dtype) under ``best_by_passes_dtype``.
-# Committed schema-3 tables (incl. the measured v5e one) load
-# unchanged: rows without db_dtype are bf16, ``best_by_passes`` keeps
-# its bare-passes keys.
-TUNE_SCHEMA_VERSION = 4
+# schema 5 (this build): the table may carry a top-level ``fine_scan``
+# column — per-(n_lists, n_probes) IVF fine-scan schedule rows written
+# by :mod:`raft_tpu.tune.ivf` and read by
+# ``ann.ivf_flat.resolve_fine_scan``. Schema-4 additions (db_dtype
+# rows/winners under ``best_by_passes_dtype``) unchanged. Committed
+# schema ≤ 4 tables (incl. the measured v5e one) load unchanged: no
+# fine_scan column simply means the cost-model crossover decides.
+TUNE_SCHEMA_VERSION = 5
 
 # counter: tuned-table loads that degraded to built-in defaults, with a
 # reason label ("tune.table_degraded" in the metrics docs) — the silent
@@ -222,6 +224,17 @@ def validate_tune_table(tbl) -> List[str]:
             for key in ("T", "Qb", "g"):
                 if not isinstance(row.get(key), int):
                     errors.append(f"rows[{i}].{key} missing/non-int")
+    fs = tbl.get("fine_scan")
+    if fs is not None:
+        if not isinstance(fs, list):
+            errors.append("fine_scan is not a list")
+        else:
+            for i, row in enumerate(fs):
+                if not (isinstance(row, dict)
+                        and isinstance(row.get("n_lists"), int)
+                        and isinstance(row.get("n_probes"), int)
+                        and row.get("fine_scan") in ("query", "list")):
+                    errors.append(f"fine_scan[{i}] malformed")
     for key in ("best", "best_by_passes", "best_by_passes_dtype"):
         entry = tbl.get(key)
         if entry is None:

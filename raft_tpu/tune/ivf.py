@@ -1,0 +1,142 @@
+"""IVF fine-scan schedule autotuner — the schema-5 ``fine_scan``
+column of the tune table.
+
+``autotune_fine_scan`` sweeps ``(n_lists, n_probes)`` geometries for
+an index shape and records, per point, the modeled bytes of BOTH
+fine-scan schedules (query-major gather vs list-major stream —
+:func:`raft_tpu.observability.costmodel.ivf_traffic_model` on the
+actual list-size histogram when one is provided) and the winning
+schedule. Off-TPU the sweep is the deterministic model ranking
+(``measured: false``), exactly like :mod:`raft_tpu.tune.fused`'s
+fallback; a TPU round replaces the modeled winners with measured ones
+by timing both schedules through ``search_ivf_flat(fine_scan=...)``.
+
+The rows land under the tune table's top-level ``fine_scan`` key
+(TUNE_FUSED.json, schema 5 — schema ≤ 4 tables simply have no such
+column and every reader falls back to the cost-model crossover).
+``fine_scan_config`` is the loader ``ann.ivf_flat.resolve_fine_scan``
+consults: corrupt/absent/mismatched tables degrade to ``None`` (cost
+model decides) with the shared ``table_degraded`` counter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from raft_tpu.observability import instrument
+from raft_tpu.resilience import fault_point
+
+_SCHEDULES = ("query", "list")
+
+# loader cache: path → (mtime, {(n_lists, n_probes): schedule})
+_cache: Dict[str, tuple] = {}
+
+
+def fine_scan_rows(shape: Sequence[int], lists: Sequence[int],
+                   list_sizes=None, padded_sizes=None,
+                   db_dtype: str = "f32") -> List[Dict]:
+    """The deterministic (model-ranked) sweep: one row per
+    (n_lists, n_probes) point with both schedules' modeled fine-scan
+    bytes and the crossover pick."""
+    from raft_tpu.observability.costmodel import (choose_fine_scan,
+                                                  ivf_traffic_model)
+
+    nq, m, d, k = (int(v) for v in shape[:4])
+    rows: List[Dict] = []
+    for L in lists:
+        L = int(L)
+        probe_window = max(8, -(-m // max(L, 1) // 8) * 8)
+        slab_rows = probe_window * L
+        p = 1
+        probe_pts = []
+        while p < L:
+            probe_pts.append(p)
+            p *= 2
+        for P in probe_pts:
+            model = ivf_traffic_model(
+                nq, m, d, k, L, P, probe_window, slab_rows,
+                db_dtype=db_dtype, list_sizes=list_sizes,
+                padded_sizes=padded_sizes)
+            rows.append({
+                "n_lists": L,
+                "n_probes": P,
+                "db_dtype": db_dtype,
+                "fine_scan": choose_fine_scan(model),
+                "model_stream_bytes": model["fine_stream_bytes"],
+                "model_gather_bytes": model["fine_gather_bytes"],
+                "gather_overread": round(model["gather_overread"], 3),
+            })
+    return rows
+
+
+@instrument("tune.autotune_fine_scan")
+def autotune_fine_scan(shape: Sequence[int],
+                       lists: Sequence[int] = (1024,),
+                       list_sizes=None, padded_sizes=None,
+                       db_dtype: str = "f32") -> List[Dict]:
+    """Produce the ``fine_scan`` rows for a tune table. Deterministic
+    (model-ranked) everywhere today — the modeled crossover IS the
+    chooser's production logic; a measured TPU round appends
+    ``seconds_query``/``seconds_list`` per row and flips ``fine_scan``
+    to the measured winner (the loader treats both alike)."""
+    fault_point("autotune_fine_scan")
+    return fine_scan_rows(shape, lists, list_sizes, padded_sizes,
+                          db_dtype)
+
+
+def _load_rows(path: str) -> Optional[Dict]:
+    """{(n_lists, n_probes): schedule} from a table's ``fine_scan``
+    rows, or None when the table has none / is unreadable (counted
+    through the shared degrade path when it LOOKS like a table but
+    cannot be used)."""
+    from raft_tpu.tune.fused import table_degraded
+
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    cached = _cache.get(path)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
+    try:
+        with open(path) as f:
+            tbl = json.load(f)
+    except (OSError, ValueError) as e:
+        table_degraded("fine_scan", "unreadable", str(e)[:120])
+        return None
+    rows = tbl.get("fine_scan") if isinstance(tbl, dict) else None
+    out: Dict = {}
+    if isinstance(rows, list):
+        for row in rows:
+            if not isinstance(row, dict):
+                table_degraded("fine_scan", "row_rejected",
+                               "non-object row")
+                continue
+            sched = row.get("fine_scan")
+            L, P = row.get("n_lists"), row.get("n_probes")
+            if sched in _SCHEDULES and isinstance(L, int) \
+                    and isinstance(P, int):
+                out[(L, P)] = sched
+            else:
+                table_degraded("fine_scan", "row_rejected",
+                               f"bad row {row}"[:120])
+    _cache[path] = (mtime, out)
+    return out
+
+
+def fine_scan_config(n_lists: int, n_probes: int) -> Optional[str]:
+    """The tuned fine-scan schedule for an exact (n_lists, n_probes)
+    geometry, or None (caller falls to the cost-model crossover).
+    Reads the same table ``fused_config`` does — the committed
+    ``TUNE_FUSED.json`` or the ``RAFT_TPU_TUNE_FUSED`` override."""
+    from raft_tpu.core import env
+    from raft_tpu.native import _REPO_ROOT
+
+    path = env.raw("RAFT_TPU_TUNE_FUSED") or os.path.join(
+        _REPO_ROOT, "TUNE_FUSED.json")
+    rows = _load_rows(path)
+    if not rows:
+        return None
+    return rows.get((int(n_lists), int(n_probes)))

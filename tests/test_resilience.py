@@ -399,6 +399,11 @@ def _always_raise_drivers():
                 g=2, grid_order="db", db_dtype="int8"),
         "ivf_build": _drive_ivf_build,
         "ivf_search": _drive_ivf_search,
+        # fine-scan schedule autotuner: deterministic model sweep
+        "autotune_fine_scan": lambda: __import__(
+            "raft_tpu.tune.ivf",
+            fromlist=["autotune_fine_scan"]).autotune_fine_scan(
+                shape=(8, 64, 8, 2), lists=(4,)),
         "serving_enqueue": _drive_serving_enqueue,
         # mutable indexes: ingest / tombstone / compaction fold — each
         # site fires before any state change, so the shared index stays
@@ -416,6 +421,9 @@ def _always_raise_drivers():
         "sharded_dispatch": None,      # dedicated ladder tests below
         "merge_permute": None,
         "merge_allgather": None,
+        # list-major fine scan DEGRADES to query-major instead of
+        # raising — dedicated id-parity test in tests/test_fine_scan.py
+        "fine_scan_list": None,
         "tune_table_read": None,       # corrupt-kind tests below
         "plan_cache_read": None,
         # serving flush/snapshot: dedicated batch/swap injection tests

@@ -64,6 +64,10 @@ RECOVERY_NAME = "BENCH_RECOVERY.json"
 # is platform-independent math, so the trend gates modeled rounds too —
 # only the ms columns are speed and measured-only)
 ANN_RECALL_SLACK = 0.02
+#: relative slack on the ANN fine-scan overread trend: the newest
+#: round's best modeled list-major overread win may not fall more than
+#: this fraction below the previous comparable round's (ISSUE 14)
+ANN_OVERREAD_SLACK = 0.2
 BASELINE_NAME = "BENCH_LAST_GOOD.json"
 DRIFT_LEDGER_NAME = "DRIFT_LEDGER.json"
 DEFAULT_THRESHOLD = 0.15   # 15% relative drop (or slowdown) fails
@@ -488,6 +492,35 @@ def _ann_best_recall(rec: Dict) -> Optional[float]:
     return max(rs) if rs else None
 
 
+def _ann_fine_scan_check(rec: Dict):
+    """(error, best_overread) for a round's fine-scan evidence: every
+    frontier point the chooser scheduled list-major must realize the
+    recorded ``gather_overread`` win (modeled stream bytes ≤ gather
+    bytes / overread), and ``best_overread`` is the round's largest
+    such win (None when the round predates the fine-scan columns)."""
+    best = None
+    for p in rec.get("frontier", []) or []:
+        if not isinstance(p, dict) or p.get("fine_scan") != "list":
+            continue
+        sb = p.get("model_stream_bytes")
+        gb = p.get("model_gather_bytes")
+        ovr = p.get("gather_overread")
+        if not all(isinstance(v, (int, float)) and v > 0
+                   for v in (sb, gb, ovr)):
+            continue
+        if sb > gb / ovr * 1.001:
+            return (
+                f"ANN FINE-SCAN BYTES VIOLATION: frontier point "
+                f"n_lists={p.get('n_lists')} n_probes="
+                f"{p.get('n_probes')} chose the list-major schedule "
+                f"but its modeled stream bytes {sb:g} exceed "
+                f"gather/overread = {gb / ovr:g} — the artifact "
+                f"records an overread win the schedule does not "
+                f"realize"), None
+        best = ovr if best is None else max(best, ovr)
+    return None, best
+
+
 def check_ann(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
               threshold: float = DEFAULT_THRESHOLD) -> Tuple[str, str]:
     """Gate the ANN speed/recall frontier (BENCH_ANN / ANN_r*):
@@ -501,6 +534,11 @@ def check_ann(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
     - **degenerate-exact invariant**: the ``n_probes = n_lists`` sweep
       point must have matched the brute-force oracle's id sets
       (``degenerate_exact: true``);
+    - **fine-scan schedule** (ISSUE 14): list-major frontier points
+      must realize the recorded ``gather_overread`` win (modeled
+      stream ≤ gather/overread), and the round's best overread win
+      must not fall more than ``ANN_OVERREAD_SLACK`` below the
+      previous comparable round's;
     - **recall trend**: best recall must not drop more than
       ``ANN_RECALL_SLACK`` absolute vs the previous comparable round;
     - **speed trend**: only MEASURED rounds gate search time — when the
@@ -539,6 +577,15 @@ def check_ann(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
             "ANN DEGENERATE-EXACT VIOLATION: the n_probes = n_lists "
             "sweep point did not match the brute-force oracle's id "
             "sets — probing everything must be exact search")
+    # fine-scan schedule gate (ISSUE 14): wherever the chooser picked
+    # the list-major schedule, its modeled bytes must realize the
+    # recorded gather_overread win (stream ≤ gather / overread), and
+    # the frontier's recorded overread ratio must not silently shrink
+    # vs the previous comparable round — the win BENCH_ANN.json exists
+    # to capture cannot regress unnoticed.
+    fine_err, fine_ovr = _ann_fine_scan_check(newest)
+    if fine_err:
+        return REGRESS, fine_err
     prev = None
     for _, _, rec in reversed(rounds[:-1]):
         if (rec is not None and not rec.get("skipped")
@@ -549,6 +596,8 @@ def check_ann(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
     msgs = [f"best recall@{newest.get('k', '?')} "
             f"{best:.4f}" if isinstance(best, (int, float))
             else "no recall points"]
+    if fine_ovr is not None:
+        msgs.append(f"list-major overread {fine_ovr:g}x")
     if prev is not None and isinstance(best, (int, float)):
         pbest = _ann_best_recall(prev)
         if pbest is not None and best < pbest - ANN_RECALL_SLACK:
@@ -557,6 +606,16 @@ def check_ann(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
                 f"< previous {pbest:.4f} − {ANN_RECALL_SLACK:g}")
         if pbest is not None:
             msgs.append(f"prev {pbest:.4f}")
+        _, prev_ovr = _ann_fine_scan_check(prev)
+        if (fine_ovr is not None and prev_ovr is not None
+                and fine_ovr < prev_ovr * (1.0 - ANN_OVERREAD_SLACK)):
+            return REGRESS, (
+                f"ANN FINE-SCAN OVERREAD TREND REGRESSION: the newest "
+                f"round's best modeled list-major overread win "
+                f"{fine_ovr:g}x fell more than "
+                f"{ANN_OVERREAD_SLACK:.0%} below the previous "
+                f"comparable round's {prev_ovr:g}x — the frontier "
+                f"shift the list-major kernel bought is eroding")
     if newest.get("measured") and prev is not None \
             and prev.get("measured"):
         sm, pm = newest.get("search_ms"), prev.get("search_ms")

@@ -63,6 +63,7 @@ HOT_PATHS: Dict[str, Sequence[str]] = {
     "raft_tpu/solver/linear_assignment.py": ("solve_lap",),
     "raft_tpu/tune/fused.py": ("autotune_fused",),
     "raft_tpu/tune/sharded.py": ("autotune_sharded",),
+    "raft_tpu/tune/ivf.py": ("autotune_fine_scan",),
     "raft_tpu/distance/knn_sharded.py": ("knn_fused_sharded",),
     "raft_tpu/serving/engine.py": ("execute_batch",),
     "raft_tpu/serving/snapshot.py": ("build_snapshot",),
@@ -169,6 +170,8 @@ EVENT_SITES: Dict[str, Sequence[str]] = {
                                    "emit_marker"),
     "raft_tpu/ann/ivf_flat.py": ("instrument", "fault_point",
                                  "emit_marker"),
+    # the fine-scan schedule autotuner (the schema-5 fine_scan column)
+    "raft_tpu/tune/ivf.py": ("instrument", "fault_point"),
     # the quantized index build: the quantize_index marker (per-build
     # Eq stats) rides next to the span + fault events
     "raft_tpu/distance/knn_fused.py": ("instrument", "fault_point",
@@ -227,6 +230,13 @@ KERNEL_VARIANTS: Dict[str, Tuple[Sequence[str], str]] = {
          "fused_l2_group_topk_packed_db_q8",
          "fused_l2_group_topk_packed_dbuf_q8"),
         "raft_tpu/distance/knn_fused.py"),
+    # the list-major IVF fine-scan family (ISSUE 14): stream each
+    # probed list once for all queries probing it; consumed by the
+    # ann tier's resolve_fine_scan "list" schedule
+    "raft_tpu/ops/fine_scan_pallas.py": (
+        ("fine_scan_list_major",
+         "fine_scan_list_major_q8"),
+        "raft_tpu/ann/ivf_flat.py"),
 }
 
 def _decorator_is_instrument(dec: ast.expr) -> bool:
