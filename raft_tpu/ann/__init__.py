@@ -8,7 +8,10 @@ wall is to read LESS of the database per query. IVF-Flat is the first
 rung: a balanced k-means coarse quantizer (raft_tpu.cluster) buckets
 the database into inverted lists, a query probes ``n_probes`` of them,
 and recall@k vs the bit-exact brute-force oracle becomes a tracked
-artifact next to GB/s (BENCH_ANN.json).)
+artifact next to GB/s (BENCH_ANN.json). IVF-PQ (ivf_pq.cuh lineage)
+is the compressed rung on top: per-subspace product-quantized codes
+cut the streamed bytes ~16–32× behind a certified exact f32 rescore,
+so 100M-class databases fit one chip's HBM budget.)
 """
 
 from raft_tpu.ann.ivf_flat import (DEFAULT_ROW_QUANTUM, FINE_SCANS,
@@ -16,16 +19,28 @@ from raft_tpu.ann.ivf_flat import (DEFAULT_ROW_QUANTUM, FINE_SCANS,
                                    build_ivf_flat, build_list_schedule,
                                    resolve_fine_scan, search_ivf_flat,
                                    shard_ivf_lists, warm_fine_scan)
+from raft_tpu.ann.ivf_pq import (PQ_SCANS, IvfPqIndex, build_ivf_pq,
+                                 pack_pq_codes, resolve_pq_scan,
+                                 search_ivf_pq, unpack_pq_codes,
+                                 warm_pq_scan)
 
 __all__ = [
     "DEFAULT_ROW_QUANTUM",
     "FINE_SCANS",
+    "PQ_SCANS",
     "IvfFlatIndex",
+    "IvfPqIndex",
     "ShardedIvfIndex",
     "build_ivf_flat",
+    "build_ivf_pq",
     "build_list_schedule",
+    "pack_pq_codes",
     "resolve_fine_scan",
+    "resolve_pq_scan",
     "search_ivf_flat",
+    "search_ivf_pq",
     "shard_ivf_lists",
+    "unpack_pq_codes",
     "warm_fine_scan",
+    "warm_pq_scan",
 ]

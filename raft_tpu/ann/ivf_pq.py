@@ -1,0 +1,714 @@
+"""IVF-PQ: the product-quantized compressed tier over the IVF slab.
+
+(ref: neighbors/ivf_pq.cuh — the reference ecosystem's flagship
+billion-vector index, migrated to cuVS as ``ivf_pq::build/search`` +
+its ``refine`` step. The int8 slab (PR 9) halves database bytes and
+the list-major fine scan (PR 14) kills the gather overread; product
+quantization is the ~16–32× rung: serving 100M–1B vectors from one
+chip's HBM means the scanned representation must shrink past what any
+scalar quantizer gives.)
+
+Index (:class:`IvfPqIndex`, built by :func:`build_ivf_pq`): the PR-8
+IVF-Flat padded ragged slab UNCHANGED (coarse balanced k-means, f32
+slab retained — it is the mandatory exact-rescore plane), plus the
+compressed sidecar packed into the same
+:class:`~raft_tpu.mutable.layout.IndexLayout` geometry:
+
+- ``pq_dim`` subspaces of width ``d / pq_dim``; per-subspace codebooks
+  of ``2^pq_bits`` codewords trained with the PR-8
+  :func:`~raft_tpu.cluster.kmeans_fit` on RESIDUALS to the coarse
+  centroid (the cuVS ``by_residual`` shape);
+- a codes slab ``[R, pq_dim]`` (8-bit, stored biased) or
+  ``[R, pq_dim/2]`` (4-bit, two codes per byte) laid out row-for-row
+  with the f32 slab, plus the 4-byte reconstructed-norm sidecar
+  ``‖ŷ‖²`` — the ONLY bytes the compressed scan streams;
+- per-subspace quantization-error bounds recorded at build
+  (generalizing the PR-9 per-group ``Eq`` argument: ``pq_eq_sub[s]``
+  envelopes every encoded row's subspace residual norm, and the
+  per-row/per-list roll-ups widen the completeness certificate).
+
+Search (:func:`search_ivf_pq`): coarse probe → the PR-14 list-major
+schedule (``build_list_schedule`` reused verbatim) → the
+:func:`~raft_tpu.ops.pq_scan_pallas.pq_scan_list_major` ADC kernel —
+per-query ``[pq_dim, 2^pq_bits]`` lookup tables computed on entry and
+held VMEM-resident while code blocks stream through the 2-slot DMA
+pipeline — → pooled candidates MANDATORILY exact-rescored from the
+f32 slab under a completeness certificate (pooled 3rd-min vs
+``θ + 2√θ·Eq + Eq²`` + the kernel-precision envelope). Certificate
+failures rerun the exact f32 scan, and the ``pq_scan`` fault site
+degrades any kernel failure to the f32/int8 query-major scan — so
+returned id sets NEVER degrade below the flat scan's, whatever the
+compression does to the approximate scores.
+
+``n_probes ≥ n_lists`` (or ``k`` past the probed capacity) degrades
+to certified-exact search over the f32 slab exactly like IVF-Flat —
+:class:`IvfPqIndex` IS an :class:`~raft_tpu.ann.ivf_flat.IvfFlatIndex`
+and inherits the whole degenerate/exact/layout machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import env
+from raft_tpu.core.error import DeadlineExceededError, expects
+from raft_tpu.core.resources import ensure_resources
+from raft_tpu.observability import instrument
+from raft_tpu.observability.quality import record_certificate
+from raft_tpu.observability.timeline import emit_marker
+from raft_tpu.resilience import fault_point
+from raft_tpu.resilience.policy import record_degradation
+
+from raft_tpu.ann.ivf_flat import (_FINE_TILE, _LIST_K_MAX,
+                                   _coarse_probe, _exact_search,
+                                   _fine_scan, _list_host,
+                                   _pad_kernel_operands,
+                                   _query_major_chunk, IvfFlatIndex,
+                                   build_ivf_flat, build_list_schedule)
+
+#: PQ schedule choices: "pq" = the list-major ADC kernel over the
+#: codes slab, "flat" = the uncompressed IVF-Flat fine scan (query- or
+#: list-major per its own chooser), "auto" = the resolve_pq_scan
+#: cost-model crossover. Env: RAFT_TPU_IVF_PQ_SCAN.
+PQ_SCANS = ("auto", "pq", "flat")
+
+#: multiplicative headroom on every recorded f32 error bound — covers
+#: the f32 norm/summation rounding between the recorded bound and the
+#: true (f64) round-trip error, same spirit as the PR-9 _Q8_ERR slack
+_PQ_EQ_HEADROOM = 1.0 + 2.0 ** -10
+#: additive headroom, scaled by the row/subspace magnitude: a row
+#: whose residual is EXACTLY a codeword records an f32 error of 0
+#: while the true round-trip still carries the f32 representation
+#: error of the reconstruction arithmetic (~ULPs of the magnitudes
+#: involved) — the relative term alone cannot cover a zero
+_PQ_EQ_ABS = 2.0 ** -16
+
+
+def _default_pq_dim(d: int) -> int:
+    """Largest divisor of ``d`` not exceeding ``d // 4`` — the 4-byte-
+    per-subspace default (~16× at 8-bit codes) that still tiles the
+    feature width exactly."""
+    target = max(1, d // 4)
+    for cand in range(target, 0, -1):
+        if d % cand == 0:
+            return cand
+    return 1
+
+
+def pack_pq_codes(codes, pq_bits: int):
+    """Host-side code packing: 8-bit codes store BIASED (code − 128)
+    int8 so the full 0..255 range fits; 4-bit codes pack two per byte
+    (low nibble = even subspace). Mirrors the kernel's
+    ``_decode_subspaces``."""
+    codes = np.asarray(codes, np.int64)
+    if pq_bits == 8:
+        return (codes - 128).astype(np.int8)
+    expects(codes.shape[1] % 2 == 0,
+            "pack_pq_codes: 4-bit packing needs an even pq_dim")
+    low = codes[:, 0::2]
+    high = codes[:, 1::2]
+    return (low | (high << 4)).astype(np.uint8).view(np.int8)
+
+
+def unpack_pq_codes(packed, pq_dim: int, pq_bits: int):
+    """Inverse of :func:`pack_pq_codes` (tests / the mutable plane)."""
+    packed = np.asarray(packed)
+    if pq_bits == 8:
+        return packed.astype(np.int64) + 128
+    vu = packed.view(np.uint8).astype(np.int64)
+    out = np.empty((packed.shape[0], pq_dim), np.int64)
+    out[:, 0::2] = vu % 16
+    out[:, 1::2] = vu // 16
+    return out
+
+
+class IvfPqIndex(IvfFlatIndex):
+    """IVF-Flat slab + the product-quantized sidecar. Inherits every
+    flat plane (degenerate-exact search, layout, schedule builder,
+    sharding geometry); adds the codebooks, the packed codes slab, the
+    reconstructed norms and the recorded error bounds."""
+
+    def __init__(self, *args, pq_dim: int = 0, pq_bits: int = 8,
+                 codebooks=None, codes=None, yy_pq=None,
+                 pq_eq_rows=None, pq_eq_sub=None, pq_eq_list=None,
+                 pq_rhat_list=None, **kw):
+        super().__init__(*args, **kw)
+        self.pq_dim = int(pq_dim)            # subspace count S
+        self.pq_bits = int(pq_bits)          # 4 or 8
+        self.codebooks = codebooks           # [S, K, dsub] f32
+        self.codes = codes                   # [R, S or S/2] int8 packed
+        self.yy_pq = yy_pq                   # [R, 1] f32 ‖ŷ‖² (pads 0)
+        self.pq_eq_rows = pq_eq_rows         # [R] f32 ‖y − ŷ‖ bound
+        self.pq_eq_sub = pq_eq_sub           # [S] f32 subspace envelope
+        self.pq_eq_list = pq_eq_list         # [L] f32 per-list max
+        self.pq_rhat_list = pq_rhat_list     # [L] f32 max ‖r̂‖ per list
+
+    @property
+    def dsub(self) -> int:
+        return self.d_orig // self.pq_dim
+
+    @property
+    def pq_k(self) -> int:
+        return 1 << self.pq_bits
+
+    @property
+    def code_bytes(self) -> int:
+        """Streamed code bytes per row."""
+        return self.pq_dim if self.pq_bits == 8 else self.pq_dim // 2
+
+    def __repr__(self):
+        return (f"IvfPqIndex(n_rows={self.n_rows}, "
+                f"n_lists={self.n_lists}, d={self.d_orig}, "
+                f"pq_dim={self.pq_dim}, pq_bits={self.pq_bits}, "
+                f"window={self.probe_window})")
+
+    def layout(self):
+        """The shared :class:`~raft_tpu.mutable.layout.IndexLayout`
+        with the PQ sidecar packed alongside the f32 slab — the codes
+        ride the same padded-ragged geometry every plane shares."""
+        lay = super().layout()
+        lay.pq_codes = self.codes
+        lay.pq_yy = self.yy_pq
+        lay.pq_eq_rows = self.pq_eq_rows
+        lay.pq_meta = {"pq_dim": self.pq_dim, "pq_bits": self.pq_bits,
+                       "codebooks": self.codebooks}
+        return lay
+
+
+@instrument("ann.build_ivf_pq")
+def build_ivf_pq(res, y, n_lists: int, pq_dim: Optional[int] = None,
+                 pq_bits: Optional[int] = None,
+                 n_probes: Optional[int] = None, max_iter: int = 10,
+                 pq_max_iter: int = 8, seed: int = 0,
+                 balanced: bool = True,
+                 row_quantum: Optional[int] = None,
+                 max_train_rows: Optional[int] = None,
+                 pq_train_rows: Optional[int] = None) -> IvfPqIndex:
+    """Build an :class:`IvfPqIndex` over ``y`` [m, d].
+
+    (ref: ivf_pq::build — coarse train, per-subspace codebooks on
+    residuals, encode.) The coarse stage IS :func:`~raft_tpu.ann.
+    build_ivf_flat` (balanced k-means + the padded ragged slab; the
+    f32 slab stays resident as the exact-rescore plane). Then, per
+    subspace ``s`` of width ``d / pq_dim``:
+
+    1. a ``2^pq_bits``-codeword codebook is trained with the PR-8
+       :func:`~raft_tpu.cluster.kmeans_fit` on a ≤ ``pq_train_rows``
+       sub-sample of the RESIDUALS ``y − c_assigned`` (default cap
+       ``max(32·2^pq_bits, 4096)``);
+    2. every slab row's residual subvector is assigned to its nearest
+       codeword (the fusedL2NN argmin sweep) → the packed codes slab;
+    3. the recorded error bounds: ``pq_eq_sub[s]`` = the max subspace
+       round-trip ``‖resid_s − cb_s[code]‖`` over the encoded rows
+       (× the ``(1 + 2⁻¹⁰)`` f32 headroom — the envelope the property
+       tests attack), ``pq_eq_rows`` the exact per-row ``‖y − ŷ‖``
+       and ``pq_eq_list`` its per-list max (the certificate inputs).
+
+    ``pq_bits`` defaults to ``RAFT_TPU_ANN_PQ_BITS`` (8). Carries the
+    ``pq_train`` fault site — a failing codebook train must surface at
+    build, never as a silently-flat index."""
+    from raft_tpu.cluster import kmeans_fit, kmeans_predict
+
+    res = ensure_resources(res)
+    y = np.asarray(y, np.float32)
+    m, d = y.shape
+    if pq_bits is None:
+        pq_bits = env.get("RAFT_TPU_ANN_PQ_BITS")
+    pq_bits = int(pq_bits)
+    expects(pq_bits in (4, 8),
+            "build_ivf_pq: pq_bits must be 4 or 8, got %d", pq_bits)
+    S = int(pq_dim) if pq_dim else _default_pq_dim(d)
+    expects(S >= 1 and d % S == 0,
+            "build_ivf_pq: pq_dim=%d must divide d=%d", S, d)
+    expects(pq_bits == 8 or S % 2 == 0,
+            "build_ivf_pq: 4-bit codes pack two per byte — pq_dim=%d "
+            "must be even", S)
+    K = 1 << pq_bits
+    expects(m >= K,
+            "build_ivf_pq: %d rows < 2^pq_bits = %d codewords — "
+            "shrink pq_bits or use IVF-Flat", m, K)
+    dsub = d // S
+
+    flat = build_ivf_flat(res, y, n_lists=n_lists, n_probes=n_probes,
+                          max_iter=max_iter, seed=seed,
+                          balanced=balanced, row_quantum=row_quantum,
+                          max_train_rows=max_train_rows)
+    L = flat.n_lists
+    padded = np.asarray(flat.padded_sizes)
+    gid = np.repeat(np.arange(L, dtype=np.int32), padded)
+    slab = np.asarray(flat.slab)
+    ids = np.asarray(flat.ids)
+    valid = ids >= 0
+    cents = np.asarray(flat.centroids)
+    resid = slab - cents[gid]                       # [R, d] residuals
+    R = slab.shape[0]
+
+    # --- per-subspace codebooks on the residual sub-sample ------------
+    fault_point("pq_train")
+    n_valid = int(valid.sum())
+    cap = pq_train_rows or max(32 * K, 4096)
+    vrows = np.nonzero(valid)[0]
+    if n_valid > cap:
+        rng = np.random.default_rng(seed + 17)
+        vrows = rng.choice(vrows, cap, replace=False)
+    train = resid[vrows]
+    expects(train.shape[0] >= K,
+            "build_ivf_pq: %d valid rows < %d codewords", n_valid, K)
+    codebooks = np.zeros((S, K, dsub), np.float32)
+    codes = np.zeros((R, S), np.int32)
+    for s in range(S):
+        sub = train[:, s * dsub:(s + 1) * dsub]
+        km = kmeans_fit(res, sub, K, max_iter=pq_max_iter,
+                        seed=seed + 101 + s, balanced=False)
+        codebooks[s] = np.asarray(km.centroids)
+        codes[:, s] = np.asarray(kmeans_predict(
+            res, km.centroids, resid[:, s * dsub:(s + 1) * dsub]))
+
+    # --- reconstruction + the recorded error envelopes ----------------
+    recon = cents[gid].copy()
+    for s in range(S):
+        recon[:, s * dsub:(s + 1) * dsub] += codebooks[s][codes[:, s]]
+    err = (slab - recon) * valid[:, None].astype(np.float32)
+    # magnitude scales for the additive float-arithmetic headroom
+    mag_sub = (np.sqrt(np.sum(slab.reshape(R, S, dsub) ** 2, axis=2))
+               + np.sqrt(np.sum(recon.reshape(R, S, dsub) ** 2,
+                                axis=2))) * valid[:, None]
+    mag_row = (np.sqrt(np.sum(slab ** 2, axis=1))
+               + np.sqrt(np.sum(recon ** 2, axis=1))) * valid
+    e_sub = np.sqrt(np.maximum(
+        np.sum(err.reshape(R, S, dsub) ** 2, axis=2), 0.0))
+    eq_sub = ((e_sub.max(axis=0) if R else np.zeros(S))
+              * _PQ_EQ_HEADROOM
+              + _PQ_EQ_ABS * (mag_sub.max(axis=0) if R
+                              else np.zeros(S)))
+    eq_rows = (np.sqrt(np.maximum(np.sum(err ** 2, axis=1), 0.0))
+               * _PQ_EQ_HEADROOM + _PQ_EQ_ABS * mag_row)
+    # per-list certificate sidecars: the max row error bound and the
+    # max reconstructed-RESIDUAL norm (the ADC kernel's hi/lo split
+    # error scales with ‖x‖·‖r̂‖, so the envelope stays tight even for
+    # data living far from the origin)
+    rhat = recon - cents[gid]
+    rhat_norm = np.sqrt(np.maximum(np.sum(rhat * rhat, axis=1), 0.0)) \
+        * valid.astype(np.float32)
+    eq_list = np.zeros(L, np.float32)
+    rhat_list = np.zeros(L, np.float32)
+    offs = np.asarray(flat.offsets)
+    for l in range(L):
+        w = int(padded[l])
+        if w:
+            eq_list[l] = eq_rows[int(offs[l]):int(offs[l]) + w].max()
+            rhat_list[l] = rhat_norm[int(offs[l]):int(offs[l])
+                                     + w].max()
+    yy_pq = np.where(valid, np.sum(recon * recon, axis=1), 0.0)
+
+    idx = IvfPqIndex(
+        centroids=flat.centroids, slab=flat.slab, ids=flat.ids,
+        yy_slab=flat.yy_slab, offsets=flat.offsets, sizes=flat.sizes,
+        padded_sizes=flat.padded_sizes, n_rows=m, d_orig=d,
+        row_quantum=flat.row_quantum,
+        n_probes_default=flat.n_probes_default, Qb=flat.Qb,
+        kmeans_iters=flat.kmeans_iters, balanced=balanced,
+        pq_dim=S, pq_bits=pq_bits,
+        codebooks=jnp.asarray(codebooks),
+        codes=jnp.asarray(pack_pq_codes(codes, pq_bits)),
+        yy_pq=jnp.asarray(yy_pq.astype(np.float32).reshape(R, 1)),
+        pq_eq_rows=jnp.asarray(eq_rows.astype(np.float32)),
+        pq_eq_sub=np.asarray(eq_sub, np.float32),
+        pq_eq_list=jnp.asarray(eq_list),
+        pq_rhat_list=jnp.asarray(rhat_list))
+    emit_marker("pq_build", n_rows=m, n_lists=L, pq_dim=S,
+                pq_bits=pq_bits,
+                code_bytes_per_row=idx.code_bytes,
+                eq_row_max=round(float(eq_rows.max()) if R else 0.0, 6),
+                eq_sub_max=round(float(eq_sub.max()), 6),
+                compression=round(4.0 * d / (idx.code_bytes + 4), 2))
+    return idx
+
+
+# ------------------------------------------------------------- search
+def _pq_certify(bound, theta, widen):
+    """certified ⇔ no probed row outside the 256-slot pool can beat
+    the exact k-th value once the scores are widened by the recorded
+    quantization envelope + the kernel-precision term (the PR-9
+    violator-exclusion argument over the PQ reconstruction ŷ).
+    Module-level so the certificate-failure tests can force the rerun
+    path."""
+    return bound >= theta + widen
+
+
+def _pq_pool_finish(x, xx, rows, slab, ids, yy_slab, starts_qm, psizes,
+                    k: int, P: int, W: int):
+    """Exact-rescore the pooled candidate rows from the f32 slab with
+    the query-major scorer's own formula, reorder into query-major
+    candidate order (probe slot × window column — ties break exactly
+    like :func:`~raft_tpu.ann.ivf_flat._fine_scan`) and select top-k.
+    Unlike the flat `_pool_finish`, rows whose id is MASKED (−1 —
+    tombstones on the mutable plane) score +inf: the codes slab keeps
+    serving after a delete without a repack."""
+    valid = rows >= 0
+    rc = jnp.maximum(rows, 0)
+    cid = jnp.where(valid, jnp.take(ids, rc), -1)
+    valid = valid & (cid >= 0)
+    yc = jnp.take(slab, rc, axis=0)                    # [nq, C2, d]
+    d2 = (xx + jnp.take(yy_slab, rc)
+          - 2.0 * jnp.einsum("qd,qcd->qc", x, yc,
+                             precision=jax.lax.Precision.HIGHEST))
+    d2 = jnp.where(valid, jnp.maximum(d2, 0.0), jnp.inf)
+    w = rows[:, :, None] - starts_qm[:, None, :]       # [nq, C2, P]
+    match = ((w >= 0) & (w < psizes[:, None, :])
+             & valid[:, :, None])
+    slot = jnp.argmax(match, axis=2).astype(jnp.int32)
+    col = jnp.take_along_axis(w, slot[:, :, None], axis=2)[:, :, 0]
+    key = jnp.where(jnp.any(match, axis=2),
+                    slot * W + col.astype(jnp.int32), P * W)
+    order = jnp.argsort(key, axis=1)
+    d2s = jnp.take_along_axis(d2, order, axis=1)
+    cids = jnp.take_along_axis(cid, order, axis=1)
+    neg, pos = jax.lax.top_k(-d2s, k)
+    vals = -neg
+    out_ids = jnp.take_along_axis(cids, pos, axis=1)
+    return vals, jnp.where(jnp.isfinite(vals), out_ids, -1)
+
+
+def _pq_lut(x, codebooks, S: int, dsub: int):
+    """The per-query ADC table: ``lut[q, s·K + j] = x_{q,s} ·
+    cb_s[j]`` — f32 HIGHEST, flattened subspace-major for the kernel's
+    one-hot contraction."""
+    nq = x.shape[0]
+    xr = x.reshape(nq, S, dsub)
+    lut = jnp.einsum("qsd,skd->qsk", xr, codebooks,
+                     precision=jax.lax.Precision.HIGHEST)
+    return lut.reshape(nq, -1)
+
+
+def pq_scan_chunk(index: IvfPqIndex, xs, probes_np, pr, st, ps,
+                  k: int, P: int, W: int, ids=None):
+    """One list-major ADC chunk → (vals, ids, certified). ``ids``
+    overrides the slab id map (the mutable plane passes its tombstone-
+    masked ``ids_live``); the certificate compares against the same
+    masked oracle, so a failure's rerun returns identical id sets."""
+    from raft_tpu.ops.fine_scan_pallas import pad_window
+    from raft_tpu.ops.pq_scan_pallas import pq_scan_list_major
+
+    if ids is None:
+        ids = index.ids
+    nq, d = xs.shape
+    S, dsub = index.pq_dim, index.dsub
+    Wk = pad_window(W)
+    sched = build_list_schedule(index, probes_np)
+    xx = jnp.sum(xs * xs, axis=1, keepdims=True)
+    xp, pp, nqp = _pad_kernel_operands(xs, pr)
+    xxp = jnp.concatenate(
+        [xx, jnp.zeros((nqp - nq, 1), jnp.float32)]) if nqp > nq else xx
+    lut = _pq_lut(xp, index.codebooks, S, dsub)
+    lids = jnp.maximum(jnp.asarray(sched.sched[3]), 0)
+    cents = jnp.take(index.centroids, lids, axis=0)     # [Lp, d]
+    cdot = jnp.einsum("qd,ld->ql", xp, cents,
+                      precision=jax.lax.Precision.HIGHEST)
+    a1, i1, a2, i2, a3 = pq_scan_list_major(
+        jnp.asarray(sched.sched), xxp, pp, cdot, lut, index.codes,
+        index.yy_pq, Wk=Wk, pq_bits=index.pq_bits)
+    rows = jnp.concatenate([i1[:nq], i2[:nq]], axis=1)   # [nq, 256]
+    vals, out_ids = _pq_pool_finish(xs, xx, rows, index.slab, ids,
+                                    index.yy_slab, st, ps, k, P, W)
+    # completeness certificate: the recorded PQ envelope (per probed
+    # list) + the ADC kernel's numeric term over the score magnitudes
+    theta = vals[:, k - 1]
+    bound = jnp.min(a3[:nq], axis=1)
+    host = _list_host(index)
+    eq_w = jnp.max(jnp.take(index.pq_eq_list, pr), axis=1)
+    yymax = jnp.max(jnp.take(host["yy_lmax"], pr), axis=1)
+    rhat_w = jnp.max(jnp.take(index.pq_rhat_list, pr), axis=1)
+    # kernel-precision envelope: the ADC table's bf16 hi/lo two-pass
+    # split carries ≤ ~2⁻¹⁷ relative error per entry against a
+    # magnitude bounded by ‖x‖·‖r̂‖ (Cauchy-Schwarz over the subspace
+    # concatenation — the RESIDUAL norm, not the row norm, which is
+    # what keeps this tight for data far from the origin), plus the
+    # f32 adds/accumulation over the full score magnitude
+    xnorm = jnp.sqrt(xx[:, 0])
+    span = (xnorm + jnp.sqrt(yymax) + eq_w) ** 2
+    e_k = (2.0 ** -15 * xnorm * rhat_w
+           + (2.0 ** -20 + d * 2.0 ** -24) * span)
+    sq_t = jnp.sqrt(jnp.maximum(theta, 0.0))
+    widen = 2.0 * sq_t * eq_w + eq_w * eq_w + e_k
+    certified = _pq_certify(bound, theta, widen)
+    return vals, out_ids, certified
+
+
+def resolve_pq_scan(index: IvfPqIndex, nq: int, k: int, P: int, W: int,
+                    requested: Optional[str] = None,
+                    probes_np=None, chunk: Optional[int] = None) -> str:
+    """EFFECTIVE schedule for one :func:`search_ivf_pq` call — the
+    ``resolve_fine_scan``-style chooser. ``None`` reads
+    ``RAFT_TPU_IVF_PQ_SCAN`` (default ``auto``).
+
+    Envelope (outside it every request runs the flat scan, with a
+    logged downgrade for an explicit ``pq``): the slab must cover one
+    kernel window, ``k`` the 256-slot candidate pool, the probe count
+    the 128-lane probe table, the ADC cell the scoped-VMEM budget, and
+    on real TPUs the flattened table width ``pq_dim · 2^pq_bits`` must
+    be lane-aligned.
+
+    ``auto`` consults the schema-6 ``pq`` tune-table column
+    (:func:`raft_tpu.tune.ivf.pq_scan_config`) first, then the
+    cost-model crossover (:func:`~raft_tpu.observability.costmodel.
+    choose_pq_scan` over the pq-aware traffic model on the index's
+    actual list-size histogram)."""
+    from raft_tpu.observability.costmodel import (choose_pq_scan,
+                                                  ivf_traffic_model)
+    from raft_tpu.ops.fine_scan_pallas import pad_window
+    from raft_tpu.ops.fused_l2_topk_pallas import vmem_budget
+    from raft_tpu.ops.pq_scan_pallas import pq_scan_vmem_footprint
+    from raft_tpu.ops.utils import interpret_mode
+
+    req = requested if requested is not None \
+        else env.get("RAFT_TPU_IVF_PQ_SCAN")
+    if req not in PQ_SCANS:
+        raise ValueError(f"pq_scan must be one of {PQ_SCANS}, "
+                         f"got {req!r}")
+    if req == "flat":
+        return "flat"
+    Wk = pad_window(W)
+    S, K = index.pq_dim, index.pq_k
+    nqp = -(-min(nq, chunk or nq) // 8) * 8
+    from raft_tpu.ann.ivf_flat import _list_cells
+    from raft_tpu.ops.fine_scan_pallas import LISTS_PER_CELL
+
+    Lp = _list_cells(min(nq, chunk or nq) * P, index.n_lists) \
+        * LISTS_PER_CELL
+    reason = None
+    if index.slab_rows < Wk:
+        reason = f"slab rows {index.slab_rows} < kernel window {Wk}"
+    elif k > _LIST_K_MAX:
+        reason = f"k={k} > {_LIST_K_MAX} exceeds the candidate pool"
+    elif P > 128:
+        reason = f"n_probes={P} > 128 exceeds the probe table"
+    elif pq_scan_vmem_footprint(Wk, nqp, S, K, Lp,
+                                index.pq_bits) > vmem_budget():
+        reason = "ADC cell footprint over the scoped-VMEM budget"
+    elif not interpret_mode() and (S * K) % 128:
+        reason = (f"ADC table width {S}x{K} is not lane-aligned on a "
+                  f"real TPU")
+    if reason is not None:
+        if req == "pq":
+            from raft_tpu.core.logger import log_warn
+
+            log_warn("pq_scan='pq' outside the ADC envelope (%s) — "
+                     "using the flat scan for this call", reason)
+        return "flat"
+    if req == "pq":
+        return "pq"
+    # auto — tuned table first, then the cost-model crossover
+    from raft_tpu.tune.ivf import pq_scan_config
+
+    tuned = pq_scan_config(index.n_lists, P, index.pq_bits)
+    if tuned in ("pq", "flat"):
+        return tuned
+    model = ivf_traffic_model(
+        nq, index.n_rows, index.d_orig, k, index.n_lists, P, W,
+        index.slab_rows, list_sizes=index._np_sizes,
+        padded_sizes=index._np_padded, pq_dim=S,
+        pq_bits=index.pq_bits)
+    return choose_pq_scan(model)
+
+
+@instrument("ann.search_ivf_pq")
+def search_ivf_pq(res, index: IvfPqIndex, queries, k: int,
+                  n_probes: Optional[int] = None,
+                  pq_scan: Optional[str] = None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Approximate top-k against an :class:`IvfPqIndex`.
+
+    (ref: ivf_pq::search + its refine step — ADC over the compressed
+    lists, then exact re-ranking of the shortlist.) Returns (d2
+    [nq, k] ascending, global ids [nq, k]) like ``search_ivf_flat``;
+    the returned values are EXACT f32 distances (every candidate is
+    rescored from the retained f32 slab — the mandatory refine), and
+    the id set is certified identical to the flat scan's over the same
+    probe lists: a failed completeness certificate reruns the exact
+    f32 scan for that chunk, and a failed kernel dispatch (fault site
+    ``pq_scan``) degrades to the f32/int8 query-major scan with a
+    recorded degradation.
+
+    ``pq_scan`` ∈ :data:`PQ_SCANS` picks the schedule (``None`` reads
+    ``RAFT_TPU_IVF_PQ_SCAN``); ``n_probes ≥ n_lists`` (or ``k`` past
+    the probed capacity) degrades to certified-EXACT search exactly
+    like IVF-Flat."""
+    fault_point("ivf_search")
+    res = ensure_resources(res)
+    expects(isinstance(index, IvfPqIndex),
+            "search_ivf_pq: index must be an IvfPqIndex (got %s)",
+            type(index).__name__)
+    x = jnp.asarray(queries, jnp.float32)
+    expects(x.ndim == 2 and x.shape[1] == index.d_orig,
+            "search_ivf_pq: query width %s != index %d",
+            x.shape[1:], index.d_orig)
+    expects(k >= 1, "search_ivf_pq: k must be >= 1")
+    expects(k <= index.n_rows,
+            "search_ivf_pq: k=%d > index size %d", k, index.n_rows)
+    nq = x.shape[0]
+    if nq == 0:
+        return (jnp.zeros((0, k), jnp.float32),
+                jnp.zeros((0, k), jnp.int32))
+    L = index.n_lists
+    if n_probes is None:
+        from raft_tpu.ann.ivf_flat import _env_int
+
+        P = _env_int("RAFT_TPU_ANN_NPROBES", index.n_probes_default)
+    else:
+        P = int(n_probes)
+    expects(P >= 1, "search_ivf_pq: n_probes must be >= 1, got %d", P)
+    W = index.probe_window
+    reason = None
+    if P >= L:
+        reason = f"n_probes={P} >= n_lists={L}"
+    elif k > P * W:
+        reason = (f"k={k} exceeds the probed candidate capacity "
+                  f"{P}x{W}={P * W}")
+    if reason is not None:
+        from raft_tpu.core.logger import log_warn
+
+        log_warn("search_ivf_pq: %s — degrading to exact search over "
+                 "the f32 slab for this call", reason)
+        emit_marker("ivf_exact_degrade", reason=reason, k=k,
+                    n_probes=P, n_lists=L)
+        return _exact_search(res, index, x, k)
+
+    probes = _coarse_probe(res, index.centroids, x, P)       # [nq, P]
+    probes_host = np.asarray(probes)
+    starts = jnp.take(index.offsets[:-1], probes)
+    psizes = jnp.take(index.padded_sizes, probes)
+    d = x.shape[1]
+    chunk = max(8, _FINE_TILE // max(1, P * W * max(d, 1)))
+    schedule = resolve_pq_scan(index, nq, k, P, W, pq_scan,
+                               probes_np=probes_host, chunk=chunk)
+    emit_marker("ivf_pq_search", nq=nq, k=k, n_probes=P, n_lists=L,
+                pq_dim=index.pq_dim, pq_bits=index.pq_bits,
+                schedule=schedule)
+    if schedule == "pq":
+        try:
+            fault_point("pq_scan")
+            return _search_pq(res, index, x, probes, probes_host,
+                              starts, psizes, k, P, W, chunk)
+        except DeadlineExceededError:
+            raise               # the caller's global budget — never eaten
+        except Exception as e:
+            from raft_tpu.core.logger import log_warn
+
+            record_degradation("pq_scan", "flat")
+            emit_marker("pq_scan_degrade",
+                        reason=f"{type(e).__name__}: {e}"[:160])
+            log_warn("PQ ADC scan failed (%s: %s) — degrading to the "
+                     "flat fine scan for this call",
+                     type(e).__name__, e)
+    # the flat rung: the uncompressed f32 (or int8) fine scan — the
+    # degradation target and the chooser's "flat" pick share one path
+    if nq <= chunk:
+        return _query_major_chunk(index, x, starts, psizes, k, P, W)
+    outs = [_query_major_chunk(index, x[s:s + chunk],
+                               starts[s:s + chunk],
+                               psizes[s:s + chunk], k, P, W)
+            for s in range(0, nq, chunk)]
+    return (jnp.concatenate([o[0] for o in outs]),
+            jnp.concatenate([o[1] for o in outs]))
+
+
+def _search_pq(res, index: IvfPqIndex, x, probes, probes_host, starts,
+               psizes, k: int, P: int, W: int, chunk: int):
+    """The ADC driver: per chunk, run :func:`pq_scan_chunk` and rerun
+    any certificate-failing rows through the exact f32 scan — returned
+    id sets match the flat scan's over the same probes in EVERY
+    case."""
+    nq = x.shape[0]
+    try:
+        res.profiler.capture_fn(
+            "ann.pq_scan", _pq_lut, x[:min(nq, chunk)],
+            index.codebooks, index.pq_dim, index.dsub)
+    except Exception:
+        pass
+
+    def run_chunk(s0: int, s1: int):
+        xs, pr = x[s0:s1], probes[s0:s1]
+        st, ps = starts[s0:s1], psizes[s0:s1]
+        vals, ids_c, ok = pq_scan_chunk(index, xs, probes_host[s0:s1],
+                                        pr, st, ps, k, P, W)
+        n_fail = int(jnp.sum(~ok))
+        # same host sync the certified gather paths already pay — the
+        # PQ slice of the certificate/fixup evidence plane
+        record_certificate("ann.search_ivf_pq",
+                           n_queries=int(xs.shape[0]), n_fail=n_fail,
+                           pool_width=256, fixup_rows=n_fail or None,
+                           rerun=bool(n_fail), pq_bits=index.pq_bits,
+                           n_probes=P)
+        if n_fail:
+            # the true top-k (or a tie) may hide outside the pooled
+            # candidates: rerun the chunk through the exact f32 scan
+            # and keep certified rows — bytes saved stand, correctness
+            # never rides on the margin
+            emit_marker("pq_cert_fallback", n_fail=n_fail,
+                        nq=int(xs.shape[0]))
+            fv, fi = _fine_scan(xs, index.slab, index.ids,
+                                index.yy_slab, st, ps, k=k, P=P, W=W)
+            okc = ok[:, None]
+            vals = jnp.where(okc, vals, fv)
+            ids_c = jnp.where(okc, ids_c, fi)
+        return vals, ids_c
+
+    if nq <= chunk:
+        return run_chunk(0, nq)
+    outs = [run_chunk(s, min(s + chunk, nq))
+            for s in range(0, nq, chunk)]
+    return (jnp.concatenate([o[0] for o in outs]),
+            jnp.concatenate([o[1] for o in outs]))
+
+
+def warm_pq_scan(res, index: IvfPqIndex, nq: int, k: int,
+                 n_probes: int) -> int:
+    """Pre-compile every program a serving bucket of ``nq`` queries
+    can reach on the PQ plane: the flat fallback/degradation programs
+    (through the public entry, so the chunking and rerun programs warm
+    too) and one ADC program per power-of-two schedule-cell rung —
+    mirrors :func:`~raft_tpu.ann.ivf_flat.warm_fine_scan` so a live
+    request never pays a compile whichever way the chooser (or the
+    certificate) lands. Returns the ADC rung count (0 = outside the
+    ADC envelope)."""
+    from raft_tpu.ops.fine_scan_pallas import (LISTS_PER_CELL,
+                                               pad_window)
+    from raft_tpu.ops.pq_scan_pallas import pq_scan_list_major
+
+    P = min(max(1, int(n_probes)), index.n_lists)
+    if P >= index.n_lists or nq < 1:
+        return 0            # the degenerate-exact plane — one schedule
+    W = index.probe_window
+    Wk = pad_window(W)
+    d = index.d_orig
+    x0 = np.zeros((nq, d), np.float32)
+    out = search_ivf_pq(res, index, x0, k, n_probes=P, pq_scan="flat")
+    jax.block_until_ready(out)
+    if resolve_pq_scan(index, nq, k, P, W, "pq") != "pq":
+        return 0
+    chunk = max(8, _FINE_TILE // max(1, P * W * max(d, 1)))
+    sizes = sorted({min(nq, chunk), nq % chunk or min(nq, chunk)})
+    cap = max(1, -(-index.n_lists // LISTS_PER_CELL))
+    rungs = sorted({min(1 << b, cap)
+                    for b in range(cap.bit_length() + 1)})
+    S, K = index.pq_dim, index.pq_k
+    for nq_c in sizes:
+        nqp = -(-nq_c // 8) * 8
+        xx0 = jnp.zeros((nqp, 1), jnp.float32)
+        pp0 = jnp.full((nqp, 128), -2, jnp.int32)
+        lut0 = jnp.zeros((nqp, S * K), jnp.float32)
+        for cells in rungs:
+            Lp = cells * LISTS_PER_CELL
+            sched = np.zeros((4, Lp), np.int32)
+            sched[3, :] = -1
+            out = pq_scan_list_major(
+                jnp.asarray(sched), xx0, pp0,
+                jnp.zeros((nqp, Lp), jnp.float32), lut0, index.codes,
+                index.yy_pq, Wk=Wk, pq_bits=index.pq_bits)
+            jax.block_until_ready(out)
+    return len(rungs)

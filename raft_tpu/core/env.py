@@ -137,6 +137,14 @@ _knob("RAFT_TPU_IVF_FINE_SCAN", "enum", "auto",
       "IVF fine-scan schedule: query-major gather, list-major "
       "stream-once kernels, or the cost-model crossover",
       choices=("auto", "query", "list"))
+_knob("RAFT_TPU_IVF_PQ_SCAN", "enum", "auto",
+      "IVF-PQ schedule: the list-major ADC kernel over the codes "
+      "slab, the uncompressed flat fine scan, or the cost-model "
+      "crossover (read per call)",
+      choices=("auto", "pq", "flat"))
+_knob("RAFT_TPU_ANN_PQ_BITS", "int", 8,
+      "fleet default code width for build_ivf_pq callers that pass "
+      "none (4 or 8 bits per subspace code)")
 
 # -- mutable indexes / durability --------------------------------------
 _knob("RAFT_TPU_COMPACT_THRESHOLD", "int", 1024,

@@ -237,18 +237,20 @@ class MutableIndex:
                  g: Optional[int] = None, db_dtype: Optional[str] = None,
                  n_lists: Optional[int] = None,
                  n_probes: Optional[int] = None,
+                 pq_dim: Optional[int] = None,
+                 pq_bits: Optional[int] = None,
                  compact_threshold: Optional[int] = None,
                  delta_cap: Optional[int] = None,
                  auto_compact: bool = True,
                  durable_dir: Optional[str] = None,
                  wal_sync: Optional[str] = None):
-        from raft_tpu.ann import IvfFlatIndex
+        from raft_tpu.ann import IvfFlatIndex, IvfPqIndex
         from raft_tpu.core.resources import ensure_resources
         from raft_tpu.distance.knn_fused import KnnIndex
 
-        expects(algorithm in ("brute", "ivf_flat"),
-                "MutableIndex: algorithm must be 'brute' or 'ivf_flat',"
-                " got %r", algorithm)
+        expects(algorithm in ("brute", "ivf_flat", "ivf_pq"),
+                "MutableIndex: algorithm must be 'brute', 'ivf_flat' "
+                "or 'ivf_pq', got %r", algorithm)
         expects(metric == "l2",
                 "MutableIndex: the mutation plane serves metric='l2' "
                 "only (the merge and the rebuild oracle are l2-space)")
@@ -260,6 +262,7 @@ class MutableIndex:
         self._build_kw = dict(passes=passes, metric=metric, T=T, Qb=Qb,
                               g=g)
         self._n_lists, self._n_probes = n_lists, n_probes
+        self._pq_dim, self._pq_bits = pq_dim, pq_bits
         self._threshold = (compact_threshold_default()
                            if compact_threshold is None
                            else max(8, int(compact_threshold)))
@@ -291,9 +294,11 @@ class MutableIndex:
             self._passes = index.passes
             m = index.n_rows
         elif isinstance(index, IvfFlatIndex):
-            expects(algorithm == "ivf_flat",
-                    "MutableIndex: an IvfFlatIndex serves "
-                    "algorithm='ivf_flat'")
+            want = ("ivf_pq" if isinstance(index, IvfPqIndex)
+                    else "ivf_flat")
+            expects(algorithm == want,
+                    "MutableIndex: a prepared %s serves algorithm=%r",
+                    type(index).__name__, want)
             expects(index.db_dtype == "f32",
                     "MutableIndex: the mutable IVF plane serves the f32"
                     " slab (int8 IVF stays frozen-index only)")
@@ -337,6 +342,15 @@ class MutableIndex:
 
     # -- construction ------------------------------------------------------
     def _build_index(self, y):
+        if self._algorithm == "ivf_pq":
+            from raft_tpu.ann import build_ivf_pq
+
+            n_lists = self._n_lists or max(
+                1, min(1024, int(round(y.shape[0] ** 0.5))))
+            return build_ivf_pq(self.res, y, n_lists=n_lists,
+                                pq_dim=self._pq_dim,
+                                pq_bits=self._pq_bits,
+                                n_probes=self._n_probes)
         if self._algorithm == "ivf_flat":
             from raft_tpu.ann import build_ivf_flat
 
@@ -846,6 +860,34 @@ def _pad_pool(vals, ids, k: int):
             [ids, jnp.full((nq, pad), -1, jnp.int32)], axis=1))
 
 
+def _mutable_ivf_chunk(base, ids_live, xs, pr, st, ps, k: int, P: int,
+                       W: int):
+    """One tombstone-masked base-plane IVF chunk: the flat probe
+    gather with the masked slab ids, or — on a PQ base — the ADC
+    codes-slab scan with the same masked ids (a tombstone masks the
+    CODES slab without a repack: the pooled candidate simply rescores
+    to +inf, and a certificate failure reruns the equally-masked f32
+    scan, so a deleted row can never resurface either way)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.ann.ivf_flat import _fine_scan
+    from raft_tpu.ann.ivf_pq import IvfPqIndex, pq_scan_chunk
+
+    if isinstance(base, IvfPqIndex):
+        vals, gids, ok = pq_scan_chunk(base, xs, np.asarray(pr), pr,
+                                       st, ps, k, P, W, ids=ids_live)
+        n_fail = int(jnp.sum(~ok))
+        if n_fail:
+            fv, fi = _fine_scan(xs, base.slab, ids_live, base.yy_slab,
+                                st, ps, k=k, P=P, W=W)
+            okc = ok[:, None]
+            vals = jnp.where(okc, vals, fv)
+            gids = jnp.where(okc, gids, fi)
+        return vals, gids
+    return _fine_scan(xs, base.slab, ids_live, base.yy_slab, st, ps,
+                      k=k, P=P, W=W)
+
+
 def _search_base(view: MutableView, x, k: int, exact: bool,
                  n_probes: Optional[int], res):
     """Top-k over the (tombstone-masked) base plane → (vals, EXTERNAL
@@ -859,8 +901,7 @@ def _search_base(view: MutableView, x, k: int, exact: bool,
         L = base.n_lists
         P = int(n_probes) if n_probes else base.n_probes_default
         if P < L:
-            from raft_tpu.ann.ivf_flat import (_FINE_TILE, _coarse_probe,
-                                               _fine_scan)
+            from raft_tpu.ann.ivf_flat import _FINE_TILE, _coarse_probe
 
             W = base.probe_window
             if k <= P * W:
@@ -871,10 +912,10 @@ def _search_base(view: MutableView, x, k: int, exact: bool,
                 chunk = max(8, _FINE_TILE // max(1, P * W * max(d, 1)))
                 outs = []
                 for s in range(0, x.shape[0], chunk):
-                    v, g = _fine_scan(
-                        x[s:s + chunk], base.slab, view.ids_live,
-                        base.yy_slab, starts[s:s + chunk],
-                        psizes[s:s + chunk], k=k, P=P, W=W)
+                    v, g = _mutable_ivf_chunk(
+                        base, view.ids_live, x[s:s + chunk],
+                        probes[s:s + chunk], starts[s:s + chunk],
+                        psizes[s:s + chunk], k, P, W)
                     outs.append((v, g))
                 vals = jnp.concatenate([o[0] for o in outs])
                 gids = jnp.concatenate([o[1] for o in outs])

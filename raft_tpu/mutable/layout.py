@@ -61,12 +61,15 @@ class IndexLayout:
 
     __slots__ = ("slab", "ids", "rows_valid", "offsets", "sizes",
                  "padded_sizes", "row_quantum", "d_orig", "n_rows",
-                 "db_dtype", "slab_q", "row_scale", "eq_rows")
+                 "db_dtype", "slab_q", "row_scale", "eq_rows",
+                 "pq_codes", "pq_yy", "pq_eq_rows", "pq_meta")
 
     def __init__(self, slab, ids, rows_valid, n_rows: int, d_orig: int,
                  offsets=None, sizes=None, padded_sizes=None,
                  row_quantum: int = ROW_QUANTUM, db_dtype: str = "f32",
-                 slab_q=None, row_scale=None, eq_rows=None):
+                 slab_q=None, row_scale=None, eq_rows=None,
+                 pq_codes=None, pq_yy=None, pq_eq_rows=None,
+                 pq_meta=None):
         self.slab = slab
         self.ids = ids
         self.rows_valid = rows_valid
@@ -80,6 +83,14 @@ class IndexLayout:
         self.slab_q = slab_q
         self.row_scale = row_scale
         self.eq_rows = eq_rows
+        # product-quantized sidecar (ann.ivf_pq — the compressed tier):
+        # the packed codes slab + reconstructed norms ride the SAME
+        # padded-ragged row geometry as the f32 slab, so tombstones /
+        # compaction treat them as one more per-row column
+        self.pq_codes = pq_codes
+        self.pq_yy = pq_yy
+        self.pq_eq_rows = pq_eq_rows
+        self.pq_meta = pq_meta
 
     @property
     def slab_rows(self) -> int:

@@ -336,6 +336,74 @@ def fused_traffic_record(Q: int, m: int, d: int, k: int,
 #: rescore and the masked-MXU work the bytes model does not price
 FINE_SCAN_MARGIN = 1.25
 
+#: the ADC kernel wins the PQ crossover only past this modeled
+#: flat/pq bytes ratio — margin for the LUT build, the one-hot MXU
+#: work and the mandatory pool rescore the bytes model prices only
+#: approximately
+PQ_SCAN_MARGIN = 1.25
+
+
+def pq_bytes_ratio(d: int, pq_dim: int, pq_bits: int) -> float:
+    """Modeled streamed-database-bytes ratio of the PQ codes slab over
+    the f32 slab for the same rows — the PQ tier's analog of
+    :func:`quantized_bytes_ratio` (slab stream only, sidecars excluded
+    on both sides, exactly like the int8 ratio compares y bytes).
+    1/16 at 8-bit codes with ``pq_dim = d/4``, 1/32 at 4-bit — the
+    number the bench artifacts stamp and ``bench_report --check``
+    gates at ≤ 0.10×."""
+    lanes = 128
+    d_eff = d + (-d) % lanes
+    code_bytes = pq_dim * pq_bits / 8.0
+    return code_bytes / max(d_eff * 4.0, 1.0)
+
+
+def pq_index_bytes(m: int, d: int, n_lists: int, pq_dim: int,
+                   pq_bits: int, pad_frac: float = 0.05) -> Dict:
+    """Modeled RESIDENT bytes of the compressed IVF-PQ tier for an
+    ``m × d`` database: the packed codes slab + the per-row norm/id
+    sidecar + the coarse centroids + the per-subspace codebooks — the
+    set the ADC scan actually touches, which is what must fit a
+    chip's HBM at the 100M-row scale (the f32 rescore slab is the
+    uncompressed tier: host- or peer-resident at that scale, streamed
+    only for the ~256-row candidate pools). ``pad_frac`` models the
+    ragged row-quantum padding."""
+    K = 1 << pq_bits
+    dsub = max(1, d // max(pq_dim, 1))
+    R = float(m) * (1.0 + max(0.0, pad_frac))
+    code_bytes = pq_dim * pq_bits / 8.0
+    codes = R * code_bytes
+    sidecar = R * (4 + 4)                      # ‖ŷ‖² + global id
+    coarse = float(n_lists) * d * 4
+    books = float(pq_dim) * K * dsub * 4
+    geometry = float(n_lists + 1) * 4 * 3
+    total = codes + sidecar + coarse + books + geometry
+    return {
+        "rows": int(m),
+        "d": int(d),
+        "pq_dim": int(pq_dim),
+        "pq_bits": int(pq_bits),
+        "codes_bytes": codes,
+        "sidecar_bytes": sidecar,
+        "coarse_bytes": coarse,
+        "codebook_bytes": books,
+        "total_bytes": total,
+        "f32_slab_bytes": R * d * 4.0,
+        "compression": (R * d * 4.0) / max(codes + sidecar, 1.0),
+    }
+
+
+def choose_pq_scan(model: Dict) -> str:
+    """The cost-model half of ``ann.ivf_pq.resolve_pq_scan``:
+    ``"pq"`` when the best FLAT schedule's modeled fine-scan bytes
+    beat the ADC stream by :data:`PQ_SCAN_MARGIN`, else ``"flat"``.
+    Takes an :func:`ivf_traffic_model` result carrying the pq keys."""
+    pq = model.get("pq_stream_bytes")
+    if not isinstance(pq, (int, float)) or pq <= 0:
+        return "flat"
+    flat = min(model.get("fine_stream_bytes", float("inf")),
+               model.get("fine_gather_bytes", float("inf")))
+    return "pq" if flat > PQ_SCAN_MARGIN * max(pq, 1.0) else "flat"
+
 #: per-query candidate pool the list-major kernels exact-rescore
 #: (2 × 128 lane-class slots — ops.fine_scan_pallas.POOL_WIDTH)
 _LIST_POOL = 256
@@ -355,7 +423,9 @@ def choose_fine_scan(model: Dict) -> str:
 def ivf_traffic_model(nq: int, m: int, d: int, k: int, n_lists: int,
                       n_probes: int, probe_window: int,
                       slab_rows: int, db_dtype: str = "f32",
-                      list_sizes=None, padded_sizes=None) -> Dict:
+                      list_sizes=None, padded_sizes=None,
+                      pq_dim: Optional[int] = None,
+                      pq_bits: Optional[int] = None) -> Dict:
     """Analytic HBM traffic of one IVF-Flat search batch
     (:mod:`raft_tpu.ann`) next to the brute-force bytes it displaces —
     the model behind BENCH_ANN.json's speed/recall frontier.
@@ -387,7 +457,15 @@ def ivf_traffic_model(nq: int, m: int, d: int, k: int, n_lists: int,
     - ``modeled_speedup``: brute_bytes / stream total — both pipelines
       are HBM-bound, so the bytes ratio IS the modeled speedup, and
       ``hbm_bw · speedup`` is the effective database-scan rate a
-      roofline-perfect chip would sustain.
+      roofline-perfect chip would sustain;
+    - with ``pq_dim``/``pq_bits`` (the IVF-PQ compressed tier,
+      ``ann.ivf_pq``): ``pq_stream_bytes`` prices the list-major ADC
+      schedule — packed code bytes + the 4-byte ``‖ŷ‖²`` sidecar per
+      streamed row, the per-chunk ADC table build (codebooks in, the
+      ``[nq, pq_dim·2^pq_bits]`` table out) and the mandatory 256-row
+      f32 pool rescore — and ``pq_bytes_ratio`` is the pure codes-vs-
+      f32 slab-stream ratio (:func:`pq_bytes_ratio`) the quantized
+      gate bounds at ≤ 0.10×.
     """
     from raft_tpu.distance.knn_fused import _Q_CHUNK
 
@@ -449,7 +527,29 @@ def ivf_traffic_model(nq: int, m: int, d: int, k: int, n_lists: int,
         + float(nq) * d_eff * 4
     fine_gather_f32 = (float(nq) * n_probes * probe_window
                        * per_row_f32)
+    pq_keys = {}
+    if pq_dim is not None and pq_bits is not None:
+        K = 1 << int(pq_bits)
+        dsub = max(1, d // max(int(pq_dim), 1))
+        code_bytes = int(pq_dim) * int(pq_bits) / 8.0
+        per_row_pq = code_bytes + 4 + 4        # codes + ‖ŷ‖² + id
+        adc_table_bytes = (float(chunks) * pq_dim * K * dsub * 4
+                           + float(nq) * pq_dim * K * 4 * 2)
+        pq_stream = (float(chunks) * stream_rows * per_row_pq
+                     + list_rescore_bytes + adc_table_bytes)
+        pq_total = coarse_bytes + pq_stream + out_bytes
+        pq_keys = {
+            "pq_dim": int(pq_dim),
+            "pq_bits": int(pq_bits),
+            "pq_stream_bytes": pq_stream,
+            "pq_total_bytes": pq_total,
+            "adc_table_bytes": adc_table_bytes,
+            "pq_bytes_ratio": pq_bytes_ratio(d, int(pq_dim),
+                                             int(pq_bits)),
+            "modeled_speedup_pq": brute_bytes / max(pq_total, 1.0),
+        }
     return {
+        **pq_keys,
         "db_dtype": db_dtype,
         "coarse_bytes": coarse_bytes,
         "fine_stream_bytes": fine_stream_bytes,

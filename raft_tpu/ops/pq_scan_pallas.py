@@ -1,0 +1,292 @@
+"""List-major IVF-PQ ADC scan kernel (Pallas/Mosaic).
+
+The compressed sibling of :mod:`raft_tpu.ops.fine_scan_pallas`: the
+grid walks the PROBED LISTS in the same 8-list cells over the same
+host-built schedule (``ann.ivf_flat.build_list_schedule`` — reused
+verbatim), but the streamed operand is the PRODUCT-QUANTIZED codes
+slab (~1/16 of the f32 bytes at 8-bit codes with ``pq_dim = d/4``,
+~1/32 at 4-bit) plus the 4-byte ``‖ŷ‖²`` reconstruction-norm sidecar,
+never the f32 rows.
+
+Scoring is asymmetric-distance computation (ADC) by TABLE LOOKUP, the
+classic IVF-PQ structure (ref: neighbors/ivf_pq.cuh / cuVS
+``ivf_pq::search``) re-shaped for the MXU:
+
+- the per-query lookup table ``lut [nqp, pq_dim·K]`` holds every
+  query-to-codeword dot product ``x_s · cb_s[j]`` (``K = 2^pq_bits``)
+  — computed ONCE on entry by the caller and held VMEM-RESIDENT for
+  the whole cell sweep (the "in-VMEM ADC" of the issue);
+- a streamed code block decodes to one-hot lanes (``code == iota`` —
+  exact 0/1 in bf16) and ONE hi/lo-split MXU contraction against the
+  resident table evaluates every query's ADC sum for every row:
+  ``Σ_s lut[q, s, code[w, s]]`` — the gather becomes a matmul, which
+  is the only shape a TPU vector unit streams at full rate;
+- the residual-coding cross term ``x · c_list`` rides the resident
+  per-scheduled-list ``cdot [nqp, Lp]`` table (per query × probed
+  list — tiny next to the slab), so the folded score is exactly
+
+  ``d2(x, ŷ) = ‖x‖² + ‖ŷ‖² − 2·x·c_l − 2·Σ_s x_s·cb_s[code_{w,s}]``
+
+  against the RECONSTRUCTED row ``ŷ = c_l + concat_s cb_s[code]``.
+
+Masks, pools and outputs are the fine-scan contract unchanged: probe-
+table membership + window-column masks to the never-wins +inf, scores
+fold into the per-query 128-lane-class top-2 pools with global slab
+rows and the running 3rd-min certificate input. The caller
+(``ann.ivf_pq``) exact-rescores the pooled candidates from the
+retained f32 slab and certifies completeness with the recorded
+per-subspace quantization bounds — failed queries rerun the exact f32
+scan, so returned ids never degrade (see ``search_ivf_pq``).
+
+4-bit codes stream PACKED (two codes per byte, low nibble = even
+subspace) and unpack in-register — the HBM stream is the honest
+``pq_dim/2`` bytes per row the cost model prices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.ops.fine_scan_pallas import (LISTS_PER_CELL, _fold_pool,
+                                           _pool_out_shape, _split_hi_lo)
+from raft_tpu.ops.utils import interpret_mode
+
+_LANES = 128
+_NT = (((1,), (1,)), ((), ()))
+
+#: supported code widths: 4-bit codes pack two per byte
+PQ_BITS = (4, 8)
+
+
+def pq_scan_vmem_footprint(Wk: int, nqp: int, pq_dim: int, K: int,
+                           Lp: int, pq_bits: int = 8) -> int:
+    """Estimated scoped-VMEM bytes of one PQ ADC cell: 2 DMA slots for
+    the code window (+ the f32 norm sidecar), the resident ADC table
+    (f32 + its bf16 hi/lo split), the resident probe + centroid-dot
+    tables, the per-subspace one-hot staging block, ~3 live [nqp, Wk]
+    f32 score temporaries and the 5-buffer fold state. UNCALIBRATED —
+    conservative, same spirit as ``fine_scan_vmem_footprint``."""
+    code_bytes = pq_dim if pq_bits == 8 else -(-pq_dim // 2)
+    bytes_ = 2 * Wk * code_bytes                 # 2 code DMA slots
+    bytes_ += 2 * Wk * 4                         # 2 ‖ŷ‖² DMA slots
+    bytes_ += nqp * pq_dim * K * (4 + 2 + 2)     # lut f32 + hi/lo bf16
+    bytes_ += nqp * _LANES * 4                   # probe table
+    bytes_ += nqp * Lp * 4                       # per-list x·c table
+    bytes_ += Wk * pq_dim * K * 2                # one-hot staging (bf16)
+    bytes_ += 3 * nqp * Wk * 4                   # d2 + temporaries
+    bytes_ += 5 * nqp * _LANES * 4 * 2           # fold state + temps
+    return bytes_
+
+
+def _decode_subspaces(codes, pq_dim: int, pq_bits: int):
+    """Per-subspace int32 code columns of a streamed window. 8-bit
+    codes are stored BIASED (code − 128) so the full 0..255 range fits
+    int8; 4-bit codes are packed two per byte (low nibble = even
+    subspace) and unpack with pure arithmetic — no bitwise ops on the
+    possibly-negative int8 lanes."""
+    v = codes.astype(jnp.int32)
+    if pq_bits == 8:
+        return [v[:, s] + 128 for s in range(pq_dim)]
+    vu = jnp.where(v < 0, v + 256, v)
+    cols = []
+    for s in range(pq_dim):
+        byte = vu[:, s // 2]
+        cols.append(byte % 16 if s % 2 == 0 else byte // 16)
+    return cols
+
+
+def _adc_scores(lut_hi, lut_lo, codes, pq_dim: int, K: int,
+                pq_bits: int, Wk: int):
+    """``Σ_s lut[q, s, code[w, s]]`` for every (query, row) of one
+    window — the table gather evaluated as a one-hot MXU contraction
+    (one-hot lanes are exact in bf16, so only the hi/lo split of the
+    table itself carries rounding)."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (Wk, K), 1)
+    hot = []
+    for s, col in enumerate(_decode_subspaces(codes, pq_dim, pq_bits)):
+        hot.append((col[:, None] == iota).astype(jnp.bfloat16))
+    onehot = jnp.concatenate(hot, axis=1)          # [Wk, pq_dim·K]
+    acc = jax.lax.dot_general(lut_hi, onehot, _NT,
+                              preferred_element_type=jnp.float32)
+    acc = acc + jax.lax.dot_general(lut_lo, onehot, _NT,
+                                    preferred_element_type=jnp.float32)
+    return acc                                      # [nqp, Wk]
+
+
+def _pq_kernel_body(sched_ref, xx_ref, probes_ref, cdot_ref, lut_ref,
+                    codes_ref, yy_ref, a1_ref, i1_ref, a2_ref, i2_ref,
+                    a3_ref, *, Wk: int, pq_dim: int, K: int,
+                    pq_bits: int):
+    """One grid cell: stream LISTS_PER_CELL probed lists' code windows
+    (+ norm sidecars) through the 2-slot DMA pipeline, evaluate the
+    ADC scores against the resident lookup table, mask non-member
+    queries / out-of-window columns to +inf and fold into the
+    revisited per-query pools."""
+    s = pl.program_id(0)
+    nqp = xx_ref.shape[0]
+    inf = jnp.full((nqp, _LANES), jnp.inf, jnp.float32)
+    neg1 = jnp.full((nqp, _LANES), -1, jnp.int32)
+
+    @pl.when(s == 0)
+    def _():
+        a1_ref[...] = inf
+        i1_ref[...] = neg1
+        a2_ref[...] = inf
+        i2_ref[...] = neg1
+        a3_ref[...] = inf
+
+    def body(cscratch, yscratch, csem, ysem):
+        def dma(slot, j):
+            return (pltpu.make_async_copy(
+                codes_ref.at[pl.ds(sched_ref[0, j], Wk), :],
+                cscratch.at[slot], csem.at[slot]),
+                pltpu.make_async_copy(
+                    yy_ref.at[pl.ds(sched_ref[0, j], Wk), :],
+                    yscratch.at[slot], ysem.at[slot]))
+
+        def start(slot, j):
+            for cp in dma(slot, j):
+                cp.start()
+
+        def wait(slot, j):
+            for cp in dma(slot, j):
+                cp.wait()
+
+        j0 = s * LISTS_PER_CELL
+        start(0, j0)
+        xx = xx_ref[...]                                 # [nqp, 1]
+        probes = probes_ref[...]                         # [nqp, Pp]
+        cdot = cdot_ref[...]                             # [nqp, Lp]
+        lut_hi, lut_lo = _split_hi_lo(lut_ref[...])      # [nqp, S·K]
+        colv = jax.lax.broadcasted_iota(jnp.int32, (nqp, Wk), 1)
+        acc = (a1_ref[...], i1_ref[...], a2_ref[...], i2_ref[...],
+               a3_ref[...])
+        for jj in range(LISTS_PER_CELL):
+            j = j0 + jj
+            slot = jj % 2
+            if jj + 1 < LISTS_PER_CELL:
+                start((jj + 1) % 2, j + 1)           # prefetch next
+            wait(slot, j)
+            st = sched_ref[0, j]
+            lsize = sched_ref[1, j]
+            off = sched_ref[2, j]
+            lid = sched_ref[3, j]
+            adc = _adc_scores(lut_hi, lut_lo, cscratch[slot], pq_dim,
+                              K, pq_bits, Wk)
+            yyw = yscratch[slot].reshape(1, Wk)          # ‖ŷ‖² lanes
+            qc = jax.lax.dynamic_slice_in_dim(cdot, j, 1, 1)
+            d2 = xx + yyw - 2.0 * qc - 2.0 * adc
+            member = jnp.sum((probes == lid).astype(jnp.float32),
+                             axis=1, keepdims=True)      # [nqp, 1]
+            d2 = jnp.where(member > 0.0, d2, jnp.inf)
+            valid = (colv >= off) & (colv < off + lsize)
+            d2 = jnp.where(valid, d2, jnp.inf)
+            acc = _fold_pool(acc, d2, st, nqp, Wk)
+        a1_ref[...], i1_ref[...], a2_ref[...], i2_ref[...], \
+            a3_ref[...] = acc
+
+    code_bytes = pq_dim if pq_bits == 8 else pq_dim // 2
+    pl.run_scoped(
+        body,
+        cscratch=pltpu.VMEM((2, Wk, code_bytes), jnp.int8),
+        yscratch=pltpu.VMEM((2, Wk, 1), jnp.float32),
+        csem=pltpu.SemaphoreType.DMA((2,)),
+        ysem=pltpu.SemaphoreType.DMA((2,)))
+
+
+@functools.partial(jax.jit, static_argnames=("Wk", "pq_bits"))
+def pq_scan_list_major(sched, xx, probes, cdot, lut, codes, yy_pq,
+                       Wk: int, pq_bits: int = 8
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                  jax.Array, jax.Array]:
+    """List-major ADC scan over the product-quantized codes slab.
+
+    Args:
+      sched: [4, Lp] int32 schedule rows — exactly
+        ``ann.ivf_flat.build_list_schedule``'s output (window start,
+        real length, in-window offset, list id; pads ``(0,0,0,−1)``).
+      xx: [nqp, 1] exact f32 query squared norms (nqp a multiple of 8).
+      probes: [nqp, 128] int32 probe table (pads −2).
+      cdot: [nqp, Lp] f32 per-(query, scheduled list) centroid dot
+        products ``x · c_{lid(j)}`` (column j pairs with schedule
+        column j; pad-list columns are never read through the mask).
+      lut: [nqp, pq_dim·K] f32 ADC table — ``lut[q, s·K + j] =
+        x_{q,s} · cb_s[j]`` flattened subspace-major.
+      codes: [R, pq_dim] int8 biased codes (8-bit: stored code−128) or
+        [R, pq_dim/2] packed nibbles (4-bit).
+      yy_pq: [R, 1] f32 reconstructed row norms ``‖ŷ‖²`` (pads 0).
+      Wk: static window length, a multiple of 128.
+      pq_bits: 4 or 8 (static — decides the decode path).
+
+    Returns:
+      (a1, i1, a2, i2, a3): the fine-scan pool contract — [nqp, 128]
+      per-lane-class top-2 approximate squared distances with GLOBAL
+      slab-row ids, plus the running 3rd-min certificate input.
+    """
+    if Wk % _LANES:
+        raise ValueError(f"pq_scan_list_major: Wk={Wk} must be a "
+                         f"multiple of {_LANES}")
+    if pq_bits not in PQ_BITS:
+        raise ValueError(f"pq_scan_list_major: pq_bits must be one of "
+                         f"{PQ_BITS}, got {pq_bits}")
+    Lp = sched.shape[1]
+    if Lp % LISTS_PER_CELL:
+        raise ValueError(f"pq_scan_list_major: schedule length {Lp} "
+                         f"must be a multiple of {LISTS_PER_CELL}")
+    nqp = xx.shape[0]
+    code_bytes = codes.shape[1]
+    pq_dim = code_bytes if pq_bits == 8 else 2 * code_bytes
+    K = 1 << pq_bits
+    if lut.shape[1] != pq_dim * K:
+        raise ValueError(f"pq_scan_list_major: lut width "
+                         f"{lut.shape[1]} != pq_dim·K = {pq_dim * K}")
+
+    def kernel(sched_ref, xx_ref, probes_ref, cdot_ref, lut_ref,
+               codes_ref, yy_ref, *out_refs):
+        _pq_kernel_body(sched_ref, xx_ref, probes_ref, cdot_ref,
+                        lut_ref, codes_ref, yy_ref, *out_refs, Wk=Wk,
+                        pq_dim=pq_dim, K=K, pq_bits=pq_bits)
+
+    n_cells = Lp // LISTS_PER_CELL
+    out_spec = pl.BlockSpec((nqp, _LANES), lambda s, *_: (0, 0),
+                            memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_cells,),
+        in_specs=[
+            pl.BlockSpec((nqp, 1), lambda s, *_: (0, 0),
+                         memory_space=pltpu.VMEM),           # xx
+            pl.BlockSpec((nqp, _LANES), lambda s, *_: (0, 0),
+                         memory_space=pltpu.VMEM),           # probes
+            pl.BlockSpec((nqp, Lp), lambda s, *_: (0, 0),
+                         memory_space=pltpu.VMEM),           # cdot
+            pl.BlockSpec((nqp, pq_dim * K), lambda s, *_: (0, 0),
+                         memory_space=pltpu.VMEM),           # lut
+            pl.BlockSpec(memory_space=pltpu.ANY),            # codes DMA
+            pl.BlockSpec(memory_space=pltpu.ANY),            # yy DMA
+        ],
+        out_specs=[out_spec] * 5,
+    )
+    L = n_cells * LISTS_PER_CELL
+    cost = pl.CostEstimate(
+        # 2 hi/lo ADC contractions over the pq_dim·K one-hot lanes
+        flops=2 * nqp * L * Wk * pq_dim * K * 2,
+        bytes_accessed=(L * Wk * (code_bytes + 4)
+                        + nqp * pq_dim * K * 4
+                        + nqp * _LANES * 8 * 5),
+        transcendentals=0)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_pool_out_shape(nqp),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        cost_estimate=cost,
+        interpret=interpret_mode(),
+    )(sched, xx, probes, cdot, lut, codes, yy_pq)

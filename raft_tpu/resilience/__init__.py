@@ -39,7 +39,9 @@ from raft_tpu.resilience.policy import (DEGRADATIONS, EXHAUSTED,
                                         MERGE_LADDER, POISONED, RETRIES,
                                         FusedRung, PoisonedOutputError,
                                         PolicyTable, RetryPolicy,
-                                        degradation_count, degrade_merge,
+                                        degradation_count,
+                                        degradation_reasons,
+                                        degrade_merge,
                                         fused_degradation_ladder,
                                         get_policy_table, record_degradation,
                                         record_exhausted, record_retry,
@@ -74,6 +76,7 @@ __all__ = [
     "PolicyTable",
     "RetryPolicy",
     "degradation_count",
+    "degradation_reasons",
     "degrade_merge",
     "fused_degradation_ladder",
     "get_policy_table",

@@ -103,6 +103,12 @@ KNOWN_SITES: Dict[str, Tuple[str, ...]] = {
     # real or injected — must DEGRADE to the query-major scan with a
     # logged degradation and identical returned ids, never surface
     "fine_scan_list": ("error", "oom"),
+    # the IVF-PQ compressed tier (ISSUE 15): a failing per-subspace
+    # codebook train must surface at build (never a silently-flat
+    # index), and a failing ADC dispatch must DEGRADE to the f32/int8
+    # fine scan with a logged degradation and identical returned ids
+    "pq_train": ("error",),
+    "pq_scan": ("error", "oom"),
     # tuners + persistent stores
     "autotune_fused": ("error",),
     "autotune_sharded": ("error",),

@@ -184,6 +184,25 @@ def degradation_count(registry=None) -> float:
     return total
 
 
+def degradation_reasons(registry=None) -> list:
+    """The recorded ladder steps as ``"site:action ×count"`` strings —
+    the evidence a NAMED-artifact refresh prints when it REFUSES to
+    overwrite committed evidence with a degraded round (see
+    ``benchmarks/bench_ann.py``)."""
+    reg = registry if registry is not None else _registry()
+    out = []
+    for metric in reg.collect():
+        if getattr(metric, "name", None) != DEGRADATIONS:
+            continue
+        if metric.value <= 0:
+            continue
+        labels = getattr(metric, "labels", {}) or {}
+        site = labels.get("site", "?")
+        action = labels.get("action", "?")
+        out.append(f"{site}:{action} x{metric.value:g}")
+    return sorted(out)
+
+
 def run_with_policy(site: str, fn: Callable[[int], object],
                     policy: Optional[RetryPolicy] = None,
                     on_retry: Optional[Callable] = None):

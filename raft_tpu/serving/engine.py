@@ -263,6 +263,8 @@ class ServingEngine:
                  algorithm: str = "brute",
                  n_lists: Optional[int] = None,
                  n_probes: Optional[int] = None,
+                 pq_dim: Optional[int] = None,
+                 pq_bits: Optional[int] = None,
                  db_dtype: Optional[str] = None,
                  shadow_frac: Optional[float] = None,
                  shadow_floor: Optional[float] = None,
@@ -282,19 +284,24 @@ class ServingEngine:
         # the data plane serves APPROXIMATE queries through
         # ann.search_ivf_flat behind the exact same bucket ladder —
         # the speed/recall knob (n_probes) rides the serving tier.
-        if algorithm not in ("brute", "ivf_flat"):
+        # algorithm="ivf_pq" is the compressed tier on the same plane:
+        # ann.build_ivf_pq snapshots + ann.search_ivf_pq serving (ADC
+        # over the codes slab, certified exact f32 rescore).
+        if algorithm not in ("brute", "ivf_flat", "ivf_pq"):
             raise ValueError(f"ServingEngine: algorithm must be "
-                             f"'brute' or 'ivf_flat', got {algorithm!r}")
-        if algorithm == "ivf_flat":
+                             f"'brute', 'ivf_flat' or 'ivf_pq', got "
+                             f"{algorithm!r}")
+        if algorithm in ("ivf_flat", "ivf_pq"):
             expects(mesh is None,
-                    "ServingEngine: algorithm='ivf_flat' serves "
-                    "single-device planes (shard the lists via "
-                    "ann.shard_ivf_lists outside the engine)")
+                    "ServingEngine: algorithm=%r serves single-device "
+                    "planes (shard the lists via ann.shard_ivf_lists "
+                    "outside the engine)" % (algorithm,))
             expects(metric == "l2",
-                    "ServingEngine: algorithm='ivf_flat' serves "
-                    "metric='l2' only")
+                    "ServingEngine: algorithm=%r serves metric='l2' "
+                    "only" % (algorithm,))
         self._algorithm = algorithm
         self._n_lists, self._n_probes = n_lists, n_probes
+        self._pq_dim, self._pq_bits = pq_dim, pq_bits
         self.res = ensure_resources(res)
         self.k = int(k)
         self._mesh, self._axis = mesh, axis
@@ -355,6 +362,8 @@ class ServingEngine:
                           n_probes=n_probes,
                           compact_threshold=compact_threshold,
                           delta_cap=delta_cap)
+            if algorithm == "ivf_pq":
+                mut_kw.update(pq_dim=pq_dim, pq_bits=pq_bits)
             if durable:
                 from raft_tpu.mutable.checkpoint import (
                     has_durable_state, recover)
@@ -387,8 +396,13 @@ class ServingEngine:
             qb_hint = self._mutable.Qb
         else:
             if isinstance(index, (KnnIndex, IvfFlatIndex)):
-                if isinstance(index, IvfFlatIndex) != (
-                        algorithm == "ivf_flat"):
+                from raft_tpu.ann import IvfPqIndex
+
+                want = ("ivf_pq" if isinstance(index, IvfPqIndex)
+                        else "ivf_flat"
+                        if isinstance(index, IvfFlatIndex)
+                        else "brute")
+                if want != algorithm:
                     raise ValueError(
                         "ServingEngine: prepared index type does not "
                         "match algorithm=%r" % (algorithm,))
@@ -441,6 +455,15 @@ class ServingEngine:
 
     # -- construction helpers --------------------------------------------
     def _build_index(self, y):
+        if self._algorithm == "ivf_pq":
+            from raft_tpu.ann import build_ivf_pq
+
+            n_lists = self._n_lists or max(
+                1, min(1024, int(round(y.shape[0] ** 0.5))))
+            return build_ivf_pq(self.res, y, n_lists=n_lists,
+                                pq_dim=self._pq_dim,
+                                pq_bits=self._pq_bits,
+                                n_probes=self._n_probes)
         if self._algorithm == "ivf_flat":
             from raft_tpu.ann import build_ivf_flat
 
@@ -467,6 +490,11 @@ class ServingEngine:
                     else self._mutable.view())
             return search_view(self._mutable, xb, self.k, view=view,
                                n_probes=self._n_probes, res=self.res)
+        if self._algorithm == "ivf_pq":
+            from raft_tpu.ann import search_ivf_pq
+
+            return search_ivf_pq(self.res, snap.index, xb, self.k,
+                                 n_probes=self._n_probes)
         if self._algorithm == "ivf_flat":
             from raft_tpu.ann import search_ivf_flat
 
@@ -548,6 +576,13 @@ class ServingEngine:
             return search_view(self._mutable, x, self.k, exact=True,
                                res=self.res)
         snap = self._store.current()
+        if self._algorithm == "ivf_pq":
+            # degenerate n_probes = n_lists runs the certified exact
+            # scan over the retained f32 slab — the brute oracle
+            from raft_tpu.ann import search_ivf_pq
+
+            return search_ivf_pq(self.res, snap.index, x, self.k,
+                                 n_probes=snap.index.n_lists)
         if self._algorithm == "ivf_flat":
             from raft_tpu.ann import search_ivf_flat
 
@@ -582,6 +617,16 @@ class ServingEngine:
                 from raft_tpu.ann.ivf_flat import warm_fine_scan
 
                 warm_fine_scan(
+                    self.res, snap.index, b, self.k,
+                    self._n_probes or snap.index.n_probes_default)
+            if self._algorithm == "ivf_pq" and self._mutable is None:
+                # same bucket-ladder contract for the compressed tier:
+                # warm the ADC rungs AND the flat fallback programs so
+                # neither the chooser nor a certificate rerun can push
+                # a compile onto a live request
+                from raft_tpu.ann import warm_pq_scan
+
+                warm_pq_scan(
                     self.res, snap.index, b, self.k,
                     self._n_probes or snap.index.n_probes_default)
             emit_serving("warmup", bucket=b, generation=snap.generation)

@@ -43,14 +43,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from raft_tpu.observability import instrument
 from raft_tpu.resilience import fault_point
 
-# schema 5 (this build): the table may carry a top-level ``fine_scan``
-# column — per-(n_lists, n_probes) IVF fine-scan schedule rows written
-# by :mod:`raft_tpu.tune.ivf` and read by
-# ``ann.ivf_flat.resolve_fine_scan``. Schema-4 additions (db_dtype
-# rows/winners under ``best_by_passes_dtype``) unchanged. Committed
-# schema ≤ 4 tables (incl. the measured v5e one) load unchanged: no
-# fine_scan column simply means the cost-model crossover decides.
-TUNE_SCHEMA_VERSION = 5
+# schema 6 (this build): the table may carry a top-level ``pq``
+# column — per-(n_lists, n_probes, pq_bits) IVF-PQ schedule rows
+# written by :mod:`raft_tpu.tune.ivf` and read by
+# ``ann.ivf_pq.resolve_pq_scan``. Schema-5 additions (the
+# ``fine_scan`` column) and schema-4 additions (db_dtype rows/winners
+# under ``best_by_passes_dtype``) unchanged. Committed schema ≤ 5
+# tables (incl. the measured v5e one) load unchanged: no pq column
+# simply means the cost-model crossover decides.
+TUNE_SCHEMA_VERSION = 6
 
 # counter: tuned-table loads that degraded to built-in defaults, with a
 # reason label ("tune.table_degraded" in the metrics docs) — the silent
@@ -235,6 +236,18 @@ def validate_tune_table(tbl) -> List[str]:
                         and isinstance(row.get("n_probes"), int)
                         and row.get("fine_scan") in ("query", "list")):
                     errors.append(f"fine_scan[{i}] malformed")
+    pq = tbl.get("pq")
+    if pq is not None:
+        if not isinstance(pq, list):
+            errors.append("pq is not a list")
+        else:
+            for i, row in enumerate(pq):
+                if not (isinstance(row, dict)
+                        and isinstance(row.get("n_lists"), int)
+                        and isinstance(row.get("n_probes"), int)
+                        and isinstance(row.get("pq_bits"), int)
+                        and row.get("pq_scan") in ("pq", "flat")):
+                    errors.append(f"pq[{i}] malformed")
     for key in ("best", "best_by_passes", "best_by_passes_dtype"):
         entry = tbl.get(key)
         if entry is None:

@@ -1,5 +1,5 @@
-"""IVF fine-scan schedule autotuner — the schema-5 ``fine_scan``
-column of the tune table.
+"""IVF fine-scan + PQ schedule autotuner — the ``fine_scan``
+(schema 5) and ``pq`` (schema 6) columns of the tune table.
 
 ``autotune_fine_scan`` sweeps ``(n_lists, n_probes)`` geometries for
 an index shape and records, per point, the modeled bytes of BOTH
@@ -17,6 +17,13 @@ column and every reader falls back to the cost-model crossover).
 ``fine_scan_config`` is the loader ``ann.ivf_flat.resolve_fine_scan``
 consults: corrupt/absent/mismatched tables degrade to ``None`` (cost
 model decides) with the shared ``table_degraded`` counter.
+
+``autotune_pq_scan`` / ``pq_scan_config`` are the IVF-PQ siblings
+(schema 6, top-level ``pq`` key, rows keyed (n_lists, n_probes,
+pq_bits) → "pq" | "flat"): same deterministic model ranking, same
+degrade-to-crossover loader contract, same committed-table
+back-compat — a schema ≤ 5 table simply has no ``pq`` column and
+``ann.ivf_pq.resolve_pq_scan`` falls to ``costmodel.choose_pq_scan``.
 """
 
 from __future__ import annotations
@@ -29,9 +36,12 @@ from raft_tpu.observability import instrument
 from raft_tpu.resilience import fault_point
 
 _SCHEDULES = ("query", "list")
+_PQ_SCHEDULES = ("pq", "flat")
 
 # loader cache: path → (mtime, {(n_lists, n_probes): schedule})
 _cache: Dict[str, tuple] = {}
+# pq loader cache: path → (mtime, {(n_lists, n_probes, pq_bits): sched})
+_pq_cache: Dict[str, tuple] = {}
 
 
 def fine_scan_rows(shape: Sequence[int], lists: Sequence[int],
@@ -140,3 +150,123 @@ def fine_scan_config(n_lists: int, n_probes: int) -> Optional[str]:
     if not rows:
         return None
     return rows.get((int(n_lists), int(n_probes)))
+
+
+# ----------------------------------------------------- the pq column
+def pq_rows(shape: Sequence[int], lists: Sequence[int],
+            pq_dim: int, pq_bits: Sequence[int] = (4, 8),
+            list_sizes=None, padded_sizes=None) -> List[Dict]:
+    """The deterministic (model-ranked) PQ sweep: one row per
+    (n_lists, n_probes, pq_bits) point with the ADC and best-flat
+    schedules' modeled bytes and the crossover pick."""
+    from raft_tpu.observability.costmodel import (choose_pq_scan,
+                                                  ivf_traffic_model)
+
+    nq, m, d, k = (int(v) for v in shape[:4])
+    rows: List[Dict] = []
+    for L in lists:
+        L = int(L)
+        probe_window = max(8, -(-m // max(L, 1) // 8) * 8)
+        slab_rows = probe_window * L
+        p = 1
+        probe_pts = []
+        while p < L:
+            probe_pts.append(p)
+            p *= 2
+        for P in probe_pts:
+            for bits in pq_bits:
+                model = ivf_traffic_model(
+                    nq, m, d, k, L, P, probe_window, slab_rows,
+                    list_sizes=list_sizes, padded_sizes=padded_sizes,
+                    pq_dim=int(pq_dim), pq_bits=int(bits))
+                rows.append({
+                    "n_lists": L,
+                    "n_probes": P,
+                    "pq_dim": int(pq_dim),
+                    "pq_bits": int(bits),
+                    "pq_scan": choose_pq_scan(model),
+                    "model_pq_bytes": model["pq_stream_bytes"],
+                    "model_flat_bytes": min(
+                        model["fine_stream_bytes"],
+                        model["fine_gather_bytes"]),
+                    "pq_bytes_ratio": round(
+                        model["pq_bytes_ratio"], 5),
+                })
+    return rows
+
+
+@instrument("tune.autotune_pq_scan")
+def autotune_pq_scan(shape: Sequence[int], lists: Sequence[int] = (1024,),
+                     pq_dim: Optional[int] = None,
+                     pq_bits: Sequence[int] = (4, 8),
+                     list_sizes=None, padded_sizes=None) -> List[Dict]:
+    """Produce the ``pq`` rows for a schema-6 tune table. Deterministic
+    (model-ranked) everywhere today, exactly like
+    :func:`autotune_fine_scan` (whose tuner fault site this sweep
+    shares — one schedule-tuner seam); a measured TPU round appends
+    ``seconds_pq``/``seconds_flat`` per row and flips ``pq_scan`` to
+    the measured winner."""
+    fault_point("autotune_fine_scan")
+    d = int(shape[2])
+    if pq_dim is None:
+        pq_dim = max(1, d // 4)
+        while d % pq_dim:
+            pq_dim -= 1
+    return pq_rows(shape, lists, pq_dim, pq_bits, list_sizes,
+                   padded_sizes)
+
+
+def _load_pq_rows(path: str) -> Optional[Dict]:
+    """{(n_lists, n_probes, pq_bits): schedule} from a table's ``pq``
+    rows — the :func:`_load_rows` contract for the schema-6 column."""
+    from raft_tpu.tune.fused import table_degraded
+
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    cached = _pq_cache.get(path)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
+    try:
+        with open(path) as f:
+            tbl = json.load(f)
+    except (OSError, ValueError) as e:
+        table_degraded("pq", "unreadable", str(e)[:120])
+        return None
+    rows = tbl.get("pq") if isinstance(tbl, dict) else None
+    out: Dict = {}
+    if isinstance(rows, list):
+        for row in rows:
+            if not isinstance(row, dict):
+                table_degraded("pq", "row_rejected", "non-object row")
+                continue
+            sched = row.get("pq_scan")
+            L, P = row.get("n_lists"), row.get("n_probes")
+            bits = row.get("pq_bits")
+            if sched in _PQ_SCHEDULES and isinstance(L, int) \
+                    and isinstance(P, int) and isinstance(bits, int):
+                out[(L, P, bits)] = sched
+            else:
+                table_degraded("pq", "row_rejected",
+                               f"bad row {row}"[:120])
+    _pq_cache[path] = (mtime, out)
+    return out
+
+
+def pq_scan_config(n_lists: int, n_probes: int,
+                   pq_bits: int) -> Optional[str]:
+    """The tuned PQ schedule for an exact (n_lists, n_probes, pq_bits)
+    geometry, or None (caller falls to the cost-model crossover).
+    Reads the same table ``fused_config`` does; schema ≤ 5 tables have
+    no ``pq`` column and return None — the committed-table back-compat
+    contract."""
+    from raft_tpu.core import env
+    from raft_tpu.native import _REPO_ROOT
+
+    path = env.raw("RAFT_TPU_TUNE_FUSED") or os.path.join(
+        _REPO_ROOT, "TUNE_FUSED.json")
+    rows = _load_pq_rows(path)
+    if not rows:
+        return None
+    return rows.get((int(n_lists), int(n_probes), int(pq_bits)))

@@ -199,12 +199,71 @@ def test_check_ann_gates_floor_and_degenerate(tmp_path):
     assert status == br.PASS and "not speed-gated" in msg
 
 
-def test_check_ann_degraded_rounds_skip(tmp_path):
+def test_check_ann_degraded_round_files_skip(tmp_path):
+    """A degraded ROUND file is history — never gated, never
+    baseline material."""
     br = _tools_import("bench_report")
-    _write(tmp_path / "BENCH_ANN.json", _ann_record(best=0.5, ok=False,
-                                                    degr=2))
+    _write(tmp_path / "ANN_r01.json", _ann_record(best=0.5, ok=False,
+                                                  degr=2))
     status, msg = br.check_ann(br.collect_ann(str(tmp_path)))
     assert status == br.SKIP and "degrad" in msg
+
+
+def test_check_ann_degraded_named_artifact_regresses(tmp_path):
+    """ISSUE 15 satellite: a degraded NAMED artifact (the committed
+    BENCH_ANN.json) must REGRESS, not SKIP — committed evidence can
+    never be an outage round (the refresh path refuses to write one;
+    one landing anyway is a bug the gate must catch)."""
+    br = _tools_import("bench_report")
+    _write(tmp_path / "BENCH_ANN.json", _ann_record(degr=2))
+    status, msg = br.check_ann(br.collect_ann(str(tmp_path)))
+    assert status == br.REGRESS and "NAMED-ARTIFACT DEGRADED" in msg
+    # the bare degraded flag (no counted steps) regresses the same way
+    rec = _ann_record()
+    rec["degraded"] = True
+    _write(tmp_path / "BENCH_ANN.json", rec)
+    status, msg = br.check_ann(br.collect_ann(str(tmp_path)))
+    assert status == br.REGRESS and "NAMED-ARTIFACT DEGRADED" in msg
+    # clean named artifact still passes
+    _write(tmp_path / "BENCH_ANN.json", _ann_record())
+    status, _ = br.check_ann(br.collect_ann(str(tmp_path)))
+    assert status == br.PASS
+
+
+def _run_bench_ann(out, extra_env=None):
+    """One tiny-shape benchmarks/bench_ann.py run in a SUBPROCESS —
+    its compile caches, resources and fault arming stay isolated from
+    the test process."""
+    root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(extra_env or {}))
+    return subprocess.run(
+        [sys.executable, os.path.join(root, "benchmarks",
+                                      "bench_ann.py"),
+         "--rows", "500", "--dim", "8", "--queries", "24", "--k", "4",
+         "--lists", "4", "--out", str(out)],
+        capture_output=True, text=True, timeout=600, env=env)
+
+
+def test_bench_ann_refuses_degraded_named_overwrite(tmp_path):
+    """The refresh path itself: a round that walks a resilience ladder
+    (here: an injected pq_scan fault, whose rung degrades the ADC scan
+    to the flat path mid-run) must hard-error instead of overwriting a
+    file named BENCH_ANN.json — listing the ladder steps — while a
+    ROUND-file path still records the degraded history."""
+    out = tmp_path / "BENCH_ANN.json"
+    out.write_text("{\"sentinel\": true}\n")
+    arm = {"RAFT_TPU_FAULTS": "pq_scan:error"}
+    r = _run_bench_ann(out, arm)
+    assert r.returncode == 1, r.stderr[-2000:]
+    assert "REFUSING to overwrite named artifact" in r.stderr
+    assert "pq_scan" in r.stderr            # the ladder step is listed
+    assert json.loads(out.read_text()) == {"sentinel": True}
+    # a ROUND-file path still writes (degraded history is recordable)
+    rout = tmp_path / "ANN_r99.json"
+    r = _run_bench_ann(rout, arm)
+    rec = json.loads(rout.read_text())
+    assert rec["degraded"] is True
+    assert rec["resilience_degradations"] >= 1
 
 
 def test_check_ann_recall_trend_and_measured_speed(tmp_path):
@@ -241,6 +300,23 @@ def test_committed_ann_artifact_schema():
     assert rec["ok"] is True
     assert rec["degenerate_exact"] is True
     assert isinstance(rec["measured"], bool)
+    # committed evidence is never an outage round (ISSUE 15): degraded
+    # means "walked a resilience ladder", and the named artifact must
+    # be clean — the refresh path refuses to write it otherwise
+    assert rec["degraded"] is False
+    assert not rec.get("resilience_degradations")
+    # the PQ compressed-tier block: ratio ≤ 0.10× of f32, id parity
+    # after the mandatory rescore, and the 100M-row single-chip fit
+    pq = rec["pq"]
+    assert pq["ok"] is True
+    assert pq["pq_bytes_ratio"] <= 0.10
+    assert pq["scale_model"]["fits_hbm"] is True
+    assert pq["scale_model"]["rows"] >= 100_000_000
+    assert pq["scale_model"]["model_index_bytes"] \
+        <= pq["scale_model"]["hbm_bytes"]
+    assert any(p["recall_at_k"] >= rec["recall_floor"]
+               and p["pq_bytes_ratio"] <= 0.10
+               and p["pq_bits"] == 8 for p in pq["frontier"])
     best = max(p["recall_at_k"] for p in rec["frontier"])
     assert best >= rec["recall_floor"]
     for p in rec["frontier"]:

@@ -531,6 +531,45 @@ def test_check_quantized_gate_matrix():
     assert s == br.PASS
 
 
+def test_check_quantized_pq_tier_gate_matrix():
+    """ISSUE 15 satellite: the quantized gate extended to the PQ tier
+    — the modeled codes-stream ratio must clear the much tighter 0.10×
+    ceiling AND the id-parity-after-rescore flag is AND-ed in."""
+    import tools.bench_report as br
+
+    ok_pq = {"pq": {"ok": True, "pq_bytes_ratio": 0.0625}}
+    s, msg = br.check_quantized([("ann", ok_pq)])
+    assert s == br.PASS and "pq=0.0625" in msg
+    # parity-after-rescore failure regresses even at a great ratio
+    s, msg = br.check_quantized(
+        [("ann", {"pq": {"ok": False, "pq_bytes_ratio": 0.03}})])
+    assert s == br.REGRESS and "id-parity-after-rescore" in msg
+    # ratio over the PQ ceiling regresses (0.12 passes the int8 gate's
+    # 0.55 but NOT the pq tier's 0.10)
+    s, msg = br.check_quantized(
+        [("ann", {"pq": {"ok": True, "pq_bytes_ratio": 0.12}})])
+    assert s == br.REGRESS and "0.1200" in msg
+    # a missing ratio in an ok block is a broken artifact, not a pass
+    s, msg = br.check_quantized([("ann", {"pq": {"ok": True}})])
+    assert s == br.REGRESS and "pq_bytes_ratio" in msg
+    # pq and int8 blocks gate together on one record
+    both = {"quantized": {"ok": True, "quantized_gather_ratio": 0.3},
+            "pq": {"ok": True, "pq_bytes_ratio": 0.05}}
+    s, msg = br.check_quantized([("ann", both)])
+    assert s == br.PASS and "pq=0.0500" in msg
+    # the ceiling constant is pinned against the bench writer's
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_bench_ann_pin", os.path.join(root, "benchmarks",
+                                       "bench_ann.py"))
+    ba = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ba)
+    assert br.PQ_RATIO_CEIL == ba.PQ_RATIO_CEIL
+
+
 def test_committed_artifacts_carry_quantized_blocks():
     """The committed MULTICHIP/ANN artifacts must pass the gate they
     exist to feed."""

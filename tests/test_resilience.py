@@ -254,6 +254,18 @@ def _drive_ivf_search():
                            np.ones((2, 8), np.float32), 2, n_probes=2)
 
 
+def _drive_pq_train():
+    """The pq_train site fires before the per-subspace codebook loop —
+    a failing codebook train must surface at build, never ship a
+    silently-flat index (4-bit keeps the 2^pq_bits codeword demand
+    inside the 64-row driver)."""
+    from raft_tpu.ann import build_ivf_pq
+
+    return build_ivf_pq(
+        None, rng.normal(size=(64, 8)).astype(np.float32),
+        n_lists=4, pq_bits=4, max_iter=1, pq_max_iter=1, seed=0)
+
+
 _mutable_index = None
 
 
@@ -399,6 +411,12 @@ def _always_raise_drivers():
                 g=2, grid_order="db", db_dtype="int8"),
         "ivf_build": _drive_ivf_build,
         "ivf_search": _drive_ivf_search,
+        # IVF-PQ compressed tier: the codebook-train seam raises at
+        # build; the ADC dispatch seam (pq_scan) DEGRADES to the flat
+        # scan instead of raising — dedicated id-parity test in
+        # tests/test_ivf_pq.py
+        "pq_train": _drive_pq_train,
+        "pq_scan": None,
         # fine-scan schedule autotuner: deterministic model sweep
         "autotune_fine_scan": lambda: __import__(
             "raft_tpu.tune.ivf",

@@ -105,6 +105,14 @@ PASS, REGRESS, MISSING_BASELINE, SKIP = ("pass", "regress",
 #: id-parity flag must hold
 QUANTIZED_RATIO_CEIL = 0.55
 
+#: PQ-tier gate (the quantized gate extended to product quantization):
+#: the modeled codes-slab stream must be ≤ this fraction of the f32
+#: slab stream (1/16 at 8-bit codes with pq_dim = d/4, 1/32 at 4-bit)
+#: AND the id-parity-after-rescore flag must hold. Mirror of
+#: benchmarks/bench_ann.PQ_RATIO_CEIL (this tool stays
+#: raft_tpu-import-free); tests pin the two equal.
+PQ_RATIO_CEIL = 0.10
+
 #: quality-telemetry gate: any recall a ``quality`` block carries
 #: (online shadow recall, offline ANN recall) must reach this floor —
 #: the same 0.95 the ANN frontier gate enforces. Mirror of
@@ -526,8 +534,12 @@ def check_ann(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
     """Gate the ANN speed/recall frontier (BENCH_ANN / ANN_r*):
 
     - the newest parseable round must be ``ok``;
-    - degraded rounds (nonzero resilience degradations) SKIP — outage
-      evidence is history, never a gate;
+    - degraded ROUND files (nonzero resilience degradations) SKIP —
+      outage evidence is history, never a gate; but a degraded NAMED
+      artifact (the bare ``BENCH_ANN.json``) REGRESSES — committed
+      baseline evidence must never be an outage round (the refresh
+      path refuses to write it; one landing anyway is a bug, not
+      history);
     - **recall floor**: the frontier's best recall@k must reach the
       artifact's own ``recall_floor`` (default 0.95) — recall is
       platform-independent math, so this gates modeled rounds too;
@@ -545,21 +557,36 @@ def check_ann(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
       newest and a previous measured round both carry ``search_ms`` at
       the floor-recall point, it must not grow past ``threshold``
       (modeled rounds are never speed-gated)."""
-    newest = None
-    for _, _, rec in reversed(rounds):
+    newest, newest_path = None, None
+    for _, path, rec in reversed(rounds):
         if rec is not None:
-            newest = rec
+            newest, newest_path = rec, path
             break
     if newest is None:
         return SKIP, "no ANN artifact to gate"
     if newest.get("skipped"):
         return SKIP, "latest ANN round skipped"
     rd = newest.get("resilience_degradations")
-    if isinstance(rd, (int, float)) and rd > 0:
+    degraded = (isinstance(rd, (int, float)) and rd > 0) \
+        or bool(newest.get("degraded"))
+    if degraded:
+        if newest_path is not None and os.path.basename(
+                newest_path) == ANN_NAME:
+            return REGRESS, (
+                f"ANN NAMED-ARTIFACT DEGRADED: {ANN_NAME} is stamped "
+                f"degraded"
+                + (f" ({rd:g} resilience degradation step(s))"
+                   if isinstance(rd, (int, float)) and rd > 0 else "")
+                + " — committed baseline evidence must never be an "
+                  "outage round; regenerate it clean "
+                  "(benchmarks/bench_ann.py refuses degraded "
+                  "overwrites)")
         return SKIP, (
-            f"latest ANN round recorded {rd:g} degradation step(s) — "
-            f"a degraded run is history, never gated and never "
-            f"baseline material")
+            f"latest ANN round is degraded"
+            + (f" ({rd:g} degradation step(s))"
+               if isinstance(rd, (int, float)) and rd > 0 else "")
+            + " — a degraded run is history, never gated and never "
+              "baseline material")
     if not newest.get("ok", True):
         return REGRESS, ("latest ANN round failed (ok=false) — the "
                          "ANN tier regressed")
@@ -1349,13 +1376,41 @@ def check_quantized(records: Sequence[Tuple[str, Optional[Dict]]],
     (id-parity int8-vs-f32 held) and its modeled bytes ratio
     (``quantized_y_ratio`` for the fused stream,
     ``quantized_gather_ratio`` for the IVF probe gather) ≤ ``ceil``.
-    Families without the block are noted; when NO family carries one
-    the gate SKIPs (pass-or-no-op — pre-quantization artifact sets)."""
+    Records carrying a ``"pq"`` block (the IVF-PQ compressed tier —
+    benchmarks/bench_ann.py) are additionally gated at the much
+    tighter :data:`PQ_RATIO_CEIL`: ``pq_bytes_ratio`` ≤ 0.10× of the
+    f32 slab stream AND the id-parity-after-rescore ``ok`` flag —
+    AND-ed into the same verdict. Families without the block are
+    noted; when NO family carries one the gate SKIPs (pass-or-no-op —
+    pre-quantization artifact sets)."""
     checked, missing = [], []
     for family, rec in records:
+        pq = rec.get("pq") if isinstance(rec, dict) else None
+        if isinstance(pq, dict):
+            if not pq.get("ok"):
+                detail = pq.get("error") or (
+                    "rescored PQ ids diverged from the flat scan, or "
+                    "no point met the recall floor at the ratio ceil")
+                return REGRESS, (
+                    f"QUANTIZED REGRESSION [{family}/pq]: "
+                    f"id-parity-after-rescore ok={pq.get('ok')} "
+                    f"({detail})")
+            pratio = pq.get("pq_bytes_ratio")
+            if not isinstance(pratio, (int, float)):
+                return REGRESS, (
+                    f"QUANTIZED REGRESSION [{family}/pq]: pq block "
+                    f"carries no pq_bytes_ratio")
+            if pratio > PQ_RATIO_CEIL:
+                return REGRESS, (
+                    f"QUANTIZED REGRESSION [{family}/pq]: modeled "
+                    f"codes-stream ratio {pratio:.4f} > "
+                    f"{PQ_RATIO_CEIL:g}× the f32 slab — the "
+                    f"compressed tier stopped paying for itself")
+            checked.append(f"{family}/pq={pratio:.4f}")
         q = rec.get("quantized") if isinstance(rec, dict) else None
         if not isinstance(q, dict):
-            missing.append(family)
+            if not isinstance(pq, dict):
+                missing.append(family)
             continue
         if not q.get("ok"):
             detail = q.get("error") or ("int8 ids diverged from the "

@@ -63,12 +63,14 @@ HOT_PATHS: Dict[str, Sequence[str]] = {
     "raft_tpu/solver/linear_assignment.py": ("solve_lap",),
     "raft_tpu/tune/fused.py": ("autotune_fused",),
     "raft_tpu/tune/sharded.py": ("autotune_sharded",),
-    "raft_tpu/tune/ivf.py": ("autotune_fine_scan",),
+    "raft_tpu/tune/ivf.py": ("autotune_fine_scan",
+                             "autotune_pq_scan"),
     "raft_tpu/distance/knn_sharded.py": ("knn_fused_sharded",),
     "raft_tpu/serving/engine.py": ("execute_batch",),
     "raft_tpu/serving/snapshot.py": ("build_snapshot",),
     "raft_tpu/cluster/kmeans.py": ("kmeans_fit", "kmeans_predict"),
     "raft_tpu/ann/ivf_flat.py": ("build_ivf_flat", "search_ivf_flat"),
+    "raft_tpu/ann/ivf_pq.py": ("build_ivf_pq", "search_ivf_pq"),
     "raft_tpu/mutable/index.py": ("apply_upsert", "apply_delete",
                                   "search_view"),
 }
@@ -85,6 +87,9 @@ COST_CAPTURE_SITES: Dict[str, Sequence[str]] = {
     # frontiers carry flops/bytes next to recall
     "raft_tpu/cluster/kmeans.py": ("capture_fn",),
     "raft_tpu/ann/ivf_flat.py": ("capture_fn",),
+    # the PQ ADC table build — the per-chunk cost the compressed tier
+    # adds on top of the shared fine-scan machinery
+    "raft_tpu/ann/ivf_pq.py": ("capture_fn",),
     # the int8 quantize prep (prepare_knn_index db_dtype="int8")
     "raft_tpu/distance/knn_fused.py": ("capture_fn",),
 }
@@ -170,7 +175,11 @@ EVENT_SITES: Dict[str, Sequence[str]] = {
                                    "emit_marker"),
     "raft_tpu/ann/ivf_flat.py": ("instrument", "fault_point",
                                  "emit_marker"),
-    # the fine-scan schedule autotuner (the schema-5 fine_scan column)
+    # the compressed tier: build/search markers (eq stats, schedule
+    # picks, certificate fallbacks) ride next to the span/fault events
+    "raft_tpu/ann/ivf_pq.py": ("instrument", "fault_point",
+                               "emit_marker"),
+    # the fine-scan/pq schedule autotuner (schema 5/6 columns)
     "raft_tpu/tune/ivf.py": ("instrument", "fault_point"),
     # the quantized index build: the quantize_index marker (per-build
     # Eq stats) rides next to the span + fault events
@@ -206,6 +215,9 @@ QUALITY_SITES: Dict[str, Sequence[str]] = {
     "raft_tpu/distance/knn_sharded.py": ("record_pending",),
     "raft_tpu/ann/ivf_flat.py": ("record_certificate",
                                  "record_pending"),
+    # the PQ tier's ADC scan reports its certificate/rerun counters
+    # at the host sync its rerun decision already pays
+    "raft_tpu/ann/ivf_pq.py": ("record_certificate",),
     "raft_tpu/runtime/entry_points.py": ("record_pending",),
     # the serving engine's quality surface is the shadow sampler
     "raft_tpu/serving/engine.py": ("ShadowSampler",),
@@ -237,6 +249,12 @@ KERNEL_VARIANTS: Dict[str, Tuple[Sequence[str], str]] = {
         ("fine_scan_list_major",
          "fine_scan_list_major_q8"),
         "raft_tpu/ann/ivf_flat.py"),
+    # the IVF-PQ ADC kernel (ISSUE 15): the codes slab streamed
+    # through the list-major schedule against the VMEM-resident
+    # lookup table; consumed by the ann.ivf_pq "pq" schedule
+    "raft_tpu/ops/pq_scan_pallas.py": (
+        ("pq_scan_list_major",),
+        "raft_tpu/ann/ivf_pq.py"),
 }
 
 def _decorator_is_instrument(dec: ast.expr) -> bool:
