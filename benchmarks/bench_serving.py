@@ -77,6 +77,43 @@ def _compile_miss_count() -> int:
                if e.get("kind") == "compile" and not e.get("hit", False))
 
 
+def _slo_block(status) -> dict:
+    """The ``"slo"`` artifact block: cumulative availability over the
+    whole run (bad-status fraction of ``raft_tpu_serving_requests_total``
+    — same semantics as the windowed objective, un-windowed), the
+    page-severity burn-alert count, and the end-of-run alert state.
+    Gated by ``bench_report --check [slo]``."""
+    from raft_tpu.observability.metrics import Counter, get_registry
+    from raft_tpu.observability.slo import (BAD_STATUSES, BURN_ALERTS,
+                                            REQUESTS)
+
+    total = bad = alerts = 0.0
+    burn_by_slo: dict = {}
+    for m in get_registry().collect():
+        if not isinstance(m, Counter):
+            continue
+        if m.name == REQUESTS:
+            total += m.value
+            if m.labels.get("status") in BAD_STATUSES:
+                bad += m.value
+        elif (m.name == BURN_ALERTS
+                and m.labels.get("severity") == "page"):
+            alerts += m.value
+            name = m.labels.get("slo", "?")
+            burn_by_slo[name] = burn_by_slo.get(name, 0) + int(m.value)
+    return {
+        "availability": (round(1.0 - bad / total, 6) if total else None),
+        "total_requests": int(total),
+        "bad_requests": int(bad),
+        "fast_burn_alerts": int(alerts),
+        "fast_burn_by_slo": burn_by_slo,
+        "healthy": bool(status.get("healthy", True)) if status else True,
+        "active_alerts": (status.get("active_alerts", [])
+                          if status else []),
+        "covered_s": status.get("covered_s") if status else None,
+    }
+
+
 def run_load(engine, queries, sizes, n_requests: int, clients: int,
              think_mean_s: float, deterministic: bool, seed: int = 0):
     """The closed loop. Returns (latencies, errors, wall_seconds)."""
@@ -206,6 +243,8 @@ def main(argv=None) -> int:
             errors.append(f"parity probe failed: {e}"[:200])
     if engine.shadow is not None:
         engine.shadow.flush(timeout=60)
+    if engine.slo is not None:
+        engine.slo.tick(force=True)
     stats = engine.stats()
     ok = ok and compile_misses == 0
     engine.stop()
@@ -263,6 +302,14 @@ def main(argv=None) -> int:
             result["quality"] = qb
     except Exception as e:
         print(f"bench_serving: quality block failed: {e}",
+              file=sys.stderr)
+    # SLO block (ISSUE 16): run-cumulative availability + burn-alert
+    # state — gated by bench_report --check [slo] (availability ≥ 0.99
+    # and no page-severity fast burn on an ok round)
+    try:
+        result["slo"] = _slo_block(stats.get("slo"))
+    except Exception as e:
+        print(f"bench_serving: slo block failed: {e}",
               file=sys.stderr)
     if degr:
         result["resilience_degradations"] = degr

@@ -64,7 +64,7 @@ import numpy as np
 from raft_tpu.core import env
 from raft_tpu.core.error import DeadlineExceededError, expects
 from raft_tpu.core.resources import ensure_resources
-from raft_tpu.observability import instrument
+from raft_tpu.observability import explain, instrument
 from raft_tpu.observability.flight import get_flight_recorder
 from raft_tpu.observability.quality import (record_certificate,
                                             record_pending)
@@ -352,8 +352,9 @@ def _fine_scan_q8(x, slab, slab_q, row_scale, ids, yy_q, starts, psizes,
     score), so a violator with true d2 < θ would need
     B ≤ (√θ + Eq)² + e_num — Eq the max quantization bound among the
     probed rows, e_num a conservative f32-accumulation envelope.
-    Returns (vals, ids, certified) — the caller reruns failed queries
-    through the exact f32 scan, so ids never degrade."""
+    Returns (vals, ids, certified, margin) — the caller reruns failed
+    queries through the exact f32 scan, so ids never degrade; margin
+    (bound − θ − widen, pre-rerun) feeds the explain plane."""
     nq = x.shape[0]
     ar = jnp.arange(W, dtype=jnp.int32)
     rows = starts[:, :, None] + ar[None, None, :]          # [nq, P, W]
@@ -401,7 +402,10 @@ def _fine_scan_q8(x, slab, slab_q, row_scale, ids, yy_q, starts, psizes,
     n_valid = jnp.sum(valid.astype(jnp.int32), axis=1)
     certified = (bound >= theta + widen) | (n_valid <= C) \
         | ~jnp.isfinite(bound)
-    return vals, out_ids, certified
+    # explain-plane margin: non-finite where the certificate was
+    # trivially complete (finalize filters those out)
+    margin = bound - (theta + widen)
+    return vals, out_ids, certified, margin
 
 
 # ----------------------------------------- list-major fine scan
@@ -591,8 +595,9 @@ def _fine_scan_list(x, sched, probes, slab, ids, yy_slab, starts_qm,
                     psizes, yy_lmax, k: int, P: int, W: int, Wk: int):
     """List-major fine scan over the f32 slab (see the block comment):
     kernel pools → exact rescore + canonical reorder → certificate.
-    Returns (vals, ids, certified) like :func:`_fine_scan_q8` — the
-    caller reruns failed queries query-major, so ids never drift."""
+    Returns (vals, ids, certified, margin) like :func:`_fine_scan_q8`
+    — the caller reruns failed queries query-major, so ids never
+    drift."""
     from raft_tpu.ops.fine_scan_pallas import fine_scan_list_major
 
     nq, d = x.shape
@@ -613,7 +618,7 @@ def _fine_scan_list(x, sched, probes, slab, ids, yy_slab, starts_qm,
     span = (jnp.sqrt(xx[:, 0]) + jnp.sqrt(yymax)) ** 2
     widen = (2.0 ** -13 + d * 2.0 ** -22) * span
     certified = _kernel_envelope(bound, theta, widen)
-    return vals, out_ids, certified
+    return vals, out_ids, certified, bound - (theta + widen)
 
 
 @partial(jax.jit, static_argnames=("k", "P", "W", "Wk"))
@@ -647,7 +652,7 @@ def _fine_scan_list_q8(x, sched, scale_l, probes, slab_q, slab, ids,
     sq_t = jnp.sqrt(jnp.maximum(theta, 0.0))
     widen = 2.0 * sq_t * eq_w + eq_w * eq_w + e_k
     certified = _kernel_envelope(bound, theta, widen)
-    return vals, out_ids, certified
+    return vals, out_ids, certified, bound - (theta + widen)
 
 
 def resolve_fine_scan(index: IvfFlatIndex, nq: int, k: int, P: int,
@@ -864,7 +869,7 @@ def _exact_search(res, index: IvfFlatIndex, x, k: int):
     if qpad:
         x = jnp.concatenate(
             [x, jnp.zeros((qpad, x.shape[1]), jnp.float32)])
-    vals, pos, n_fail = _knn_fused_core(
+    vals, pos, n_fail, margin = _knn_fused_core(
         x, yp, y_hi, y_lo, yyh_k, yy_raw, k=k, T=T, Qb=Qb_eff, g=g,
         passes=3, metric="l2", m=M, rescore=True, pbits=pbits,
         with_stats=True, rows_valid=rv)
@@ -876,6 +881,9 @@ def _exact_search(res, index: IvfFlatIndex, x, k: int):
     record_pending("ann.ivf_exact", n_fail, n_queries=x.shape[0],
                    pool_width=rescore_pool_width(k, S_pool, True),
                    fix_tiers=fixup_tiers_for(M))
+    if explain.active() is not None:
+        explain.note_margin("ann.ivf_exact",
+                            margin[:nq] if qpad else margin)
     vals, pos = vals[:nq], pos[:nq]
     gids = jnp.where(pos >= 0,
                      jnp.take(index.ids, jnp.maximum(pos, 0)), -1)
@@ -890,13 +898,16 @@ def _query_major_chunk(index: IvfFlatIndex, xs, st, ps, k: int,
     path, now shared by the query-major schedule and the list-major
     certificate-failure rerun."""
     if index.db_dtype != "int8":
+        # exact f32 scan over the probed rows — no certificate, hence
+        # no margin to note (the scan IS the oracle for its pool)
         return _fine_scan(xs, index.slab, index.ids, index.yy_slab,
                           st, ps, k=k, P=P, W=W)
     C = min(k + _IVF_RESCORE_PAD, P * W)
-    vals, ids_c, ok = _fine_scan_q8(
+    vals, ids_c, ok, margin = _fine_scan_q8(
         xs, index.slab, index.slab_q, index.row_scale, index.ids,
         index.yy_q, st, ps, k=k, P=P, W=W, C=C,
         eq_rows=index.eq_rows)
+    explain.note_margin("ann.search_ivf_flat", margin)
     n_fail = int(jnp.sum(~ok))
     # quality telemetry: this path ALREADY syncs (the int() above
     # decides the rerun), so the counters cost nothing extra —
@@ -914,6 +925,7 @@ def _query_major_chunk(index: IvfFlatIndex, xs, st, ps, k: int,
         # never rides on the margin)
         emit_marker("ivf_q8_fallback", n_fail=n_fail,
                     nq=int(xs.shape[0]))
+        explain.note(rerun="q8_exact", rerun_rows=n_fail)
         fv, fi = _fine_scan(xs, index.slab, index.ids,
                             index.yy_slab, st, ps, k=k, P=P, W=W)
         okc = ok[:, None]
@@ -949,17 +961,18 @@ def _search_list_major(res, index: IvfFlatIndex, x, probes,
                         stream_rows=sched.stream_rows,
                         db_dtype=index.db_dtype)
         if quant:
-            vals, ids_c, ok = _fine_scan_list_q8(
+            vals, ids_c, ok, margin = _fine_scan_list_q8(
                 xs, jnp.asarray(sched.sched),
                 jnp.asarray(sched.scale_l), pr, index.slab_q,
                 index.slab, index.ids, index.yy_slab,
                 host["yy_lmax"], host["eq_list"], st, ps,
                 k=k, P=P, W=W, Wk=Wk)
         else:
-            vals, ids_c, ok = _fine_scan_list(
+            vals, ids_c, ok, margin = _fine_scan_list(
                 xs, jnp.asarray(sched.sched), pr, index.slab,
                 index.ids, index.yy_slab, st, ps, host["yy_lmax"],
                 k=k, P=P, W=W, Wk=Wk)
+        explain.note_margin("ann.search_ivf_flat", margin)
         n_fail = int(jnp.sum(~ok))
         # same host sync the q8 gather path already pays — the
         # list-major slice of the certificate/fixup evidence plane
@@ -974,6 +987,7 @@ def _search_list_major(res, index: IvfFlatIndex, x, probes,
             # — rerun the chunk query-major and keep certified rows
             emit_marker("ivf_list_fallback", n_fail=n_fail,
                         nq=int(xs.shape[0]))
+            explain.note(rerun="list_query_major", rerun_rows=n_fail)
             fv, fi = _query_major_chunk(index, xs, st, ps, k, P, W)
             okc = ok[:, None]
             vals = jnp.where(okc, vals, fv)
@@ -1062,9 +1076,29 @@ def search_ivf_flat(res, index, queries, k: int,
                  "over the full index for this call", reason)
         emit_marker("ivf_exact_degrade", reason=reason, k=k,
                     n_probes=P, n_lists=L)
+        explain.note(plane="ivf_flat", exact_degrade=reason,
+                     n_probes=P, n_lists=L, k=k)
         return _exact_search(res, base, x, k)
 
     probes = _coarse_probe(res, base.centroids, x, P)       # [nq, P]
+
+    if explain.active() is not None:
+        # explain capture: probed list ids (first query's probe set —
+        # the record is per-request-batch) + the probed-size histogram
+        # and pool width; the host transfer only happens under capture
+        pr_np = np.asarray(probes)
+        sz = np.asarray(base.sizes)[pr_np]
+        explain.note(plane="ivf_flat", n_probes=P, n_lists=L, k=k,
+                     db_dtype=base.db_dtype,
+                     probed_lists=pr_np[0].tolist(),
+                     probed_rows=int(sz.sum()),
+                     probed_size_hist={
+                         "min": int(sz.min()), "p50": float(
+                             np.percentile(sz, 50)),
+                         "max": int(sz.max())},
+                     pool_width=(min(k + _IVF_RESCORE_PAD,
+                                     P * index.probe_window)
+                                 if base.db_dtype == "int8" else k))
 
     rec = get_flight_recorder()
     if rec.enabled:
@@ -1100,6 +1134,7 @@ def search_ivf_flat(res, index, queries, k: int,
     probes_host = np.asarray(probes) if req != "query" else None
     schedule = resolve_fine_scan(index, nq, k, P, W, req,
                                  probes_np=probes_host, chunk=chunk)
+    explain.note(fine_scan=schedule)
     if schedule == "list":
         try:
             fault_point("fine_scan_list")
@@ -1114,6 +1149,7 @@ def search_ivf_flat(res, index, queries, k: int,
             record_degradation("fine_scan_list", "query")
             emit_marker("fine_scan_degrade",
                         reason=f"{type(e).__name__}: {e}"[:160])
+            explain.note(fine_scan_degrade=f"{type(e).__name__}"[:64])
             log_warn("list-major fine scan failed (%s: %s) — "
                      "degrading to the query-major scan for this "
                      "call", type(e).__name__, e)
@@ -1352,7 +1388,9 @@ def _search_sharded(res, index: ShardedIvfIndex, x, probes, k: int,
             owned = (pr >= r * Ll) & (pr < (r + 1) * Ll)
             starts = jnp.take(starts_g, pr)
             psz = jnp.where(owned, jnp.take(psz_g, pr), 0)
-            vals, gids, ok = _fine_scan_q8(
+            # margin (4th output) is DCE'd — per-shard margins would
+            # need their own out_spec the explain plane doesn't ask for
+            vals, gids, ok, _ = _fine_scan_q8(
                 xq, slab_l, slabq_l, scale_l, ids_l, yyq_l, starts,
                 psz, k=k, P=P, W=W, C=C, eq_rows=eq_l)
             mv, mi = merge_fn(comms, p, k, vals, gids)

@@ -57,7 +57,7 @@ import numpy as np
 from raft_tpu.core import env
 from raft_tpu.core.error import DeadlineExceededError, expects
 from raft_tpu.core.resources import ensure_resources
-from raft_tpu.observability import instrument
+from raft_tpu.observability import explain, instrument
 from raft_tpu.observability.quality import record_certificate
 from raft_tpu.observability.timeline import emit_marker
 from raft_tpu.resilience import fault_point
@@ -387,10 +387,12 @@ def _pq_lut(x, codebooks, S: int, dsub: int):
 
 def pq_scan_chunk(index: IvfPqIndex, xs, probes_np, pr, st, ps,
                   k: int, P: int, W: int, ids=None):
-    """One list-major ADC chunk → (vals, ids, certified). ``ids``
-    overrides the slab id map (the mutable plane passes its tombstone-
-    masked ``ids_live``); the certificate compares against the same
-    masked oracle, so a failure's rerun returns identical id sets."""
+    """One list-major ADC chunk → (vals, ids, certified, margin).
+    ``ids`` overrides the slab id map (the mutable plane passes its
+    tombstone-masked ``ids_live``); the certificate compares against
+    the same masked oracle, so a failure's rerun returns identical id
+    sets. ``margin`` (bound − θ − widen, pre-rerun) feeds the explain
+    plane."""
     from raft_tpu.ops.fine_scan_pallas import pad_window
     from raft_tpu.ops.pq_scan_pallas import pq_scan_list_major
 
@@ -436,7 +438,7 @@ def pq_scan_chunk(index: IvfPqIndex, xs, probes_np, pr, st, ps,
     sq_t = jnp.sqrt(jnp.maximum(theta, 0.0))
     widen = 2.0 * sq_t * eq_w + eq_w * eq_w + e_k
     certified = _pq_certify(bound, theta, widen)
-    return vals, out_ids, certified
+    return vals, out_ids, certified, bound - (theta + widen)
 
 
 def resolve_pq_scan(index: IvfPqIndex, nq: int, k: int, P: int, W: int,
@@ -576,6 +578,8 @@ def search_ivf_pq(res, index: IvfPqIndex, queries, k: int,
                  "the f32 slab for this call", reason)
         emit_marker("ivf_exact_degrade", reason=reason, k=k,
                     n_probes=P, n_lists=L)
+        explain.note(plane="ivf_pq", exact_degrade=reason,
+                     n_probes=P, n_lists=L, k=k)
         return _exact_search(res, index, x, k)
 
     probes = _coarse_probe(res, index.centroids, x, P)       # [nq, P]
@@ -589,6 +593,18 @@ def search_ivf_pq(res, index: IvfPqIndex, queries, k: int,
     emit_marker("ivf_pq_search", nq=nq, k=k, n_probes=P, n_lists=L,
                 pq_dim=index.pq_dim, pq_bits=index.pq_bits,
                 schedule=schedule)
+    if explain.active() is not None:
+        sz = np.asarray(index.sizes)[probes_host]
+        explain.note(plane="ivf_pq", n_probes=P, n_lists=L, k=k,
+                     pq_bits=index.pq_bits, pq_dim=index.pq_dim,
+                     pq_scan=schedule,
+                     probed_lists=probes_host[0].tolist(),
+                     probed_rows=int(sz.sum()),
+                     probed_size_hist={
+                         "min": int(sz.min()), "p50": float(
+                             np.percentile(sz, 50)),
+                         "max": int(sz.max())},
+                     pool_width=256)
     if schedule == "pq":
         try:
             fault_point("pq_scan")
@@ -602,6 +618,7 @@ def search_ivf_pq(res, index: IvfPqIndex, queries, k: int,
             record_degradation("pq_scan", "flat")
             emit_marker("pq_scan_degrade",
                         reason=f"{type(e).__name__}: {e}"[:160])
+            explain.note(pq_scan_degrade=f"{type(e).__name__}"[:64])
             log_warn("PQ ADC scan failed (%s: %s) — degrading to the "
                      "flat fine scan for this call",
                      type(e).__name__, e)
@@ -634,8 +651,9 @@ def _search_pq(res, index: IvfPqIndex, x, probes, probes_host, starts,
     def run_chunk(s0: int, s1: int):
         xs, pr = x[s0:s1], probes[s0:s1]
         st, ps = starts[s0:s1], psizes[s0:s1]
-        vals, ids_c, ok = pq_scan_chunk(index, xs, probes_host[s0:s1],
-                                        pr, st, ps, k, P, W)
+        vals, ids_c, ok, margin = pq_scan_chunk(
+            index, xs, probes_host[s0:s1], pr, st, ps, k, P, W)
+        explain.note_margin("ann.search_ivf_pq", margin)
         n_fail = int(jnp.sum(~ok))
         # same host sync the certified gather paths already pay — the
         # PQ slice of the certificate/fixup evidence plane
@@ -651,6 +669,7 @@ def _search_pq(res, index: IvfPqIndex, x, probes, probes_host, starts,
             # never rides on the margin
             emit_marker("pq_cert_fallback", n_fail=n_fail,
                         nq=int(xs.shape[0]))
+            explain.note(rerun="pq_exact", rerun_rows=n_fail)
             fv, fi = _fine_scan(xs, index.slab, index.ids,
                                 index.yy_slab, st, ps, k=k, P=P, W=W)
             okc = ok[:, None]

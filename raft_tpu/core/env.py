@@ -127,6 +127,12 @@ _knob("RAFT_TPU_SERVING_SHADOW_FRAC", "float", 0.0,
       "online recall shadow-sampling fraction of live requests")
 _knob("RAFT_TPU_SERVING_SHADOW_FLOOR", "float", 0.95,
       "rolling shadow-recall floor (breach emits a drift event)")
+_knob("RAFT_TPU_EXPLAIN_FRAC", "float", 0.0,
+      "per-query explain-capture sampling fraction of live searches "
+      "(0 = off; constructor explain_frac= wins)")
+_knob("RAFT_TPU_DEBUGZ_PORT", "int", None,
+      "start the debugz HTTP server on this localhost port at engine "
+      "start (0 = ephemeral; unset = no server)")
 
 # -- ANN ----------------------------------------------------------------
 _knob("RAFT_TPU_IVF_ROW_QUANTUM", "int", 8,

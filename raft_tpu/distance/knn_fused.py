@@ -823,6 +823,12 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
     certified = bound >= theta + err                            # [Q] bool
     failed = ~certified
     n_fail = jnp.sum(failed.astype(jnp.int32))
+    # per-query certificate margin (pre-fixup): how much headroom the
+    # certificate had — negative exactly where the fixup runs. Rides
+    # out on the with_stats/_diag paths for the explain plane
+    # (observability.explain); computed either way, so with_stats adds
+    # one [Q] f32 output and zero extra compute.
+    margin = bound - (theta + err)                              # [Q] f32
 
     # ---- fixup: exact sweep for failed queries ----
     # shape-aware tier ladder: only tiers whose [F, M] f32 tile fits
@@ -967,10 +973,11 @@ def _knn_fused_core(x, yp, y_hi, y_lo, yyh_k, yy_raw,
     if with_stats:
         # ``with_stats``: the certificate-failure count rides out as a
         # third (scalar) output so the NON-jitted wrappers can report
-        # fixup-rate telemetry host-side (observability.quality) — one
-        # extra int32 per program, no extra compute, fixup semantics
-        # untouched
-        return vals, ids, n_fail
+        # fixup-rate telemetry host-side (observability.quality), plus
+        # the PRE-FIXUP per-query margin as a fourth so the explain
+        # plane can histogram it — one int32 + one [Q] f32 per program,
+        # no extra compute, fixup semantics untouched
+        return vals, ids, n_fail, margin
     return vals, ids
 
 
@@ -1715,7 +1722,7 @@ def knn_fused(x, y, k: int, passes: int = 3,
     pool_len = S_pool if packed_env else 2 * S_pool
     pool_algo = resolve_pool_algo(pool_select_algo(), pool_len,
                                   min(k + _POOL_PAD, pool_len))
-    vals, ids, n_fail = _knn_fused_core(
+    vals, ids, n_fail, margin = _knn_fused_core(
         x, idx.yp, idx.y_hi, idx.y_lo, idx.yyh_k, idx.yy_raw,
         k=k, T=T, Qb=Qb, g=g, passes=passes, metric=metric, m=m,
         rescore=rescore, pbits=idx.pbits, certify=certify,
@@ -1725,8 +1732,12 @@ def knn_fused(x, y, k: int, passes: int = 3,
         rows_valid=idx.rows_valid)
     # certificate/fixup telemetry: the failure count is a device scalar
     # — queue it UNRESOLVED (quality.drain() converts later, after the
-    # program's results have been consumed; no sync on this path)
+    # program's results have been consumed; no sync on this path).
+    # The margin likewise stays a device-array REFERENCE: the explain
+    # plane resolves it at finalize (post-response-sync) or drops it
+    # unreferenced when no capture is active.
     try:
+        from raft_tpu.observability import explain
         from raft_tpu.observability.quality import record_pending
 
         record_pending(
@@ -1734,6 +1745,11 @@ def knn_fused(x, y, k: int, passes: int = 3,
             pool_width=rescore_pool_width(k, S_pool, packed_env),
             fix_tiers=fixup_tiers_for(idx.yyh_k.shape[1]),
             db_dtype=db_dtype, passes=passes, certify=certify)
+        if explain.active() is not None:
+            # pad rows carry vacuous margins — slice them off (the
+            # slice dispatch only happens under an active capture)
+            explain.note_margin("distance.knn_fused",
+                                margin[:Q] if qpad else margin)
     except Exception:
         pass
     if vals.shape[0] != Q:

@@ -521,7 +521,11 @@ def knn_fused_sharded(x, y, k: int, mesh=None, axis: str = "x",
                 for b in range(nb):
                     xb = jax.lax.slice_in_dim(xq_l, b * qb_len,
                                               (b + 1) * qb_len, axis=0)
-                    vals, ids, nfb = _knn_fused_core(
+                    # margin (4th with_stats output) is DCE'd here: the
+                    # sharded out_specs stay (vals, ids, n_fail) —
+                    # per-shard margins would need a gather the explain
+                    # plane doesn't ask for
+                    vals, ids, nfb, _ = _knn_fused_core(
                         xb, yp_l, yhi_l, ylo_l, yyh_l, yy_l,
                         k=k, T=T_, Qb=Qb_eff, g=g_, passes=passes_,
                         metric=metric_, m=rows_per, rescore=rescore,
@@ -744,7 +748,7 @@ def _knn_query_sharded(x, y, k, mesh, axis, passes, metric, T, Qb, g,
                 ylo_l = next(it) if has_ylo else None
             yyh_l = next(it)
             yy_l = next(it)
-            v, i, nf = _knn_fused_core(
+            v, i, nf, _ = _knn_fused_core(
                 xq, yp_l, yhi_l, ylo_l, yyh_l, yy_l,
                 k=k, T=T_, Qb=Qb_eff, g=g_, passes=passes_,
                 metric=metric_, m=m, rescore=rescore, pbits=pbits_,

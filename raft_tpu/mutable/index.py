@@ -874,8 +874,9 @@ def _mutable_ivf_chunk(base, ids_live, xs, pr, st, ps, k: int, P: int,
     from raft_tpu.ann.ivf_pq import IvfPqIndex, pq_scan_chunk
 
     if isinstance(base, IvfPqIndex):
-        vals, gids, ok = pq_scan_chunk(base, xs, np.asarray(pr), pr,
-                                       st, ps, k, P, W, ids=ids_live)
+        vals, gids, ok, _margin = pq_scan_chunk(
+            base, xs, np.asarray(pr), pr, st, ps, k, P, W,
+            ids=ids_live)
         n_fail = int(jnp.sum(~ok))
         if n_fail:
             fv, fi = _fine_scan(xs, base.slab, ids_live, base.yy_slab,

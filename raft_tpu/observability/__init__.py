@@ -25,6 +25,12 @@ TPU-native port's equivalents behind ONE substrate:
   errors and fired deadlines (:mod:`raft_tpu.observability.flight` +
   :mod:`raft_tpu.observability.timeline`), plus the model-vs-measured
   :class:`DriftLedger` gated by ``tools/bench_report.py --check``.
+- telemetry front door — the per-query explain plane (hash-sampled
+  decision records with certificate margins,
+  :mod:`raft_tpu.observability.explain`), windowed metric aggregation
+  (:mod:`raft_tpu.observability.windows`) feeding declarative SLOs with
+  multi-window burn-rate alerts (:mod:`raft_tpu.observability.slo`),
+  all served live over HTTP by ``tools/debugz.py``.
 - cost model — static XLA ``cost_analysis``/``memory_analysis`` capture
   per compiled executable plus roofline attribution against the
   per-TPU-generation peaks in :mod:`raft_tpu.utils.arch`
@@ -125,6 +131,17 @@ from raft_tpu.observability.quality import (
     record_certificate,
     record_pending,
 )
+from raft_tpu.observability.explain import (
+    clear_records,
+    explain_records,
+)
+from raft_tpu.observability.slo import (
+    BurnWindow,
+    SloEngine,
+    SloObjective,
+    default_objectives,
+)
+from raft_tpu.observability.windows import MetricWindows
 
 
 def reset() -> None:
@@ -199,4 +216,11 @@ __all__ = [
     "recall_at_k",
     "record_certificate",
     "record_pending",
+    "explain_records",
+    "clear_records",
+    "MetricWindows",
+    "BurnWindow",
+    "SloEngine",
+    "SloObjective",
+    "default_objectives",
 ]

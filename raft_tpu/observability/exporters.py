@@ -50,7 +50,18 @@ def _fmt_value(v: float) -> str:
 
 
 def _escape_label(v: str) -> str:
+    """Label-value escaping per the text exposition format: backslash,
+    double quote and newline (site names can carry paths — a literal
+    backslash or an embedded newline would corrupt the scrape)."""
     return v.replace("\\", "\\\\").replace("\"", "\\\"").replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping: the exposition format escapes backslash and
+    newline ONLY (quotes are legal in help text). Without this a
+    multi-line help string splits the ``# HELP`` line and the scraper
+    rejects the whole page."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _label_str(labels: Dict[str, str], extra: Optional[Dict[str, str]] = None
@@ -77,7 +88,7 @@ def export_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
         seen_header.add(name)
         help_text = reg.help_of(name)
         if help_text:
-            out.write(f"# HELP {name} {help_text}\n")
+            out.write(f"# HELP {name} {_escape_help(help_text)}\n")
         out.write(f"# TYPE {name} {kind}\n")
 
     for metric in reg.collect():

@@ -73,6 +73,9 @@ KNOWN_EVENT_KINDS = (
     "flow",          # per-request Perfetto flow points (ph s/t/f)
     "mutation",      # mutable-index write-ahead stream: upsert/delete/
     #                  compact_start/compact_swap (raft_tpu.mutable)
+    "explain",       # per-query explain records (observability.explain)
+    "alert",         # SLO burn-rate alerts firing/resolving
+    #                  (observability.slo)
 )
 
 #: events attached to DeviceError/DeadlineExceededError payloads

@@ -197,27 +197,50 @@ class Histogram:
 
     def percentile(self, q: float) -> Optional[float]:
         """Estimated q-th percentile from the bucket counts (the
-        ``histogram_quantile`` method: find the bucket holding the
-        target rank, interpolate linearly inside it; the first bucket's
-        lower edge is 0 for non-negative bounds, observations past the
-        last finite bound report that bound). None when empty —
-        exporters render a dash instead of a fake zero."""
-        if not 0.0 <= q <= 100.0:
-            raise ValueError(f"percentile: q={q} outside [0, 100]")
+        ``histogram_quantile`` method, via :func:`bucket_percentile`).
+
+        Interpolation contract (pinned against :func:`percentile` on
+        raw samples by tests/test_observability.py):
+
+        - the target rank is ``(q/100)·count``; the answer is a linear
+          interpolation inside the first bucket whose CUMULATIVE count
+          reaches it — the estimate is therefore exact only up to one
+          bucket width (a single populated bucket ``(lo, b]`` reports
+          a point inside ``[lo, b]``, not the sample's true value);
+        - the first bucket's lower edge is 0 for non-negative bounds
+          (``min(0, b0)`` otherwise);
+        - observations past the last finite bound (the ``+Inf``
+          overflow bucket) clamp to that bound — an all-in-+Inf
+          histogram reports ``buckets[-1]`` for every q;
+        - None when empty — exporters render a dash instead of a fake
+          zero."""
         cum = self.cumulative_counts()
-        total = cum[-1]
-        if total == 0:
-            return None
-        rank = (q / 100.0) * total
-        for i, b in enumerate(self.buckets):
-            if cum[i] >= rank:
-                lo = (self.buckets[i - 1] if i > 0
-                      else min(0.0, b))
-                prev = cum[i - 1] if i > 0 else 0
-                in_bucket = cum[i] - prev
-                frac = ((rank - prev) / in_bucket) if in_bucket else 1.0
-                return lo + (b - lo) * frac
-        return self.buckets[-1]   # +Inf bucket: clamp to the last bound
+        return bucket_percentile(self.buckets, cum, q)
+
+
+def bucket_percentile(buckets: Tuple[float, ...], cumulative: List[int],
+                      q: float) -> Optional[float]:
+    """The ``histogram_quantile`` interpolation over explicit bucket
+    state: ``buckets`` are the finite upper bounds, ``cumulative`` the
+    cumulative counts per bound with the ``+Inf`` entry LAST (length
+    ``len(buckets) + 1``). Shared by :meth:`Histogram.percentile` (live
+    totals) and :mod:`raft_tpu.observability.windows` (windowed count
+    DELTAS — the same math over a snapshot difference). See the method
+    docstring for the full interpolation contract."""
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"percentile: q={q} outside [0, 100]")
+    total = cumulative[-1]
+    if total == 0:
+        return None
+    rank = (q / 100.0) * total
+    for i, b in enumerate(buckets):
+        if cumulative[i] >= rank:
+            lo = (buckets[i - 1] if i > 0 else min(0.0, b))
+            prev = cumulative[i - 1] if i > 0 else 0
+            in_bucket = cumulative[i] - prev
+            frac = ((rank - prev) / in_bucket) if in_bucket else 1.0
+            return lo + (b - lo) * frac
+    return buckets[-1]   # +Inf bucket: clamp to the last finite bound
 
 
 class _NullMetric:

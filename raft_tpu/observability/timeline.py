@@ -272,6 +272,33 @@ def emit_serving(event: str, **args) -> None:
     rec.record("serving", event, lane="serving", **args)
 
 
+def emit_explain(site: str, rid: int, **args) -> None:
+    """One per-query explain record (``explain`` kind): the decision
+    trail of a sampled live search — chosen plane with its downgrade
+    reasons, probed lists, pool width, per-query certificate margins,
+    fixup/rerun outcome and per-stage timings — emitted by
+    :mod:`raft_tpu.observability.explain` when a capture finalizes, so
+    the trace shows WHY a request resolved the way it did next to the
+    dispatch/flow events of the same request id."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("explain", site, rid=rid, **args)
+
+
+def emit_alert(slo: str, severity: str, state: str, **args) -> None:
+    """One SLO burn-rate alert transition (``alert`` kind): ``state``
+    is ``firing`` (both burn windows over threshold) or ``resolved``
+    (recovery cleared it) — emitted by
+    :mod:`raft_tpu.observability.slo` so pages line up on the same
+    timeline as the sheds/deadlines that caused them."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("alert", slo, severity=severity, state=state,
+               lane="slo", **args)
+
+
 # --------------------------------------------------------- drift ledger
 class DriftLedger:
     """Per-site history of (predicted, measured) pairs.

@@ -193,17 +193,17 @@ def knn_query(res, index, x, k: int, rescore: Optional[bool] = None,
         if qpad:
             xq = jnp.concatenate(
                 [xq, jnp.zeros((qpad, xq.shape[1]), jnp.float32)])
-        vals, ids, n_fail = _knn_fused_core(
+        vals, ids, n_fail, margin = _knn_fused_core(
             xq, yp, y_hi, y_lo, yyh_k, yy_raw,
             k=k, T=T_, Qb=Qb_eff, g=g_, passes=passes_, metric=metric_,
             m=m_, rescore=rescore, pbits=pbits_, certify=certify,
             pool_algo=pool_algo, grid_order=order_, db_dtype=dtype_,
             with_stats=True, y_q=y_q, y_scale_k=scale_k, eq_groups=eq)
         if qpad:
-            vals, ids = vals[:Q], ids[:Q]
+            vals, ids, margin = vals[:Q], ids[:Q], margin[:Q]
         if metric_ == "ip":
             vals = -vals        # internal −x·y ascending → IP descending
-        return vals, ids, n_fail
+        return vals, ids, n_fail, margin
 
     statics = (k, T_, Qb_eff, g_, passes_, metric_, m_, bool(rescore),
                pbits_, certify, pool_algo, order_, dtype_, has_yp,
@@ -214,14 +214,17 @@ def knn_query(res, index, x, k: int, rescore: Optional[bool] = None,
     else:
         ops += [o for o in (idx.y_hi, idx.y_lo) if o is not None]
     ops += [idx.yyh_k, idx.yy_raw]
-    vals, ids, n_fail = _aot_call(res, "knn_query", statics, run, x,
-                                  *ops)
+    vals, ids, n_fail, margin = _aot_call(res, "knn_query", statics,
+                                          run, x, *ops)
     # certificate/fixup telemetry for the AOT serving plane: the
     # failure count stays a device scalar here (quality.drain resolves
-    # it later — the live request path never syncs for telemetry)
+    # it later — the live request path never syncs for telemetry); the
+    # per-query margin is likewise only HELD (by reference) when an
+    # explain capture is active, resolved at capture finalize
     try:
         from raft_tpu.distance.knn_fused import (fixup_tiers_for,
                                                  rescore_pool_width)
+        from raft_tpu.observability import explain
         from raft_tpu.observability.quality import record_pending
 
         record_pending(
@@ -230,6 +233,11 @@ def knn_query(res, index, x, k: int, rescore: Optional[bool] = None,
             pool_width=rescore_pool_width(k, S_pool, packed),
             fix_tiers=fixup_tiers_for(idx.yyh_k.shape[1]),
             db_dtype=dtype_, passes=passes_)
+        if explain.active() is not None:
+            explain.note_margin("runtime.knn_query", margin)
+            explain.note(plane="brute", db_dtype=dtype_,
+                         grid_order=order_, passes=passes_,
+                         pool_algo=pool_algo, certify=certify, k=k)
     except Exception:
         pass
     return vals, ids

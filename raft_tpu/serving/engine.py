@@ -170,10 +170,10 @@ class ServingFuture:
 
 class _Request:
     __slots__ = ("x", "n", "enqueued_at", "deadline_at", "future",
-                 "requeues", "rid", "kind", "ids")
+                 "requeues", "rid", "kind", "ids", "explain")
 
     def __init__(self, x, n, enqueued_at, deadline_at, future,
-                 rid=0, kind="query", ids=None):
+                 rid=0, kind="query", ids=None, explain=False):
         self.x = x
         self.n = n
         self.enqueued_at = enqueued_at
@@ -183,6 +183,8 @@ class _Request:
         self.rid = rid          # monotonic flow-trace id (enqueue order)
         self.kind = kind        # "query" | "upsert" | "delete"
         self.ids = ids          # external row ids (mutation requests)
+        self.explain = explain  # capture an explain record for the
+        #                         batch this request rides
 
 
 @instrument("serving.execute_batch")
@@ -275,6 +277,9 @@ class ServingEngine:
                  durable: bool = False,
                  durable_dir: Optional[str] = None,
                  wal_sync: Optional[str] = None,
+                 explain_frac: Optional[float] = None,
+                 debug_port: Optional[int] = None,
+                 slo=None,
                  clock=time.monotonic):
         from raft_tpu.ann import IvfFlatIndex
         from raft_tpu.distance.knn_fused import KnnIndex
@@ -452,6 +457,29 @@ class ServingEngine:
                               if shadow_floor is None
                               else float(shadow_floor))
         self._shadow: Optional[ShadowSampler] = None
+        # per-query explain capture (PR 16): frac 0 = off; constructor
+        # wins over RAFT_TPU_EXPLAIN_FRAC; submit(explain=True) forces
+        # capture for one request regardless of the fraction
+        from raft_tpu.observability.explain import explain_frac_default
+
+        self._explain_frac = (explain_frac_default()
+                              if explain_frac is None
+                              else max(0.0, min(1.0,
+                                                float(explain_frac))))
+        # windowed SLO burn-rate engine: always on (evaluation is one
+        # registry snapshot per window interval); injectable for tests
+        if slo is None:
+            from raft_tpu.observability.slo import SloEngine
+
+            slo = SloEngine(registry=self.res.metrics,
+                            clock=self._clock)
+        self._slo = slo
+        # debugz server: constructor wins over RAFT_TPU_DEBUGZ_PORT
+        # (0 = ephemeral port; None/unset = no server)
+        if debug_port is None:
+            debug_port = env.get("RAFT_TPU_DEBUGZ_PORT")
+        self._debug_port = debug_port
+        self._debugz = None
 
     # -- construction helpers --------------------------------------------
     def _build_index(self, y):
@@ -522,6 +550,12 @@ class ServingEngine:
     def started(self) -> bool:
         return self._started
 
+    @property
+    def slo(self):
+        """The attached :class:`~raft_tpu.observability.slo.SloEngine`
+        (burn-rate alerts), or None."""
+        return self._slo
+
     def start(self) -> "ServingEngine":
         """Warm every bucket shape (AOT compile through the runtime
         entry — live requests then always hit the compile cache) and
@@ -540,6 +574,11 @@ class ServingEngine:
                                         name="serving-batcher",
                                         daemon=True)
         self._thread.start()
+        if self._debug_port is not None and self._debugz is None:
+            from tools.debugz import DebugzServer
+
+            self._debugz = DebugzServer(
+                engine=self, port=int(self._debug_port)).start()
         return self
 
     def stop(self, timeout: float = 30.0) -> None:
@@ -552,6 +591,9 @@ class ServingEngine:
         if t is not None:
             t.join(timeout)
         self._thread = None
+        if self._debugz is not None:
+            self._debugz.stop()
+            self._debugz = None
         if self._shadow is not None:
             self._shadow.flush(timeout=min(10.0, timeout))
             self._shadow.stop()
@@ -635,14 +677,18 @@ class ServingEngine:
             self.res.compile_cache.misses - misses0)
 
     # -- admission --------------------------------------------------------
-    def submit(self, x, deadline_s: Optional[float] = None
-               ) -> ServingFuture:
+    def submit(self, x, deadline_s: Optional[float] = None,
+               explain: bool = False) -> ServingFuture:
         """Enqueue one request of [n, d] (or [d]) query rows; returns a
         :class:`ServingFuture`. Admission control happens HERE:
         oversized requests raise :class:`RequestTooLargeError`, a full
         queue raises :class:`OverloadShedError` (counted as the
         ``shed:overload`` degradation rung). Carries the
-        ``serving_enqueue`` fault site."""
+        ``serving_enqueue`` fault site.
+
+        ``explain=True`` forces an explain record for the batch this
+        request rides (otherwise a deterministic hash-sample of rids at
+        ``RAFT_TPU_EXPLAIN_FRAC`` decides)."""
         fault_point("serving_enqueue")
         x = np.asarray(x, np.float32)
         if x.ndim == 1:
@@ -674,9 +720,14 @@ class ServingEngine:
         now = self._clock()
         budget = (deadline_s if deadline_s is not None
                   else self._default_deadline_s)
+        from raft_tpu.observability import explain as explain_mod
+
         req = _Request(x, n, now,
                        now + budget if budget else None,
-                       ServingFuture(), rid=rid)
+                       ServingFuture(), rid=rid,
+                       explain=(bool(explain)
+                                or explain_mod.want(rid,
+                                                    self._explain_frac)))
         with self._cond:
             if self._depth_rows + n > self._max_queue_rows:
                 self._count_request("shed")
@@ -901,6 +952,17 @@ class ServingEngine:
             out["recovery"] = dict(self._recovery)
         if self._shadow is not None:
             out.update(self._shadow.snapshot())
+        if self._slo is not None:
+            try:
+                out["slo"] = self._slo.status()
+            except Exception:
+                pass
+        from raft_tpu.observability.explain import explain_records
+
+        out["explain"] = {"frac": self._explain_frac,
+                          "records": len(explain_records())}
+        if self._debugz is not None:
+            out["debugz_port"] = self._debugz.port
         return out
 
     # the name the quality-telemetry plane documents; same snapshot
@@ -988,6 +1050,10 @@ class ServingEngine:
                         # empty-queue flush timer tick: nothing to
                         # dispatch — the timer is a no-op, not a batch
                         self._cond.wait(self._flush_interval_s)
+                        if self._slo is not None:
+                            # break out so the SLO tick runs OUTSIDE
+                            # the cond lock (it snapshots the registry)
+                            break
                 if self._stop and not self._queue:
                     self._busy = False
                     self._cond.notify_all()
@@ -1006,6 +1072,10 @@ class ServingEngine:
                     with self._cond:
                         self._busy = False
                         self._cond.notify_all()
+            if self._slo is not None:
+                # self-rate-limited (MetricWindows.interval_s): most
+                # calls are one clock read; never raises
+                self._slo.tick()
 
     def _run_batch(self, batch, total: int) -> None:
         # ONE snapshot/view per batch — every rider sees one index
@@ -1049,13 +1119,26 @@ class ServingEngine:
         for req in batch:
             emit_flow("dispatch", req.rid, ph="t",
                       generation=snap.generation)
+        from raft_tpu.observability import explain as explain_mod
+
+        # explain capture spans the dispatch: any flagged rider opens
+        # one record for the whole batch (the plane/margin notes land
+        # in it from the kernels below); begin_capture returns None
+        # when no rider is flagged, and every hook no-ops then
+        cap = (explain_mod.begin_capture([r.rid for r in batch])
+               if any(r.explain for r in batch) else None)
         try:
-            vals, ids = execute_batch(self._plane, snap, x, bucket,
-                                      total, budget)
+            with explain_mod.stage("execute_batch"):
+                vals, ids = execute_batch(self._plane, snap, x, bucket,
+                                          total, budget)
         except DeadlineExceededError as e:
+            explain_mod.end_capture(cap, outcome="deadline",
+                                    bucket=bucket, riders=len(batch))
             self._on_batch_deadline(batch, e)
             return
         except Exception as e:
+            explain_mod.end_capture(cap, outcome="error",
+                                    bucket=bucket, riders=len(batch))
             for req in batch:
                 self._count_request("error")
                 emit_flow("fail", req.rid, ph="f", outcome="error")
@@ -1076,6 +1159,9 @@ class ServingEngine:
             off += req.n
             self._count_request("ok")
             self._observe_latency(max(0.0, done - req.enqueued_at))
+        explain_mod.end_capture(cap, outcome="ok", bucket=bucket,
+                                rows=total, riders=len(batch),
+                                generation=snap.generation)
 
     def _run_mutation(self, req) -> None:
         """Apply ONE mutation request on the batcher thread, inside its

@@ -160,9 +160,12 @@ def test_certificate_passes_on_margin_data(fixture):
     probes = _coarse_probe(res, idx8.centroids, jnp.asarray(Q), P)
     st = jnp.take(idx8.offsets[:-1], probes)
     ps = jnp.take(idx8.padded_sizes, probes)
-    _, _, ok = pq_scan_chunk(idx8, jnp.asarray(Q), np.asarray(probes),
-                             probes, st, ps, k, P, idx8.probe_window)
+    _, _, ok, margin = pq_scan_chunk(
+        idx8, jnp.asarray(Q), np.asarray(probes), probes, st, ps,
+        k, P, idx8.probe_window)
     assert float(jnp.mean(ok.astype(jnp.float32))) >= 0.9
+    # the margin output agrees sign-for-sign with the certificate
+    assert bool(jnp.all((margin >= 0) == ok))
 
 
 def test_exact_oracle_parity_at_degenerate(fixture):

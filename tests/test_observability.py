@@ -309,6 +309,137 @@ def test_prometheus_label_escaping():
     assert 'e_total{p="a\\"b\\\\c\\nd"} 1' in export_prometheus(reg)
 
 
+def test_prometheus_help_escaping():
+    reg = MetricsRegistry()
+    reg.counter("h_total", help="line one\nline two \\ done").inc()
+    text = export_prometheus(reg)
+    # HELP continuation lines escape \n and \ per the exposition
+    # format — a literal newline would truncate the comment and make
+    # the next line junk to the scraper
+    assert "# HELP h_total line one\\nline two \\\\ done\n" in text
+    assert "\nline two" not in text
+
+
+def _parse_exposition(text):
+    """Minimal exposition-format parser (scrape-side view): name →
+    {(label tuple): value}, unescaping label values."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_part, value = line.rsplit(" ", 1)
+        if "{" in name_part:
+            name, rest = name_part.split("{", 1)
+            body = rest.rsplit("}", 1)[0]
+            labels = []
+            for item in _split_labels(body):
+                k, v = item.split("=", 1)
+                labels.append((k, _unescape(v[1:-1])))
+            key = tuple(sorted(labels))
+        else:
+            name, key = name_part, ()
+        out.setdefault(name, {})[key] = float(value)
+    return out
+
+
+def _unescape(v):
+    """Single-pass label-value unescape (sequential str.replace would
+    corrupt a literal backslash-n into a newline)."""
+    out, i = [], 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt in ('"', "\\"):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(v[i])
+        i += 1
+    return "".join(out)
+
+
+def _split_labels(body):
+    """Split a label body on commas OUTSIDE quoted values."""
+    items, cur, in_q, esc = [], "", False, False
+    for ch in body:
+        if esc:
+            cur += ch
+            esc = False
+        elif ch == "\\":
+            cur += ch
+            esc = True
+        elif ch == '"':
+            cur += ch
+            in_q = not in_q
+        elif ch == "," and not in_q:
+            items.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        items.append(cur)
+    return items
+
+
+def test_prometheus_roundtrip_adversarial_labels():
+    # label values chosen to break naive exposition writers: embedded
+    # quotes, backslashes, newlines, commas, braces, '=' signs
+    adversarial = ['plain', 'a"b', 'back\\slash', 'new\nline',
+                   'comma,brace}', 'eq=sign', '\\"both\\n', '']
+    reg = MetricsRegistry()
+    for i, v in enumerate(adversarial):
+        reg.counter("rt_total", {"p": v, "i": str(i)}).inc(i + 1)
+    parsed = _parse_exposition(export_prometheus(reg))
+    assert len(parsed["rt_total"]) == len(adversarial)
+    for i, v in enumerate(adversarial):
+        key = tuple(sorted([("p", v), ("i", str(i))]))
+        assert parsed["rt_total"][key] == i + 1, (i, v)
+
+
+# -------------------------------------------------- histogram percentiles
+def test_percentile_empty_histogram_is_none():
+    reg = MetricsRegistry()
+    h = reg.histogram("p_seconds", buckets=(0.1, 1.0))
+    assert h.percentile(50) is None
+    assert h.percentile(99) is None
+
+
+def test_percentile_single_bucket_interpolates_from_zero_edge():
+    reg = MetricsRegistry()
+    h = reg.histogram("p1_seconds", buckets=(1.0,))
+    for _ in range(4):
+        h.observe(0.5)
+    # all mass in [0, 1]: rank interpolation within the first bucket,
+    # lower edge pinned at min(0, b0) = 0
+    assert h.percentile(50) == pytest.approx(0.5)
+    assert h.percentile(100) == pytest.approx(1.0)
+
+
+def test_percentile_all_in_overflow_clamps_to_last_bound():
+    reg = MetricsRegistry()
+    h = reg.histogram("p2_seconds", buckets=(0.1, 1.0))
+    for _ in range(10):
+        h.observe(50.0)                  # everything past the buckets
+    # +Inf bucket has no upper edge — the estimate clamps to the last
+    # FINITE bound rather than inventing a number
+    assert h.percentile(50) == pytest.approx(1.0)
+    assert h.percentile(99) == pytest.approx(1.0)
+
+
+def test_percentile_negative_first_edge():
+    from raft_tpu.observability.metrics import bucket_percentile
+
+    # a bucket layout spanning negatives (the certificate-margin
+    # histogram): the first bucket's lower edge is min(0, b0)
+    buckets = (-10.0, -1.0, 0.0, 1.0)
+    cumulative = [4, 4, 4, 4, 4]         # all mass in (-inf, -10]
+    assert bucket_percentile(buckets, cumulative, 50) <= -5.0
+
+
 def test_jsonl_golden():
     reg = _golden_registry()
     reg.emit({"type": "span", "span": "s", "range": "", "seconds": 0.25,

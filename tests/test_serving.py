@@ -588,3 +588,65 @@ def test_committed_serving_artifact_schema():
         assert field in rec, field
     assert rec["compile_misses_after_warmup"] == 0
     assert rec["ok"] is True
+    # the SLO block (ISSUE 16): availability + burn-alert evidence
+    slo = rec.get("slo")
+    assert isinstance(slo, dict), "slo block missing — regenerate"
+    for field in ("availability", "total_requests", "bad_requests",
+                  "fast_burn_alerts", "fast_burn_by_slo", "healthy"):
+        assert field in slo, field
+
+
+def test_bench_report_slo_gate_matrix():
+    br = _tools_import("bench_report")
+    mk = lambda n, rec: (n, f"SERVING_r{n:02d}.json", rec)
+    good_slo = {"availability": 0.999, "total_requests": 1000,
+                "bad_requests": 1, "fast_burn_alerts": 0,
+                "fast_burn_by_slo": {}, "healthy": True}
+    # nothing to gate / degraded round → SKIP
+    assert br.check_slo([])[0] == br.SKIP
+    st, msg = br.check_slo(
+        [mk(1, {"ok": True, "resilience_degradations": 2.0,
+                "slo": dict(good_slo)})])
+    assert st == br.SKIP and "degrad" in msg
+    # artifact predating the SLO plane → MISSING_BASELINE
+    st, msg = br.check_slo([mk(1, {"ok": True})])
+    assert st == br.MISSING_BASELINE and "regenerate" in msg
+    # failed round: the [serving] gate owns it, [slo] skips
+    assert br.check_slo(
+        [mk(1, {"ok": False, "slo": dict(good_slo)})])[0] == br.SKIP
+    # clean round passes
+    st, msg = br.check_slo([mk(1, {"ok": True, "slo": dict(good_slo)})])
+    assert st == br.PASS and "availability" in msg
+    # availability below the 0.99 floor regresses
+    st, msg = br.check_slo([mk(1, {
+        "ok": True, "slo": dict(good_slo, availability=0.97,
+                                bad_requests=30)})])
+    assert st == br.REGRESS and "availability" in msg
+    # no traffic: no evidence, no gate
+    assert br.check_slo([mk(1, {
+        "ok": True,
+        "slo": dict(good_slo, availability=None)})])[0] == br.SKIP
+    # a page-severity fast burn on an ok MEASURED round regresses
+    st, msg = br.check_slo([mk(1, {
+        "ok": True, "measured": True,
+        "slo": dict(good_slo, fast_burn_alerts=1,
+                    fast_burn_by_slo={"availability": 1})})])
+    assert st == br.REGRESS and "burn" in msg
+    # modeled round: LATENCY burns are wall-clock noise — not gated ...
+    st, msg = br.check_slo([mk(1, {
+        "ok": True, "measured": False,
+        "slo": dict(good_slo, fast_burn_alerts=1,
+                    fast_burn_by_slo={"latency_p99": 1})})])
+    assert st == br.PASS and "not gated" in msg
+    # ... but an availability burn gates even on modeled rounds
+    st, msg = br.check_slo([mk(1, {
+        "ok": True, "measured": False,
+        "slo": dict(good_slo, fast_burn_alerts=2,
+                    fast_burn_by_slo={"latency_p99": 1,
+                                      "availability": 1})})])
+    assert st == br.REGRESS and "availability" in str(msg)
+    # legacy block without the per-slo split: gate conservatively
+    st, msg = br.check_slo([mk(1, {
+        "ok": True, "measured": False,
+        "slo": {"availability": 1.0, "fast_burn_alerts": 1}})])
+    assert st == br.REGRESS

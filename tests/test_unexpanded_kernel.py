@@ -7,6 +7,7 @@ distance/detail/pairwise_distance ops; mirrored here per-metric the way
 cpp/tests/distance/dist_*.cu parameterize per metric.)
 """
 
+import jax
 import numpy as np
 import pytest
 from scipy.spatial.distance import cdist
@@ -16,6 +17,20 @@ from raft_tpu.ops.unexpanded_pallas import (unexpanded_eligible,
                                             unexpanded_pairwise_tiled)
 
 rng = np.random.default_rng(3)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_jit_arena():
+    # The interpret-mode pallas programs this module compiles are the
+    # largest in the suite, and this module runs LAST — by now the
+    # process carries >1100 tests of accumulated CPU-JIT executables,
+    # and XLA's compiler segfaults once that arena is near its ceiling
+    # (the crash wanders between this module's compiles as the suite
+    # grows). Dropping the cached executables first gives these
+    # compiles a fresh arena; nothing runs after this module, so the
+    # recompile cost is only its own shared helpers.
+    jax.clear_caches()
+    yield
 
 
 def _prob(a):

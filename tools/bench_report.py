@@ -1488,6 +1488,86 @@ def check_quality(records: Sequence[Tuple[str, Optional[Dict]]],
     return PASS, "quality ok: " + "; ".join(checked) + note
 
 
+#: availability floor for the serving SLO gate: an ok round that served
+#: less than this fraction of admitted requests is a regression.
+SLO_AVAILABILITY_FLOOR = 0.99
+
+
+def check_slo(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
+              floor: float = SLO_AVAILABILITY_FLOOR
+              ) -> Tuple[str, str]:
+    """Gate the serving SLO block (ISSUE 16).
+
+    The newest parseable serving round must carry an ``"slo"`` block
+    (MISSING_BASELINE without one — the artifact predates the SLO
+    plane, regenerate it); degraded rounds SKIP (outage evidence is
+    history, never a gate). On an ok round:
+
+    - run-cumulative ``availability`` must reach ``floor`` (0.99 —
+      admitted requests that shed/expired/errored ate more than the
+      availability budget);
+    - no page-severity fast-burn alert may have fired
+      (``fast_burn_alerts == 0``) — an ok round that still tripped the
+      pager means the burn thresholds and the serving path disagree
+      about health, which is exactly what this gate exists to catch.
+      On MODELED (off-TPU) rounds latency burns are excluded: latency
+      is speed evidence and CPU wall clock is never chip evidence —
+      the same measured-only rule every speed gate here follows."""
+    newest = None
+    for _, _, rec in reversed(rounds):
+        if rec is not None:
+            newest = rec
+            break
+    if newest is None:
+        return SKIP, "no serving artifact to gate"
+    if newest.get("skipped"):
+        return SKIP, "latest serving round skipped"
+    rd = newest.get("resilience_degradations")
+    if isinstance(rd, (int, float)) and rd > 0:
+        return SKIP, (
+            f"latest serving round recorded {rd:g} degradation "
+            f"step(s) — a degraded run is history, never gated")
+    slo = newest.get("slo")
+    if not isinstance(slo, dict):
+        return MISSING_BASELINE, (
+            "latest serving round carries no slo block — regenerate "
+            "BENCH_SERVING.json (benchmarks/bench_serving.py)")
+    if not newest.get("ok", True):
+        return SKIP, ("latest serving round failed (ok=false) — the "
+                      "[serving] gate owns that regression")
+    avail = slo.get("availability")
+    if avail is None:
+        return SKIP, "slo block has no availability evidence (no traffic)"
+    if not isinstance(avail, (int, float)):
+        return REGRESS, (
+            f"SLO REGRESSION: availability is non-numeric ({avail!r})")
+    if avail < floor:
+        return REGRESS, (
+            f"SLO REGRESSION: availability {avail:.4f} < floor "
+            f"{floor:g} ({slo.get('bad_requests', '?')} bad of "
+            f"{slo.get('total_requests', '?')} requests)")
+    burns = slo.get("fast_burn_alerts")
+    note = ""
+    if isinstance(burns, (int, float)) and burns > 0:
+        by_slo = slo.get("fast_burn_by_slo")
+        if newest.get("measured") or not isinstance(by_slo, dict):
+            gated = {"all": burns} if not isinstance(by_slo, dict) \
+                else by_slo
+        else:
+            gated = {k: v for k, v in by_slo.items()
+                     if k != "latency_p99" and v > 0}
+        if gated:
+            return REGRESS, (
+                f"SLO REGRESSION: page-severity burn alert(s) fired "
+                f"during an ok round ({gated}) — the pager and the "
+                f"serving path disagree about health")
+        note = (f" (latency fast-burn(s) {by_slo} not gated on a "
+                f"modeled round — CPU wall clock is not chip evidence)")
+    return PASS, (f"slo ok: availability {avail:.4f} ≥ {floor:g} "
+                  f"over {slo.get('total_requests', '?')} request(s)"
+                  + note)
+
+
 def staleness_section(entries: List[Dict]) -> str:
     lines = ["named artifacts (freshness vs the last-good commit)",
              "---------------------------------------------------"]
@@ -1579,6 +1659,8 @@ def main(argv: Sequence[str] = None) -> int:
              ("serving", newest_s), ("ann", newest_a),
              ("mutation", newest_mu)])
         print(f"bench_report --check [quality]: {qlstatus}: {qlmsg}")
+        slstatus, slmsg = check_slo(srounds)
+        print(f"bench_report --check [slo]: {slstatus}: {slmsg}")
         ledger_path = args.drift_ledger or os.path.join(
             args.dir, DRIFT_LEDGER_NAME)
         dstatus, dmsg = check_drift(load_drift_ledger(ledger_path),
@@ -1596,8 +1678,8 @@ def main(argv: Sequence[str] = None) -> int:
         # nothing regressed
         rcs = (codes[status], codes[mstatus], codes[sstatus],
                codes[astatus], codes[mustatus], codes[rstatus],
-               codes[qstatus], codes[qlstatus], codes[dstatus],
-               codes[lstatus])
+               codes[qstatus], codes[qlstatus], codes[slstatus],
+               codes[dstatus], codes[lstatus])
         return 1 if 1 in rcs else max(rcs)
 
     if args.json:

@@ -202,6 +202,12 @@ EVENT_SITES: Dict[str, Sequence[str]] = {
     # audited post-mortem
     "raft_tpu/mutable/wal.py": ("fault_point", "emit_marker"),
     "raft_tpu/mutable/checkpoint.py": ("fault_point", "emit_mutation"),
+    # the telemetry front door (ISSUE 16): explain records land on the
+    # flight timeline as "explain" events, SLO burn transitions as
+    # "alert" events — deleting either bridge silently blinds the
+    # debugz surfaces while every capture/tick keeps "running"
+    "raft_tpu/observability/explain.py": ("emit_explain",),
+    "raft_tpu/observability/slo.py": ("emit_alert",),
 }
 
 #: quality-telemetry gate (ISSUE 10): every module with a certificate /

@@ -167,6 +167,60 @@ def render_statusz(registry=None, recorder=None, engine=None,
     except Exception as e:
         out.write(f"(durability section unavailable: {e})\n")
 
+    # ---- SLO burn state ------------------------------------------------
+    out.write("\nSLO burn state\n--------------\n")
+    try:
+        slo = getattr(engine, "slo", None) if engine is not None \
+            else None
+        if slo is None:
+            out.write("(no SLO engine attached)\n")
+        else:
+            st = slo.status()
+            out.write(f"healthy={st['healthy']}  "
+                      f"covered={st['covered_s']:.1f}s\n")
+            for obj in st.get("objectives", []):
+                out.write(f"  {obj['slo']:<18} objective="
+                          f"{obj['objective']:.4f}\n")
+                for rung in obj.get("windows", []):
+                    fast = rung.get("burn_fast")
+                    slow = rung.get("burn_slow")
+                    out.write(
+                        f"    {rung['severity']:<8} "
+                        f"fast={'-' if fast is None else f'{fast:.2f}'}"
+                        f"  slow="
+                        f"{'-' if slow is None else f'{slow:.2f}'}"
+                        f"  x{rung['factor']:g}"
+                        + ("  FIRING" if rung.get("firing") else "")
+                        + "\n")
+            for alert in st.get("active_alerts", []):
+                out.write(f"  ALERT {alert['slo']}/{alert['severity']}"
+                          f" burn={alert.get('burn_fast', 0):.2f}\n")
+    except Exception as e:
+        out.write(f"(SLO section unavailable: {e})\n")
+
+    # ---- explain ring --------------------------------------------------
+    out.write("\nexplain ring (newest records)\n")
+    out.write("-----------------------------\n")
+    try:
+        from raft_tpu.observability.explain import explain_records
+
+        records = explain_records(limit=4)
+        if not records:
+            out.write("(no explain records — set RAFT_TPU_EXPLAIN_FRAC"
+                      " or submit(explain=True))\n")
+        for r in records:
+            margins = r.get("margins", {})
+            m_min = min((m["min"] for m in margins.values()),
+                        default=None)
+            out.write(f"  rid={r.get('rids', ['-'])[0]:<8} "
+                      f"plane={r.get('plane', '?'):<9} "
+                      f"outcome={r.get('outcome', '?'):<8} "
+                      f"margin_min="
+                      f"{'-' if m_min is None else f'{m_min:.4g}'}"
+                      f"  wall={r.get('wall_s', 0) * 1e3:.1f}ms\n")
+    except Exception as e:
+        out.write(f"(explain section unavailable: {e})\n")
+
     out.write("\ndegradations\n------------\n")
     try:
         from raft_tpu.resilience import degradation_count
