@@ -196,14 +196,24 @@ def main(argv=None) -> int:
                    else (0.05 if measured else 1.0))
     rng = np.random.default_rng(args.seed)
     Y = rng.normal(size=(m, d)).astype(np.float32)
+    # blackbox riding the load run (ISSUE 17): every flight event is
+    # mirrored into a crash-durable mmap ring; the artifact stamps the
+    # measured per-record overhead, gated < 1% of request wall time by
+    # bench_report --check [blackbox]
+    import tempfile
+
+    bb_dir = tempfile.mkdtemp(prefix="bench-blackbox-")
+    bb_path = os.path.join(bb_dir, "blackbox.bin")
     if measured:
         idx = prepare_knn_index(Y)
-        engine = ServingEngine(idx, k=k, shadow_frac=shadow_frac)
+        engine = ServingEngine(idx, k=k, shadow_frac=shadow_frac,
+                               blackbox_path=bb_path)
     else:
         idx = prepare_knn_index(Y, passes=3, T=256, Qb=32, g=2)
         engine = ServingEngine(idx, k=k, buckets=(8, 16, 32),
                                flush_interval_s=0.002,
-                               shadow_frac=shadow_frac)
+                               shadow_frac=shadow_frac,
+                               blackbox_path=bb_path)
     ladder = engine.buckets
 
     # request mix: ragged sizes across the ladder (Poisson-ish bulk,
@@ -247,6 +257,8 @@ def main(argv=None) -> int:
         engine.slo.tick(force=True)
     stats = engine.stats()
     ok = ok and compile_misses == 0
+    bb_stats = (engine.blackbox.stats()
+                if engine.blackbox is not None else None)
     engine.stop()
 
     from raft_tpu.observability.metrics import percentile
@@ -310,6 +322,28 @@ def main(argv=None) -> int:
         result["slo"] = _slo_block(stats.get("slo"))
     except Exception as e:
         print(f"bench_serving: slo block failed: {e}",
+              file=sys.stderr)
+    # blackbox block (ISSUE 17): the recorder's own overhead evidence —
+    # overhead_frac = cumulative mmap-append seconds / total client
+    # request wall time. Gated < 1% by bench_report --check [blackbox].
+    try:
+        if bb_stats is not None:
+            req_wall = float(sum(latencies))
+            result["blackbox"] = {
+                "records": bb_stats["records"],
+                "bytes_written": bb_stats["bytes_written"],
+                "ring_bytes": bb_stats["ring_bytes"],
+                "append_seconds": round(bb_stats["append_seconds"], 6),
+                "request_wall_seconds": round(req_wall, 6),
+                "overhead_frac": (
+                    round(bb_stats["append_seconds"] / req_wall, 6)
+                    if req_wall > 0 else None),
+            }
+        import shutil
+
+        shutil.rmtree(bb_dir, ignore_errors=True)
+    except Exception as e:
+        print(f"bench_serving: blackbox block failed: {e}",
               file=sys.stderr)
     if degr:
         result["resilience_degradations"] = degr

@@ -98,6 +98,15 @@ _knob("RAFT_TPU_FLIGHT_MAX_DUMPS", "int", 16,
 _knob("RAFT_TPU_DRIFT_LEDGER", "path", None,
       "persist the model-vs-measured drift ledger to this path")
 
+# -- forensics (blackbox / watchdog) ------------------------------------
+_knob("RAFT_TPU_BLACKBOX_PATH", "path", None,
+      "crash-durable blackbox ring file mirroring flight events "
+      "(unset = forensics off)")
+_knob("RAFT_TPU_BLACKBOX_BYTES", "int", 1048576,
+      "blackbox ring size in bytes (min 16 KiB)")
+_knob("RAFT_TPU_WATCHDOG_S", "float", None,
+      "hang-watchdog tick interval in seconds (unset/0 = off)")
+
 # -- resilience ---------------------------------------------------------
 _knob("RAFT_TPU_FAULTS", "str", None,
       "fault-injection DSL: site:kind[@call=N][:p=F];…")

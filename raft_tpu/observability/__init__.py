@@ -25,6 +25,13 @@ TPU-native port's equivalents behind ONE substrate:
   errors and fired deadlines (:mod:`raft_tpu.observability.flight` +
   :mod:`raft_tpu.observability.timeline`), plus the model-vs-measured
   :class:`DriftLedger` gated by ``tools/bench_report.py --check``.
+- forensics plane — the crash-durable blackbox (a memory-mapped
+  CRC-framed ring file mirroring every flight event + periodic metrics
+  snapshots, readable after SIGKILL — :mod:`raft_tpu.observability
+  .blackbox`), the hang watchdog (heartbeat tracking + thread-stack
+  stall dumps, :mod:`raft_tpu.observability.watchdog`), and the
+  offline reconstruction CLI ``tools/postmortem.py`` with its live
+  debugz routes ``/stackz`` and ``/crashz``.
 - telemetry front door — the per-query explain plane (hash-sampled
   decision records with certificate margins,
   :mod:`raft_tpu.observability.explain`), windowed metric aggregation
@@ -142,6 +149,15 @@ from raft_tpu.observability.slo import (
     default_objectives,
 )
 from raft_tpu.observability.windows import MetricWindows
+from raft_tpu.observability.blackbox import (
+    BlackBox,
+    reconstruct,
+)
+from raft_tpu.observability.watchdog import (
+    Watchdog,
+    dump_stacks,
+    format_stacks,
+)
 
 
 def reset() -> None:
@@ -223,4 +239,9 @@ __all__ = [
     "SloEngine",
     "SloObjective",
     "default_objectives",
+    "BlackBox",
+    "reconstruct",
+    "Watchdog",
+    "dump_stacks",
+    "format_stacks",
 ]

@@ -76,6 +76,10 @@ KNOWN_EVENT_KINDS = (
     "explain",       # per-query explain records (observability.explain)
     "alert",         # SLO burn-rate alerts firing/resolving
     #                  (observability.slo)
+    "stall",         # hang-watchdog stall detections
+    #                  (observability.watchdog)
+    "epilogue",      # clean-shutdown marker the blackbox appends last
+    #                  (observability.blackbox)
 )
 
 #: events attached to DeviceError/DeadlineExceededError payloads
@@ -84,6 +88,15 @@ TAIL_EVENTS = 64
 DEFAULT_CAPACITY = 4096
 
 FLIGHT_EVENTS_TOTAL = "raft_tpu_flight_events_total"
+
+#: ring evictions surfaced to the registry by :func:`sync_dropped_metric`
+#: — truncated evidence must be visible before anyone trusts a dump
+FLIGHT_DROPPED = "raft_tpu_flight_dropped_total"
+
+#: crash-durable mirror (an observability.blackbox.BlackBox, installed
+#: by blackbox.install()) — None is the disabled state, and the cost of
+#: disabled is exactly one module-attribute read + None test per event.
+_mirror = None
 
 
 def _env_capacity() -> int:
@@ -136,6 +149,11 @@ class FlightRecorder:
         with self._lock:
             self._seq += 1
             self._ring.append(ev)
+        # crash-durable mirror, outside the ring lock: the blackbox
+        # serializes internally and its append never raises
+        bb = _mirror
+        if bb is not None:
+            bb.append_event(ev)
 
     # -- queries ----------------------------------------------------------
     def events(self) -> List[Dict]:
@@ -280,6 +298,36 @@ def post_mortem(trigger: str, error: Optional[BaseException] = None,
         return path
     except Exception:
         return None
+
+
+_dropped_sync_lock = threading.Lock()
+_dropped_exported = 0
+
+
+def sync_dropped_metric(recorder: Optional[FlightRecorder] = None) -> int:
+    """Fold ring evictions since the last sync into the monotone
+    :data:`FLIGHT_DROPPED` counter; returns the recorder's current
+    ``dropped`` count. Called from /statusz renders, watchdog ticks and
+    blackbox snapshots — cheap (two lock-guarded reads) and never
+    raises past the registry. A ``clear()`` (which resets ``dropped``)
+    only rebaselines: the counter never decrements."""
+    rec = recorder if recorder is not None else _global_recorder
+    dropped = rec.dropped
+    global _dropped_exported
+    with _dropped_sync_lock:
+        delta = dropped - _dropped_exported
+        _dropped_exported = dropped
+    if delta > 0:
+        try:
+            from raft_tpu.observability.metrics import get_registry
+
+            get_registry().counter(
+                FLIGHT_DROPPED,
+                help="Flight-recorder events evicted by ring wraparound",
+            ).inc(delta)
+        except Exception:
+            pass
+    return dropped
 
 
 def error_tail() -> List[Dict]:

@@ -299,6 +299,29 @@ def emit_alert(slo: str, severity: str, state: str, **args) -> None:
                lane="slo", **args)
 
 
+def emit_stall(source: str, **args) -> None:
+    """One hang-watchdog stall detection (``stall`` kind): ``source``
+    names the silent heartbeat (``serving-batcher``) or the overdue
+    request set — emitted by :mod:`raft_tpu.observability.watchdog`
+    alongside the thread-stack dump it writes into the blackbox, so a
+    postmortem can tell a hang from a violent crash."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("stall", source, lane="watchdog", **args)
+
+
+def emit_epilogue(reason: str, **args) -> None:
+    """The clean-shutdown marker (``epilogue`` kind) the blackbox
+    records last: a blackbox file whose newest record is NOT an
+    epilogue was a violent death (:mod:`raft_tpu.observability
+    .blackbox` reconstructs the verdict from exactly this)."""
+    rec = get_flight_recorder()
+    if not rec.enabled:
+        return
+    rec.record("epilogue", reason, lane="lifecycle", **args)
+
+
 # --------------------------------------------------------- drift ledger
 class DriftLedger:
     """Per-site history of (predicted, measured) pairs.

@@ -80,13 +80,14 @@ import collections
 import os
 import threading
 import time
-from typing import Optional, Sequence, Tuple, Union
+from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from raft_tpu.core import env, interruptible
 from raft_tpu.core.error import (DeadlineExceededError, LogicError,
                                  RaftException, expects)
+from raft_tpu.core.logger import log_warn
 from raft_tpu.core.resources import ensure_resources
 from raft_tpu.observability import instrument
 from raft_tpu.observability.metrics import percentile
@@ -279,6 +280,8 @@ class ServingEngine:
                  wal_sync: Optional[str] = None,
                  explain_frac: Optional[float] = None,
                  debug_port: Optional[int] = None,
+                 blackbox_path: Optional[str] = None,
+                 watchdog_s: Optional[float] = None,
                  slo=None,
                  clock=time.monotonic):
         from raft_tpu.ann import IvfFlatIndex
@@ -480,6 +483,15 @@ class ServingEngine:
             debug_port = env.get("RAFT_TPU_DEBUGZ_PORT")
         self._debug_port = debug_port
         self._debugz = None
+        # forensics plane (ISSUE 17): crash-durable blackbox + hang
+        # watchdog, both defaults-off; constructor wins over
+        # RAFT_TPU_BLACKBOX_PATH / RAFT_TPU_WATCHDOG_S
+        self._blackbox_path = blackbox_path
+        self._watchdog_s = watchdog_s
+        self._blackbox = None
+        self._owns_blackbox = False
+        self._watchdog = None
+        self._crash_report: Optional[dict] = None
 
     # -- construction helpers --------------------------------------------
     def _build_index(self, y):
@@ -565,6 +577,7 @@ class ServingEngine:
                 return self
             self._started = True
             self._stop = False
+        self._boot_forensics()
         self._warm_snapshot(self._store.current())
         if self._shadow_frac > 0.0 and self._shadow is None:
             self._shadow = ShadowSampler(
@@ -579,7 +592,45 @@ class ServingEngine:
 
             self._debugz = DebugzServer(
                 engine=self, port=int(self._debug_port)).start()
+        if self._watchdog is not None:
+            self._watchdog.start()
         return self
+
+    def _boot_forensics(self) -> None:
+        """Open the blackbox (env/constructor-gated) — surfacing and
+        preserving a prior run's unclean file first — and build the
+        watchdog. Never raises: forensics must not block serving."""
+        from raft_tpu.observability import blackbox as blackbox_mod
+        from raft_tpu.observability.watchdog import Watchdog
+
+        try:
+            booted = blackbox_mod.boot(path=self._blackbox_path)
+            self._blackbox = booted.recorder
+            self._owns_blackbox = booted.created
+            prior = booted.prior
+            if prior is not None and prior.get("verdict") != "clean":
+                # the previous run died violently: keep the evidence
+                # (reconstructed + preserved as <path>.prev), serve it
+                # at /crashz, and count it
+                self._crash_report = prior
+                self.res.metrics.counter(
+                    blackbox_mod.UNCLEAN_SHUTDOWNS,
+                    help="Prior-run blackboxes found without an "
+                         "epilogue at engine start").inc()
+                log_warn("serving: prior run died unclean (verdict "
+                         "%r, %d records) — postmortem at /crashz",
+                         prior.get("verdict"), prior.get("records"))
+            if self._blackbox is not None:
+                # the run-start snapshot: the verdict floor a killed
+                # process is guaranteed to leave behind
+                self._blackbox.snapshot()
+        except Exception:
+            self._blackbox, self._owns_blackbox = None, False
+        try:
+            wd = Watchdog(engine=self, interval_s=self._watchdog_s)
+            self._watchdog = wd if wd.enabled else None
+        except Exception:
+            self._watchdog = None
 
     def stop(self, timeout: float = 30.0) -> None:
         """Drain the queue, then stop the batcher (and the shadow
@@ -591,6 +642,14 @@ class ServingEngine:
         if t is not None:
             t.join(timeout)
         self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        if self._blackbox is not None and self._owns_blackbox:
+            # the epilogue: what distinguishes this stop from a kill
+            from raft_tpu.observability import blackbox as blackbox_mod
+
+            blackbox_mod.shutdown(reason="clean")
+            self._blackbox, self._owns_blackbox = None, False
         if self._debugz is not None:
             self._debugz.stop()
             self._debugz = None
@@ -963,6 +1022,47 @@ class ServingEngine:
                           "records": len(explain_records())}
         if self._debugz is not None:
             out["debugz_port"] = self._debugz.port
+        if self._blackbox is not None:
+            out["blackbox"] = self._blackbox.stats()
+        if self._watchdog is not None:
+            out["watchdog"] = self._watchdog.stats()
+        if self._crash_report is not None:
+            out["prior_crash"] = {
+                "verdict": self._crash_report.get("verdict"),
+                "records": self._crash_report.get("records"),
+                "preserved_path":
+                    self._crash_report.get("preserved_path")}
+        return out
+
+    @property
+    def crash_report(self) -> Optional[dict]:
+        """The prior run's postmortem reconstruction when this engine's
+        start() found an epilogue-less blackbox (else None) — the
+        /crashz body."""
+        return self._crash_report
+
+    @property
+    def blackbox(self):
+        """The installed crash-durable recorder, or None."""
+        return self._blackbox
+
+    def inflight_requests(self) -> List[dict]:
+        """Snapshot of queued requests (age, remaining deadline) — the
+        watchdog's stall evidence and the blackbox's in-flight table.
+        Takes the cond only long enough to copy the queue."""
+        with self._cond:
+            reqs = list(self._queue)
+            busy = self._busy
+        now = self._clock()
+        out = [{"rid": r.rid, "kind": r.kind, "rows": r.n,
+                "age_s": round(now - r.enqueued_at, 6),
+                "deadline_in_s": (round(r.deadline_at - now, 6)
+                                  if r.deadline_at is not None
+                                  else None)}
+               for r in reqs]
+        if busy:
+            out.append({"rid": None, "kind": "dispatch", "rows": 0,
+                        "age_s": 0.0, "deadline_in_s": None})
         return out
 
     # the name the quality-telemetry plane documents; same snapshot
@@ -1061,6 +1161,16 @@ class ServingEngine:
                 batch, total, expired, mutation = \
                     self._pop_batch_locked()
                 self._busy = bool(batch) or mutation is not None
+            wd = self._watchdog
+            if wd is not None:
+                # liveness heartbeat, OUTSIDE the cond (one dict store)
+                wd.beat()
+            bb = self._blackbox
+            if bb is not None:
+                # rate-limited (snapshot_interval_s): most calls are
+                # one clock read; keeps the "final metrics snapshot"
+                # fresh even when no watchdog ticks
+                bb.maybe_snapshot()
             self._fail_expired(expired)
             if batch or mutation is not None:
                 try:

@@ -1,0 +1,91 @@
+"""Subprocess driver for the SIGKILL forensics proof (ISSUE 17).
+
+Usage: ``python tests/_blackbox_worker.py <blackbox_path> <nth>`` —
+boots a tiny CPU brute :class:`ServingEngine` with the blackbox
+enabled through the ``RAFT_TPU_BLACKBOX_PATH`` env knob, drives
+sequential single-client traffic, and SIGKILLs ITSELF on the ``nth``
+call to the ``serving_flush`` fault site (wrapping
+``resilience.faults.fault_point`` exactly like ``_crash_worker.py`` —
+the kill lands INSIDE a live batch dispatch, mid-traffic by
+construction).
+
+The parent test then reconstructs the dead process's blackbox with
+``tools/postmortem.py`` and asserts the acceptance contract: verdict
+``crash`` (no epilogue), ≥ 64 recovered flight events, and a final
+metrics snapshot carrying the serving counters. Traffic is sized so
+well over 64 events precede the kill (each request contributes its
+flow/enqueue/flush/dispatch events), and a metrics snapshot is forced
+every ``SNAP_EVERY`` requests so the "final snapshot" is never just
+the boot-time one. Prints ``COMPLETED`` only on clean survival — the
+parent treats that as the failure it is.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+D = 32
+ROWS = 2048
+N_REQUESTS = 60
+SNAP_EVERY = 8
+RING_BYTES = 256 * 1024
+
+
+def main() -> int:
+    bb_path, nth = sys.argv[1], int(sys.argv[2])
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["RAFT_TPU_BLACKBOX_PATH"] = bb_path
+    os.environ["RAFT_TPU_BLACKBOX_BYTES"] = str(RING_BYTES)
+
+    import numpy as np
+
+    from raft_tpu.resilience import faults
+
+    real_fault_point = faults.fault_point
+    calls = {"n": 0}
+
+    def killing_fault_point(name):
+        if name == "serving_flush":
+            calls["n"] += 1
+            if calls["n"] == nth:
+                os.kill(os.getpid(), signal.SIGKILL)
+        return real_fault_point(name)
+
+    faults.fault_point = killing_fault_point
+    # the engine bound the name at import — patch its copy too
+    import raft_tpu.serving.engine as eng_mod
+
+    eng_mod.fault_point = killing_fault_point
+
+    from raft_tpu.distance.knn_fused import prepare_knn_index
+    from raft_tpu.observability import blackbox
+    from raft_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(0)
+    y = rng.normal(size=(ROWS, D)).astype(np.float32)
+    idx = prepare_knn_index(y, passes=3, T=256, Qb=32, g=2)
+    eng = ServingEngine(idx, k=8, buckets=(8, 16),
+                        flush_interval_s=0.002)
+    eng.start()
+    assert blackbox.active() is not None, "env-gated boot failed"
+    for i in range(N_REQUESTS):
+        n = 1 + (i % 8)
+        q = rng.normal(size=(n, D)).astype(np.float32)
+        fut = eng.submit(q)
+        eng.flush()
+        fut.result(timeout=60)
+        if (i + 1) % SNAP_EVERY == 0:
+            blackbox.active().snapshot()
+    eng.stop()
+    print("COMPLETED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

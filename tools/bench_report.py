@@ -1568,6 +1568,59 @@ def check_slo(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
                   + note)
 
 
+#: blackbox overhead ceiling: the crash-durable recorder may cost at
+#: most this fraction of total client request wall time.
+BLACKBOX_OVERHEAD_CEILING = 0.01
+
+
+def check_blackbox(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
+                   ceiling: float = BLACKBOX_OVERHEAD_CEILING
+                   ) -> Tuple[str, str]:
+    """Gate the serving blackbox block (ISSUE 17).
+
+    The newest parseable serving round must carry a ``"blackbox"``
+    block (MISSING_BASELINE without one — the artifact predates the
+    forensics plane, regenerate it). On an ok round the recorder's
+    measured ``overhead_frac`` (cumulative mmap-append seconds over
+    total client request wall time) must stay under ``ceiling`` (1%) —
+    a flight recorder that taxes the requests it exists to explain is
+    a regression, not a feature."""
+    newest = None
+    for _, _, rec in reversed(rounds):
+        if rec is not None:
+            newest = rec
+            break
+    if newest is None:
+        return SKIP, "no serving artifact to gate"
+    if newest.get("skipped"):
+        return SKIP, "latest serving round skipped"
+    bb = newest.get("blackbox")
+    if not isinstance(bb, dict):
+        return MISSING_BASELINE, (
+            "latest serving round carries no blackbox block — "
+            "regenerate BENCH_SERVING.json "
+            "(benchmarks/bench_serving.py)")
+    if not newest.get("ok", True):
+        return SKIP, ("latest serving round failed (ok=false) — the "
+                      "[serving] gate owns that regression")
+    frac = bb.get("overhead_frac")
+    if frac is None:
+        return SKIP, "blackbox block has no overhead evidence (no traffic)"
+    if not isinstance(frac, (int, float)):
+        return REGRESS, (
+            f"BLACKBOX REGRESSION: overhead_frac is non-numeric "
+            f"({frac!r})")
+    if frac >= ceiling:
+        return REGRESS, (
+            f"BLACKBOX REGRESSION: record overhead {frac:.4%} of "
+            f"request wall time ≥ ceiling {ceiling:.0%} "
+            f"({bb.get('records', '?')} record(s), "
+            f"{bb.get('append_seconds', '?')}s appending)")
+    return PASS, (f"blackbox ok: overhead {frac:.4%} < {ceiling:.0%} "
+                  f"over {bb.get('records', '?')} record(s), "
+                  f"{bb.get('bytes_written', '?')} bytes")
+
+
 def staleness_section(entries: List[Dict]) -> str:
     lines = ["named artifacts (freshness vs the last-good commit)",
              "---------------------------------------------------"]
@@ -1661,6 +1714,8 @@ def main(argv: Sequence[str] = None) -> int:
         print(f"bench_report --check [quality]: {qlstatus}: {qlmsg}")
         slstatus, slmsg = check_slo(srounds)
         print(f"bench_report --check [slo]: {slstatus}: {slmsg}")
+        bbstatus, bbmsg = check_blackbox(srounds)
+        print(f"bench_report --check [blackbox]: {bbstatus}: {bbmsg}")
         ledger_path = args.drift_ledger or os.path.join(
             args.dir, DRIFT_LEDGER_NAME)
         dstatus, dmsg = check_drift(load_drift_ledger(ledger_path),
@@ -1679,7 +1734,7 @@ def main(argv: Sequence[str] = None) -> int:
         rcs = (codes[status], codes[mstatus], codes[sstatus],
                codes[astatus], codes[mustatus], codes[rstatus],
                codes[qstatus], codes[qlstatus], codes[slstatus],
-               codes[dstatus], codes[lstatus])
+               codes[bbstatus], codes[dstatus], codes[lstatus])
         return 1 if 1 in rcs else max(rcs)
 
     if args.json:

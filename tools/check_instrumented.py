@@ -208,6 +208,12 @@ EVENT_SITES: Dict[str, Sequence[str]] = {
     # debugz surfaces while every capture/tick keeps "running"
     "raft_tpu/observability/explain.py": ("emit_explain",),
     "raft_tpu/observability/slo.py": ("emit_alert",),
+    # the forensics plane (ISSUE 17): the watchdog's stall detections
+    # and the blackbox's clean-shutdown epilogue are themselves flight
+    # events — a hang or a shutdown invisible in the timeline would
+    # defeat the very postmortem this plane exists to serve
+    "raft_tpu/observability/watchdog.py": ("emit_stall",),
+    "raft_tpu/observability/blackbox.py": ("emit_epilogue",),
 }
 
 #: quality-telemetry gate (ISSUE 10): every module with a certificate /

@@ -11,6 +11,13 @@ the process (stdlib ``http.server`` — no dependency, no framework):
 - ``/healthz``   — 200 ``ok`` normally; 503 ``burning`` while the SLO
   engine has a page-severity burn alert active (a load balancer's
   drain signal)
+- ``/stackz``    — live thread dump (every Python thread's stack with
+  blocked-at lock-site annotations — what the hang watchdog writes
+  into the blackbox, readable on demand)
+- ``/crashz``    — the PRIOR run's postmortem reconstruction when the
+  engine booted over an epilogue-less blackbox (verdict, final
+  metrics snapshot, in-flight table, event tail); ``{"verdict":
+  "none"}`` after a clean predecessor
 
 Wire it through the engine (``ServingEngine(debug_port=0)`` or the
 ``RAFT_TPU_DEBUGZ_PORT`` env knob — port 0 binds an ephemeral port,
@@ -70,6 +77,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._flightz()
             elif route == "/healthz":
                 self._healthz()
+            elif route == "/stackz":
+                self._stackz()
+            elif route == "/crashz":
+                self._crashz()
             else:
                 self._send(404, "not found: %s\n" % route)
         except Exception as e:  # read-only page: render, don't raise
@@ -104,6 +115,21 @@ class _Handler(BaseHTTPRequestHandler):
 
         self._send(200, json.dumps(export_perfetto()) + "\n",
                    ctype="application/json")
+
+    def _stackz(self) -> None:
+        from raft_tpu.observability.watchdog import format_stacks
+
+        self._send(200, format_stacks() + "\n")
+
+    def _crashz(self) -> None:
+        eng = self.debugz.engine
+        report = (getattr(eng, "crash_report", None)
+                  if eng is not None else None)
+        if report is None:
+            report = {"verdict": "none",
+                      "note": "no prior-run unclean blackbox detected"}
+        self._send(200, json.dumps(report, default=str, indent=2)
+                   + "\n", ctype="application/json")
 
     def _healthz(self) -> None:
         burning = False
@@ -185,7 +211,8 @@ def main(argv=None) -> int:
     srv = DebugzServer(engine=engine, port=args.port,
                        host=args.host).start()
     print("debugz listening on http://%s:%d  "
-          "(/statusz /metricsz /explainz /flightz /healthz)"
+          "(/statusz /metricsz /explainz /flightz /healthz /stackz "
+          "/crashz)"
           % (args.host, srv.port))
     try:
         while True:

@@ -1,0 +1,202 @@
+"""postmortem: read a dead process's blackbox and say what happened.
+
+The offline half of the forensics plane
+(:mod:`raft_tpu.observability.blackbox`): point it at the ring file a
+killed / crashed / hung process left behind and it reconstructs —
+tolerating the torn tail via per-record CRCs, exactly like WAL
+recovery — and prints:
+
+- the **verdict**: ``clean`` (the newest record is the epilogue),
+  ``hang`` (the watchdog got a stall dump in before death) or
+  ``crash`` (violent death with a healthy batcher — SIGKILL, OOM,
+  native crash);
+- the run header (pid, wall-clock start), record/torn counts;
+- the **final metrics snapshot** (requests, sheds, deadline fails —
+  the counters as the process last saw them);
+- alerts still **firing** at death, the **in-flight request table**,
+  and the newest flight events;
+- with ``--trace out.json``, the last-N-seconds timeline as a
+  Perfetto/Chrome trace (open at https://ui.perfetto.dev) via the same
+  exporter the live ``/flightz`` route uses.
+
+Usage::
+
+    python tools/postmortem.py /var/run/raft/blackbox.bin
+    python tools/postmortem.py blackbox.bin --json          # machine view
+    python tools/postmortem.py blackbox.bin --trace tail.json --last-s 5
+
+Exit code 0 for ``clean``, 2 for ``crash``/``hang`` (scriptable), 1 on
+an unreadable file. The live counterpart is debugz ``/crashz``: on
+restart the engine runs this same reconstruction over its
+predecessor's file automatically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as `python tools/postmortem.py`
+    sys.path.insert(0, _REPO)
+
+
+class _ReplayRecorder:
+    """Just enough FlightRecorder surface (``events()``) to feed the
+    reconstructed event list through ``export_perfetto``."""
+
+    def __init__(self, events: List[Dict]):
+        self._events = events
+
+    def events(self) -> List[Dict]:
+        return list(self._events)
+
+
+def _tail_filter(events: List[Dict], last_s: Optional[float]
+                 ) -> List[Dict]:
+    """Events within ``last_s`` seconds of the newest event's stamp
+    (perf_counter clock — relative windows only make sense within one
+    run, which is exactly what a blackbox holds)."""
+    if not last_s or not events:
+        return events
+    newest = max(float(e.get("ts") or 0.0) for e in events)
+    floor = newest - float(last_s)
+    return [e for e in events if float(e.get("ts") or 0.0) >= floor]
+
+
+def write_trace(report: Dict, out_path: str,
+                last_s: Optional[float] = None) -> int:
+    """Write the reconstructed last-``last_s``-seconds timeline as
+    Perfetto JSON; returns the event count."""
+    from raft_tpu.observability.exporters import export_perfetto
+
+    events = _tail_filter(report.get("events") or [], last_s)
+    trace = export_perfetto(_ReplayRecorder(events))
+    trace["raft_tpu"] = {
+        "source": "postmortem",
+        "blackbox": report.get("path"),
+        "verdict": report.get("verdict"),
+        "pid": report.get("pid"),
+        "wall_start": report.get("wall_start"),
+        "last_s": last_s,
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(trace, f, indent=1, default=str)
+        f.write("\n")
+    os.replace(tmp, out_path)
+    return len(events)
+
+
+def _fmt_wall(wall: Optional[float]) -> str:
+    if not wall:
+        return "?"
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(wall))
+
+
+def render_report(report: Dict, tail: int = 16) -> str:
+    """The human rendering of :func:`blackbox.reconstruct`."""
+    lines = []
+    w = lines.append
+    w(f"blackbox: {report['path']}")
+    w(f"verdict:  {report['verdict'].upper()}")
+    w(f"run:      pid {report['pid']}, started "
+      f"{_fmt_wall(report.get('wall_start'))}")
+    w(f"records:  {report['records']} recovered "
+      f"({report['torn_records']} torn candidate(s), "
+      f"{report['undecodable_records']} undecodable), "
+      f"{len(report['events'])} flight events, "
+      f"{report['snapshots']} snapshot(s)")
+    epi = report.get("epilogue")
+    if epi is not None:
+        w(f"epilogue: reason={epi.get('reason')!r} after "
+          f"{epi.get('records')} records")
+    else:
+        w("epilogue: MISSING — the process did not shut down cleanly")
+    for stall in report.get("stall_events") or []:
+        w(f"stall:    {stall.get('name')} age_s={stall.get('age_s')} "
+          f"inflight={stall.get('inflight')}")
+    firing = report.get("firing_alerts") or []
+    if firing:
+        w("alerts firing at death:")
+        for a in firing:
+            w(f"  {a.get('name')} severity={a.get('severity')}")
+    inflight = report.get("inflight")
+    if inflight:
+        w(f"in-flight at death ({len(inflight)} request(s)):")
+        for r in inflight[:12]:
+            w(f"  rid={r.get('rid')} kind={r.get('kind')} "
+              f"rows={r.get('rows')} age_s={r.get('age_s')} "
+              f"deadline_in_s={r.get('deadline_in_s')}")
+        if len(inflight) > 12:
+            w(f"  ... {len(inflight) - 12} more")
+    snap = report.get("final_snapshot")
+    if snap is not None:
+        metrics = snap.get("metrics") or {}
+        w(f"final metrics snapshot ({_fmt_wall(snap.get('wall'))}, "
+          f"{len(metrics)} series):")
+        for key in sorted(metrics):
+            val = metrics[key]
+            if isinstance(val, dict):
+                w(f"  {key}: count={val.get('count')} "
+                  f"p50={val.get('p50')} p99={val.get('p99')}")
+            else:
+                w(f"  {key}: {val}")
+    events = report.get("events") or []
+    if events:
+        w(f"newest flight events (last {min(tail, len(events))} "
+          f"of {len(events)}):")
+        for ev in events[-tail:]:
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("kind", "name", "ts", "ph", "lane",
+                                  "stack")}
+            w(f"  [{ev.get('ts', 0):.6f}] {ev.get('kind')}"
+              f"/{ev.get('name')} lane={ev.get('lane')}"
+              + (f" {extra}" if extra else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("path", help="blackbox ring file from a dead run")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full reconstruction as JSON")
+    ap.add_argument("--trace", metavar="OUT.json",
+                    help="write the reconstructed timeline as "
+                         "Perfetto/Chrome trace JSON")
+    ap.add_argument("--last-s", type=float, default=None,
+                    help="restrict --trace to the final N seconds")
+    ap.add_argument("--tail", type=int, default=16,
+                    help="flight events to print (default 16)")
+    args = ap.parse_args(argv)
+
+    from raft_tpu.observability.blackbox import reconstruct
+
+    report = reconstruct(args.path)
+    if report is None:
+        print(f"postmortem: {args.path}: not a readable blackbox file",
+              file=sys.stderr)
+        return 1
+    if args.trace:
+        n = write_trace(report, args.trace, last_s=args.last_s)
+        report["trace_path"] = args.trace
+        report["trace_events"] = n
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(render_report(report, tail=args.tail))
+        if args.trace:
+            print(f"trace:    {args.trace} "
+                  f"({report['trace_events']} events) — open at "
+                  f"https://ui.perfetto.dev")
+    return 0 if report["verdict"] == "clean" else 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,6 +12,10 @@ operator reads FIRST when paged:
   registry (bucket-interpolated) — serving request latency included;
 - **degradations**: the resilience ladder's step count — a nonzero
   value means some hot path is running below its configured rung;
+- **forensics**: flight-ring drop count (truncated evidence must be
+  visible before anyone trusts a dump), blackbox write stats, watchdog
+  tick/stall counts, and the prior run's crash verdict when the engine
+  booted over an unclean blackbox;
 - **flight tail**: the newest flight-recorder events, time-ordered —
   the last thing that happened before you looked;
 - the full registry summary table for everything else.
@@ -220,6 +224,49 @@ def render_statusz(registry=None, recorder=None, engine=None,
                       f"  wall={r.get('wall_s', 0) * 1e3:.1f}ms\n")
     except Exception as e:
         out.write(f"(explain section unavailable: {e})\n")
+
+    # ---- forensics (blackbox / watchdog) -------------------------------
+    out.write("\nforensics (blackbox / watchdog)\n")
+    out.write("-------------------------------\n")
+    try:
+        from raft_tpu.observability import blackbox as bb_mod
+        from raft_tpu.observability.flight import (FLIGHT_DROPPED,
+                                                   sync_dropped_metric)
+
+        dropped = sync_dropped_metric(rec)
+        out.write(f"flight ring     seq={rec.seq} dropped={dropped} "
+                  f"({FLIGHT_DROPPED})\n")
+        bb = bb_mod.active()
+        if bb is not None:
+            st = bb.stats()
+            out.write(f"blackbox        {st['path']}: "
+                      f"{st['records']} record(s), "
+                      f"{st['bytes_written']} bytes into "
+                      f"{st['ring_bytes']}-byte ring, "
+                      f"{st['append_seconds'] * 1e3:.2f} ms append "
+                      f"time\n")
+        else:
+            out.write("blackbox        (off — set "
+                      "RAFT_TPU_BLACKBOX_PATH)\n")
+        wd = getattr(engine, "_watchdog", None) if engine is not None \
+            else None
+        if wd is not None:
+            st = wd.stats()
+            out.write(f"watchdog        interval={st['interval_s']:g}s "
+                      f"ticks={st['ticks']} stalls={st['stalls']}"
+                      + ("  STALL ACTIVE" if st["stall_active"]
+                         else "") + "\n")
+        else:
+            out.write("watchdog        (off — set "
+                      "RAFT_TPU_WATCHDOG_S)\n")
+        report = getattr(engine, "crash_report", None) \
+            if engine is not None else None
+        if report is not None:
+            out.write(f"prior run       verdict={report.get('verdict')}"
+                      f" ({report.get('records')} record(s) recovered"
+                      f" — see /crashz)\n")
+    except Exception as e:
+        out.write(f"(forensics section unavailable: {e})\n")
 
     out.write("\ndegradations\n------------\n")
     try:
