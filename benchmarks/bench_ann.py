@@ -30,12 +30,20 @@ committed artifact). A degraded round REFUSES to overwrite the NAMED
 ``BENCH_ANN.json`` (hard error listing the ladder steps): committed
 evidence never silently becomes an outage artifact.
 
-The ``pq`` block is the IVF-PQ compressed-tier evidence (ISSUE 15):
-frontier points over ``pq_bits`` × ``n_probes`` with post-rescore
-recall, the modeled codes-vs-f32 streamed-bytes ratio (gated ≤ 0.10×
-at 8-bit), id-parity after the mandatory exact rescore vs the flat
-scan over the same probes, and a modeled 100M-row point whose resident
-index bytes must fit a single v5e's HBM.
+The ``pq`` block is the IVF-PQ compressed-tier evidence (ISSUE 15 +
+the ISSUE 19 quality round): frontier points over ``pq_bits`` ×
+``n_probes`` with post-rescore recall, the modeled codes-vs-f32
+streamed-bytes ratio (gated ≤ 0.10× at 8-bit), id-parity after the
+mandatory exact rescore vs the flat scan over the same probes, and a
+modeled 100M-row point whose resident index bytes must fit a single
+v5e's HBM. Every point stamps its certification-ladder evidence —
+``cert_rerun_frac`` + the per-rung histogram (certified / widened /
+exact_rerun) — and a second **diffuse-Gaussian** (worst-case,
+cluster-free) distribution sweeps alongside the clustered one: the
+distribution where PR 15's worst-case certificate collapsed to an
+83–88% exact-rerun rate. ``bench_report --check`` gates
+``cert_rerun_frac ≤ 0.10`` at recall ≥ 0.95 on the diffuse points and
+trend-gates erosion vs the previous comparable round.
 
 Prints ONE JSON line and writes ``BENCH_ANN.json``.
 """
@@ -60,6 +68,10 @@ RECALL_FLOOR = 0.95
 #: most this fraction of the f32 slab stream (1/16 at 8-bit codes
 #: with pq_dim = d/4 — mirror of tools/bench_report.PQ_RATIO_CEIL)
 PQ_RATIO_CEIL = 0.10
+#: PQ certificate-rerun gate: on the diffuse-Gaussian (worst-case)
+#: distribution, the exact-rerun fraction at the recall floor must be
+#: at most this (mirror of tools/bench_report.PQ_RERUN_CEIL)
+PQ_RERUN_CEIL = 0.10
 #: the 100M-row modeled scale point (the single-chip HBM-fit claim)
 PQ_SCALE_ROWS = 100_000_000
 PQ_SCALE_D = 128
@@ -98,6 +110,23 @@ def _pq_cert_counts():
         elif mtr.name == CERT_FIXUPS:
             fixups += mtr.value
     return checks, fixups
+
+
+def _pq_rung_counts():
+    """{rung: queries} of the PQ certification ladder so far — the
+    per-point rung histogram stamped into the pq frontier."""
+    from raft_tpu.observability import get_registry
+    from raft_tpu.observability.quality import PQ_RUNGS
+
+    out = {"certified": 0, "widened": 0, "exact_rerun": 0}
+    for mtr in get_registry().collect():
+        if mtr.name != PQ_RUNGS or getattr(mtr, "labels", {}).get(
+                "site") != "ann.search_ivf_pq":
+            continue
+        rung = mtr.labels.get("rung")
+        if rung in out:
+            out[rung] += int(mtr.value)
+    return out
 
 
 def _probe_schedule(L: int):
@@ -266,56 +295,103 @@ def main(argv=None) -> int:
 
         L = lists[-1]
         pq_points, pq_ok = [], True
+
+        def pq_point(idxq, flat_idx, Qd, truth_sets, P, dist):
+            """One pq frontier point: forced-ADC search + certificate/
+            rung evidence + id-parity vs the flat scan over the same
+            probes (the chooser's own pick is stamped alongside as
+            pq_scan)."""
+            snap0, rung0 = _pq_cert_counts(), _pq_rung_counts()
+            t0 = time.perf_counter()
+            _, pi = search_ivf_pq(res, idxq, Qd, k, n_probes=P,
+                                  pq_scan="pq")
+            pi = np.asarray(pi)
+            ms = (time.perf_counter() - t0) * 1e3
+            recall = float(np.mean(
+                [len(truth_sets[q] & set(pi[q])) / k
+                 for q in range(nq)]))
+            _, fi2 = search_ivf_flat(res, flat_idx, Qd, k, n_probes=P,
+                                     fine_scan="query")
+            fi2 = np.asarray(fi2)
+            parity = all(set(pi[q]) == set(fi2[q]) for q in range(nq))
+            model = ivf_traffic_model(
+                nq, m, d, k, L, P, idxq.probe_window,
+                idxq.slab_rows,
+                list_sizes=np.asarray(idxq.sizes),
+                padded_sizes=np.asarray(idxq.padded_sizes),
+                pq_dim=idxq.pq_dim, pq_bits=idxq.pq_bits)
+            snap1, rung1 = _pq_cert_counts(), _pq_rung_counts()
+            checks = snap1[0] - snap0[0]
+            reruns = snap1[1] - snap0[1]
+            return {
+                "dist": dist,
+                "pq_bits": idxq.pq_bits,
+                "pq_dim": idxq.pq_dim,
+                "pq_mode": idxq.pq_mode,
+                "n_lists": L,
+                "n_probes": P,
+                "recall_at_k": round(recall, 4),
+                "rescore_id_parity": bool(parity),
+                "pq_bytes_ratio": round(
+                    model["pq_bytes_ratio"], 5),
+                "model_pq_bytes": round(model["pq_stream_bytes"]),
+                "model_flat_bytes": round(min(
+                    model["fine_stream_bytes"],
+                    model["fine_gather_bytes"])),
+                "pq_scan": resolve_pq_scan(idxq, nq, k, P,
+                                           idxq.probe_window),
+                "cert_rerun_frac": round(reruns / max(checks, 1), 4),
+                "rungs": {r: rung1[r] - rung0[r] for r in rung1},
+                "search_ms": round(ms, 2),
+            }
+
         for bits in (8, 4):
             idxq = build_ivf_pq(res, X, n_lists=L, pq_bits=bits,
                                 max_iter=8, seed=3)
             for P in _probe_schedule(L)[:-1]:
-                snap0 = _pq_cert_counts()
-                t0 = time.perf_counter()
-                # force the ADC schedule: this block EVIDENCES the
-                # compressed kernel + certificate + rescore (the
-                # chooser's own pick is stamped alongside as pq_scan)
-                _, pi = search_ivf_pq(res, idxq, Q, k, n_probes=P,
-                                      pq_scan="pq")
-                pi = np.asarray(pi)
-                ms = (time.perf_counter() - t0) * 1e3
-                recall = float(np.mean(
-                    [len(oracle_sets[q] & set(pi[q])) / k
-                     for q in range(nq)]))
-                _, fi2 = search_ivf_flat(res, idx, Q, k, n_probes=P,
-                                         fine_scan="query")
-                fi2 = np.asarray(fi2)
-                parity = all(set(pi[q]) == set(fi2[q])
-                             for q in range(nq))
-                model = ivf_traffic_model(
-                    nq, m, d, k, L, P, idxq.probe_window,
-                    idxq.slab_rows,
-                    list_sizes=np.asarray(idxq.sizes),
-                    padded_sizes=np.asarray(idxq.padded_sizes),
-                    pq_dim=idxq.pq_dim, pq_bits=bits)
-                snap1 = _pq_cert_counts()
-                checks = snap1[0] - snap0[0]
-                reruns = snap1[1] - snap0[1]
-                pq_points.append({
-                    "pq_bits": bits,
-                    "pq_dim": idxq.pq_dim,
-                    "n_lists": L,
-                    "n_probes": P,
-                    "recall_at_k": round(recall, 4),
-                    "rescore_id_parity": bool(parity),
-                    "pq_bytes_ratio": round(
-                        model["pq_bytes_ratio"], 5),
-                    "model_pq_bytes": round(model["pq_stream_bytes"]),
-                    "model_flat_bytes": round(min(
-                        model["fine_stream_bytes"],
-                        model["fine_gather_bytes"])),
-                    "pq_scan": resolve_pq_scan(idxq, nq, k, P,
-                                               idxq.probe_window),
-                    "cert_rerun_frac": round(
-                        reruns / max(checks, 1), 4),
-                    "search_ms": round(ms, 2),
-                })
-                pq_ok = pq_ok and parity
+                point = pq_point(idxq, idx, Q, oracle_sets, P,
+                                 "clustered")
+                pq_points.append(point)
+                pq_ok = pq_ok and point["rescore_id_parity"]
+        # the diffuse-Gaussian worst case (ISSUE 19): cluster-free
+        # data where quantization error rivals neighbor distances —
+        # the distribution that collapsed PR 15's worst-case
+        # certificate to an 83–88% exact-rerun rate. The OPQ build +
+        # adaptive per-row certificate + widen rung must keep the
+        # exact-rerun fraction ≤ rerun_ceil at the recall floor.
+        Xg = rng.normal(size=(m, d)).astype(np.float32)
+        Qg = rng.normal(size=(nq, d)).astype(np.float32)
+        _, ogi = knn(res, Xg, Qg, k)
+        diffuse_sets = [set(r) for r in np.asarray(ogi)]
+        idxg_flat = build_ivf_flat(res, Xg, n_lists=L, max_iter=8,
+                                   seed=3)
+        # pq_dim = d/2 (2-dim subspaces, 4 bits/dim): on cluster-free
+        # data the d/4 default leaves quantization error at the
+        # neighbor-gap scale and the certificate reruns everything —
+        # the finer codebooks pay 2x the code bytes (stamped in
+        # pq_bytes_ratio) to keep the compressed tier certified
+        idxg = build_ivf_pq(res, Xg, n_lists=L, pq_dim=d // 2,
+                            pq_bits=8, max_iter=8, seed=3,
+                            pq_mode="opq")
+        for P in _probe_schedule(L)[:-1]:
+            point = pq_point(idxg, idxg_flat, Qg, diffuse_sets, P,
+                             "diffuse")
+            pq_points.append(point)
+            pq_ok = pq_ok and point["rescore_id_parity"]
+        diffuse_at_floor = [
+            p for p in pq_points if p["dist"] == "diffuse"
+            and p["recall_at_k"] >= RECALL_FLOOR]
+        diffuse_rerun = min((p["cert_rerun_frac"]
+                             for p in diffuse_at_floor), default=None)
+        if diffuse_rerun is None:
+            pq_ok = False
+            errors.append("no diffuse PQ point reaches the recall "
+                          "floor")
+        elif diffuse_rerun > PQ_RERUN_CEIL:
+            pq_ok = False
+            errors.append(
+                f"diffuse cert_rerun_frac {diffuse_rerun} > "
+                f"{PQ_RERUN_CEIL} at the recall floor")
         best_pq = [p for p in pq_points
                    if p["pq_bits"] == 8
                    and p["recall_at_k"] >= RECALL_FLOOR
@@ -338,6 +414,8 @@ def main(argv=None) -> int:
         pq_block = {
             "ok": bool(pq_ok),
             "ratio_ceil": PQ_RATIO_CEIL,
+            "rerun_ceil": PQ_RERUN_CEIL,
+            "diffuse_cert_rerun_frac": diffuse_rerun,
             "pq_bytes_ratio": min(p["pq_bytes_ratio"]
                                   for p in pq_points),
             "frontier": pq_points,

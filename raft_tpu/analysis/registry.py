@@ -69,10 +69,11 @@ ALIAS_EMITTERS: Dict[str, str] = {
     "record_drift": "drift",
     "record_certificate": "quality",
     "record_pending": "quality",
+    "record_pq_rungs": "quality",
 }
 
 QUALITY_RECORDERS = ("record_certificate", "record_pending",
-                    "ShadowSampler")
+                    "record_pq_rungs", "ShadowSampler")
 
 
 # ---------------------------------------------------------------- utils

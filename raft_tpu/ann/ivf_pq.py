@@ -33,12 +33,30 @@ schedule (``build_list_schedule`` reused verbatim) → the
 per-query ``[pq_dim, 2^pq_bits]`` lookup tables computed on entry and
 held VMEM-resident while code blocks stream through the 2-slot DMA
 pipeline — → pooled candidates MANDATORILY exact-rescored from the
-f32 slab under a completeness certificate (pooled 3rd-min vs
-``θ + 2√θ·Eq + Eq²`` + the kernel-precision envelope). Certificate
-failures rerun the exact f32 scan, and the ``pq_scan`` fault site
+f32 slab under a PER-QUERY ADAPTIVE completeness certificate: the
+kernel folds each streamed row's certified true-distance lower bound
+``(max(√d2_adc − Eq_row, 0))²`` (the recorded per-row round-trip
+error, streamed as a 4-byte sidecar), so the pooled rest-min is
+compared against ``θ`` plus only the kernel-precision envelope — no
+per-list worst-case ``Eq`` widening. Certificate failures climb a
+three-rung ladder: (1) certified as-is, (2) the ``pq_widen`` rung
+re-runs the ADC scan with a 2×/4× deeper candidate pool and
+re-certifies, (3) the exact f32 rerun. The ``pq_scan`` fault site
 degrades any kernel failure to the f32/int8 query-major scan — so
 returned id sets NEVER degrade below the flat scan's, whatever the
 compression does to the approximate scores.
+
+``pq_mode`` picks the quantizer: ``"plain"`` trains codebooks on raw
+residuals; ``"opq"`` learns an orthogonal rotation first (OPQ
+alternating minimization — orthogonal Procrustes against the current
+reconstruction, codebooks re-trained on the rotated residuals — ref:
+Ge et al., and cuVS' codebook options); ``"opq_aniso"`` additionally
+assigns codewords under a score-aware anisotropic loss (ScaNN-style:
+the residual component parallel to the data point is weighted η×).
+The rotation is stored as ``pq_rot`` (also on the shared
+``IndexLayout``), applied to QUERIES at ADC-table build and to
+RESIDUALS at encode — norms are preserved, so every certificate and
+sidecar stays exactly as recorded.
 
 ``n_probes ≥ n_lists`` (or ``k`` past the probed capacity) degrades
 to certified-exact search over the f32 slab exactly like IVF-Flat —
@@ -58,7 +76,8 @@ from raft_tpu.core import env
 from raft_tpu.core.error import DeadlineExceededError, expects
 from raft_tpu.core.resources import ensure_resources
 from raft_tpu.observability import explain, instrument
-from raft_tpu.observability.quality import record_certificate
+from raft_tpu.observability.quality import (record_certificate,
+                                            record_pq_rungs)
 from raft_tpu.observability.timeline import emit_marker
 from raft_tpu.resilience import fault_point
 from raft_tpu.resilience.policy import record_degradation
@@ -75,6 +94,17 @@ from raft_tpu.ann.ivf_flat import (_FINE_TILE, _LIST_K_MAX,
 #: list-major per its own chooser), "auto" = the resolve_pq_scan
 #: cost-model crossover. Env: RAFT_TPU_IVF_PQ_SCAN.
 PQ_SCANS = ("auto", "pq", "flat")
+
+#: quantizer modes: "plain" = codebooks on raw residuals, "opq" = the
+#: learned orthogonal rotation (OPQ alternating minimization),
+#: "opq_aniso" = OPQ + score-aware anisotropic codeword assignment.
+#: Env default: RAFT_TPU_ANN_PQ_MODE.
+PQ_MODES = ("plain", "opq", "opq_aniso")
+
+#: anisotropic assignment weight: the residual component PARALLEL to
+#: the data point costs this much more than the orthogonal one
+#: (ScaNN's score-aware loss, fixed-η form)
+_PQ_ANISO_ETA = 4.0
 
 #: multiplicative headroom on every recorded f32 error bound — covers
 #: the f32 norm/summation rounding between the recorded bound and the
@@ -135,7 +165,9 @@ class IvfPqIndex(IvfFlatIndex):
     def __init__(self, *args, pq_dim: int = 0, pq_bits: int = 8,
                  codebooks=None, codes=None, yy_pq=None,
                  pq_eq_rows=None, pq_eq_sub=None, pq_eq_list=None,
-                 pq_rhat_list=None, **kw):
+                 pq_rhat_list=None, pq_mode: str = "plain",
+                 pq_rot=None, pq_eq_qlist=None,
+                 pq_resid_med: float = 0.0, **kw):
         super().__init__(*args, **kw)
         self.pq_dim = int(pq_dim)            # subspace count S
         self.pq_bits = int(pq_bits)          # 4 or 8
@@ -146,6 +178,11 @@ class IvfPqIndex(IvfFlatIndex):
         self.pq_eq_sub = pq_eq_sub           # [S] f32 subspace envelope
         self.pq_eq_list = pq_eq_list         # [L] f32 per-list max
         self.pq_rhat_list = pq_rhat_list     # [L] f32 max ‖r̂‖ per list
+        self.pq_mode = str(pq_mode)          # plain | opq | opq_aniso
+        self.pq_rot = pq_rot                 # [d, d] f32 or None
+        self.pq_eq_qlist = pq_eq_qlist       # [L, 3] q50/q90/max sketch
+        self.pq_resid_med = float(pq_resid_med)  # median ‖y − c‖
+        self._pq_eq_col = None               # lazy [R, 1] kernel view
 
     @property
     def dsub(self) -> int:
@@ -159,6 +196,15 @@ class IvfPqIndex(IvfFlatIndex):
     def code_bytes(self) -> int:
         """Streamed code bytes per row."""
         return self.pq_dim if self.pq_bits == 8 else self.pq_dim // 2
+
+    @property
+    def pq_eq_col(self):
+        """[R, 1] device view of ``pq_eq_rows`` — the adaptive-
+        certificate sidecar the ADC kernel streams (built once)."""
+        if self._pq_eq_col is None:
+            self._pq_eq_col = jnp.reshape(
+                jnp.asarray(self.pq_eq_rows, jnp.float32), (-1, 1))
+        return self._pq_eq_col
 
     def __repr__(self):
         return (f"IvfPqIndex(n_rows={self.n_rows}, "
@@ -174,9 +220,71 @@ class IvfPqIndex(IvfFlatIndex):
         lay.pq_codes = self.codes
         lay.pq_yy = self.yy_pq
         lay.pq_eq_rows = self.pq_eq_rows
+        lay.pq_rot = self.pq_rot
         lay.pq_meta = {"pq_dim": self.pq_dim, "pq_bits": self.pq_bits,
+                       "pq_mode": self.pq_mode,
                        "codebooks": self.codebooks}
         return lay
+
+
+def _opq_rotation(res, train, S: int, dsub: int, K: int, seed: int,
+                  n_iters: int = 3, train_iters: int = 3):
+    """OPQ alternating minimization over the residual TRAIN sample:
+    (codebooks | rotation) → encode → orthogonal Procrustes (the SVD
+    of ``trainᵀ · recon`` — min ‖train·R − recon‖ over orthogonal R)
+    → re-train codebooks on the re-rotated residuals, warm-started via
+    ``kmeans_fit(init_centroids=…)``. Returns ``(R [d,d] f32, warm
+    per-subspace codebooks)`` — the caller runs the final full-budget
+    codebook train on ``train @ R`` seeded with the warm books.
+    Orthogonality is exact to f32 rounding (the SVD runs in f64)."""
+    from raft_tpu.cluster import kmeans_fit, kmeans_predict
+
+    d = train.shape[1]
+    rot = np.eye(d, dtype=np.float32)
+    cbs = [None] * S
+    for _ in range(max(1, int(n_iters))):
+        tr = (train @ rot).astype(np.float32)
+        recon = np.empty_like(tr)
+        for s in range(S):
+            sl = slice(s * dsub, (s + 1) * dsub)
+            km = kmeans_fit(res, tr[:, sl], K, max_iter=train_iters,
+                            seed=seed + 211 + s, balanced=False,
+                            init_centroids=cbs[s])
+            cbs[s] = np.asarray(km.centroids, np.float32)
+            code = np.asarray(kmeans_predict(res, km.centroids,
+                                             tr[:, sl]))
+            recon[:, sl] = cbs[s][code]
+        u, _, vt = np.linalg.svd(
+            train.astype(np.float64).T @ recon.astype(np.float64))
+        rot = (u @ vt).astype(np.float32)
+    return rot, cbs
+
+
+def _aniso_assign(sub, cb, eta: float = _PQ_ANISO_ETA):
+    """Score-aware codeword assignment for one subspace (ScaNN's
+    anisotropic loss, fixed-η form): pick ``argmin_c ‖r − c‖² +
+    (η − 1)·((r − c)·r/‖r‖)²`` — quantization error PARALLEL to the
+    residual (which perturbs the dot-product score directly) costs η×
+    the orthogonal error. Codebook centroids stay the k-means fit;
+    only the assignment is re-weighted. Chunked [rows × K] host
+    sweep."""
+    sub = np.asarray(sub, np.float32)
+    cb = np.asarray(cb, np.float32)
+    n = sub.shape[0]
+    out = np.empty(n, np.int32)
+    cc = np.sum(cb * cb, axis=1)
+    step = 65536
+    for s0 in range(0, n, step):
+        r = sub[s0:s0 + step]
+        rn2 = np.sum(r * r, axis=1, keepdims=True)       # [n, 1]
+        rn = np.sqrt(rn2)
+        rc = r @ cb.T                                    # [n, K]
+        base = rn2 + cc[None, :] - 2.0 * rc
+        par = (rn - rc / np.maximum(rn, 1e-30)) ** 2
+        par = np.where(rn > 0.0, par, 0.0)
+        out[s0:s0 + step] = np.argmin(base + (eta - 1.0) * par,
+                                      axis=1)
+    return out
 
 
 @instrument("ann.build_ivf_pq")
@@ -187,7 +295,9 @@ def build_ivf_pq(res, y, n_lists: int, pq_dim: Optional[int] = None,
                  balanced: bool = True,
                  row_quantum: Optional[int] = None,
                  max_train_rows: Optional[int] = None,
-                 pq_train_rows: Optional[int] = None) -> IvfPqIndex:
+                 pq_train_rows: Optional[int] = None,
+                 pq_mode: Optional[str] = None,
+                 opq_iters: int = 3) -> IvfPqIndex:
     """Build an :class:`IvfPqIndex` over ``y`` [m, d].
 
     (ref: ivf_pq::build — coarse train, per-subspace codebooks on
@@ -208,14 +318,25 @@ def build_ivf_pq(res, y, n_lists: int, pq_dim: Optional[int] = None,
        tests attack), ``pq_eq_rows`` the exact per-row ``‖y − ŷ‖``
        and ``pq_eq_list`` its per-list max (the certificate inputs).
 
-    ``pq_bits`` defaults to ``RAFT_TPU_ANN_PQ_BITS`` (8). Carries the
-    ``pq_train`` fault site — a failing codebook train must surface at
-    build, never as a silently-flat index."""
+    ``pq_mode`` ∈ :data:`PQ_MODES` (default the
+    ``RAFT_TPU_ANN_PQ_MODE`` knob): ``"opq"`` learns an orthogonal
+    rotation by alternating minimization before the codebook train
+    (applied to residuals at encode and to queries at ADC-table
+    build); ``"opq_aniso"`` additionally assigns codewords under the
+    score-aware anisotropic loss. ``pq_bits`` defaults to
+    ``RAFT_TPU_ANN_PQ_BITS`` (8). Carries the ``pq_train`` and
+    ``opq_train`` fault sites — a failing codebook/rotation train must
+    surface at build, never as a silently-flat index."""
     from raft_tpu.cluster import kmeans_fit, kmeans_predict
 
     res = ensure_resources(res)
     y = np.asarray(y, np.float32)
     m, d = y.shape
+    if pq_mode is None:
+        pq_mode = env.get("RAFT_TPU_ANN_PQ_MODE")
+    expects(pq_mode in PQ_MODES,
+            "build_ivf_pq: pq_mode must be one of %s, got %r",
+            PQ_MODES, pq_mode)
     if pq_bits is None:
         pq_bits = env.get("RAFT_TPU_ANN_PQ_BITS")
     pq_bits = int(pq_bits)
@@ -258,20 +379,52 @@ def build_ivf_pq(res, y, n_lists: int, pq_dim: Optional[int] = None,
     train = resid[vrows]
     expects(train.shape[0] >= K,
             "build_ivf_pq: %d valid rows < %d codewords", n_valid, K)
+    rot = None
+    warm_cb = [None] * S
+    if pq_mode != "plain":
+        # the learned rotation: OPQ alternating minimization over the
+        # train sample, then the full-budget codebook train below runs
+        # in the ROTATED residual space (warm-started from the OPQ
+        # books)
+        fault_point("opq_train")
+        rot, warm_cb = _opq_rotation(res, train, S, dsub, K, seed,
+                                     n_iters=opq_iters,
+                                     train_iters=max(
+                                         1, pq_max_iter // 2))
+        train = (train @ rot).astype(np.float32)
+        resid_enc = (resid @ rot).astype(np.float32)
+    else:
+        resid_enc = resid
     codebooks = np.zeros((S, K, dsub), np.float32)
     codes = np.zeros((R, S), np.int32)
     for s in range(S):
         sub = train[:, s * dsub:(s + 1) * dsub]
         km = kmeans_fit(res, sub, K, max_iter=pq_max_iter,
-                        seed=seed + 101 + s, balanced=False)
+                        seed=seed + 101 + s, balanced=False,
+                        init_centroids=warm_cb[s])
         codebooks[s] = np.asarray(km.centroids)
-        codes[:, s] = np.asarray(kmeans_predict(
-            res, km.centroids, resid[:, s * dsub:(s + 1) * dsub]))
+        sub_all = resid_enc[:, s * dsub:(s + 1) * dsub]
+        if pq_mode == "opq_aniso":
+            codes[:, s] = _aniso_assign(sub_all, codebooks[s])
+        else:
+            codes[:, s] = np.asarray(kmeans_predict(
+                res, km.centroids, sub_all))
 
     # --- reconstruction + the recorded error envelopes ----------------
+    # (with a rotation: codes encode the ROTATED residual r' = r·R, so
+    # the reconstructed row is c + r̂'·Rᵀ — norms preserved, every
+    # envelope below is computed on the ACTUAL reconstruction)
     recon = cents[gid].copy()
-    for s in range(S):
-        recon[:, s * dsub:(s + 1) * dsub] += codebooks[s][codes[:, s]]
+    if rot is None:
+        for s in range(S):
+            recon[:, s * dsub:(s + 1) * dsub] += \
+                codebooks[s][codes[:, s]]
+    else:
+        recon_rot = np.zeros((R, d), np.float32)
+        for s in range(S):
+            recon_rot[:, s * dsub:(s + 1) * dsub] = \
+                codebooks[s][codes[:, s]]
+        recon += recon_rot @ rot.T
     err = (slab - recon) * valid[:, None].astype(np.float32)
     # magnitude scales for the additive float-arithmetic headroom
     mag_sub = (np.sqrt(np.sum(slab.reshape(R, S, dsub) ** 2, axis=2))
@@ -296,13 +449,24 @@ def build_ivf_pq(res, y, n_lists: int, pq_dim: Optional[int] = None,
         * valid.astype(np.float32)
     eq_list = np.zeros(L, np.float32)
     rhat_list = np.zeros(L, np.float32)
+    # per-list quantile sketch of the row error bounds (q50/q90/max
+    # over the VALID rows) — the chooser's expected-rerun model and
+    # the explain plane read it; the certificate itself rides the
+    # exact per-row sidecar
+    eq_qlist = np.zeros((L, 3), np.float32)
     offs = np.asarray(flat.offsets)
     for l in range(L):
         w = int(padded[l])
         if w:
-            eq_list[l] = eq_rows[int(offs[l]):int(offs[l]) + w].max()
-            rhat_list[l] = rhat_norm[int(offs[l]):int(offs[l])
-                                     + w].max()
+            o = int(offs[l])
+            eq_list[l] = eq_rows[o:o + w].max()
+            rhat_list[l] = rhat_norm[o:o + w].max()
+            seg = eq_rows[o:o + w][valid[o:o + w]]
+            if seg.size:
+                eq_qlist[l] = np.quantile(seg, (0.5, 0.9, 1.0))
+    resid_norm = np.sqrt(np.maximum(np.sum(resid * resid, axis=1),
+                                    0.0))
+    resid_med = float(np.median(resid_norm[valid])) if n_valid else 0.0
     yy_pq = np.where(valid, np.sum(recon * recon, axis=1), 0.0)
 
     idx = IvfPqIndex(
@@ -319,24 +483,30 @@ def build_ivf_pq(res, y, n_lists: int, pq_dim: Optional[int] = None,
         pq_eq_rows=jnp.asarray(eq_rows.astype(np.float32)),
         pq_eq_sub=np.asarray(eq_sub, np.float32),
         pq_eq_list=jnp.asarray(eq_list),
-        pq_rhat_list=jnp.asarray(rhat_list))
+        pq_rhat_list=jnp.asarray(rhat_list),
+        pq_mode=pq_mode,
+        pq_rot=None if rot is None else jnp.asarray(rot),
+        pq_eq_qlist=np.asarray(eq_qlist, np.float32),
+        pq_resid_med=resid_med)
     emit_marker("pq_build", n_rows=m, n_lists=L, pq_dim=S,
-                pq_bits=pq_bits,
+                pq_bits=pq_bits, pq_mode=pq_mode,
                 code_bytes_per_row=idx.code_bytes,
                 eq_row_max=round(float(eq_rows.max()) if R else 0.0, 6),
                 eq_sub_max=round(float(eq_sub.max()), 6),
-                compression=round(4.0 * d / (idx.code_bytes + 4), 2))
+                resid_med=round(resid_med, 6),
+                compression=round(4.0 * d / (idx.code_bytes + 8), 2))
     return idx
 
 
 # ------------------------------------------------------------- search
 def _pq_certify(bound, theta, widen):
-    """certified ⇔ no probed row outside the 256-slot pool can beat
-    the exact k-th value once the scores are widened by the recorded
-    quantization envelope + the kernel-precision term (the PR-9
-    violator-exclusion argument over the PQ reconstruction ŷ).
-    Module-level so the certificate-failure tests can force the rerun
-    path."""
+    """certified ⇔ no probed row outside the candidate pool can beat
+    the exact k-th value. ``bound`` is the kernel's pooled rest-min of
+    the PER-ROW certified lower bounds ``(max(√d2_adc − Eq_row, 0))²``
+    (the adaptive certificate — each row is widened by ITS OWN
+    recorded error, not the probed lists' worst case), so ``widen``
+    carries only the kernel-precision envelope. Module-level so the
+    certificate-failure tests can force the widen/rerun rungs."""
     return bound >= theta + widen
 
 
@@ -386,13 +556,24 @@ def _pq_lut(x, codebooks, S: int, dsub: int):
 
 
 def pq_scan_chunk(index: IvfPqIndex, xs, probes_np, pr, st, ps,
-                  k: int, P: int, W: int, ids=None):
+                  k: int, P: int, W: int, ids=None,
+                  pool_depth: int = 2):
     """One list-major ADC chunk → (vals, ids, certified, margin).
     ``ids`` overrides the slab id map (the mutable plane passes its
     tombstone-masked ``ids_live``); the certificate compares against
     the same masked oracle, so a failure's rerun returns identical id
-    sets. ``margin`` (bound − θ − widen, pre-rerun) feeds the explain
-    plane."""
+    sets. ``pool_depth`` ∈ (2, 4, 8) sizes the per-lane-class
+    candidate pool (the ``pq_widen`` rung re-runs at 4/8). ``margin``
+    (bound − θ − e_k, pre-rerun) feeds the explain plane.
+
+    The certificate is PER-QUERY ADAPTIVE: the kernel pools each
+    streamed row's certified true-distance lower bound
+    ``(max(√d2_adc − Eq_row, 0))²`` (its own recorded round-trip
+    error, streamed as a sidecar), so the pooled rest-min needs only
+    the kernel-precision envelope ``e_k`` on top of ``θ`` — the
+    per-list worst-case ``2√θ·Eq + Eq²`` widening the pre-adaptive
+    certificate paid survives only as the explain plane's
+    ``pq_margin_adaptive_gain`` delta."""
     from raft_tpu.ops.fine_scan_pallas import pad_window
     from raft_tpu.ops.pq_scan_pallas import pq_scan_list_major
 
@@ -406,21 +587,29 @@ def pq_scan_chunk(index: IvfPqIndex, xs, probes_np, pr, st, ps,
     xp, pp, nqp = _pad_kernel_operands(xs, pr)
     xxp = jnp.concatenate(
         [xx, jnp.zeros((nqp - nq, 1), jnp.float32)]) if nqp > nq else xx
-    lut = _pq_lut(xp, index.codebooks, S, dsub)
+    # the learned rotation applies to the QUERY side of the ADC table
+    # only: codes encode r·R, and x·(r̂'Rᵀ) = (x·R)·r̂' — the centroid
+    # cross term and the exact rescore stay in the original basis
+    xq = xp if index.pq_rot is None else jnp.matmul(
+        xp, index.pq_rot, precision=jax.lax.Precision.HIGHEST)
+    lut = _pq_lut(xq, index.codebooks, S, dsub)
     lids = jnp.maximum(jnp.asarray(sched.sched[3]), 0)
     cents = jnp.take(index.centroids, lids, axis=0)     # [Lp, d]
     cdot = jnp.einsum("qd,ld->ql", xp, cents,
                       precision=jax.lax.Precision.HIGHEST)
-    a1, i1, a2, i2, a3 = pq_scan_list_major(
+    pool = pq_scan_list_major(
         jnp.asarray(sched.sched), xxp, pp, cdot, lut, index.codes,
-        index.yy_pq, Wk=Wk, pq_bits=index.pq_bits)
-    rows = jnp.concatenate([i1[:nq], i2[:nq]], axis=1)   # [nq, 256]
+        index.yy_pq, index.pq_eq_col, Wk=Wk, pq_bits=index.pq_bits,
+        pool_depth=pool_depth)
+    rows = jnp.concatenate(
+        [pool[2 * t + 1][:nq] for t in range(pool_depth)], axis=1)
     vals, out_ids = _pq_pool_finish(xs, xx, rows, index.slab, ids,
                                     index.yy_slab, st, ps, k, P, W)
-    # completeness certificate: the recorded PQ envelope (per probed
-    # list) + the ADC kernel's numeric term over the score magnitudes
+    # adaptive completeness certificate: every probed row OUTSIDE the
+    # pool has certified lower bound ≥ the pooled rest-min, so only
+    # the ADC kernel's numeric term over the score magnitudes widens θ
     theta = vals[:, k - 1]
-    bound = jnp.min(a3[:nq], axis=1)
+    bound = jnp.min(pool[2 * pool_depth][:nq], axis=1)
     host = _list_host(index)
     eq_w = jnp.max(jnp.take(index.pq_eq_list, pr), axis=1)
     yymax = jnp.max(jnp.take(host["yy_lmax"], pr), axis=1)
@@ -430,15 +619,61 @@ def pq_scan_chunk(index: IvfPqIndex, xs, probes_np, pr, st, ps,
     # magnitude bounded by ‖x‖·‖r̂‖ (Cauchy-Schwarz over the subspace
     # concatenation — the RESIDUAL norm, not the row norm, which is
     # what keeps this tight for data far from the origin), plus the
-    # f32 adds/accumulation over the full score magnitude
+    # f32 adds/accumulation over the full score magnitude. The
+    # lower-bound map z ↦ (max(√z − Eq, 0))² is 1-Lipschitz, so the
+    # same envelope bounds the pooled certificate scores.
     xnorm = jnp.sqrt(xx[:, 0])
     span = (xnorm + jnp.sqrt(yymax) + eq_w) ** 2
     e_k = (2.0 ** -15 * xnorm * rhat_w
            + (2.0 ** -20 + d * 2.0 ** -24) * span)
-    sq_t = jnp.sqrt(jnp.maximum(theta, 0.0))
-    widen = 2.0 * sq_t * eq_w + eq_w * eq_w + e_k
-    certified = _pq_certify(bound, theta, widen)
-    return vals, out_ids, certified, bound - (theta + widen)
+    certified = _pq_certify(bound, theta, e_k)
+    if explain.active() is not None:
+        # what the pre-adaptive per-list worst-case certificate would
+        # have ADDED to the widening — the adaptive margin gain
+        sq_t = jnp.sqrt(jnp.maximum(theta, 0.0))
+        gain = 2.0 * sq_t * eq_w + eq_w * eq_w
+        explain.note(pq_margin_adaptive_gain=round(
+            float(jnp.mean(gain)), 6))
+    return vals, out_ids, certified, bound - (theta + e_k)
+
+
+def expected_pq_rerun_frac(index: IvfPqIndex, probes_np=None
+                           ) -> Tuple[float, str]:
+    """Measured-or-modeled expected certificate-rerun fraction for
+    ``index`` — the number the chooser folds into the ADC-vs-flat
+    byte comparison (the PR-15 blind spot: best-case codes bytes hid
+    the exact-rerun cost on hard data).
+
+    MEASURED wins when the quality plane has seen enough checks at the
+    ``ann.search_ivf_pq`` site this process. Otherwise the MODEL reads
+    the build-time per-list quantile sketch (``pq_eq_qlist``,
+    restricted to the probed lists when given): when a typical row's
+    recorded quantization error approaches the median residual norm,
+    ADC ordering is noise at the margin scale and the certificate
+    reruns — the prior is ``min(1, (q90_Eq / median‖y − c‖)²)``.
+    Returns ``(frac, source)`` with source ∈ ("measured", "modeled",
+    "unmodeled")."""
+    from raft_tpu.observability.quality import measured_rerun_frac
+
+    m = measured_rerun_frac("ann.search_ivf_pq")
+    if m is not None:
+        return float(m), "measured"
+    q = getattr(index, "pq_eq_qlist", None)
+    med = float(getattr(index, "pq_resid_med", 0.0) or 0.0)
+    if q is None or med <= 0.0:
+        return 0.0, "unmodeled"
+    q = np.asarray(q)
+    if probes_np is not None and q.ndim == 2 and q.shape[0]:
+        lists = np.unique(np.asarray(probes_np).ravel())
+        lists = lists[(lists >= 0) & (lists < q.shape[0])]
+        if lists.size:
+            q = q[lists]
+    live = q[q[:, 2] > 0.0] if q.size else q
+    if not live.size:
+        return 0.0, "unmodeled"
+    q90 = float(np.median(live[:, 1]))
+    ratio = q90 / med
+    return float(min(1.0, ratio * ratio)), "modeled"
 
 
 def resolve_pq_scan(index: IvfPqIndex, nq: int, k: int, P: int, W: int,
@@ -455,11 +690,14 @@ def resolve_pq_scan(index: IvfPqIndex, nq: int, k: int, P: int, W: int,
     on real TPUs the flattened table width ``pq_dim · 2^pq_bits`` must
     be lane-aligned.
 
-    ``auto`` consults the schema-6 ``pq`` tune-table column
-    (:func:`raft_tpu.tune.ivf.pq_scan_config`) first, then the
-    cost-model crossover (:func:`~raft_tpu.observability.costmodel.
-    choose_pq_scan` over the pq-aware traffic model on the index's
-    actual list-size histogram)."""
+    ``auto`` consults the schema-7 ``pq`` tune-table column
+    (:func:`raft_tpu.tune.ivf.pq_scan_config`, mode-aware) first,
+    then the cost-model crossover (:func:`~raft_tpu.observability.
+    costmodel.choose_pq_scan` over the pq-aware traffic model on the
+    index's actual list-size histogram) — priced at the EXPECTED
+    bytes including the measured-or-modeled certificate-rerun
+    fraction (:func:`expected_pq_rerun_frac`), with a logged
+    downgrade when the rerun pricing flips the best-case pick."""
     from raft_tpu.observability.costmodel import (choose_pq_scan,
                                                   ivf_traffic_model)
     from raft_tpu.ops.fine_scan_pallas import pad_window
@@ -504,18 +742,32 @@ def resolve_pq_scan(index: IvfPqIndex, nq: int, k: int, P: int, W: int,
         return "flat"
     if req == "pq":
         return "pq"
-    # auto — tuned table first, then the cost-model crossover
+    # auto — tuned table first, then the cost-model crossover at the
+    # rerun-aware expected bytes
     from raft_tpu.tune.ivf import pq_scan_config
 
-    tuned = pq_scan_config(index.n_lists, P, index.pq_bits)
+    tuned = pq_scan_config(index.n_lists, P, index.pq_bits,
+                           pq_mode=getattr(index, "pq_mode", "plain"))
     if tuned in ("pq", "flat"):
         return tuned
+    frac, src = expected_pq_rerun_frac(index, probes_np)
     model = ivf_traffic_model(
         nq, index.n_rows, index.d_orig, k, index.n_lists, P, W,
         index.slab_rows, list_sizes=index._np_sizes,
         padded_sizes=index._np_padded, pq_dim=S,
-        pq_bits=index.pq_bits)
-    return choose_pq_scan(model)
+        pq_bits=index.pq_bits, pq_rerun_frac=frac)
+    pick = choose_pq_scan(model)
+    if pick == "flat" and choose_pq_scan(model, rerun_frac=0.0) == "pq":
+        from raft_tpu.core.logger import log_warn
+
+        log_warn("pq_scan auto: expected certificate-rerun fraction "
+                 "%.2f (%s) prices the ADC scan above the flat scan "
+                 "— downgrading to flat for this call", frac, src)
+        emit_marker("pq_chooser_downgrade",
+                    rerun_frac=round(frac, 4), source=src)
+        explain.note(pq_chooser_downgrade={
+            "rerun_frac": round(frac, 4), "source": src})
+    return pick
 
 
 @instrument("ann.search_ivf_pq")
@@ -636,11 +888,19 @@ def search_ivf_pq(res, index: IvfPqIndex, queries, k: int,
 
 def _search_pq(res, index: IvfPqIndex, x, probes, probes_host, starts,
                psizes, k: int, P: int, W: int, chunk: int):
-    """The ADC driver: per chunk, run :func:`pq_scan_chunk` and rerun
-    any certificate-failing rows through the exact f32 scan — returned
-    id sets match the flat scan's over the same probes in EVERY
-    case."""
+    """The ADC driver: per chunk, run :func:`pq_scan_chunk`, walk any
+    certificate-failing rows down the widen rungs (2x / 4x candidate
+    pool, re-ADC, re-certify), and rerun whatever still fails through
+    the exact f32 scan — returned id sets match the flat scan's over
+    the same probes in EVERY case."""
+    from raft_tpu.ann.ivf_flat import _list_cells
+    from raft_tpu.ops.fine_scan_pallas import (LISTS_PER_CELL,
+                                               pad_window)
+    from raft_tpu.ops.fused_l2_topk_pallas import vmem_budget
+    from raft_tpu.ops.pq_scan_pallas import pq_scan_vmem_footprint
+
     nq = x.shape[0]
+    widen_cap = int(env.get("RAFT_TPU_ANN_PQ_WIDEN"))
     try:
         res.profiler.capture_fn(
             "ann.pq_scan", _pq_lut, x[:min(nq, chunk)],
@@ -651,24 +911,75 @@ def _search_pq(res, index: IvfPqIndex, x, probes, probes_host, starts,
     def run_chunk(s0: int, s1: int):
         xs, pr = x[s0:s1], probes[s0:s1]
         st, ps = starts[s0:s1], psizes[s0:s1]
+        nq_c = int(xs.shape[0])
         vals, ids_c, ok, margin = pq_scan_chunk(
             index, xs, probes_host[s0:s1], pr, st, ps, k, P, W)
         explain.note_margin("ann.search_ivf_pq", margin)
-        n_fail = int(jnp.sum(~ok))
+        n_fail0 = n_fail = int(jnp.sum(~ok))
+        depth_used = 2
+        if n_fail:
+            # the widen rung: before escalating to the exact scan,
+            # re-run the ADC with a deeper candidate pool (256 -> 512
+            # -> 1024 slots) and re-certify — on margin-starved rows
+            # the pooled rest-min usually clears theta + e_k once the
+            # pool holds the near-boundary candidates
+            Wk = pad_window(W)
+            nqp = -(-nq_c // 8) * 8
+            Lp = _list_cells(nq_c * P, index.n_lists) * LISTS_PER_CELL
+            for factor in (2, 4):
+                if factor > widen_cap or not n_fail:
+                    break
+                depth = 2 * factor
+                if pq_scan_vmem_footprint(
+                        Wk, nqp, index.pq_dim, index.pq_k, Lp,
+                        index.pq_bits,
+                        pool_depth=depth) > vmem_budget():
+                    break
+                try:
+                    fault_point("pq_widen")
+                    wv, wi, wok, _wm = pq_scan_chunk(
+                        index, xs, probes_host[s0:s1], pr, st, ps,
+                        k, P, W, pool_depth=depth)
+                except DeadlineExceededError:
+                    raise       # the global budget — never eaten
+                except Exception as e:
+                    from raft_tpu.core.logger import log_warn
+
+                    record_degradation("pq_widen", "exact")
+                    emit_marker("pq_widen_degrade",
+                                reason=f"{type(e).__name__}: "
+                                       f"{e}"[:160])
+                    log_warn("PQ widen rung x%d failed (%s: %s) — "
+                             "escalating straight to the exact "
+                             "rerun", factor, type(e).__name__, e)
+                    break
+                okc = ok[:, None]
+                vals = jnp.where(okc, vals, wv)
+                ids_c = jnp.where(okc, ids_c, wi)
+                ok = ok | wok
+                depth_used = depth
+                n_fail = int(jnp.sum(~ok))
         # same host sync the certified gather paths already pay — the
         # PQ slice of the certificate/fixup evidence plane
         record_certificate("ann.search_ivf_pq",
-                           n_queries=int(xs.shape[0]), n_fail=n_fail,
-                           pool_width=256, fixup_rows=n_fail or None,
+                           n_queries=nq_c, n_fail=n_fail,
+                           pool_width=128 * depth_used,
+                           fixup_rows=n_fail or None,
                            rerun=bool(n_fail), pq_bits=index.pq_bits,
                            n_probes=P)
+        record_pq_rungs("ann.search_ivf_pq",
+                        certified=nq_c - n_fail0,
+                        widened=n_fail0 - n_fail, exact_rerun=n_fail)
+        if explain.active() is not None:
+            explain.note(pq_rungs={
+                "certified": nq_c - n_fail0,
+                "widened": n_fail0 - n_fail, "exact_rerun": n_fail})
         if n_fail:
             # the true top-k (or a tie) may hide outside the pooled
             # candidates: rerun the chunk through the exact f32 scan
             # and keep certified rows — bytes saved stand, correctness
             # never rides on the margin
-            emit_marker("pq_cert_fallback", n_fail=n_fail,
-                        nq=int(xs.shape[0]))
+            emit_marker("pq_cert_fallback", n_fail=n_fail, nq=nq_c)
             explain.note(rerun="pq_exact", rerun_rows=n_fail)
             fv, fi = _fine_scan(xs, index.slab, index.ids,
                                 index.yy_slab, st, ps, k=k, P=P, W=W)
@@ -690,11 +1001,13 @@ def warm_pq_scan(res, index: IvfPqIndex, nq: int, k: int,
     """Pre-compile every program a serving bucket of ``nq`` queries
     can reach on the PQ plane: the flat fallback/degradation programs
     (through the public entry, so the chunking and rerun programs warm
-    too) and one ADC program per power-of-two schedule-cell rung —
-    mirrors :func:`~raft_tpu.ann.ivf_flat.warm_fine_scan` so a live
-    request never pays a compile whichever way the chooser (or the
-    certificate) lands. Returns the ADC rung count (0 = outside the
-    ADC envelope)."""
+    too) and one ADC program per (power-of-two schedule-cell rung x
+    certification pool depth — the widen ladder up to
+    ``RAFT_TPU_ANN_PQ_WIDEN``) — mirrors
+    :func:`~raft_tpu.ann.ivf_flat.warm_fine_scan` so a live request
+    never pays a compile whichever way the chooser (or the
+    certificate) lands. Returns the warmed ADC program count (0 =
+    outside the ADC envelope)."""
     from raft_tpu.ops.fine_scan_pallas import (LISTS_PER_CELL,
                                                pad_window)
     from raft_tpu.ops.pq_scan_pallas import pq_scan_list_major
@@ -715,7 +1028,10 @@ def warm_pq_scan(res, index: IvfPqIndex, nq: int, k: int,
     cap = max(1, -(-index.n_lists // LISTS_PER_CELL))
     rungs = sorted({min(1 << b, cap)
                     for b in range(cap.bit_length() + 1)})
+    widen_cap = int(env.get("RAFT_TPU_ANN_PQ_WIDEN"))
+    depths = [2] + [2 * f for f in (2, 4) if f <= widen_cap]
     S, K = index.pq_dim, index.pq_k
+    warmed = 0
     for nq_c in sizes:
         nqp = -(-nq_c // 8) * 8
         xx0 = jnp.zeros((nqp, 1), jnp.float32)
@@ -725,9 +1041,12 @@ def warm_pq_scan(res, index: IvfPqIndex, nq: int, k: int,
             Lp = cells * LISTS_PER_CELL
             sched = np.zeros((4, Lp), np.int32)
             sched[3, :] = -1
-            out = pq_scan_list_major(
-                jnp.asarray(sched), xx0, pp0,
-                jnp.zeros((nqp, Lp), jnp.float32), lut0, index.codes,
-                index.yy_pq, Wk=Wk, pq_bits=index.pq_bits)
-            jax.block_until_ready(out)
-    return len(rungs)
+            for depth in depths:
+                out = pq_scan_list_major(
+                    jnp.asarray(sched), xx0, pp0,
+                    jnp.zeros((nqp, Lp), jnp.float32), lut0,
+                    index.codes, index.yy_pq, index.pq_eq_col,
+                    Wk=Wk, pq_bits=index.pq_bits, pool_depth=depth)
+                jax.block_until_ready(out)
+                warmed += 1
+    return warmed

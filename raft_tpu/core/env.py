@@ -160,6 +160,15 @@ _knob("RAFT_TPU_IVF_PQ_SCAN", "enum", "auto",
 _knob("RAFT_TPU_ANN_PQ_BITS", "int", 8,
       "fleet default code width for build_ivf_pq callers that pass "
       "none (4 or 8 bits per subspace code)")
+_knob("RAFT_TPU_ANN_PQ_MODE", "enum", "plain",
+      "fleet default build_ivf_pq quantizer mode: plain PQ, an OPQ "
+      "learned rotation, or OPQ plus score-aware anisotropic "
+      "codeword assignment",
+      choices=("plain", "opq", "opq_aniso"))
+_knob("RAFT_TPU_ANN_PQ_WIDEN", "int", 4,
+      "max widen factor for the PQ certificate middle rung (1 "
+      "disables widening; >=2 allows the 512-slot re-ADC pool, >=4 "
+      "the 1024-slot pool)")
 
 # -- mutable indexes / durability --------------------------------------
 _knob("RAFT_TPU_COMPACT_THRESHOLD", "int", 1024,

@@ -62,14 +62,15 @@ class IndexLayout:
     __slots__ = ("slab", "ids", "rows_valid", "offsets", "sizes",
                  "padded_sizes", "row_quantum", "d_orig", "n_rows",
                  "db_dtype", "slab_q", "row_scale", "eq_rows",
-                 "pq_codes", "pq_yy", "pq_eq_rows", "pq_meta")
+                 "pq_codes", "pq_yy", "pq_eq_rows", "pq_rot",
+                 "pq_meta")
 
     def __init__(self, slab, ids, rows_valid, n_rows: int, d_orig: int,
                  offsets=None, sizes=None, padded_sizes=None,
                  row_quantum: int = ROW_QUANTUM, db_dtype: str = "f32",
                  slab_q=None, row_scale=None, eq_rows=None,
                  pq_codes=None, pq_yy=None, pq_eq_rows=None,
-                 pq_meta=None):
+                 pq_rot=None, pq_meta=None):
         self.slab = slab
         self.ids = ids
         self.rows_valid = rows_valid
@@ -90,6 +91,10 @@ class IndexLayout:
         self.pq_codes = pq_codes
         self.pq_yy = pq_yy
         self.pq_eq_rows = pq_eq_rows
+        # the OPQ learned rotation ([d, d] orthogonal, None for plain
+        # PQ) — per-INDEX, not per-row: compaction and tombstone folds
+        # carry it through unchanged
+        self.pq_rot = pq_rot
         self.pq_meta = pq_meta
 
     @property

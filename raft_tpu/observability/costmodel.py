@@ -392,17 +392,30 @@ def pq_index_bytes(m: int, d: int, n_lists: int, pq_dim: int,
     }
 
 
-def choose_pq_scan(model: Dict) -> str:
+def choose_pq_scan(model: Dict,
+                   rerun_frac: Optional[float] = None) -> str:
     """The cost-model half of ``ann.ivf_pq.resolve_pq_scan``:
     ``"pq"`` when the best FLAT schedule's modeled fine-scan bytes
-    beat the ADC stream by :data:`PQ_SCAN_MARGIN`, else ``"flat"``.
-    Takes an :func:`ivf_traffic_model` result carrying the pq keys."""
+    beat the EXPECTED ADC bytes by :data:`PQ_SCAN_MARGIN`, else
+    ``"flat"``. Takes an :func:`ivf_traffic_model` result carrying
+    the pq keys.
+
+    Expected ADC bytes are NOT the best case: every certificate-
+    failing query pays the flat rerun on top of the codes stream, so
+    the comparison prices ``pq_stream + rerun_frac · flat``
+    (``rerun_frac`` overrides the model's own ``pq_rerun_frac`` key;
+    both default 0 — the PR-15 blind spot this closes)."""
     pq = model.get("pq_stream_bytes")
     if not isinstance(pq, (int, float)) or pq <= 0:
         return "flat"
     flat = min(model.get("fine_stream_bytes", float("inf")),
                model.get("fine_gather_bytes", float("inf")))
-    return "pq" if flat > PQ_SCAN_MARGIN * max(pq, 1.0) else "flat"
+    frac = model.get("pq_rerun_frac", 0.0) if rerun_frac is None \
+        else rerun_frac
+    frac = min(1.0, max(0.0, float(frac)))
+    expected = pq + frac * flat
+    return "pq" if flat > PQ_SCAN_MARGIN * max(expected, 1.0) \
+        else "flat"
 
 #: per-query candidate pool the list-major kernels exact-rescore
 #: (2 × 128 lane-class slots — ops.fine_scan_pallas.POOL_WIDTH)
@@ -425,7 +438,8 @@ def ivf_traffic_model(nq: int, m: int, d: int, k: int, n_lists: int,
                       slab_rows: int, db_dtype: str = "f32",
                       list_sizes=None, padded_sizes=None,
                       pq_dim: Optional[int] = None,
-                      pq_bits: Optional[int] = None) -> Dict:
+                      pq_bits: Optional[int] = None,
+                      pq_rerun_frac: float = 0.0) -> Dict:
     """Analytic HBM traffic of one IVF-Flat search batch
     (:mod:`raft_tpu.ann`) next to the brute-force bytes it displaces —
     the model behind BENCH_ANN.json's speed/recall frontier.
@@ -460,12 +474,16 @@ def ivf_traffic_model(nq: int, m: int, d: int, k: int, n_lists: int,
       roofline-perfect chip would sustain;
     - with ``pq_dim``/``pq_bits`` (the IVF-PQ compressed tier,
       ``ann.ivf_pq``): ``pq_stream_bytes`` prices the list-major ADC
-      schedule — packed code bytes + the 4-byte ``‖ŷ‖²`` sidecar per
-      streamed row, the per-chunk ADC table build (codebooks in, the
-      ``[nq, pq_dim·2^pq_bits]`` table out) and the mandatory 256-row
-      f32 pool rescore — and ``pq_bytes_ratio`` is the pure codes-vs-
-      f32 slab-stream ratio (:func:`pq_bytes_ratio`) the quantized
-      gate bounds at ≤ 0.10×.
+      schedule — packed code bytes + the 4-byte ``‖ŷ‖²`` and 4-byte
+      per-row ``Eq`` sidecars per streamed row, the per-chunk ADC
+      table build (codebooks in, the ``[nq, pq_dim·2^pq_bits]`` table
+      out) and the mandatory 256-row f32 pool rescore — and
+      ``pq_bytes_ratio`` is the pure codes-vs-f32 slab-stream ratio
+      (:func:`pq_bytes_ratio`) the quantized gate bounds at ≤ 0.10×.
+      ``pq_rerun_frac`` (measured-or-modeled expected certificate-
+      rerun fraction) adds the flat-rerun bytes those queries pay:
+      ``pq_expected_bytes = pq_stream + frac · fine_stream`` — what
+      :func:`choose_pq_scan` actually compares.
     """
     from raft_tpu.distance.knn_fused import _Q_CHUNK
 
@@ -532,16 +550,23 @@ def ivf_traffic_model(nq: int, m: int, d: int, k: int, n_lists: int,
         K = 1 << int(pq_bits)
         dsub = max(1, d // max(int(pq_dim), 1))
         code_bytes = int(pq_dim) * int(pq_bits) / 8.0
-        per_row_pq = code_bytes + 4 + 4        # codes + ‖ŷ‖² + id
+        # codes + ‖ŷ‖² + per-row Eq (adaptive certificate) + id
+        per_row_pq = code_bytes + 4 + 4 + 4
         adc_table_bytes = (float(chunks) * pq_dim * K * dsub * 4
                            + float(nq) * pq_dim * K * 4 * 2)
         pq_stream = (float(chunks) * stream_rows * per_row_pq
                      + list_rescore_bytes + adc_table_bytes)
-        pq_total = coarse_bytes + pq_stream + out_bytes
+        frac = min(1.0, max(0.0, float(pq_rerun_frac)))
+        pq_expected = pq_stream + frac * (float(chunks) * stream_rows
+                                          * per_row
+                                          + list_rescore_bytes)
+        pq_total = coarse_bytes + pq_expected + out_bytes
         pq_keys = {
             "pq_dim": int(pq_dim),
             "pq_bits": int(pq_bits),
             "pq_stream_bytes": pq_stream,
+            "pq_rerun_frac": frac,
+            "pq_expected_bytes": pq_expected,
             "pq_total_bytes": pq_total,
             "adc_table_bytes": adc_table_bytes,
             "pq_bytes_ratio": pq_bytes_ratio(d, int(pq_dim),

@@ -69,6 +69,13 @@ IVF_RERUNS = "raft_tpu_ivf_cert_rerun_total"
 #: record_pending calls skipped because they executed under tracing
 #: (n_fail was a Tracer — see the guard in record_pending)
 TRACE_SKIPS = "raft_tpu_certificate_trace_skips_total"
+#: per-rung outcomes of the PQ certification ladder
+#: (rung ∈ certified / widened / exact_rerun)
+PQ_RUNGS = "raft_tpu_pq_cert_rung_total"
+#: running per-site fraction of PQ queries that escalated past the
+#: widen rungs to the full exact rerun (the BENCH_ANN cert_rerun_frac,
+#: live)
+PQ_RERUN_FRAC = "raft_tpu_pq_cert_rerun_frac"
 #: shadow-sampled requests re-scored against the oracle
 SHADOW_SAMPLES = "raft_tpu_serving_shadow_samples_total"
 #: shadow candidates dropped because the sampler queue was full
@@ -169,6 +176,59 @@ def record_certificate(site: str, n_queries: int, n_fail: int,
         pass
 
 
+# per-site running PQ rung tallies: site -> [total_queries, exact_reruns]
+# — the evidence expected_pq_rerun_frac's MEASURED branch reads
+_pq_tally: Dict[str, List[int]] = {}
+_pq_lock = threading.Lock()
+
+
+def record_pq_rungs(site: str, certified: int, widened: int,
+                    exact_rerun: int) -> None:
+    """Host-side record of one PQ certification-ladder batch: how many
+    queries each rung resolved (``certified`` = base ADC pool cleared
+    the bound, ``widened`` = a 2x/4x re-ADC pool cleared it,
+    ``exact_rerun`` = escalated to the full exact scan). Maintains the
+    per-rung counters and the running ``raft_tpu_pq_cert_rerun_frac``
+    gauge. Never raises into the result path."""
+    if not quality_enabled():
+        return
+    try:
+        total = max(0, int(certified)) + max(0, int(widened)) \
+            + max(0, int(exact_rerun))
+        if not total:
+            return
+        with _pq_lock:
+            tally = _pq_tally.setdefault(site, [0, 0])
+            tally[0] += total
+            tally[1] += max(0, int(exact_rerun))
+            frac = tally[1] / tally[0]
+        reg = get_registry()
+        for rung, n in (("certified", certified), ("widened", widened),
+                        ("exact_rerun", exact_rerun)):
+            if n > 0:
+                reg.counter(PQ_RUNGS, {"site": site, "rung": rung},
+                            help="PQ queries resolved per "
+                                 "certification-ladder rung"
+                            ).inc(int(n))
+        reg.gauge(PQ_RERUN_FRAC, {"site": site},
+                  help="Running fraction of PQ queries escalating to "
+                       "the full exact rerun").set(round(frac, 6))
+    except Exception:
+        pass
+
+
+def measured_rerun_frac(site: str,
+                        min_checks: int = 64) -> Optional[float]:
+    """The process-measured exact-rerun fraction at ``site``, or None
+    until at least ``min_checks`` queries have walked the ladder —
+    the chooser's measured-beats-modeled evidence."""
+    with _pq_lock:
+        tally = _pq_tally.get(site)
+        if tally is None or tally[0] < max(1, int(min_checks)):
+            return None
+        return tally[1] / tally[0]
+
+
 # pending certificate stats whose failure count is still a device value:
 # (site, n_fail_device, n_queries, pool_width, fix_tiers, meta)
 _PENDING_CAP = 4096
@@ -241,9 +301,12 @@ def pending_count() -> int:
 
 
 def clear() -> None:
-    """Drop pending (undrained) records — tests."""
+    """Drop pending (undrained) records and the PQ rung tallies —
+    tests."""
     with _pending_lock:
         _pending.clear()
+    with _pq_lock:
+        _pq_tally.clear()
 
 
 # ------------------------------------------------------------ snapshot
@@ -268,6 +331,12 @@ def quality_block(registry=None, drain_first: bool = True
             sites.setdefault(site, {})["fixups"] = int(metric.value)
         elif metric.name == IVF_RERUNS and site:
             sites.setdefault(site, {})["cert_reruns"] = int(metric.value)
+        elif metric.name == PQ_RERUN_FRAC and site:
+            sites.setdefault(site, {})["pq_rerun_frac"] = round(
+                float(metric.value), 6)
+        elif metric.name == PQ_RUNGS and site:
+            sites.setdefault(site, {}).setdefault("pq_rungs", {})[
+                metric.labels.get("rung", "?")] = int(metric.value)
         elif metric.name == RESCORE_POOL and site:
             cnt = metric.count
             pools[site] = {"count": cnt,

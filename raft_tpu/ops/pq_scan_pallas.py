@@ -5,8 +5,8 @@ grid walks the PROBED LISTS in the same 8-list cells over the same
 host-built schedule (``ann.ivf_flat.build_list_schedule`` — reused
 verbatim), but the streamed operand is the PRODUCT-QUANTIZED codes
 slab (~1/16 of the f32 bytes at 8-bit codes with ``pq_dim = d/4``,
-~1/32 at 4-bit) plus the 4-byte ``‖ŷ‖²`` reconstruction-norm sidecar,
-never the f32 rows.
+~1/32 at 4-bit) plus the 4-byte ``‖ŷ‖²`` reconstruction-norm and
+4-byte per-row quantization-error sidecars, never the f32 rows.
 
 Scoring is asymmetric-distance computation (ADC) by TABLE LOOKUP, the
 classic IVF-PQ structure (ref: neighbors/ivf_pq.cuh / cuVS
@@ -23,19 +23,35 @@ classic IVF-PQ structure (ref: neighbors/ivf_pq.cuh / cuVS
   is the only shape a TPU vector unit streams at full rate;
 - the residual-coding cross term ``x · c_list`` rides the resident
   per-scheduled-list ``cdot [nqp, Lp]`` table (per query × probed
-  list — tiny next to the slab), so the folded score is exactly
+  list — tiny next to the slab), so the ADC score is exactly
 
   ``d2(x, ŷ) = ‖x‖² + ‖ŷ‖² − 2·x·c_l − 2·Σ_s x_s·cb_s[code_{w,s}]``
 
   against the RECONSTRUCTED row ``ŷ = c_l + concat_s cb_s[code]``.
 
-Masks, pools and outputs are the fine-scan contract unchanged: probe-
-table membership + window-column masks to the never-wins +inf, scores
-fold into the per-query 128-lane-class top-2 pools with global slab
-rows and the running 3rd-min certificate input. The caller
-(``ann.ivf_pq``) exact-rescores the pooled candidates from the
-retained f32 slab and certifies completeness with the recorded
-per-subspace quantization bounds — failed queries rerun the exact f32
+What FOLDS into the pool is the per-row ADAPTIVE certificate score —
+the certified true-distance lower bound
+
+  ``lb(x, y) = max(√max(d2(x, ŷ), 0) − Eq_y, 0)²``
+
+where ``Eq_y`` is the row's RECORDED round-trip error bound streamed
+from the 4-byte sidecar (``|√d2(x,y) − √d2(x,ŷ)| ≤ ‖y − ŷ‖ ≤ Eq_y``
+by the triangle inequality, and ``z ↦ (max(√z − Eq, 0))²`` is
+1-Lipschitz so the kernel's own score error passes through
+undiminished). The pool therefore ranks rows by how close they COULD
+be, and its running rest-min is directly the per-query completeness
+bound — no per-list worst-case widening term survives to the caller,
+only the kernel-precision envelope.
+
+Masks and outputs follow the fine-scan contract, generalized to a
+static ``pool_depth``: probe-table membership + window-column masks to
+the never-wins +inf, scores fold into the per-query 128-lane-class
+top-``pool_depth`` pools with global slab rows, plus the running
+(depth+1)-min certificate input. ``pool_depth=2`` is the ordinary
+256-slot pool; the ``pq_widen`` rung re-runs at 4/8 for a 512/1024-
+slot pool before the caller escalates to the exact f32 rerun. The
+caller (``ann.ivf_pq``) exact-rescores the pooled candidates from the
+retained f32 slab — failed queries widen, then rerun the exact f32
 scan, so returned ids never degrade (see ``search_ivf_pq``).
 
 4-bit codes stream PACKED (two codes per byte, low nibble = even
@@ -53,8 +69,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_tpu.ops.fine_scan_pallas import (LISTS_PER_CELL, _fold_pool,
-                                           _pool_out_shape, _split_hi_lo)
+from raft_tpu.ops.fine_scan_pallas import (LISTS_PER_CELL,
+                                           _split_hi_lo)
 from raft_tpu.ops.utils import interpret_mode
 
 _LANES = 128
@@ -63,25 +79,72 @@ _NT = (((1,), (1,)), ((), ()))
 #: supported code widths: 4-bit codes pack two per byte
 PQ_BITS = (4, 8)
 
+#: supported pool depths (top-N per 128-lane class): 2 is the base
+#: 256-slot pool, 4/8 are the pq_widen rungs (512/1024 slots)
+PQ_POOL_DEPTHS = (2, 4, 8)
+
 
 def pq_scan_vmem_footprint(Wk: int, nqp: int, pq_dim: int, K: int,
-                           Lp: int, pq_bits: int = 8) -> int:
+                           Lp: int, pq_bits: int = 8,
+                           pool_depth: int = 2) -> int:
     """Estimated scoped-VMEM bytes of one PQ ADC cell: 2 DMA slots for
-    the code window (+ the f32 norm sidecar), the resident ADC table
+    the code window (+ the two f32 sidecars), the resident ADC table
     (f32 + its bf16 hi/lo split), the resident probe + centroid-dot
     tables, the per-subspace one-hot staging block, ~3 live [nqp, Wk]
-    f32 score temporaries and the 5-buffer fold state. UNCALIBRATED —
-    conservative, same spirit as ``fine_scan_vmem_footprint``."""
+    f32 score temporaries and the (2·depth+1)-buffer fold state.
+    UNCALIBRATED — conservative, same spirit as
+    ``fine_scan_vmem_footprint``."""
     code_bytes = pq_dim if pq_bits == 8 else -(-pq_dim // 2)
     bytes_ = 2 * Wk * code_bytes                 # 2 code DMA slots
-    bytes_ += 2 * Wk * 4                         # 2 ‖ŷ‖² DMA slots
+    bytes_ += 2 * 2 * Wk * 4                     # 2×(‖ŷ‖², Eq) DMA slots
     bytes_ += nqp * pq_dim * K * (4 + 2 + 2)     # lut f32 + hi/lo bf16
     bytes_ += nqp * _LANES * 4                   # probe table
     bytes_ += nqp * Lp * 4                       # per-list x·c table
     bytes_ += Wk * pq_dim * K * 2                # one-hot staging (bf16)
-    bytes_ += 3 * nqp * Wk * 4                   # d2 + temporaries
-    bytes_ += 5 * nqp * _LANES * 4 * 2           # fold state + temps
+    bytes_ += 3 * nqp * Wk * 4                   # d2/lb + temporaries
+    bytes_ += (2 * pool_depth + 1) * nqp * _LANES * 4 * 2  # fold state
     return bytes_
+
+
+def _pq_pool_out_shape(nqp: int, depth: int):
+    """``depth`` (score, row) pool pairs + the running rest-min."""
+    out = []
+    for _ in range(depth):
+        out.append(jax.ShapeDtypeStruct((nqp, _LANES), jnp.float32))
+        out.append(jax.ShapeDtypeStruct((nqp, _LANES), jnp.int32))
+    out.append(jax.ShapeDtypeStruct((nqp, _LANES), jnp.float32))
+    return out
+
+
+def _fold_pool_deep(acc, d2, base_row, nqp: int, Wk: int, depth: int):
+    """Fold a masked [nqp, Wk] score window into the per-query
+    ``depth``-deep 128-lane-class pool — the fine-scan ``_fold_pool``
+    insertion cascade generalized from top-2 to top-``depth``, plus
+    the running (depth+1)-min (certificate input — every row outside a
+    lane's top-``depth`` scored ≥ that lane's rest-min). ``acc`` is
+    the flat ``(a_1, i_1, …, a_depth, i_depth, rest)`` tuple."""
+    a = [acc[2 * t] for t in range(depth)]
+    i = [acc[2 * t + 1] for t in range(depth)]
+    rest = acc[2 * depth]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (nqp, _LANES), 1)
+    for r in range(Wk // _LANES):
+        c = d2[:, r * _LANES:(r + 1) * _LANES]
+        ci = base_row + r * _LANES + lane
+        lt = [c < a[t] for t in range(depth)]
+        lt_rest = c < rest
+        rest = jnp.where(lt[depth - 1], a[depth - 1],
+                         jnp.where(lt_rest, c, rest))
+        for t in range(depth - 1, 0, -1):
+            a[t] = jnp.where(lt[t - 1], a[t - 1],
+                             jnp.where(lt[t], c, a[t]))
+            i[t] = jnp.where(lt[t - 1], i[t - 1],
+                             jnp.where(lt[t], ci, i[t]))
+        a[0] = jnp.where(lt[0], c, a[0])
+        i[0] = jnp.where(lt[0], ci, i[0])
+    out = []
+    for t in range(depth):
+        out += [a[t], i[t]]
+    return tuple(out) + (rest,)
 
 
 def _decode_subspaces(codes, pq_dim: int, pq_bits: int):
@@ -120,14 +183,14 @@ def _adc_scores(lut_hi, lut_lo, codes, pq_dim: int, K: int,
 
 
 def _pq_kernel_body(sched_ref, xx_ref, probes_ref, cdot_ref, lut_ref,
-                    codes_ref, yy_ref, a1_ref, i1_ref, a2_ref, i2_ref,
-                    a3_ref, *, Wk: int, pq_dim: int, K: int,
-                    pq_bits: int):
+                    codes_ref, yy_ref, eq_ref, *out_refs, Wk: int,
+                    pq_dim: int, K: int, pq_bits: int, depth: int):
     """One grid cell: stream LISTS_PER_CELL probed lists' code windows
-    (+ norm sidecars) through the 2-slot DMA pipeline, evaluate the
-    ADC scores against the resident lookup table, mask non-member
-    queries / out-of-window columns to +inf and fold into the
-    revisited per-query pools."""
+    (+ norm and error sidecars) through the 2-slot DMA pipeline,
+    evaluate the ADC scores against the resident lookup table, subtract
+    each row's recorded error bound into the certified lower-bound
+    score, mask non-member queries / out-of-window columns to +inf and
+    fold into the revisited per-query pools."""
     s = pl.program_id(0)
     nqp = xx_ref.shape[0]
     inf = jnp.full((nqp, _LANES), jnp.inf, jnp.float32)
@@ -135,20 +198,22 @@ def _pq_kernel_body(sched_ref, xx_ref, probes_ref, cdot_ref, lut_ref,
 
     @pl.when(s == 0)
     def _():
-        a1_ref[...] = inf
-        i1_ref[...] = neg1
-        a2_ref[...] = inf
-        i2_ref[...] = neg1
-        a3_ref[...] = inf
+        for t in range(depth):
+            out_refs[2 * t][...] = inf
+            out_refs[2 * t + 1][...] = neg1
+        out_refs[2 * depth][...] = inf
 
-    def body(cscratch, yscratch, csem, ysem):
+    def body(cscratch, yscratch, escratch, csem, ysem, esem):
         def dma(slot, j):
             return (pltpu.make_async_copy(
                 codes_ref.at[pl.ds(sched_ref[0, j], Wk), :],
                 cscratch.at[slot], csem.at[slot]),
                 pltpu.make_async_copy(
                     yy_ref.at[pl.ds(sched_ref[0, j], Wk), :],
-                    yscratch.at[slot], ysem.at[slot]))
+                    yscratch.at[slot], ysem.at[slot]),
+                pltpu.make_async_copy(
+                    eq_ref.at[pl.ds(sched_ref[0, j], Wk), :],
+                    escratch.at[slot], esem.at[slot]))
 
         def start(slot, j):
             for cp in dma(slot, j):
@@ -165,8 +230,7 @@ def _pq_kernel_body(sched_ref, xx_ref, probes_ref, cdot_ref, lut_ref,
         cdot = cdot_ref[...]                             # [nqp, Lp]
         lut_hi, lut_lo = _split_hi_lo(lut_ref[...])      # [nqp, S·K]
         colv = jax.lax.broadcasted_iota(jnp.int32, (nqp, Wk), 1)
-        acc = (a1_ref[...], i1_ref[...], a2_ref[...], i2_ref[...],
-               a3_ref[...])
+        acc = tuple(ref[...] for ref in out_refs)
         for jj in range(LISTS_PER_CELL):
             j = j0 + jj
             slot = jj % 2
@@ -180,31 +244,41 @@ def _pq_kernel_body(sched_ref, xx_ref, probes_ref, cdot_ref, lut_ref,
             adc = _adc_scores(lut_hi, lut_lo, cscratch[slot], pq_dim,
                               K, pq_bits, Wk)
             yyw = yscratch[slot].reshape(1, Wk)          # ‖ŷ‖² lanes
+            eqw = escratch[slot].reshape(1, Wk)          # Eq_row lanes
             qc = jax.lax.dynamic_slice_in_dim(cdot, j, 1, 1)
             d2 = xx + yyw - 2.0 * qc - 2.0 * adc
+            # the certified lower bound on the TRUE distance: pull the
+            # ADC score toward 0 by the row's recorded round-trip
+            # error (triangle inequality on the norms; 1-Lipschitz in
+            # the score, so the kernel-precision envelope carries over
+            # unchanged) — +inf masks propagate through the sqrt
+            rad = jnp.sqrt(jnp.maximum(d2, 0.0))
+            lb = jnp.maximum(rad - eqw, 0.0) ** 2
             member = jnp.sum((probes == lid).astype(jnp.float32),
                              axis=1, keepdims=True)      # [nqp, 1]
-            d2 = jnp.where(member > 0.0, d2, jnp.inf)
+            lb = jnp.where(member > 0.0, lb, jnp.inf)
             valid = (colv >= off) & (colv < off + lsize)
-            d2 = jnp.where(valid, d2, jnp.inf)
-            acc = _fold_pool(acc, d2, st, nqp, Wk)
-        a1_ref[...], i1_ref[...], a2_ref[...], i2_ref[...], \
-            a3_ref[...] = acc
+            lb = jnp.where(valid, lb, jnp.inf)
+            acc = _fold_pool_deep(acc, lb, st, nqp, Wk, depth)
+        for t, ref in enumerate(out_refs):
+            ref[...] = acc[t]
 
     code_bytes = pq_dim if pq_bits == 8 else pq_dim // 2
     pl.run_scoped(
         body,
         cscratch=pltpu.VMEM((2, Wk, code_bytes), jnp.int8),
         yscratch=pltpu.VMEM((2, Wk, 1), jnp.float32),
+        escratch=pltpu.VMEM((2, Wk, 1), jnp.float32),
         csem=pltpu.SemaphoreType.DMA((2,)),
-        ysem=pltpu.SemaphoreType.DMA((2,)))
+        ysem=pltpu.SemaphoreType.DMA((2,)),
+        esem=pltpu.SemaphoreType.DMA((2,)))
 
 
-@functools.partial(jax.jit, static_argnames=("Wk", "pq_bits"))
+@functools.partial(jax.jit,
+                   static_argnames=("Wk", "pq_bits", "pool_depth"))
 def pq_scan_list_major(sched, xx, probes, cdot, lut, codes, yy_pq,
-                       Wk: int, pq_bits: int = 8
-                       ) -> Tuple[jax.Array, jax.Array, jax.Array,
-                                  jax.Array, jax.Array]:
+                       eq_rows, Wk: int, pq_bits: int = 8,
+                       pool_depth: int = 2) -> Tuple[jax.Array, ...]:
     """List-major ADC scan over the product-quantized codes slab.
 
     Args:
@@ -221,13 +295,17 @@ def pq_scan_list_major(sched, xx, probes, cdot, lut, codes, yy_pq,
       codes: [R, pq_dim] int8 biased codes (8-bit: stored code−128) or
         [R, pq_dim/2] packed nibbles (4-bit).
       yy_pq: [R, 1] f32 reconstructed row norms ``‖ŷ‖²`` (pads 0).
+      eq_rows: [R, 1] f32 recorded per-row round-trip error bounds
+        ``‖y − ŷ‖`` (pads 0) — the adaptive-certificate sidecar.
       Wk: static window length, a multiple of 128.
       pq_bits: 4 or 8 (static — decides the decode path).
+      pool_depth: static per-lane-class pool depth ∈ (2, 4, 8) —
+        2 is the base 256-slot pool, 4/8 the ``pq_widen`` rungs.
 
     Returns:
-      (a1, i1, a2, i2, a3): the fine-scan pool contract — [nqp, 128]
-      per-lane-class top-2 approximate squared distances with GLOBAL
-      slab-row ids, plus the running 3rd-min certificate input.
+      (a_1, i_1, …, a_depth, i_depth, rest): [nqp, 128] per-lane-class
+      top-``pool_depth`` certified-lower-bound scores with GLOBAL slab
+      rows, plus the running rest-min certificate input.
     """
     if Wk % _LANES:
         raise ValueError(f"pq_scan_list_major: Wk={Wk} must be a "
@@ -235,6 +313,9 @@ def pq_scan_list_major(sched, xx, probes, cdot, lut, codes, yy_pq,
     if pq_bits not in PQ_BITS:
         raise ValueError(f"pq_scan_list_major: pq_bits must be one of "
                          f"{PQ_BITS}, got {pq_bits}")
+    if pool_depth not in PQ_POOL_DEPTHS:
+        raise ValueError(f"pq_scan_list_major: pool_depth must be one "
+                         f"of {PQ_POOL_DEPTHS}, got {pool_depth}")
     Lp = sched.shape[1]
     if Lp % LISTS_PER_CELL:
         raise ValueError(f"pq_scan_list_major: schedule length {Lp} "
@@ -248,12 +329,14 @@ def pq_scan_list_major(sched, xx, probes, cdot, lut, codes, yy_pq,
                          f"{lut.shape[1]} != pq_dim·K = {pq_dim * K}")
 
     def kernel(sched_ref, xx_ref, probes_ref, cdot_ref, lut_ref,
-               codes_ref, yy_ref, *out_refs):
+               codes_ref, yy_ref, eq_ref, *out_refs):
         _pq_kernel_body(sched_ref, xx_ref, probes_ref, cdot_ref,
-                        lut_ref, codes_ref, yy_ref, *out_refs, Wk=Wk,
-                        pq_dim=pq_dim, K=K, pq_bits=pq_bits)
+                        lut_ref, codes_ref, yy_ref, eq_ref, *out_refs,
+                        Wk=Wk, pq_dim=pq_dim, K=K, pq_bits=pq_bits,
+                        depth=pool_depth)
 
     n_cells = Lp // LISTS_PER_CELL
+    n_out = 2 * pool_depth + 1
     out_spec = pl.BlockSpec((nqp, _LANES), lambda s, *_: (0, 0),
                             memory_space=pltpu.VMEM)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -270,23 +353,26 @@ def pq_scan_list_major(sched, xx, probes, cdot, lut, codes, yy_pq,
                          memory_space=pltpu.VMEM),           # lut
             pl.BlockSpec(memory_space=pltpu.ANY),            # codes DMA
             pl.BlockSpec(memory_space=pltpu.ANY),            # yy DMA
+            pl.BlockSpec(memory_space=pltpu.ANY),            # eq DMA
         ],
-        out_specs=[out_spec] * 5,
+        out_specs=[out_spec] * n_out,
     )
     L = n_cells * LISTS_PER_CELL
     cost = pl.CostEstimate(
         # 2 hi/lo ADC contractions over the pq_dim·K one-hot lanes
         flops=2 * nqp * L * Wk * pq_dim * K * 2,
-        bytes_accessed=(L * Wk * (code_bytes + 4)
+        bytes_accessed=(L * Wk * (code_bytes + 8)
                         + nqp * pq_dim * K * 4
-                        + nqp * _LANES * 8 * 5),
-        transcendentals=0)
+                        + nqp * _LANES * 8 * n_out),
+        # one sqrt per (query, streamed row) for the certified bound
+        transcendentals=nqp * L * Wk,
+    )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=_pool_out_shape(nqp),
+        out_shape=_pq_pool_out_shape(nqp, pool_depth),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
         cost_estimate=cost,
         interpret=interpret_mode(),
-    )(sched, xx, probes, cdot, lut, codes, yy_pq)
+    )(sched, xx, probes, cdot, lut, codes, yy_pq, eq_rows)

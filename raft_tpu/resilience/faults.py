@@ -109,6 +109,12 @@ KNOWN_SITES: Dict[str, Tuple[str, ...]] = {
     # fine scan with a logged degradation and identical returned ids
     "pq_train": ("error",),
     "pq_scan": ("error", "oom"),
+    # the PQ quality round (ISSUE 19): a failing OPQ rotation train
+    # must surface at build (never a silently-unrotated index); a
+    # failing widen-rung re-ADC must DEGRADE straight to the exact
+    # rerun with a logged degradation and identical returned ids
+    "opq_train": ("error",),
+    "pq_widen": ("error", "oom"),
     # tuners + persistent stores
     "autotune_fused": ("error",),
     "autotune_sharded": ("error",),

@@ -43,15 +43,16 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from raft_tpu.observability import instrument
 from raft_tpu.resilience import fault_point
 
-# schema 6 (this build): the table may carry a top-level ``pq``
-# column — per-(n_lists, n_probes, pq_bits) IVF-PQ schedule rows
-# written by :mod:`raft_tpu.tune.ivf` and read by
-# ``ann.ivf_pq.resolve_pq_scan``. Schema-5 additions (the
-# ``fine_scan`` column) and schema-4 additions (db_dtype rows/winners
-# under ``best_by_passes_dtype``) unchanged. Committed schema ≤ 5
-# tables (incl. the measured v5e one) load unchanged: no pq column
-# simply means the cost-model crossover decides.
-TUNE_SCHEMA_VERSION = 6
+# schema 7 (this build): ``pq`` rows may carry a ``pq_mode`` field
+# (plain / opq / opq_aniso) — mode-specific schedule picks written by
+# :mod:`raft_tpu.tune.ivf` and read by ``ann.ivf_pq.resolve_pq_scan``.
+# Schema-6 rows (no pq_mode) load unchanged and match EVERY mode.
+# Schema-5 additions (the ``fine_scan`` column) and schema-4 additions
+# (db_dtype rows/winners under ``best_by_passes_dtype``) unchanged.
+# Committed schema ≤ 5 tables (incl. the measured v5e one) load
+# unchanged: no pq column simply means the cost-model crossover
+# decides.
+TUNE_SCHEMA_VERSION = 7
 
 # counter: tuned-table loads that degraded to built-in defaults, with a
 # reason label ("tune.table_degraded" in the metrics docs) — the silent

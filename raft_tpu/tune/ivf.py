@@ -19,11 +19,15 @@ consults: corrupt/absent/mismatched tables degrade to ``None`` (cost
 model decides) with the shared ``table_degraded`` counter.
 
 ``autotune_pq_scan`` / ``pq_scan_config`` are the IVF-PQ siblings
-(schema 6, top-level ``pq`` key, rows keyed (n_lists, n_probes,
-pq_bits) → "pq" | "flat"): same deterministic model ranking, same
+(top-level ``pq`` key, rows keyed (n_lists, n_probes, pq_bits[,
+pq_mode]) → "pq" | "flat"): same deterministic model ranking, same
 degrade-to-crossover loader contract, same committed-table
 back-compat — a schema ≤ 5 table simply has no ``pq`` column and
 ``ann.ivf_pq.resolve_pq_scan`` falls to ``costmodel.choose_pq_scan``.
+Schema 7 adds the optional per-row ``pq_mode`` column (plain / opq /
+opq_aniso — quantizer modes change the rerun economics, so their
+tuned picks differ); schema-6 rows carry no ``pq_mode`` and match
+every mode, so older committed tables load unchanged.
 """
 
 from __future__ import annotations
@@ -155,10 +159,12 @@ def fine_scan_config(n_lists: int, n_probes: int) -> Optional[str]:
 # ----------------------------------------------------- the pq column
 def pq_rows(shape: Sequence[int], lists: Sequence[int],
             pq_dim: int, pq_bits: Sequence[int] = (4, 8),
-            list_sizes=None, padded_sizes=None) -> List[Dict]:
+            list_sizes=None, padded_sizes=None,
+            pq_mode: str = "plain") -> List[Dict]:
     """The deterministic (model-ranked) PQ sweep: one row per
-    (n_lists, n_probes, pq_bits) point with the ADC and best-flat
-    schedules' modeled bytes and the crossover pick."""
+    (n_lists, n_probes, pq_bits) point at quantizer mode ``pq_mode``
+    with the ADC and best-flat schedules' modeled bytes and the
+    crossover pick."""
     from raft_tpu.observability.costmodel import (choose_pq_scan,
                                                   ivf_traffic_model)
 
@@ -184,6 +190,7 @@ def pq_rows(shape: Sequence[int], lists: Sequence[int],
                     "n_probes": P,
                     "pq_dim": int(pq_dim),
                     "pq_bits": int(bits),
+                    "pq_mode": str(pq_mode),
                     "pq_scan": choose_pq_scan(model),
                     "model_pq_bytes": model["pq_stream_bytes"],
                     "model_flat_bytes": min(
@@ -199,8 +206,9 @@ def pq_rows(shape: Sequence[int], lists: Sequence[int],
 def autotune_pq_scan(shape: Sequence[int], lists: Sequence[int] = (1024,),
                      pq_dim: Optional[int] = None,
                      pq_bits: Sequence[int] = (4, 8),
-                     list_sizes=None, padded_sizes=None) -> List[Dict]:
-    """Produce the ``pq`` rows for a schema-6 tune table. Deterministic
+                     list_sizes=None, padded_sizes=None,
+                     pq_mode: str = "plain") -> List[Dict]:
+    """Produce the ``pq`` rows for a schema-7 tune table. Deterministic
     (model-ranked) everywhere today, exactly like
     :func:`autotune_fine_scan` (whose tuner fault site this sweep
     shares — one schedule-tuner seam); a measured TPU round appends
@@ -213,12 +221,14 @@ def autotune_pq_scan(shape: Sequence[int], lists: Sequence[int] = (1024,),
         while d % pq_dim:
             pq_dim -= 1
     return pq_rows(shape, lists, pq_dim, pq_bits, list_sizes,
-                   padded_sizes)
+                   padded_sizes, pq_mode=pq_mode)
 
 
 def _load_pq_rows(path: str) -> Optional[Dict]:
-    """{(n_lists, n_probes, pq_bits): schedule} from a table's ``pq``
-    rows — the :func:`_load_rows` contract for the schema-6 column."""
+    """{(n_lists, n_probes, pq_bits, pq_mode_or_None): schedule} from a
+    table's ``pq`` rows — the :func:`_load_rows` contract for the
+    schema-7 column. A row without ``pq_mode`` (schema ≤ 6) keys with
+    None and matches every quantizer mode."""
     from raft_tpu.tune.fused import table_degraded
 
     try:
@@ -244,9 +254,12 @@ def _load_pq_rows(path: str) -> Optional[Dict]:
             sched = row.get("pq_scan")
             L, P = row.get("n_lists"), row.get("n_probes")
             bits = row.get("pq_bits")
+            mode = row.get("pq_mode")
+            mode_ok = mode is None or isinstance(mode, str)
             if sched in _PQ_SCHEDULES and isinstance(L, int) \
-                    and isinstance(P, int) and isinstance(bits, int):
-                out[(L, P, bits)] = sched
+                    and isinstance(P, int) and isinstance(bits, int) \
+                    and mode_ok:
+                out[(L, P, bits, mode)] = sched
             else:
                 table_degraded("pq", "row_rejected",
                                f"bad row {row}"[:120])
@@ -254,13 +267,15 @@ def _load_pq_rows(path: str) -> Optional[Dict]:
     return out
 
 
-def pq_scan_config(n_lists: int, n_probes: int,
-                   pq_bits: int) -> Optional[str]:
+def pq_scan_config(n_lists: int, n_probes: int, pq_bits: int,
+                   pq_mode: str = "plain") -> Optional[str]:
     """The tuned PQ schedule for an exact (n_lists, n_probes, pq_bits)
-    geometry, or None (caller falls to the cost-model crossover).
-    Reads the same table ``fused_config`` does; schema ≤ 5 tables have
-    no ``pq`` column and return None — the committed-table back-compat
-    contract."""
+    geometry at quantizer mode ``pq_mode``, or None (caller falls to
+    the cost-model crossover). A mode-specific (schema 7) row wins;
+    otherwise a mode-less (schema ≤ 6) row matches any mode — older
+    committed tables keep working. Reads the same table
+    ``fused_config`` does; schema ≤ 5 tables have no ``pq`` column and
+    return None — the committed-table back-compat contract."""
     from raft_tpu.core import env
     from raft_tpu.native import _REPO_ROOT
 
@@ -269,4 +284,8 @@ def pq_scan_config(n_lists: int, n_probes: int,
     rows = _load_pq_rows(path)
     if not rows:
         return None
-    return rows.get((int(n_lists), int(n_probes), int(pq_bits)))
+    key = (int(n_lists), int(n_probes), int(pq_bits))
+    hit = rows.get(key + (str(pq_mode),))
+    if hit is not None:
+        return hit
+    return rows.get(key + (None,))

@@ -266,6 +266,18 @@ def _drive_pq_train():
         n_lists=4, pq_bits=4, max_iter=1, pq_max_iter=1, seed=0)
 
 
+def _drive_opq_train():
+    """The opq_train site fires before the OPQ alternating
+    minimization — a failing rotation train must surface at build,
+    never ship a silently-unrotated index."""
+    from raft_tpu.ann import build_ivf_pq
+
+    return build_ivf_pq(
+        None, rng.normal(size=(64, 8)).astype(np.float32),
+        n_lists=4, pq_bits=4, max_iter=1, pq_max_iter=1, seed=0,
+        pq_mode="opq", opq_iters=1)
+
+
 _mutable_index = None
 
 
@@ -411,12 +423,16 @@ def _always_raise_drivers():
                 g=2, grid_order="db", db_dtype="int8"),
         "ivf_build": _drive_ivf_build,
         "ivf_search": _drive_ivf_search,
-        # IVF-PQ compressed tier: the codebook-train seam raises at
-        # build; the ADC dispatch seam (pq_scan) DEGRADES to the flat
-        # scan instead of raising — dedicated id-parity test in
-        # tests/test_ivf_pq.py
+        # IVF-PQ compressed tier: the codebook-train and OPQ
+        # rotation-train seams raise at build; the ADC dispatch seam
+        # (pq_scan) DEGRADES to the flat scan and the widen-rung
+        # re-ADC seam (pq_widen) DEGRADES to the exact rerun instead
+        # of raising — dedicated id-parity tests in
+        # tests/test_ivf_pq.py / tests/test_pq_quality.py
         "pq_train": _drive_pq_train,
+        "opq_train": _drive_opq_train,
         "pq_scan": None,
+        "pq_widen": None,
         # fine-scan schedule autotuner: deterministic model sweep
         "autotune_fine_scan": lambda: __import__(
             "raft_tpu.tune.ivf",

@@ -4,6 +4,7 @@ tests/sparse/spectral_matrix.cu, tests/lap/lap.cu,
 tests/label/{label,merge_labels}.cu, and pylibraft test_sparse.py's
 scipy-comparison strategy.)"""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -21,6 +22,22 @@ from raft_tpu.sparse.solver import (
 )
 
 rng = np.random.default_rng(41)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_jit_arena():
+    # Same arena reset test_unexpanded_kernel.py does before its big
+    # interpret-mode compiles: this module's Lanczos/SVD jits are the
+    # largest remaining in the suite, and by the time it runs the
+    # process carries >1100 tests of accumulated CPU-JIT executables —
+    # XLA's compiler segfaults once that arena nears its ceiling (the
+    # crash wanders to whichever late module compiles next as the
+    # suite grows; it moved here when the PQ quality tests landed).
+    # Dropping the cached executables first gives these compiles a
+    # fresh arena at the cost of recompiling this module's own
+    # shared helpers.
+    jax.clear_caches()
+    yield
 
 
 def random_sym_sparse(n, density=0.1, seed=0, shift=0.0):

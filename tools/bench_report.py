@@ -113,6 +113,15 @@ QUANTIZED_RATIO_CEIL = 0.55
 #: raft_tpu-import-free); tests pin the two equal.
 PQ_RATIO_CEIL = 0.10
 
+#: PQ certificate-rerun gate (ISSUE 19): on the diffuse-Gaussian
+#: (worst-case) benchmark distribution the certificate's exact-rerun
+#: fraction at the recall floor must be ≤ this ceiling, and must not
+#: rise more than ``PQ_RERUN_SLACK`` absolute vs the previous
+#: comparable round. Mirror of benchmarks/bench_ann.PQ_RERUN_CEIL
+#: (this tool stays raft_tpu-import-free); tests pin the two equal.
+PQ_RERUN_CEIL = 0.10
+PQ_RERUN_SLACK = 0.05
+
 #: quality-telemetry gate: any recall a ``quality`` block carries
 #: (online shadow recall, offline ANN recall) must reach this floor —
 #: the same 0.95 the ANN frontier gate enforces. Mirror of
@@ -529,6 +538,33 @@ def _ann_fine_scan_check(rec: Dict):
     return None, best
 
 
+def _ann_diffuse_rerun(rec: Dict) -> Tuple[Optional[str],
+                                           Optional[float]]:
+    """Min certificate exact-rerun fraction among the round's
+    diffuse-Gaussian PQ frontier points that reach the recall floor.
+    Returns ``(error, frac)``: ``error`` is set when diffuse points
+    exist but none reach the floor; ``(None, None)`` means the round
+    carries no diffuse points (a pre-ISSUE-19 artifact — the gate
+    skips rather than invents a verdict)."""
+    pq = rec.get("pq") or {}
+    pts = [p for p in pq.get("frontier") or []
+           if isinstance(p, dict) and p.get("dist") == "diffuse"]
+    if not pts:
+        return None, None
+    floor = rec.get("recall_floor", 0.95)
+    at_floor = [p["cert_rerun_frac"] for p in pts
+                if isinstance(p.get("recall_at_k"), (int, float))
+                and p["recall_at_k"] >= floor
+                and isinstance(p.get("cert_rerun_frac"),
+                               (int, float))]
+    if not at_floor:
+        return ("ANN PQ DIFFUSE RECALL VIOLATION: no diffuse-Gaussian "
+                f"PQ frontier point reaches the recall floor {floor:g}"
+                " — the compressed tier cannot serve worst-case data "
+                "at the promised quality"), None
+    return None, float(min(at_floor))
+
+
 def check_ann(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
               threshold: float = DEFAULT_THRESHOLD) -> Tuple[str, str]:
     """Gate the ANN speed/recall frontier (BENCH_ANN / ANN_r*):
@@ -551,6 +587,12 @@ def check_ann(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
       stream ≤ gather/overread), and the round's best overread win
       must not fall more than ``ANN_OVERREAD_SLACK`` below the
       previous comparable round's;
+    - **PQ diffuse rerun** (ISSUE 19): among diffuse-Gaussian PQ
+      frontier points, at least one must reach the recall floor and
+      the min ``cert_rerun_frac`` there must be ≤ ``PQ_RERUN_CEIL``,
+      and must not rise more than ``PQ_RERUN_SLACK`` absolute vs the
+      previous comparable round (rounds without diffuse points skip
+      this gate);
     - **recall trend**: best recall must not drop more than
       ``ANN_RECALL_SLACK`` absolute vs the previous comparable round;
     - **speed trend**: only MEASURED rounds gate search time — when the
@@ -613,6 +655,21 @@ def check_ann(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
     fine_err, fine_ovr = _ann_fine_scan_check(newest)
     if fine_err:
         return REGRESS, fine_err
+    # PQ diffuse-rerun gate (ISSUE 19): on the diffuse-Gaussian worst
+    # case the adaptive certificate + widen rung must keep the
+    # exact-rerun fraction at the recall floor under PQ_RERUN_CEIL —
+    # this is the regime where the worst-case certificate collapsed
+    # to an 83–88% exact-scan rate and evaporated the ADC win.
+    rerun_err, rerun = _ann_diffuse_rerun(newest)
+    if rerun_err:
+        return REGRESS, rerun_err
+    if rerun is not None and rerun > PQ_RERUN_CEIL:
+        return REGRESS, (
+            f"ANN PQ DIFFUSE RERUN VIOLATION: diffuse-Gaussian "
+            f"cert_rerun_frac {rerun:g} at the recall floor exceeds "
+            f"{PQ_RERUN_CEIL:g} — the certificate falls back to the "
+            f"exact scan often enough to erase the compressed tier's "
+            f"win")
     prev = None
     for _, _, rec in reversed(rounds[:-1]):
         if (rec is not None and not rec.get("skipped")
@@ -625,6 +682,8 @@ def check_ann(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
             else "no recall points"]
     if fine_ovr is not None:
         msgs.append(f"list-major overread {fine_ovr:g}x")
+    if rerun is not None:
+        msgs.append(f"diffuse rerun {rerun:g}")
     if prev is not None and isinstance(best, (int, float)):
         pbest = _ann_best_recall(prev)
         if pbest is not None and best < pbest - ANN_RECALL_SLACK:
@@ -643,6 +702,15 @@ def check_ann(rounds: Sequence[Tuple[int, str, Optional[Dict]]],
                 f"{ANN_OVERREAD_SLACK:.0%} below the previous "
                 f"comparable round's {prev_ovr:g}x — the frontier "
                 f"shift the list-major kernel bought is eroding")
+        _, prev_rerun = _ann_diffuse_rerun(prev)
+        if (rerun is not None and prev_rerun is not None
+                and rerun > prev_rerun + PQ_RERUN_SLACK):
+            return REGRESS, (
+                f"ANN PQ DIFFUSE RERUN TREND REGRESSION: "
+                f"diffuse-Gaussian cert_rerun_frac {rerun:g} rose "
+                f"more than {PQ_RERUN_SLACK:g} absolute above the "
+                f"previous comparable round's {prev_rerun:g} — "
+                f"certificate quality on worst-case data is eroding")
     if newest.get("measured") and prev is not None \
             and prev.get("measured"):
         sm, pm = newest.get("search_ms"), prev.get("search_ms")

@@ -228,8 +228,10 @@ QUALITY_SITES: Dict[str, Sequence[str]] = {
     "raft_tpu/ann/ivf_flat.py": ("record_certificate",
                                  "record_pending"),
     # the PQ tier's ADC scan reports its certificate/rerun counters
-    # at the host sync its rerun decision already pays
-    "raft_tpu/ann/ivf_pq.py": ("record_certificate",),
+    # at the host sync its rerun decision already pays, plus the
+    # per-rung ladder outcomes (certified / widened / exact_rerun)
+    "raft_tpu/ann/ivf_pq.py": ("record_certificate",
+                               "record_pq_rungs"),
     "raft_tpu/runtime/entry_points.py": ("record_pending",),
     # the serving engine's quality surface is the shadow sampler
     "raft_tpu/serving/engine.py": ("ShadowSampler",),
